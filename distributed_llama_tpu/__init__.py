@@ -1,0 +1,12 @@
+"""distributed_llama_tpu — a TPU-native distributed LLM inference framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+`inpyu/distributed-llama` (reference: /root/reference, a C++11 TCP-cluster
+inference engine): same `.m` Q40 model files and `.t` tokenizers, same model
+families (Llama 3.x, Qwen3, Qwen3-MoE), same CLI and OpenAI-compatible API —
+but SPMD over a `jax.sharding.Mesh` with XLA/ICI collectives instead of
+hand-rolled socket star/ring all-reduce, and Pallas kernels instead of
+NEON/AVX2 intrinsics.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+from distributed_llama_tpu.tokenizer import ChatItem, ChatTemplateGenerator, TEMPLATE_CHATML
+
+
+def test_chatml_generation_prompt_once_at_end():
+    g = ChatTemplateGenerator(TEMPLATE_CHATML, eos="<|im_end|>")
+    out = g.generate([ChatItem("system", "S"), ChatItem("user", "U")])
+    assert out.content == (
+        "<|im_start|>system\nS<|im_end|>\n"
+        "<|im_start|>user\nU<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+    # no stray assistant header between turns
+    assert out.content.count("<|im_start|>assistant\n") == 1

@@ -1,0 +1,161 @@
+"""Tokenizer encode/decode, sampler, chat templates, EOS detector."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.testing import byte_vocab_tokenizer
+from distributed_llama_tpu.tokenizer import (
+    ChatItem,
+    ChatTemplateGenerator,
+    EOS_FOUND,
+    EOS_MAYBE,
+    EOS_NOT,
+    EosDetector,
+    Sampler,
+    TEMPLATE_CHATML,
+    TEMPLATE_LLAMA2,
+    TEMPLATE_LLAMA3,
+    Tokenizer,
+    _random_u32,
+)
+
+
+@pytest.fixture()
+def tok():
+    return Tokenizer(byte_vocab_tokenizer())
+
+
+def test_encode_merges_best_pairs(tok):
+    ids = tok.encode("hello", is_start=False)
+    # "hello" exists as a merged token with the top score
+    assert ids == [tok.vocab.index(b"hello")]
+
+
+def test_encode_bos(tok):
+    ids = tok.encode("hi", is_start=True)
+    assert ids[0] == tok.bos_id
+    assert b"".join(tok.vocab[i] for i in ids[1:]) == b"hi"
+
+
+def test_encode_special_tokens(tok):
+    eot = tok.vocab.index(b"<|eot|>")
+    ids = tok.encode("hi<|eot|>", is_start=False, add_special_tokens=True)
+    assert eot in ids
+    # without special token matching, it must fall back to bytes
+    ids2 = tok.encode("hi<|eot|>", is_start=False, add_special_tokens=False)
+    assert eot not in ids2
+
+
+def test_encode_decode_round_trip(tok):
+    text = "hello world"
+    ids = tok.encode(text, is_start=False)
+    tok.reset_decoder()
+    out = "".join(filter(None, (tok.decode(i) for i in ids)))
+    assert out == text
+
+
+def test_streaming_utf8_decode(tok):
+    # multi-byte char split across two tokens must be held back then emitted
+    text = "é"  # 2 bytes: 0xC3 0xA9
+    b = text.encode("utf-8")
+    tok.reset_decoder()
+    assert tok.decode(b[0]) is None  # lead byte alone: held
+    assert tok.decode(b[1]) == "é"
+
+
+def test_eos_token_flushes_decoder(tok):
+    tok.reset_decoder()
+    assert tok.decode("é".encode()[0]) is None
+    out = tok.decode(tok.eos_token_ids[0])
+    assert out is not None  # flushed (replacement char for the dangling byte)
+
+
+def test_rng_matches_xorshift_star_reference():
+    # first values of xorshift* from seed 1 (reference tokenizer.cpp:25-31)
+    state = np.uint64(1)
+    seq = []
+    for _ in range(3):
+        r, state = _random_u32(state)
+        seq.append(r)
+    # computed independently: python big-int model of the same recurrence
+    s = 1
+    expect = []
+    for _ in range(3):
+        s ^= s >> 12
+        s = (s ^ (s << 25)) & (2**64 - 1)
+        s ^= s >> 27
+        expect.append(((s * 0x2545F4914F6CDD1D) & (2**64 - 1)) >> 32)
+    assert seq == expect
+
+
+def test_sampler_greedy():
+    s = Sampler(10, temperature=0.0, topp=0.9, seed=42)
+    logits = np.zeros(10, dtype=np.float32)
+    logits[7] = 5.0
+    assert s.sample(logits) == 7
+
+
+def test_sampler_topp_restricts_support():
+    s = Sampler(10, temperature=1.0, topp=0.5, seed=1)
+    logits = np.full(10, -10.0, dtype=np.float32)
+    logits[3] = 10.0  # dominates: p ~ 1
+    for _ in range(20):
+        assert s.sample(logits.copy()) == 3
+
+
+def test_sampler_seeded_reproducible():
+    a = Sampler(100, 0.8, 0.9, seed=123)
+    b = Sampler(100, 0.8, 0.9, seed=123)
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal(100).astype(np.float32)
+    assert [a.sample(logits.copy()) for _ in range(10)] == [
+        b.sample(logits.copy()) for _ in range(10)
+    ]
+
+
+def test_chat_template_llama3():
+    g = ChatTemplateGenerator(TEMPLATE_LLAMA3, eos="<|eot_id|>")
+    out = g.generate([ChatItem("system", "sys"), ChatItem("user", "hi")])
+    assert out.content == (
+        "<|start_header_id|>system<|end_header_id|>\n\nsys<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nhi<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+
+
+def test_chat_template_llama2_sys_fold():
+    g = ChatTemplateGenerator(TEMPLATE_LLAMA2, eos="</s>")
+    out = g.generate([ChatItem("system", "S"), ChatItem("user", "U")])
+    assert out.content == "[INST] <<SYS>>\nS\n<</SYS>>\n\nU [/INST]</s>"
+
+
+def test_chat_template_autodetect():
+    g = ChatTemplateGenerator(chat_template="...<|im_start|>...", eos="<|im_end|>")
+    assert g.type == TEMPLATE_CHATML
+    g2 = ChatTemplateGenerator(chat_template="x<|start_header_id|>y", eos="")
+    assert g2.type == TEMPLATE_LLAMA3
+    with pytest.raises(ValueError):
+        ChatTemplateGenerator(chat_template="nothing special", eos="")
+
+
+def test_eos_detector_exact():
+    d = EosDetector([5], ["<stop>"])
+    assert d.append(1, "hello") == EOS_NOT
+    assert d.get_delta() == "hello"
+    d.reset()
+    assert d.append(2, "<st") == EOS_MAYBE
+    assert d.append(3, "op>") == EOS_FOUND
+    assert d.get_delta() is None  # stop string swallowed
+
+
+def test_eos_detector_eos_token():
+    d = EosDetector([5], ["</s>"])
+    assert d.append(5, None) == EOS_FOUND
+
+
+def test_eos_detector_padding():
+    d = EosDetector([9], ["</s>"], padding_left=1, padding_right=1)
+    d.reset()
+    assert d.append(1, "x</s") == EOS_MAYBE  # 1 stray char + partial stop
+    assert d.append(2, ">") == EOS_FOUND
+    assert d.get_delta() == "x"
