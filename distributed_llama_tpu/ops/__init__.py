@@ -1,0 +1,39 @@
+"""TPU-native compute ops.
+
+Each op here replaces one of the reference engine's CPU/Vulkan kernels
+(reference: src/nn/nn-cpu-ops.cpp, src/nn/vulkan/*.comp) with a functional JAX
+equivalent designed for XLA fusion on TPU. There is no op registry or kernel
+dispatch table — XLA is the executor, so ops are plain functions composed in
+models/transformer.py.
+"""
+
+from .norm import rms_norm
+from .activations import silu, gelu
+from .rope import RopeTables, build_rope_tables, apply_rope_llama, apply_rope_falcon, apply_rope
+from .attention import gqa_attention
+from .quant import (
+    QuantTensor,
+    quant_tensor_from_q40,
+    dequantize,
+    quant_matmul,
+    quantize_q80_activations,
+)
+from .moe import moe_router
+
+__all__ = [
+    "rms_norm",
+    "silu",
+    "gelu",
+    "RopeTables",
+    "build_rope_tables",
+    "apply_rope_llama",
+    "apply_rope_falcon",
+    "apply_rope",
+    "gqa_attention",
+    "QuantTensor",
+    "quant_tensor_from_q40",
+    "dequantize",
+    "quant_matmul",
+    "quantize_q80_activations",
+    "moe_router",
+]

@@ -1,0 +1,20 @@
+"""Activation functions (reference: src/nn/nn-cpu-ops.cpp OP_SILU / OP_GELU).
+
+The reference's SiLU kernel computes ``x / (1 + exp(-x))`` and its GELU uses
+the tanh approximation; both are elementwise and fuse into the surrounding
+matmuls under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    # tanh approximation, matching the reference's geluForward
+    return jax.nn.gelu(x, approximate=True)

@@ -1,0 +1,72 @@
+"""Grouped-query attention against a full KV cache.
+
+Replaces the reference's per-head scalar loop (reference: multiheadAtt_F32,
+src/nn/nn-cpu-ops.cpp:753-788): score = q.k/sqrt(headDim) over positions
+0..pos, softmax, weighted V sum, with GQA via kvMul = nHeads/nKvHeads.
+
+TPU-first differences from the reference:
+* whole-cache batched einsum instead of per-position dot products — the
+  score/softmax/value chain is three fused XLA ops that tile onto the MXU;
+* causal masking with a static-shape cache (positions > pos are masked with
+  -inf rather than loop-bounded), keeping shapes static under jit;
+* f32 softmax accumulation regardless of compute dtype.
+
+Long-context path: for sequence-parallel execution the cache's seq axis is
+sharded over the mesh's `sp` axis and this same function runs under
+shard_map with a psum-based online-softmax combine (parallel/sequence.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal GQA attention over the (padded) cache.
+
+    q: [batch, q_len, n_heads, head_dim]
+    k_cache, v_cache: [batch, cache_len, n_kv_heads, head_dim]
+    positions: [batch, q_len] int32 absolute position of each query token;
+        cache slot t is visible to a query at position p iff t <= p.
+    Returns [batch, q_len, n_heads, head_dim] in q.dtype.
+    """
+    b, q_len, n_heads, head_dim = q.shape
+    cache_len = k_cache.shape[1]
+    n_kv_heads = k_cache.shape[2]
+    kv_mul = n_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
+
+    qg = q.reshape(b, q_len, n_kv_heads, kv_mul, head_dim)
+    # scores: [b, n_kv_heads, kv_mul, q_len, cache_len]
+    scores = jnp.einsum(
+        "bqhgd,bthd->bhgqt",
+        qg,
+        k_cache,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    scores = scores.astype(jnp.float32) * scale
+
+    t_idx = jnp.arange(cache_len, dtype=jnp.int32)
+    mask = t_idx[None, None, :] <= positions[:, :, None]  # [b, q_len, cache_len]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqt,bthd->bqhgd",
+        probs.astype(v_cache.dtype),
+        v_cache,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.reshape(b, q_len, n_heads, head_dim).astype(q.dtype)

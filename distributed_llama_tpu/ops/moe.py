@@ -1,0 +1,42 @@
+"""MoE router (Qwen3-MoE style).
+
+Semantics match the reference graph exactly (reference: src/llm.cpp:440-514 +
+moeGateForward_F32_F32, src/nn/nn-cpu-ops.cpp:1462-1492):
+
+    probs  = softmax(x @ gate.T)            # full softmax over all experts
+    topk   = top-k of probs
+    weight = probs[topk] / sum(probs[topk])  # normTopk=1 renormalization
+
+The reference then runs each active expert's SwiGLU through matmul kernels
+that index a stacked weight tensor by expert id
+(reference: src/nn/nn-cpu-ops.cpp:1166-1192). On TPU the equivalent is a
+gather-free einsum over one-hot combine weights (small models / tiny batch)
+or a sort-based ragged dispatch; models/transformer.py uses the dense
+einsum formulation, which XLA turns into gathered matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_router(
+    x: jnp.ndarray, gate: jnp.ndarray, n_active: int, norm_topk: bool = True
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Select experts for each token.
+
+    x: [..., dim]; gate: [n_experts, dim] f32.
+    Returns (indices [..., n_active] int32, weights [..., n_active] f32).
+    """
+    logits = jnp.einsum(
+        "...d,ed->...e",
+        x.astype(jnp.float32),
+        gate.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, n_active)
+    if norm_topk:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    return top_i.astype(jnp.int32), top_p

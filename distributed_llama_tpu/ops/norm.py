@@ -1,0 +1,26 @@
+"""RMS normalization.
+
+The reference splits this into two ops — OP_INV_RMS computing
+``1/sqrt(mean(x^2) + eps)`` and OP_RMS_NORM applying ``w * (invRms * x)``
+(reference: src/nn/nn-cpu-ops.cpp:114-175) — because its executor has no
+fusion. Under XLA the two fuse automatically, so this is a single function.
+
+The reduction is always done in f32 regardless of the compute dtype: on TPU
+the bf16->f32 upcast is free inside the fused kernel and it keeps parity with
+the reference's f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """``w * x / rms(x)`` along the last axis.
+
+    x: [..., dim]; weight: [dim] (or any shape broadcastable to x after the
+    normalization — qwen3's per-head q/k norms pass [head_dim]).
+    """
+    xf = x.astype(jnp.float32)
+    inv_rms = jnp.reciprocal(jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return (weight.astype(jnp.float32) * (xf * inv_rms)).astype(x.dtype)

@@ -1,0 +1,123 @@
+"""Device-side quantized weights and the quantized matmul.
+
+The reference's hot loop is `matmul_Q80_Q40_F32` — a Q80-quantized activation
+row dotted against Q40 block-quantized weight rows with NEON/AVX intrinsics
+(reference: src/nn/nn-cpu-ops.cpp:231-449). On TPU the same math maps to:
+
+* weights stay resident in HBM as int8 values + per-block scales
+  (`QuantTensor`) — 4.5 bits/weight of traffic instead of 16/32;
+* the matmul dequantizes on the fly and accumulates in f32 on the MXU. Two
+  implementations: a plain-XLA path (`quant_matmul`, dequant fuses into the
+  matmul's operand load) and a fused Pallas kernel (ops/pallas_q40.py) that
+  dequantizes per-tile in VMEM.
+
+Activation quantization to Q80 exists only to *emulate the reference's
+numerics* when bit-parity testing (`quantize_q80_activations`); the production
+path feeds bf16/f32 activations straight in — on TPU there is no bandwidth
+win from quantizing activations that are already on-chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.quants import Q_BLOCK
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantTensor:
+    """A Q40 weight on device: int8 values in [-8,7] + per-block f32 scales.
+
+    q: [out_features, in_features // 32, 32] int8
+    d: [out_features, in_features // 32] f32 (converted from the file's f16)
+
+    Logical value = q * d (per block). Layout matches `unpack_q40`
+    (formats/quants.py) reshaped per row, i.e. exactly the reference's
+    NnBlockQ40 stream (reference: src/nn/nn-quants.hpp:64-67).
+    """
+
+    q: jnp.ndarray
+    d: jnp.ndarray
+
+    @property
+    def out_features(self) -> int:
+        return self.q.shape[-3]
+
+    @property
+    def in_features(self) -> int:
+        return self.q.shape[-2] * self.q.shape[-1]
+
+    @property
+    def shape(self) -> tuple:
+        return (*self.q.shape[:-3], self.out_features, self.in_features)
+
+    def tree_flatten(self):
+        return (self.q, self.d), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quant_tensor_from_q40(q: np.ndarray, d: np.ndarray) -> QuantTensor:
+    """From host-side unpack_q40 output reshaped to [out, in//32, 32]/[out, in//32]."""
+    return QuantTensor(q=jnp.asarray(q, dtype=jnp.int8), d=jnp.asarray(d, dtype=jnp.float32))
+
+
+def dequantize(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
+    """Materialize [..., out_features, in_features] in `dtype`."""
+    x = w.q.astype(dtype) * w.d[..., None].astype(dtype)
+    return x.reshape(w.shape)
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def _quant_matmul_xla(x, q, d, dtype):
+    w = (q.astype(dtype) * d[..., None].astype(dtype)).reshape(q.shape[-3], -1)
+    # f32 operands get full-precision accumulation (parity tests); bf16
+    # operands are the MXU-native fast path where precision is moot.
+    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+    return jax.lax.dot_general(
+        x.astype(dtype),
+        w,
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+
+
+def quant_matmul(
+    x: jnp.ndarray, w: QuantTensor, dtype=jnp.bfloat16, out_dtype=None
+) -> jnp.ndarray:
+    """``x @ w.T`` for a Q40 weight; x: [..., in_features] -> [..., out_features].
+
+    `dtype` is the dequantized-operand dtype fed to the MXU (bf16 for speed,
+    f32 for parity tests); accumulation is always f32.
+    """
+    out = _quant_matmul_xla(x, w.q, w.d, dtype)
+    return out.astype(out_dtype if out_dtype is not None else x.dtype)
+
+
+def quantize_q80_activations(x: jnp.ndarray) -> jnp.ndarray:
+    """Round-trip x through Q80 (per-32-block int8 + f16 scale) numerics.
+
+    Emulates the reference's `--buffer-float-type q80` activation path
+    (reference: quantizeF32toQ80, src/nn/nn-quants.cpp:67-…) for parity
+    testing: returns f32 values equal to dequantize(quantize(x)).
+    """
+    shape = x.shape
+    xf = x.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // Q_BLOCK, Q_BLOCK)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    delta = amax / 127.0
+    # int8 values are computed against the *unrounded* f32 scale, but dequant
+    # uses the f16-rounded scale stored in the block — exactly the host codec
+    # (formats/quants.py quantize_q80) and the reference converter.
+    inv = jnp.where(delta != 0, 1.0 / delta, 0.0)
+    qv = jnp.clip(jnp.round(xf * inv), -127, 127)
+    delta16 = delta.astype(jnp.float16).astype(jnp.float32)
+    return (qv * delta16).reshape(shape).astype(x.dtype)

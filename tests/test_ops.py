@@ -1,0 +1,244 @@
+"""Golden tests for the ops layer against independent numpy implementations
+that mirror the reference kernels' scalar semantics
+(reference: src/nn/nn-cpu-ops.cpp; test style mirrors nn-cpu-ops-test.cpp)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.formats.mfile import ModelHeader, RopeType
+from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40
+from distributed_llama_tpu.ops import (
+    QuantTensor,
+    apply_rope_falcon,
+    apply_rope_llama,
+    build_rope_tables,
+    dequantize,
+    gqa_attention,
+    moe_router,
+    quant_matmul,
+    quant_tensor_from_q40,
+    quantize_q80_activations,
+    rms_norm,
+    silu,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def rope_header(rope_type, head_dim=8, seq_len=32, theta=10000.0, scaling=False):
+    h = ModelHeader(
+        dim=head_dim * 4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=head_dim,
+        seq_len=seq_len,
+        rope_theta=theta,
+        rope_type=rope_type,
+    )
+    if scaling:
+        h.rope_scaling_factor = 8.0
+        h.rope_scaling_low_freq_factor = 1.0
+        h.rope_scaling_high_freq_factor = 4.0
+        h.rope_scaling_orig_max_seq_len = 8192
+        h.rope_type = RopeType.LLAMA3_1
+    return h
+
+
+def test_rms_norm_matches_reference_formula():
+    x = RNG.standard_normal((2, 3, 64)).astype(np.float32)
+    w = RNG.standard_normal(64).astype(np.float32)
+    eps = 1e-5
+    # reference: invRms_F32 + rmsNorm_F32 (nn-cpu-ops.cpp:114-175)
+    inv_rms = 1.0 / np.sqrt((x.astype(np.float64) ** 2).mean(-1, keepdims=True) + eps)
+    want = (w * (x * inv_rms)).astype(np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w), eps))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_silu():
+    x = RNG.standard_normal(100).astype(np.float32)
+    want = x / (1.0 + np.exp(-x))
+    np.testing.assert_allclose(np.asarray(silu(jnp.asarray(x))), want, rtol=1e-6, atol=1e-6)
+
+
+def _numpy_rope_llama(x, pos, head_dim, theta):
+    """Scalar mirror of ropeLlama_F32 + fullfillRopeLlamaCache."""
+    out = x.copy()
+    n_heads = x.shape[-2]
+    for h in range(n_heads):
+        for j in range(head_dim // 2):
+            i = 2 * j
+            freq = 1.0 / theta ** (i / head_dim)
+            val = pos * freq
+            fcr, fci = np.cos(val), np.sin(val)
+            v0, v1 = x[..., h, i], x[..., h, i + 1]
+            out[..., h, i] = v0 * fcr - v1 * fci
+            out[..., h, i + 1] = v0 * fci + v1 * fcr
+    return out
+
+
+def _numpy_rope_falcon(x, pos, head_dim, theta):
+    """Scalar mirror of ropeFalcon_F32 + fullfillRopeFalconCache."""
+    out = x.copy()
+    half = head_dim // 2
+    n_heads = x.shape[-2]
+    for h in range(n_heads):
+        for j in range(half):
+            freq = 1.0 / theta ** (2.0 * j / head_dim)
+            val = pos * freq
+            fcr, fci = np.cos(val), np.sin(val)
+            q0, q1 = x[..., h, j], x[..., h, j + half]
+            out[..., h, j] = q0 * fcr - q1 * fci
+            out[..., h, j + half] = q0 * fci + q1 * fcr
+    return out
+
+
+@pytest.mark.parametrize("pos", [0, 1, 17])
+def test_rope_llama_matches_scalar(pos):
+    h = rope_header(RopeType.LLAMA)
+    tables = build_rope_tables(h)
+    x = RNG.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
+    want = _numpy_rope_llama(x[0, 0], pos, h.head_dim, h.rope_theta)
+    got = np.asarray(
+        apply_rope_llama(jnp.asarray(x), tables, jnp.full((1, 1), pos, jnp.int32))
+    )[0, 0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("pos", [0, 3, 29])
+def test_rope_falcon_matches_scalar(pos):
+    h = rope_header(RopeType.FALCON)
+    tables = build_rope_tables(h)
+    x = RNG.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
+    want = _numpy_rope_falcon(x[0, 0], pos, h.head_dim, h.rope_theta)
+    got = np.asarray(
+        apply_rope_falcon(jnp.asarray(x), tables, jnp.full((1, 1), pos, jnp.int32))
+    )[0, 0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_rope_llama31_scaling_monotonic_tables():
+    """Llama-3.1 scaling shrinks low-frequency rotations (long wavelengths)."""
+    h_plain = rope_header(RopeType.LLAMA, head_dim=64, theta=500000.0)
+    h_scaled = rope_header(RopeType.LLAMA, head_dim=64, theta=500000.0, scaling=True)
+    t_plain = build_rope_tables(h_plain)
+    t_scaled = build_rope_tables(h_scaled)
+    # highest-frequency pair (j=0) is above the high-freq cutoff: unchanged
+    np.testing.assert_allclose(np.asarray(t_plain.cos[:, 0]), np.asarray(t_scaled.cos[:, 0]))
+    # lowest-frequency pair rotates ~8x slower: angle at pos p matches plain at p/8
+    ang_scaled = np.arccos(np.clip(np.asarray(t_scaled.cos[16, -1]), -1, 1))
+    ang_plain = np.arccos(np.clip(np.asarray(t_plain.cos[2, -1]), -1, 1))
+    np.testing.assert_allclose(ang_scaled, ang_plain, rtol=1e-4)
+
+
+def test_rope_llama31_without_scaling_keys_builds():
+    """A LLAMA3_1-typed header lacking scaling keys must behave like plain
+    llama rope (reference gates on ropeScalingFactor != 1.0, nn-core.cpp:346)."""
+    h = ModelHeader(dim=32, n_heads=4, n_kv_heads=2, seq_len=16, rope_type=RopeType.LLAMA3_1).finalize()
+    t = build_rope_tables(h)
+    h2 = ModelHeader(dim=32, n_heads=4, n_kv_heads=2, seq_len=16, rope_type=RopeType.LLAMA).finalize()
+    t2 = build_rope_tables(h2)
+    np.testing.assert_array_equal(np.asarray(t.cos), np.asarray(t2.cos))
+
+
+def _numpy_gqa(q, k_cache, v_cache, pos):
+    """Scalar mirror of multiheadAtt_F32 (nn-cpu-ops.cpp:753-788)."""
+    n_heads, head_dim = q.shape
+    n_kv = k_cache.shape[1]
+    kv_mul = n_heads // n_kv
+    out = np.zeros_like(q)
+    for h in range(n_heads):
+        kh = h // kv_mul
+        scores = np.array(
+            [q[h] @ k_cache[t, kh] / np.sqrt(head_dim) for t in range(pos + 1)]
+        )
+        e = np.exp(scores - scores.max())
+        att = e / e.sum()
+        for t in range(pos + 1):
+            out[h] += att[t] * v_cache[t, kh]
+    return out
+
+
+@pytest.mark.parametrize("pos", [0, 5, 15])
+def test_gqa_attention_matches_scalar(pos):
+    n_heads, n_kv, head_dim, cache_len = 4, 2, 8, 16
+    q = RNG.standard_normal((n_heads, head_dim)).astype(np.float32)
+    k_cache = RNG.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
+    v_cache = RNG.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
+    want = _numpy_gqa(q, k_cache, v_cache, pos)
+    got = np.asarray(
+        gqa_attention(
+            jnp.asarray(q)[None, None],
+            jnp.asarray(k_cache)[None],
+            jnp.asarray(v_cache)[None],
+            jnp.full((1, 1), pos, jnp.int32),
+        )
+    )[0, 0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_prefill_batch_matches_per_position():
+    """A multi-token prefill call must equal token-by-token decode calls."""
+    n_heads, n_kv, head_dim, cache_len, q_len = 4, 4, 8, 16, 6
+    q = RNG.standard_normal((1, q_len, n_heads, head_dim)).astype(np.float32)
+    k_cache = RNG.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
+    v_cache = RNG.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
+    positions = jnp.arange(q_len, dtype=jnp.int32)[None, :]
+    batched = np.asarray(
+        gqa_attention(jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache), positions)
+    )
+    for p in range(q_len):
+        single = np.asarray(
+            gqa_attention(
+                jnp.asarray(q[:, p : p + 1]),
+                jnp.asarray(k_cache),
+                jnp.asarray(v_cache),
+                jnp.full((1, 1), p, jnp.int32),
+            )
+        )
+        np.testing.assert_allclose(batched[:, p : p + 1], single, rtol=1e-5, atol=1e-5)
+
+
+def test_quant_tensor_round_trip_and_matmul():
+    out_f, in_f = 24, 64
+    w = RNG.standard_normal((out_f, in_f)).astype(np.float32) * 0.1
+    raw = quantize_q40(w.reshape(-1))
+    q, d = unpack_q40(raw, w.size)
+    wt = quant_tensor_from_q40(q.reshape(out_f, in_f // 32, 32), d.reshape(out_f, in_f // 32))
+    wf = np.asarray(dequantize(wt))
+    # dequantized weight equals the host-side dequant
+    from distributed_llama_tpu.formats.quants import dequantize_q40
+
+    np.testing.assert_allclose(wf.reshape(-1), dequantize_q40(raw, w.size), rtol=1e-6, atol=1e-6)
+    # matmul in f32 equals numpy on the dequantized weight
+    x = RNG.standard_normal((3, in_f)).astype(np.float32)
+    got = np.asarray(quant_matmul(jnp.asarray(x), wt, dtype=jnp.float32))
+    np.testing.assert_allclose(got, x @ wf.T, rtol=1e-4, atol=1e-4)
+
+
+def test_q80_activation_round_trip_matches_host_codec():
+    from distributed_llama_tpu.formats.quants import dequantize_q80, quantize_q80
+
+    x = RNG.standard_normal(128).astype(np.float32)
+    want = dequantize_q80(quantize_q80(x), x.size)
+    got = np.asarray(quantize_q80_activations(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_moe_router_matches_scalar():
+    """Mirror of softmax -> topk -> normTopk renorm (nn-cpu-ops.cpp:1462-1492)."""
+    dim, n_experts, k = 16, 8, 3
+    x = RNG.standard_normal((5, dim)).astype(np.float32)
+    gate = RNG.standard_normal((n_experts, dim)).astype(np.float32)
+    idx, wts = moe_router(jnp.asarray(x), jnp.asarray(gate), k)
+    idx, wts = np.asarray(idx), np.asarray(wts)
+    for b in range(x.shape[0]):
+        logits = x[b] @ gate.T
+        e = np.exp(logits - logits.max())
+        probs = e / e.sum()
+        order = np.argsort(-probs)[:k]
+        assert set(idx[b]) == set(order)
+        sel = probs[idx[b]]
+        np.testing.assert_allclose(wts[b], sel / sel.sum(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(wts[b].sum(), 1.0, rtol=1e-5)
