@@ -1,0 +1,103 @@
+"""Headline benchmark: single-chip decode throughput on a 1B-class Q40 Llama.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Model: synthetic Llama-3.2-1B-shaped .m file (dim 2048, 16 layers, 32 heads /
+8 KV heads, FFN 8192, Q40 weights) — no real checkpoints exist in this
+environment (zero egress), so weights are random but the compute/memory
+profile matches the real 1B.
+
+Baseline: the reference's best in-repo prediction throughput, 26.4 tok/s —
+8 workers, PP=4, 8B-class Q40 model
+(/root/reference/docs/PP_PARAMETER_EXPERIMENT_RESULTS_20260303.md). Its
+best single-digit-node TP numbers are far lower (0.44-0.83 tok/s on the
+RPi cluster reports). vs_baseline = value / 26.4.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+BASELINE_TOK_S = 26.4  # reference PP=4 best (see module docstring)
+
+DIM = 2048
+N_LAYERS = 16
+N_HEADS = 32
+N_KV_HEADS = 8
+HIDDEN = 8192
+VOCAB = 32768
+SEQ_LEN = 2048
+
+PREFILL_TOKENS = 64
+DECODE_TOKENS = 128
+
+
+def ensure_model() -> str:
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    path = os.path.join(CACHE_DIR, f"llama1b_q40_v1.m")
+    if os.path.exists(path):
+        return path
+    from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+    h = tiny_header(
+        dim=DIM,
+        hidden_dim=HIDDEN,
+        n_layers=N_LAYERS,
+        n_heads=N_HEADS,
+        n_kv_heads=N_KV_HEADS,
+        vocab_size=VOCAB,
+        seq_len=SEQ_LEN,
+    )
+    t0 = time.time()
+    write_tiny_model(path + ".tmp", h, seed=1234, scale=0.02)
+    os.rename(path + ".tmp", path)
+    print(f"# built synthetic 1B model in {time.time() - t0:.1f}s -> {path}", file=sys.stderr)
+    return path
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    model_path = ensure_model()
+
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    t0 = time.time()
+    engine = InferenceEngine(model_path, compute_dtype="bfloat16", max_chunk=PREFILL_TOKENS)
+    print(f"# engine loaded in {time.time() - t0:.1f}s on {jax.devices()[0]}", file=sys.stderr)
+
+    prompt = list(range(1, PREFILL_TOKENS + 1))
+    res = engine.generate(prompt, PREFILL_TOKENS + DECODE_TOKENS, sampler=None)  # greedy
+    # warmup done (includes compiles); measure steady-state decode
+    engine.reset()
+    res = engine.generate(prompt, PREFILL_TOKENS + DECODE_TOKENS, sampler=None)
+
+    # steady-state: median per-token wall time (first chunk can carry
+    # one-time lazy-initialization cost even after warmup)
+    import statistics
+
+    per_tok_us = statistics.median(s.eval_us + s.sync_us for s in res.pred_steps)
+    tok_s = 1e6 / per_tok_us
+    print(
+        f"# prefill {res.prefill_us/1e3:.1f} ms ({res.eval_tok_per_s:.1f} tok/s), "
+        f"decode {res.n_pred_tokens} tokens, ttft {res.ttft_us/1e3:.1f} ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "llama1b_q40_decode_tok_s_1chip",
+                "value": round(tok_s, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

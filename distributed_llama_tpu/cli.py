@@ -1,0 +1,251 @@
+"""Command-line entry point — the `dllama` analogue.
+
+Modes (reference: src/dllama.cpp:325-359):
+  inference   benchmark generation with eval/pred tok/s, TTFT, wall times
+  chat        interactive REPL using the tokenizer's chat template
+  perplexity  next-token probability evaluation over the prompt
+
+The reference's `worker` mode does not exist here: there are no TCP workers —
+multi-chip execution is a `jax.sharding.Mesh` given via --tp/--pp
+(parallel/), with XLA collectives where the reference ran socket all-reduce.
+
+Usage:
+  python -m distributed_llama_tpu.cli inference --model m.m --tokenizer t.t \
+      --prompt "Hello" --steps 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .runtime.engine import InferenceEngine
+from .tokenizer import (
+    ChatItem,
+    ChatTemplateGenerator,
+    EOS_FOUND,
+    EOS_MAYBE,
+    EosDetector,
+    Sampler,
+    TEMPLATE_UNKNOWN,
+    Tokenizer,
+)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="distributed_llama_tpu")
+    p.add_argument("mode", choices=["inference", "chat", "perplexity"])
+    p.add_argument("--model", required=True)
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--chat-template", default=None)
+    # TPU-native knobs (replace --nthreads/--workers/--gpu-index):
+    p.add_argument("--compute-dtype", choices=["bfloat16", "float32"], default="bfloat16")
+    p.add_argument("--cache-dtype", choices=["bfloat16", "float32"], default=None)
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh size")
+    p.add_argument("--pp", type=int, default=1, help="pipeline-parallel mesh size")
+    # accepted-for-compat knobs from the reference CLI (no-ops or remapped):
+    p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--net-turbo", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--max-batch-size", "--nbatches", dest="max_chunk", type=int, default=32)
+    p.add_argument("--prefill-chunk-size", type=int, default=0)
+    p.add_argument("--prefill-chunk-threshold", type=int, default=128)
+    return p
+
+
+def make_engine(args) -> InferenceEngine:
+    max_chunk = args.prefill_chunk_size if args.prefill_chunk_size > 0 else args.max_chunk
+    mesh = None
+    if args.tp > 1 or args.pp > 1:
+        from .parallel import make_mesh
+
+        mesh = make_mesh(tp=args.tp, pp=args.pp)
+    return InferenceEngine(
+        args.model,
+        compute_dtype=args.compute_dtype,
+        cache_dtype=args.cache_dtype,
+        max_seq_len=args.max_seq_len,
+        max_chunk=max_chunk,
+        mesh=mesh,
+    )
+
+
+def make_sampler(args, vocab_size: int) -> Sampler:
+    seed = args.seed if args.seed is not None else 12345
+    return Sampler(vocab_size, args.temperature, args.topp, seed)
+
+
+def run_inference(args) -> int:
+    if not args.prompt:
+        print("Prompt is required", file=sys.stderr)
+        return 1
+    if args.steps == 0:
+        print("Number of steps is required", file=sys.stderr)
+        return 1
+    engine = make_engine(args)
+    tok = Tokenizer(args.tokenizer)
+    sampler = make_sampler(args, engine.cfg.vocab_size)
+    ids = tok.encode(args.prompt)
+
+    print(args.prompt)
+    pieces: list[str] = []
+
+    def on_token(t):
+        piece = tok.decode(t)
+        pieces.append(piece or "")
+
+    res = engine.generate(ids, args.steps, sampler=sampler, on_token=on_token)
+
+    for s in res.eval_steps:
+        print(f"🔷️ Eval{s.eval_us // 1000:5d} ms Sync{s.sync_us // 1000:5d} ms | ({s.n_tokens} tokens)")
+    for s, piece in zip(res.pred_steps, pieces):
+        print(f"🔶 Pred{s.eval_us // 1000:5d} ms Sync{s.sync_us // 1000:5d} ms | {piece or '~'}")
+
+    n_eval = res.n_prompt_tokens - 1
+    n_pred = res.n_pred_tokens
+    eval_ms = sum(s.eval_us + s.sync_us for s in res.eval_steps) / 1000.0
+    pred_ms = sum(s.eval_us + s.sync_us for s in res.pred_steps) / 1000.0
+    print()
+    print("Evaluation")
+    print(f"   nBatches: {engine.max_chunk}")
+    print(f"    nTokens: {n_eval}")
+    if eval_ms > 0 and n_eval > 0:
+        print(f"   tokens/s: {n_eval * 1000 / eval_ms:3.2f} ({eval_ms / n_eval:3.2f} ms/tok)")
+    print("Prediction")
+    print(f"    nTokens: {n_pred}")
+    if pred_ms > 0 and n_pred > 0:
+        print(f"   tokens/s: {n_pred * 1000 / pred_ms:3.2f} ({pred_ms / n_pred:3.2f} ms/tok)")
+    print("Timing")
+    print(f"  prefillMs: {res.prefill_us / 1000.0:3.2f}")
+    print(f"     ttftMs: {(res.ttft_us or res.prefill_us) / 1000.0:3.2f}")
+    print(f"   decodeMs: {res.decode_us / 1000.0:3.2f}")
+    print(f"    totalMs: {res.total_us / 1000.0:3.2f}")
+    return 0
+
+
+def run_perplexity(args) -> int:
+    """Reference: dllama.cpp:167-207 — sequential next-token probabilities.
+
+    TPU upgrade: one batched logits_mode="all" pass per chunk instead of a
+    per-token loop.
+    """
+    import numpy as np
+
+    if not args.prompt:
+        print("Prompt is required", file=sys.stderr)
+        return 1
+    engine = make_engine(args)
+    tok = Tokenizer(args.tokenizer)
+    ids = tok.encode(args.prompt)
+    n = len(ids)
+    print(f"Evaluating {n} tokens...")
+
+    total_log_prob = 0.0
+    pos = 0
+    # chunked teacher-forced pass; logits for every position
+    chunk = engine.max_chunk
+    for i in range(0, n - 1, chunk):
+        part = ids[i : i + chunk]
+        arr_logits = engine.forward_tokens(part, i, logits_mode="all")[0]
+        probs = _softmax_np(arr_logits)
+        for j in range(len(part)):
+            if i + j + 1 >= n:
+                break
+            p = max(float(probs[j, ids[i + j + 1]]), 1e-30)
+            total_log_prob += float(np.log(p))
+            pos += 1
+            print(f"{pos:5d} / {n - 1}, prob={p:f}")
+
+    avg = total_log_prob / (n - 1)
+    print()
+    print("Results")
+    print(f"   perplexity: {float(np.exp(-avg)):f} (lower = better)")
+    print(f"   avgLogProb: {avg:f}")
+    print(f"   bitPerToken: {-avg / float(np.log(2.0)):f}")
+    return 0
+
+
+def _softmax_np(x):
+    import numpy as np
+
+    m = x.max(axis=-1, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def run_chat(args) -> int:
+    """Interactive chat REPL (reference: dllama.cpp:209-305)."""
+    engine = make_engine(args)
+    tok = Tokenizer(args.tokenizer)
+    sampler = make_sampler(args, engine.cfg.vocab_size)
+
+    template_type = (
+        ChatTemplateGenerator.parse_type(args.chat_template)
+        if args.chat_template
+        else TEMPLATE_UNKNOWN
+    )
+    stops = [tok.piece(t).decode("utf-8", errors="replace") for t in tok.eos_token_ids]
+    gen = ChatTemplateGenerator(template_type, tok.chat_template, stops[0] if stops else "")
+    max_stop = max((len(s) for s in stops), default=0)
+
+    sys_prompt = input("💻 System prompt (optional): ")
+    delta_items: list[ChatItem] = []
+    if sys_prompt:
+        delta_items.append(ChatItem("system", sys_prompt))
+
+    pos = 0
+    seq_len = engine.cfg.seq_len
+    while pos < seq_len:
+        user = ""
+        while not user:
+            user = input("\n👱 User\n> ")
+        delta_items.append(ChatItem("user", user))
+        prompt = gen.generate(delta_items, True)
+        ids = tok.encode(prompt.content, is_start=(pos == 0))
+        end = min(seq_len, pos + len(ids) - 1)
+        engine.prefill(ids[: end - pos], pos)
+        token = ids[-1]
+        pos = end
+
+        tok.reset_decoder()
+        detector = EosDetector(tok.eos_token_ids, stops, max_stop, max_stop)
+        print("\n🤖 Assistant")
+        if prompt.public_prompt:
+            print(prompt.public_prompt, end="")
+        while pos < seq_len:
+            logits = engine.decode_one(token, pos)
+            token = sampler.sample(logits[0].copy())
+            piece = tok.decode(token)
+            eos_type = detector.append(token, piece)
+            if eos_type != EOS_MAYBE:
+                delta = detector.get_delta()
+                if delta:
+                    print(delta, end="", flush=True)
+                detector.reset()
+            pos += 1
+            if eos_type == EOS_FOUND:
+                break
+        delta_items.clear()
+    print("(end of context)")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.mode == "inference":
+        return run_inference(args)
+    if args.mode == "perplexity":
+        return run_perplexity(args)
+    if args.mode == "chat":
+        return run_chat(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

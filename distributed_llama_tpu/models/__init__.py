@@ -1,0 +1,22 @@
+"""Model layer: configs, weight loading, and the transformer forward pass.
+
+Replaces the reference's graph builder (reference: buildLlmNet,
+src/llm.cpp:152-649): instead of emitting a per-node op graph that a
+hand-written executor walks, the forward pass is a jit-compiled JAX function
+scanned over stacked per-layer weights; XLA is the executor and scheduler.
+"""
+
+from .config import ModelConfig, config_from_header
+from .params import KVCache, LayerParams, ModelParams, init_kv_cache, load_params
+from .transformer import forward
+
+__all__ = [
+    "ModelConfig",
+    "config_from_header",
+    "ModelParams",
+    "LayerParams",
+    "KVCache",
+    "init_kv_cache",
+    "load_params",
+    "forward",
+]

@@ -1,0 +1,200 @@
+"""Parameter pytrees and the `.m` weight loader.
+
+Weights for all layers are *stacked* along a leading n_layers axis so the
+forward pass can `lax.scan` over layers — one compiled layer body instead of
+n_layers unrolled copies (compile time and HBM-code-size win; no reference
+analogue, the reference builds n_layers explicit segments).
+
+Q40 tensors stay quantized on device as `QuantTensor` (int8 + per-block
+scales); F32/F16 tensors load as dense arrays. The loader replaces the
+reference's root-mmap + TCP weight streaming (reference: loadLlmNetWeight,
+src/llm.cpp:658-713 and NnRootWeightLoader, src/nn/nn-network.cpp:1818-1943):
+on TPU each stacked tensor is handed to `jax.device_put` with an optional
+`NamedSharding`, and JAX ships every chip exactly its shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.mfile import MFileReader, TensorSpec
+from ..formats.quants import FloatType
+from ..ops.quant import QuantTensor
+from .config import ModelConfig
+
+# A weight is either a dense jnp array [out, in] or a QuantTensor.
+Weight = Any
+
+
+def _register(cls, fields):
+    def flatten(s):
+        return tuple(getattr(s, f) for f in fields), None
+
+    def unflatten(aux, children):
+        return cls(*children)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+@dataclass
+class LayerParams:
+    """Per-layer weights, each stacked with a leading [n_layers] axis."""
+
+    q: Weight  # [L, q_dim, dim]
+    k: Weight  # [L, kv_dim, dim]
+    v: Weight  # [L, kv_dim, dim]
+    wo: Weight  # [L, dim, q_dim]
+    w1: Weight  # [L, ff, dim] dense | [L, E, ff, dim] moe
+    w2: Weight  # [L, dim, ff] dense | [L, E, dim, ff] moe
+    w3: Weight  # [L, ff, dim] dense | [L, E, ff, dim] moe
+    norm0: jnp.ndarray  # [L, dim]
+    norm1: jnp.ndarray  # [L, dim]
+    q_norm: Optional[jnp.ndarray] = None  # [L, head_dim] (qwen3)
+    k_norm: Optional[jnp.ndarray] = None  # [L, head_dim] (qwen3)
+    moe_gate: Optional[jnp.ndarray] = None  # [L, E, dim] f32 (moe)
+
+
+_register(
+    LayerParams,
+    ["q", "k", "v", "wo", "w1", "w2", "w3", "norm0", "norm1", "q_norm", "k_norm", "moe_gate"],
+)
+
+
+@dataclass
+class ModelParams:
+    embedding: jnp.ndarray  # [vocab, dim] (always dense; reference keeps F32)
+    layers: LayerParams
+    final_norm: jnp.ndarray  # [dim]
+    wcls: Weight  # [vocab, dim]
+
+
+_register(ModelParams, ["embedding", "layers", "final_norm", "wcls"])
+
+
+@dataclass
+class KVCache:
+    """[n_layers, batch, seq_len, n_kv_heads, head_dim] key/value tensors.
+
+    Functional replacement for the reference's per-layer key/value cache
+    buffers updated by OP_SHIFT (reference: shiftForward,
+    src/nn/nn-cpu-ops.cpp:1419-1441); under jit the dynamic-update-slice
+    happens in place thanks to buffer donation.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def seq_len(self) -> int:
+        return self.k.shape[2]
+
+
+_register(KVCache, ["k", "v"])
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None) -> KVCache:
+    shape = (
+        cfg.n_layers,
+        batch,
+        seq_len if seq_len is not None else cfg.seq_len,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+    )
+    return KVCache(
+        k=jnp.zeros(shape, dtype=cfg.kv_dtype), v=jnp.zeros(shape, dtype=cfg.kv_dtype)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def _load_one(reader: MFileReader, spec: TensorSpec, dense_dtype) -> Any:
+    """Host-side load of a single tensor: QuantTensor parts or dense ndarray."""
+    if spec.float_type == FloatType.Q40 and len(spec.shape) == 2:
+        q, d = reader.tensor_q40(spec)
+        return (q, d.astype(np.float32))
+    x = reader.tensor_f32(spec)
+    return x.astype(dense_dtype) if len(spec.shape) == 2 else x
+
+
+def _stack(parts: list) -> Any:
+    """Stack host-side per-layer tensors; quant pairs stack componentwise."""
+    if isinstance(parts[0], tuple):
+        q = np.stack([p[0] for p in parts])
+        d = np.stack([p[1] for p in parts])
+        return (q, d)
+    return np.stack(parts)
+
+
+def _put(x: Any, sharding=None) -> Weight:
+    """Host tensor (or quant pair) -> device array(s), optionally sharded.
+
+    `sharding` is one entry of parallel.sharding.param_shardings:
+    {"quant": (q_sharding, d_sharding), "dense": sharding} — or None.
+    """
+    if isinstance(x, tuple):
+        q, d = x
+        if sharding is not None:
+            q_sh, d_sh = sharding["quant"]
+            return QuantTensor(q=jax.device_put(q, q_sh), d=jax.device_put(d, d_sh))
+        return QuantTensor(q=jax.device_put(jnp.asarray(q)), d=jax.device_put(jnp.asarray(d)))
+    if sharding is not None:
+        return jax.device_put(x, sharding["dense"])
+    return jax.device_put(jnp.asarray(x))
+
+
+def load_params(
+    reader: MFileReader,
+    cfg: ModelConfig,
+    shardings: Optional[dict] = None,
+) -> ModelParams:
+    """Read all weights, stack per-layer, move to device.
+
+    `shardings` maps role name ("q", "w1", "embedding", ...) to either a
+    `NamedSharding` (dense weights) or a pair of shardings (QuantTensor's q/d
+    components) — provided by parallel/sharding.py; None loads replicated on
+    the default device.
+    """
+    dense = np.dtype(cfg.compute_dtype)
+    sh = shardings or {}
+
+    def put(role: str, x):
+        return _put(x, sh.get(role))
+
+    roles = ["q", "k", "v", "wo", "w1", "w2", "w3", "norm0", "norm1"]
+    if cfg.is_qwen3:
+        roles += ["q_norm", "k_norm"]
+    if cfg.is_moe:
+        roles += ["moe_gate"]
+
+    per_role: dict[str, list] = {r: [] for r in roles}
+    for l in range(cfg.n_layers):
+        for r in roles:
+            if r in ("w1", "w2", "w3") and cfg.is_moe:
+                experts = [
+                    _load_one(reader, reader.by_name[f"{r}.l{l}.e{e}"], dense)
+                    for e in range(cfg.n_experts)
+                ]
+                per_role[r].append(_stack(experts))
+            else:
+                per_role[r].append(_load_one(reader, reader.by_name[f"{r}.l{l}"], dense))
+
+    layer_kw = {r: put(r, _stack(per_role[r])) for r in roles}
+    layers = LayerParams(**layer_kw)
+
+    embedding = put("embedding", _load_one(reader, reader.by_name["embedding"], dense))
+    final_norm = put("final_norm", _load_one(reader, reader.by_name["final_norm"], dense))
+    wcls = put("wcls", _load_one(reader, reader.by_name["wcls"], dense))
+    return ModelParams(embedding=embedding, layers=layers, final_norm=final_norm, wcls=wcls)

@@ -1,0 +1,203 @@
+"""The unified transformer forward pass (Llama / Qwen3 / Qwen3-MoE).
+
+Functional re-design of the reference's per-node op graph (reference:
+buildLlmNet, src/llm.cpp:152-649). One layer body is `lax.scan`ned over
+stacked weights; XLA fuses norm->matmul->rope->attention chains and inserts
+collectives when the arrays carry shardings (parallel/sharding.py).
+
+Math per layer (reference att segment src/llm.cpp:278-418, ff segment
+src/llm.cpp:421-569):
+
+    y  = rms_norm(x, norm0);  q,k,v = y @ Wq,Wk,Wv
+    [qwen3: per-head rms_norm of q,k]          (src/llm.cpp:337-361)
+    q,k = rope(q,k); cache[pos] = k,v          (shiftForward)
+    a  = gqa_attention(q, cache);  x += a @ Wo (+ TP psum in reference)
+    y  = rms_norm(x, norm1)
+    dense: x += (silu(y@W1) * (y@W3)) @ W2
+    moe:   route -> top-k experts' swiglu, weighted sum (src/llm.cpp:440-514)
+
+Final: rms_norm(x, final_norm) @ Wcls -> logits   (src/llm.cpp:593-636)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..formats.mfile import HiddenAct
+from ..ops import gqa_attention, moe_router, rms_norm
+from ..ops.activations import gelu, silu
+from ..ops.quant import QuantTensor, quant_matmul
+from ..ops.rope import RopeTables, apply_rope
+from .config import ModelConfig
+from .params import KVCache, LayerParams, ModelParams
+
+
+def linear(x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
+    """x @ w.T for a dense or Q40 weight; returns x.dtype."""
+    if isinstance(w, QuantTensor):
+        return quant_matmul(x, w, dtype=dtype)
+    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+    y = jax.lax.dot_general(
+        x.astype(dtype),
+        w.astype(dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    return y.astype(x.dtype)
+
+
+def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    return silu(x) if cfg.hidden_act == HiddenAct.SILU else gelu(x)
+
+
+def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
+    h = _activation(cfg, linear(y, lp.w1, cfg.dtype)) * linear(y, lp.w3, cfg.dtype)
+    return linear(h, lp.w2, cfg.dtype)
+
+
+def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
+    """Select per-token expert weights: w [E, out, in] + idx [b, t, k]."""
+    if isinstance(w, QuantTensor):
+        return QuantTensor(q=w.q[idx], d=w.d[idx])
+    return w[idx]
+
+
+def _expert_matmul(x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
+    """Per-token expert matmul: x [b,t,k,in] with gathered w [b,t,k,out,in...]."""
+    if isinstance(w, QuantTensor):
+        wd = (w.q.astype(dtype) * w.d[..., None].astype(dtype)).reshape(*w.q.shape[:-2], -1)
+    else:
+        wd = w.astype(dtype)
+    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+    y = jnp.einsum(
+        "btki,btkoi->btko",
+        x.astype(dtype),
+        wd,
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    return y.astype(x.dtype)
+
+
+def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
+    """Top-k expert SwiGLU, matching the reference MoE graph
+    (src/llm.cpp:440-514): router on the *normed* activation, per-token
+    expert weight indexing, weighted merge-sum.
+
+    Formulation: gather the k active experts' weights per token. Memory is
+    O(tokens * k * expert_params); the engine keeps prefill chunks small
+    enough for this. (A sort-based ragged dispatch is the planned upgrade for
+    large-batch prefill.)
+    """
+    idx, wts = moe_router(y, lp.moe_gate, cfg.n_active_experts)  # [b,t,k]
+    w1 = _gather_expert(lp.w1, idx)
+    w3 = _gather_expert(lp.w3, idx)
+    w2 = _gather_expert(lp.w2, idx)
+    xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
+    h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype)) * _expert_matmul(xk, w3, cfg.dtype)
+    out = _expert_matmul(h, w2, cfg.dtype)  # [b,t,k,dim]
+    return jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts).astype(y.dtype)
+
+
+def _layer(
+    cfg: ModelConfig,
+    rope: RopeTables,
+    x: jnp.ndarray,  # [b, t, dim] residual stream (f32)
+    positions: jnp.ndarray,  # [b, t] int32
+    pos_start: jnp.ndarray,  # scalar int32 — cache write offset
+    lp: LayerParams,
+    k_cache: jnp.ndarray,  # [b, seq, n_kv, head_dim]
+    v_cache: jnp.ndarray,
+    reduce_fn=None,  # TP partial-sum reduction (shard_map path): applied to
+    # the attention and ffn output projections. None under GSPMD — XLA
+    # inserts the psum itself from the shardings (the reference's explicit
+    # SYNC_NODE_SLICES after att/ff, src/llm.cpp:418,569).
+):
+    if reduce_fn is None:
+        reduce_fn = lambda z: z
+    b, t, _ = x.shape
+
+    # --- attention block ---
+    y = rms_norm(x, lp.norm0, cfg.norm_epsilon)
+    # head counts come from the weight shapes, not cfg: under shard_map the
+    # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
+    # src/nn/nn-core.cpp:280-287)
+    q = linear(y, lp.q, cfg.dtype)
+    k = linear(y, lp.k, cfg.dtype)
+    v = linear(y, lp.v, cfg.dtype)
+    q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
+    k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
+    v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
+
+    if cfg.is_qwen3:
+        q = rms_norm(q, lp.q_norm, cfg.norm_epsilon)
+        k = rms_norm(k, lp.k_norm, cfg.norm_epsilon)
+
+    q = apply_rope(q, rope, positions, cfg.rope_type)
+    k = apply_rope(k, rope, positions, cfg.rope_type)
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k.astype(k_cache.dtype), pos_start, axis=1
+    )
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v.astype(v_cache.dtype), pos_start, axis=1
+    )
+
+    a = gqa_attention(q, k_cache, v_cache, positions)
+    n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
+    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype)
+    x = x + reduce_fn(att_out).astype(x.dtype)
+
+    # --- ffn block ---
+    y = rms_norm(x, lp.norm1, cfg.norm_epsilon)
+    ff = _moe_ffn(cfg, y, lp) if cfg.is_moe else _dense_ffn(cfg, y, lp)
+    x = x + reduce_fn(ff).astype(x.dtype)
+    return x, k_cache, v_cache
+
+
+def forward_uncompiled(
+    cfg: ModelConfig,
+    params: ModelParams,
+    rope: RopeTables,
+    cache: KVCache,
+    tokens: jnp.ndarray,  # [b, t] int32
+    pos_start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    logits_mode: str = "last",  # "last" | "all"
+) -> tuple[jnp.ndarray, KVCache]:
+    """One forward step (prefill chunk or decode token).
+
+    Returns (logits, updated cache). logits: [b, vocab] for "last",
+    [b, t, vocab] for "all" (perplexity path, reference dllama.cpp:167-207).
+    The cache is donated: under jit the update is in-place in HBM.
+    """
+    b, t = tokens.shape
+    positions = pos_start + jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, t))
+
+    x = params.embedding[tokens].astype(jnp.float32)
+
+    def body(carry, per_layer):
+        x = carry
+        lp, k_c, v_c = per_layer
+        x, k_c, v_c = _layer(cfg, rope, x, positions, pos_start, lp, k_c, v_c)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, cache.k, cache.v))
+
+    x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
+    if logits_mode == "last":
+        x = x[:, -1, :]
+    logits = linear(x, params.wcls, cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
+
+
+# The jit entry point: cache is donated (updated in place in HBM); one
+# compiled program per (cfg, token-shape, logits_mode).
+forward = partial(jax.jit, static_argnames=("cfg", "logits_mode"), donate_argnames=("cache",))(
+    forward_uncompiled
+)

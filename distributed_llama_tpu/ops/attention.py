@@ -63,10 +63,13 @@ def gqa_attention(
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
+    # probs stay f32 into the weighted-V sum (f32 accumulation even over a
+    # bf16 cache), matching the reference's f32 attention path
     out = jnp.einsum(
         "bhgqt,bthd->bqhgd",
-        probs.astype(v_cache.dtype),
+        probs,
         v_cache,
+        preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
     return out.reshape(b, q_len, n_heads, head_dim).astype(q.dtype)

@@ -27,17 +27,26 @@ import math
 from dataclasses import dataclass
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..formats.mfile import ModelHeader, RopeType
 
 
+@jax.tree_util.register_pytree_node_class
 @dataclass(frozen=True)
 class RopeTables:
     """cos/sin lookup tables, shape [seq_len, head_dim // 2] (f32)."""
 
     cos: jnp.ndarray
     sin: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.cos, self.sin), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
 
 
 def _scale_frequency_llama3(

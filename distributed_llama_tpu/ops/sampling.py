@@ -1,0 +1,54 @@
+"""On-device sampling (argmax / temperature / top-p).
+
+The reference samples on the host per token (reference: Sampler::sample,
+src/tokenizer.cpp:482-512) — fine over PCIe-attached CPUs, but on TPU every
+device->host round trip costs tunnel/dispatch latency, so the decode loop
+samples on-device and ships tokens back in chunks (runtime/decode.py).
+
+Math matches the reference exactly (temperature scaling -> softmax -> top-p
+truncation at the first cumulative-prob > topp, sampling within the kept
+mass); only the RNG differs — the reference's xorshift* stream requires
+sequential host state, here it's jax.random (counter-based, reproducible
+under a fixed seed, but a different stream). The host Sampler remains the
+bit-parity path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [b, vocab] f32
+    key: jnp.ndarray,
+    temperature: float,
+    topp: float,
+) -> jnp.ndarray:
+    """Returns [b] int32 sampled tokens. `temperature`/`topp` are static."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    probs = jax.nn.softmax(logits / temperature, axis=-1)
+    if topp <= 0.0 or topp >= 1.0:
+        coin = jax.random.uniform(key, (logits.shape[0],))
+        cdf = jnp.cumsum(probs, axis=-1)
+        idx = jnp.sum(cdf < coin[:, None], axis=-1)
+        return idx.astype(jnp.int32).clip(0, logits.shape[-1] - 1)
+    return _sample_topp(probs, key, topp)
+
+
+def _sample_topp(probs: jnp.ndarray, key: jnp.ndarray, topp: float) -> jnp.ndarray:
+    b, n = probs.shape
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    order = jnp.argsort(-probs, axis=-1)
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep everything up to and including the first element whose cumulative
+    # probability exceeds topp (reference: sample_topp, tokenizer.cpp:426-447)
+    over = csum > topp
+    keep = jnp.logical_not(jnp.concatenate([jnp.zeros((b, 1), bool), over[:, :-1]], axis=-1))
+    kept = jnp.where(keep, sorted_probs, 0.0)
+    kept_sum = jnp.sum(kept, axis=-1, keepdims=True)
+    coin = jax.random.uniform(key, (b, 1)) * kept_sum
+    cdf = jnp.cumsum(kept, axis=-1)
+    pick = jnp.sum(cdf < coin, axis=-1).clip(0, n - 1)
+    return jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)

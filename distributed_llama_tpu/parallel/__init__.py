@@ -1,0 +1,29 @@
+"""Parallel layer: topology, device mesh, shardings, and the explicit
+shard_map pipeline.
+
+This layer replaces the reference's entire distributed stack — the TCP socket
+mesh, hand-rolled star/ring collectives, config/weight wire protocols, and
+pipeline communicator (reference: src/nn/nn-network.cpp, nn-pipeline.cpp,
+nn-topology.hpp) — with a `jax.sharding.Mesh` and XLA collectives over
+ICI/DCN. Two execution styles:
+
+* **GSPMD** (mesh.py + sharding.py): params/cache carry `NamedSharding`s, jit
+  partitions the forward pass, XLA inserts all-reduces where the reference
+  called `SYNC_NODE_SLICES` — the default and fastest path for TP(+DP).
+* **Explicit shard_map** (pipeline.py): PPxTP with hand-placed `psum` (TP
+  group) and `ppermute` (stage handoff) — the moral equivalent of the
+  reference's topology-aware collectives, needed for pipeline parallelism
+  where stages execute different weights.
+"""
+
+from .topology import PPxTPTopology
+from .mesh import make_mesh
+from .sharding import cache_shardings, data_shardings, param_shardings
+
+__all__ = [
+    "PPxTPTopology",
+    "make_mesh",
+    "param_shardings",
+    "cache_shardings",
+    "data_shardings",
+]

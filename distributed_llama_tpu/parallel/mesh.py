@@ -1,0 +1,37 @@
+"""Device mesh construction.
+
+The mesh replaces the reference's socket full-mesh bootstrap
+(reference: NnNetwork::serve/connect, src/nn/nn-network.cpp:516-629): there
+is no handshake — the mesh is a logical view over `jax.devices()`, and the
+axes carry the roles the reference encoded in its PPxTP rank layout:
+
+  dp — data/replica parallel (reference: gateway-level request DP)
+  pp — pipeline stages       (reference: ppRank, layer ranges)
+  tp — tensor parallel       (reference: tpRank, head/ff split + all-reduce)
+  sp — sequence parallel     (no reference analogue; long-context sharding)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+def make_mesh(
+    tp: int = 1, pp: int = 1, dp: int = 1, sp: int = 1, devices=None
+) -> Mesh:
+    """Build a ("dp","pp","tp","sp") mesh over the first dp*pp*tp*sp devices.
+
+    Axis order puts tp/sp innermost so TP/SP collectives ride the
+    fastest/nearest ICI links under the default device enumeration.
+    """
+    n = dp * pp * tp * sp
+    if devices is None:
+        devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, tp, sp)
+    return Mesh(arr, AXES)

@@ -1,0 +1,214 @@
+"""Pipeline-parallel (PPxTP) forward via shard_map + ppermute.
+
+The explicit-collectives twin of the GSPMD path. The reference implements PP
+by giving each stage a contiguous layer range and shipping activations
+stage-to-stage over TCP with a header/checksum protocol (reference:
+src/nn/nn-pipeline.cpp:61-148, graph bridge src/llm.cpp:575-590). Here:
+
+* the stacked layer axis of every per-layer weight is sharded over the mesh's
+  `pp` axis — each device holds n_layers/pp layers (reference layer ranges,
+  src/llm.cpp:210-216, with the divisibility requirement made explicit);
+* activations hand off stage-to-stage with `lax.ppermute` over ICI — the
+  whole NnPipelineCommunicator collapses into one collective;
+* inside a stage, TP runs exactly like the reference's head-split: local
+  heads/ff slices, `lax.psum` over the `tp` axis after the attention and FFN
+  output projections (reference SYNC_NODE_SLICES, src/llm.cpp:418,569);
+* logits are computed on the stage holding the final output and broadcast
+  with a psum-mask (replacing the reference's root-only logits pipe).
+
+Single-token decode necessarily serializes across stages (each round only
+one stage does useful work — the same bubble the reference has per token).
+Prefill gets the PP win via `microbatches`: the prompt is cut into pp
+chunks that flow through stages back-to-back, keeping all stages busy
+(the reference's prefill chunking heuristic, src/app.cpp:156-184, exists
+for exactly this reason).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.config import ModelConfig
+from ..models.params import KVCache, ModelParams
+from ..models.transformer import _layer, linear, rms_norm
+from ..ops.rope import RopeTables
+
+
+def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
+    """param_shardings variant for the pipeline path: the stacked layer axis
+    shards over `pp` in addition to the TP feature split."""
+
+    def _ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    def entry(quant_pair, dense):
+        return {"quant": quant_pair, "dense": dense}
+
+    row = entry((_ns("pp", "tp", None, None), _ns("pp", "tp", None)), _ns("pp", "tp", None))
+    col = entry((_ns("pp", None, "tp", None), _ns("pp", None, "tp")), _ns("pp", None, "tp"))
+    erow = entry((_ns("pp", None, "tp", None, None), _ns("pp", None, "tp", None)),
+                 _ns("pp", None, "tp", None))
+    ecol = entry((_ns("pp", None, None, "tp", None), _ns("pp", None, None, "tp")),
+                 _ns("pp", None, None, "tp"))
+    lrep = entry((_ns("pp"), _ns("pp")), _ns("pp"))  # per-layer vectors
+    rep = entry((_ns(), _ns()), _ns())
+
+    return {
+        "q": row,
+        "k": row,
+        "v": row,
+        "wo": col,
+        "w1": erow if moe else row,
+        "w3": erow if moe else row,
+        "w2": ecol if moe else col,
+        "wcls": entry((_ns("tp", None, None), _ns("tp", None)), _ns("tp", None)),
+        "embedding": rep,
+        "final_norm": rep,
+        "norm0": lrep,
+        "norm1": lrep,
+        "q_norm": lrep,
+        "k_norm": lrep,
+        "moe_gate": lrep,
+    }
+
+
+def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
+
+
+def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache):
+    """Run this device's resident layers over x (a scan, like the global
+    forward but over the local slice)."""
+    reduce_fn = lambda z: jax.lax.psum(z, "tp")
+
+    def body(carry, per_layer):
+        x = carry
+        lp, k_c, v_c = per_layer
+        x, k_c, v_c = _layer(
+            cfg, rope, x, positions, pos_start, lp, k_c, v_c, reduce_fn=reduce_fn
+        )
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, k_cache, v_cache))
+    return x, new_k, new_v
+
+
+_COMPILED: dict = {}
+
+
+def pipeline_forward(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: ModelParams,
+    rope: RopeTables,
+    cache: KVCache,
+    tokens: jnp.ndarray,  # [b, t]
+    pos_start,  # scalar int32
+    logits_mode: str = "last",
+    microbatches: int = 1,
+):
+    """PPxTP forward step. Same contract as models.transformer.forward.
+
+    `microbatches` > 1 splits the batch's token axis into that many equal
+    chunks pushed through the pipeline back-to-back (prefill). Must divide t.
+
+    Partition specs must be read off the *concrete* input arrays (inside jit
+    they are tracers without NamedShardings), so this wrapper builds the
+    shard_map program once per (cfg, mesh, mode, specs) and caches the
+    jitted function.
+    """
+    params_leaves, params_def = jax.tree.flatten(params)
+    cache_leaves, cache_def = jax.tree.flatten(cache)
+    params_spec = jax.tree.unflatten(params_def, [_spec_of(a) for a in params_leaves])
+    cache_spec = jax.tree.unflatten(cache_def, [_spec_of(a) for a in cache_leaves])
+    key = (
+        cfg,
+        mesh,
+        logits_mode,
+        microbatches,
+        tuple(_spec_of(a) for a in params_leaves),
+        tuple(_spec_of(a) for a in cache_leaves),
+    )
+    fn = _COMPILED.get(key)
+    if fn is None:
+        fn = _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbatches)
+        _COMPILED[key] = fn
+    return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
+
+
+def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbatches):
+    pp = mesh.shape["pp"]
+    rope_spec = RopeTables(cos=P(), sin=P())
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_spec, rope_spec, cache_spec, P(None, None), P()),
+        out_specs=(P(), cache_spec),
+        check_vma=False,
+    )
+    def run(params, rope_t, cache, tokens, pos_start):
+        pp_rank = jax.lax.axis_index("pp")
+        b, t = tokens.shape
+        n_micro = microbatches if t % max(microbatches, 1) == 0 else 1
+        mt = t // n_micro
+
+        k_cache, v_cache = cache.k, cache.v  # [L_local, b, seq, kvh_local, hd]
+
+        emb = params.embedding
+        x_all = emb[tokens].astype(jnp.float32)  # [b, t, dim]
+
+        # microbatch m enters stage 0 in round m; stage s processes it in
+        # round m+s; total rounds = n_micro + pp - 1 (GPipe schedule).
+        # Each device carries one in-flight activation slot `x`.
+        x = jnp.zeros((b, mt, cfg.dim), jnp.float32)
+        done = []
+        for r in range(n_micro + pp - 1):
+            # inject microbatch r into stage 0's slot
+            if r < n_micro:
+                x_in = jax.lax.dynamic_slice_in_dim(x_all, r * mt, mt, axis=1)
+                x = jnp.where(pp_rank == 0, x_in, x)
+            mb_idx = r - pp_rank  # which microbatch this stage holds this round
+            pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
+            positions = pos0 + jnp.arange(mt, dtype=jnp.int32)[None, :]
+            positions = jnp.broadcast_to(positions, (b, mt))
+
+            y, k_upd, v_upd = _local_stage(
+                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache
+            )
+            # commit cache only when this stage held a real microbatch
+            active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+            k_cache = jnp.where(active, k_upd, k_cache)
+            v_cache = jnp.where(active, v_upd, v_cache)
+            # last stage's output for microbatch (r - pp + 1) is final
+            if r >= pp - 1:
+                done.append(jnp.where(pp_rank == pp - 1, y, 0.0))
+            # hand off to the next stage (wraps; stage 0's incoming is
+            # overwritten by the next injected microbatch)
+            x = jax.lax.ppermute(y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+
+        # final outputs: [b, t, dim], valid on the last stage; broadcast to
+        # all stages so every device computes logits identically
+        x_out = jnp.concatenate(done, axis=1)
+        x_out = jax.lax.psum(x_out, "pp")
+
+        x_out = rms_norm(x_out, params.final_norm, cfg.norm_epsilon)
+        if logits_mode == "last":
+            x_out = x_out[:, -1, :]
+        logits_local = linear(x_out, params.wcls, cfg.dtype)  # vocab/tp slice
+        logits = jax.lax.all_gather(logits_local, "tp", axis=-1, tiled=True)
+        return logits.astype(jnp.float32), KVCache(k=k_cache, v=v_cache)
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def _spec_of(a) -> P:
+    sh = getattr(a, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return P()

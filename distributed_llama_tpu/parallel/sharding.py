@@ -1,0 +1,89 @@
+"""NamedSharding rules for params, cache, and data.
+
+The placement math mirrors the reference's slicers exactly
+(reference: src/nn/nn-core.cpp:222-324):
+
+  q/k/v, w1, w3   row-split over TP (output-feature axis)  -> sliceRowMatmul
+  wo, w2          col-split over TP (input-feature axis)   -> sliceColMatmul
+  wcls            row-split over vocab                     -> sliceRowMatmul
+  kv cache        head axis over TP                        -> sliceKvCache
+  moe experts     ff axis over TP (TP-within-expert, the reference's MoE
+                  layout: every node holds a slice of every expert,
+                  src/llm.cpp:682-684); expert axis over an `ep` upgrade is
+                  planned (parallel/pipeline.py docstring)
+  norms, gate,    replicated                               -> loadAll
+  embedding
+
+With these in place, jit/GSPMD inserts exactly the collectives the reference
+hand-codes: an all-reduce over the TP group after the attention and FFN
+output projections and after logits (reference: SYNC_NODE_SLICES at
+src/llm.cpp:418,569,633).
+
+Q40 weights are (q, d) component pairs; both components shard on the same
+logical axis (q: [L, out, in/32, 32], d: [L, out, in/32]).
+
+Constraint carried over from the reference (src/app.cpp:341-343):
+tp must divide n_kv_heads (and the per-32-block count for col-splits).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
+    """Role -> sharding (or (q, d) pair of shardings for Q40 roles).
+
+    Works for both dense and Q40 weights: loaders pick the pair form when the
+    tensor is quantized. Layer axis (leading) is replicated — pipeline
+    parallelism shards it explicitly in parallel/pipeline.py instead.
+    """
+    def entry(quant_pair, dense):
+        return {"quant": quant_pair, "dense": dense}
+
+    # [L, out, in] row-split -> shard axis 1; quant pair: q [L,out,b,32] d [L,out,b]
+    row = entry((_ns(mesh, None, "tp", None, None), _ns(mesh, None, "tp", None)),
+                _ns(mesh, None, "tp", None))
+    # [L, out, in] col-split -> shard axis 2 (blocks axis for q components)
+    col = entry((_ns(mesh, None, None, "tp", None), _ns(mesh, None, None, "tp")),
+                _ns(mesh, None, None, "tp"))
+    # MoE expert stacks: [L, E, out, in] — ff axis sharded (TP-within-expert)
+    erow = entry((_ns(mesh, None, None, "tp", None, None), _ns(mesh, None, None, "tp", None)),
+                 _ns(mesh, None, None, "tp", None))
+    ecol = entry((_ns(mesh, None, None, None, "tp", None), _ns(mesh, None, None, None, "tp")),
+                 _ns(mesh, None, None, None, "tp"))
+    rep = entry((_ns(mesh), _ns(mesh)), _ns(mesh))
+
+    return {
+        "q": row,
+        "k": row,
+        "v": row,
+        "wo": col,
+        "w1": erow if moe else row,
+        "w3": erow if moe else row,
+        "w2": ecol if moe else col,
+        # wcls: [vocab, dim] row-split over vocab; quant pair [vocab,b,32]/[vocab,b]
+        "wcls": entry((_ns(mesh, "tp", None, None), _ns(mesh, "tp", None)), _ns(mesh, "tp", None)),
+        "embedding": rep,
+        "final_norm": rep,
+        "norm0": rep,
+        "norm1": rep,
+        "q_norm": rep,
+        "k_norm": rep,
+        "moe_gate": rep,
+    }
+
+
+def cache_shardings(mesh: Mesh) -> NamedSharding:
+    """KV cache [L, batch, seq, n_kv_heads, head_dim]: batch over dp, heads
+    over tp, seq over sp (long-context)."""
+    return _ns(mesh, None, "dp", "sp", "tp", None)
+
+
+def data_shardings(mesh: Mesh) -> NamedSharding:
+    """Token/position arrays [batch, t]: batch over dp."""
+    return _ns(mesh, "dp", None)

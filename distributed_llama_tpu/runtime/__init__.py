@@ -1,0 +1,11 @@
+"""Runtime: the inference engine driving jit-compiled forward steps.
+
+Replaces the reference's executor/thread-pool/socket runtime (reference:
+src/nn/nn-executor.cpp, src/app.cpp): XLA replaces the step list and thread
+pool, buffer donation replaces pipe memory management, and the host-side
+engine here only orchestrates prefill chunking, sampling, and timing.
+"""
+
+from .engine import GenerationResult, InferenceEngine, StepTiming
+
+__all__ = ["InferenceEngine", "GenerationResult", "StepTiming"]

@@ -1,0 +1,64 @@
+"""On-device multi-token decode loop.
+
+The reference pays one socket broadcast + 2L+1 all-reduces per decoded token
+and samples on the host (reference: app.cpp:251-303, SURVEY.md §3.1). The
+TPU analogue of that per-token cost is the host->device dispatch and
+device->host logits fetch — tens of ms through the driver tunnel, dwarfing
+the ~1 ms of actual 1B-model compute.
+
+So the decode loop itself is a `lax.scan` on device: K forward steps +
+on-device sampling per host call, returning K tokens in one transfer. The
+host overlaps fetching chunk i with computing chunk i+1 (both live on
+device), making steady-state decode throughput compute-bound. EOS is checked
+between chunks; at most K-1 tokens of overrun compute are discarded.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.params import KVCache, ModelParams
+from ..models.transformer import forward_uncompiled
+from ..ops.rope import RopeTables
+from ..ops.sampling import sample_logits
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "temperature", "topp"),
+    donate_argnames=("cache",),
+)
+def decode_chunk(
+    cfg: ModelConfig,
+    params: ModelParams,
+    rope: RopeTables,
+    cache: KVCache,
+    token: jnp.ndarray,  # [b] int32 — the token to feed first
+    pos_start,  # scalar int32
+    key: jnp.ndarray,  # PRNG key (ignored when temperature == 0)
+    n_steps: int = 16,
+    temperature: float = 0.0,
+    topp: float = 0.9,
+):
+    """Run n_steps feed-forward+sample iterations on device.
+
+    Returns (tokens [b, n_steps] — the sampled continuations, cache).
+    """
+
+    def step(carry, _):
+        token, pos, cache, key = carry
+        logits, cache = forward_uncompiled(
+            cfg, params, rope, cache, token[:, None], pos, logits_mode="last"
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, temperature, topp)
+        return (nxt, pos + 1, cache, key), nxt
+
+    (_, _, cache, _), toks = jax.lax.scan(
+        step, (token, jnp.asarray(pos_start, jnp.int32), cache, key), None, length=n_steps
+    )
+    return jnp.transpose(toks, (1, 0)), cache

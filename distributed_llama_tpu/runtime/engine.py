@@ -1,0 +1,299 @@
+"""The inference engine: model loading, prefill/decode orchestration, timing.
+
+Host-side equivalent of the reference's `RootLlmInference` + `inference()`
+driver (reference: src/app.cpp:223-303, src/dllama.cpp:13-151), minus
+everything XLA now owns (thread pool, step list, collectives).
+
+TPU-specific design:
+* the forward step is jit-compiled once per (batch, chunk) shape; prompt
+  chunks are padded to power-of-two buckets so the number of compiled
+  programs is O(log max_chunk), not O(prompt length);
+* the KV cache is donated through every step — it lives in HBM and is
+  updated in place, never shipped to the host;
+* sampling runs on the host over the final logits row (f32), byte-matching
+  the reference Sampler's numerics (tokenizer.py); a device-side argmax fast
+  path covers the temperature=0 benchmark case.
+* padded tail positions write garbage into cache slots past the true length;
+  those slots are either masked (attention masks t <= pos) or overwritten by
+  the next real token before they are ever visible — same invariant the
+  reference maintains by only advancing `pos` over real tokens.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..formats.mfile import MFileReader
+from ..models import KVCache, config_from_header, forward, init_kv_cache, load_params
+from ..ops import build_rope_tables
+from ..tokenizer import Sampler
+
+
+@dataclass
+class StepTiming:
+    """Per-forward timing (analogue of the reference's Eval/Pred + Sync ms
+    columns, reference dllama.cpp:76-83,111-118). Under XLA, compute and
+    collective time are fused in one device program, so `sync_us` is only
+    nonzero when a profiler-derived split is available."""
+
+    eval_us: int = 0
+    sync_us: int = 0
+    n_tokens: int = 0
+
+
+@dataclass
+class GenerationResult:
+    tokens: list[int] = field(default_factory=list)
+    n_prompt_tokens: int = 0
+    prefill_us: int = 0
+    ttft_us: int = 0
+    decode_us: int = 0
+    total_us: int = 0
+    eval_steps: list[StepTiming] = field(default_factory=list)
+    pred_steps: list[StepTiming] = field(default_factory=list)
+
+    @property
+    def n_pred_tokens(self) -> int:
+        return len(self.tokens) - self.n_prompt_tokens
+
+    @property
+    def eval_tok_per_s(self) -> float:
+        us = sum(s.eval_us + s.sync_us for s in self.eval_steps) or 1
+        n = sum(s.n_tokens for s in self.eval_steps)
+        return n * 1e6 / us
+
+    @property
+    def pred_tok_per_s(self) -> float:
+        us = sum(s.eval_us + s.sync_us for s in self.pred_steps) or 1
+        return len(self.pred_steps) * 1e6 / us
+
+
+def _chunk_buckets(max_chunk: int) -> list[int]:
+    out = [1]
+    while out[-1] < max_chunk:
+        out.append(min(out[-1] * 2, max_chunk))
+    return out
+
+
+class InferenceEngine:
+    """Owns params + cache + compiled steps for one model."""
+
+    def __init__(
+        self,
+        model_path: str,
+        compute_dtype: str = "bfloat16",
+        max_seq_len: int = 0,
+        batch: int = 1,
+        max_chunk: int = 32,
+        mesh=None,
+        cache_dtype: str | None = None,
+        device_decode: bool = True,
+        decode_chunk_size: int = 32,
+    ):
+        self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
+        self.header = self.reader.header
+        self.cfg = config_from_header(
+            self.header, compute_dtype=compute_dtype, cache_dtype=cache_dtype
+        )
+        self.mesh = mesh
+        shardings = None
+        self._cache_sharding = None
+        if mesh is not None:
+            from ..parallel import cache_shardings, param_shardings
+
+            shardings = param_shardings(mesh, moe=self.cfg.is_moe)
+            self._cache_sharding = cache_shardings(mesh)
+        self.params = load_params(self.reader, self.cfg, shardings=shardings)
+        self.rope = build_rope_tables(self.header)
+        self.batch = batch
+        self.max_chunk = max(1, min(max_chunk, self.cfg.seq_len))
+        # device_decode: run the decode loop on device in chunks (fast path);
+        # False = per-token host loop with the reference's exact RNG stream.
+        self.device_decode = device_decode
+        self.decode_chunk_size = decode_chunk_size
+        self.cache = self._new_cache()
+        self._argmax_step = jax.jit(
+            lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        )
+
+    # -- low-level steps ----------------------------------------------------
+
+    def _new_cache(self):
+        cache = init_kv_cache(self.cfg, self.batch)
+        if self._cache_sharding is not None:
+            import jax as _jax
+
+            cache = KVCache(
+                k=_jax.device_put(cache.k, self._cache_sharding),
+                v=_jax.device_put(cache.v, self._cache_sharding),
+            )
+        return cache
+
+    def reset(self):
+        """Zero the cache (new independent sequence)."""
+        self.cache = self._new_cache()
+
+    def forward_tokens(
+        self, tokens: list[int], pos_start: int, logits_mode: str = "last"
+    ) -> np.ndarray:
+        """Run one (unpadded, caller-shaped) forward over `tokens` for every
+        batch row; returns host logits."""
+        arr = jnp.asarray([tokens] * self.batch, dtype=jnp.int32)
+        logits, self.cache = forward(
+            self.cfg, self.params, self.rope, self.cache, arr,
+            jnp.int32(pos_start), logits_mode=logits_mode,
+        )
+        return np.asarray(logits)
+
+    def prefill(self, tokens: list[int], pos_start: int = 0, on_chunk=None) -> np.ndarray | None:
+        """Feed `tokens` through the model in padded power-of-two chunks.
+
+        Returns the logits after the final real token (or None if tokens is
+        empty). `on_chunk(timing)` is called per chunk with wall timing.
+        """
+        buckets = _chunk_buckets(self.max_chunk)
+        logits = None
+        i = 0
+        n = len(tokens)
+        while i < n:
+            remaining = n - i
+            size = next(b for b in buckets if b >= min(remaining, self.max_chunk))
+            chunk = tokens[i : i + size]
+            n_real = len(chunk)
+            pad = size - n_real
+            chunk = chunk + [0] * pad
+            t0 = time.perf_counter()
+            arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
+            out, self.cache = forward(
+                self.cfg, self.params, self.rope, self.cache, arr,
+                jnp.int32(pos_start + i), logits_mode="all",
+            )
+            out.block_until_ready()
+            dt = int((time.perf_counter() - t0) * 1e6)
+            if on_chunk is not None:
+                on_chunk(StepTiming(eval_us=dt, n_tokens=n_real))
+            logits = np.asarray(out[:, n_real - 1, :])
+            i += n_real
+        return logits
+
+    def decode_one(self, token: int, pos: int) -> np.ndarray:
+        """One decode step; returns host logits [batch, vocab]."""
+        arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
+        logits, self.cache = forward(
+            self.cfg, self.params, self.rope, self.cache, arr, jnp.int32(pos)
+        )
+        return np.asarray(logits)
+
+    # -- generation driver --------------------------------------------------
+
+    def generate(
+        self,
+        prompt_tokens: list[int],
+        steps: int,
+        sampler: Sampler | None = None,
+        on_token=None,
+        stop_fn=None,
+    ) -> GenerationResult:
+        """The reference `inference()` loop (dllama.cpp:13-151): prefill all
+        but the last prompt token, then decode until `steps` total tokens or
+        `stop_fn(token)` says stop.
+        """
+        if not prompt_tokens:
+            raise ValueError("prompt tokens required")
+        if len(prompt_tokens) > self.cfg.seq_len:
+            raise ValueError("prompt is longer than the sequence length")
+        res = GenerationResult(tokens=list(prompt_tokens), n_prompt_tokens=len(prompt_tokens))
+        wall0 = time.perf_counter()
+
+        # prefill all but the last prompt token (its logits come from the
+        # first decode step, reference dllama.cpp:44-85)
+        self.prefill(prompt_tokens[:-1], 0, on_chunk=res.eval_steps.append)
+        res.prefill_us = int((time.perf_counter() - wall0) * 1e6)
+
+        pos = len(prompt_tokens) - 1
+        token = prompt_tokens[-1]
+        max_pos = min(self.cfg.seq_len, steps)
+        if self.device_decode:
+            self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
+        else:
+            self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
+        res.total_us = int((time.perf_counter() - wall0) * 1e6)
+        res.decode_us = res.total_us - res.prefill_us
+        return res
+
+    def _decode_host(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
+        """Per-token host loop: one device round trip per token. Bit-parity
+        path (host Sampler = the reference's xorshift* stream)."""
+        greedy = sampler is None or sampler.temperature == 0.0
+        first = True
+        while pos < max_pos:
+            t0 = time.perf_counter()
+            if greedy:
+                arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
+                logits, self.cache = forward(
+                    self.cfg, self.params, self.rope, self.cache, arr, jnp.int32(pos)
+                )
+                token = int(self._argmax_step(logits)[0])
+            else:
+                logits = self.decode_one(token, pos)
+                token = sampler.sample(logits[0].copy())
+            dt = int((time.perf_counter() - t0) * 1e6)
+            res.pred_steps.append(StepTiming(eval_us=dt, n_tokens=1))
+            if first:
+                res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
+                first = False
+            res.tokens.append(token)
+            pos += 1
+            if on_token is not None:
+                on_token(token)
+            if stop_fn is not None and stop_fn(token):
+                return
+
+    def _decode_device(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
+        """Chunked on-device decode: K forward+sample steps per host call
+        (runtime/decode.py), one token-array fetch per chunk."""
+        import jax
+
+        from .decode import decode_chunk
+
+        temperature = 0.0 if sampler is None else sampler.temperature
+        topp = sampler.topp if sampler is not None else 0.9
+        seed = getattr(sampler, "_state", None)
+        key = jax.random.PRNGKey(int(seed) if seed is not None else 0)
+        tok_arr = jnp.full((self.batch,), token, dtype=jnp.int32)
+        first = True
+        while pos < max_pos:
+            n = self.decode_chunk_size
+            if pos + n > max_pos or pos + n > self.cfg.seq_len:
+                n = 1  # tail: fall back to single-step chunks (bounded compiles)
+            t0 = time.perf_counter()
+            key, sub = jax.random.split(key)
+            toks, self.cache = decode_chunk(
+                self.cfg, self.params, self.rope, self.cache, tok_arr, jnp.int32(pos),
+                sub, n_steps=n, temperature=temperature, topp=topp,
+            )
+            tok_arr = toks[:, -1]
+            # single bulk fetch — per-element indexing would issue one
+            # device->host transfer per token (ruinous through the tunnel)
+            host_toks = np.asarray(toks[0]).tolist()
+            dt = int((time.perf_counter() - t0) * 1e6)
+            if first:
+                res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
+                first = False
+            for j, t in enumerate(host_toks):
+                res.pred_steps.append(StepTiming(eval_us=dt // n, n_tokens=1))
+                res.tokens.append(t)
+                pos += 1
+                if on_token is not None:
+                    on_token(t)
+                if stop_fn is not None and stop_fn(t):
+                    # tokens past the stop within this chunk are never
+                    # appended; the cache overran by up to n-j-1 positions,
+                    # which is harmless — a continuation re-writes those
+                    # slots before reading them
+                    return

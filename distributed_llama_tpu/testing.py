@@ -106,7 +106,7 @@ def write_tiny_model(path: str, h: ModelHeader, seed: int = 0, scale: float = 0.
 
 
 def byte_vocab_tokenizer(
-    n_special: int = 8, chat_template: str | None = None
+    n_special: int = 8, chat_template: str | None = None, pad_to: int = 0
 ) -> TokenizerData:
     """A 256-byte-vocabulary tokenizer plus a few special tokens.
 
@@ -125,6 +125,12 @@ def byte_vocab_tokenizer(
     specials = [b"<s>", b"</s>", b"<|eot|>"] + [f"<sp{i}>".encode() for i in range(max(0, n_special - 3))]
     vocab += specials
     scores += [0.0] * len(specials)
+    # pad_to: extend with unused filler tokens so the tokenizer's vocab covers
+    # a model with a larger (rounded-up) vocab_size — a sampled filler id must
+    # still be decodable
+    while pad_to > len(vocab):
+        vocab.append(f"<pad{len(vocab)}>".encode())
+        scores.append(0.0)
     return TokenizerData(
         vocab=vocab,
         scores=scores,

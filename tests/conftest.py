@@ -4,9 +4,14 @@ first `import jax` anywhere in the test process."""
 
 import os
 
-# Hard override: the driver environment may preset JAX_PLATFORMS to the real
-# TPU; tests must run on the virtual 8-device CPU mesh regardless.
+# Hard override: the driver environment presets JAX_PLATFORMS to the real TPU
+# (the axon sitecustomize re-forces it even over the env var); tests must run
+# on the virtual 8-device CPU mesh regardless, so set the config directly.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,115 @@
+"""Engine + CLI tests on tiny synthetic models."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu import cli
+from distributed_llama_tpu.formats.mfile import ArchType, MFileReader
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model, write_tiny_tokenizer
+from distributed_llama_tpu.tokenizer import Sampler
+
+from numpy_reference import NumpyModel
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("m")
+    # vocab 288 covers the byte-vocab tokenizer's merged + special ids (~270)
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=64, vocab_size=288
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=h.vocab_size)
+    return mp, tp
+
+
+def test_device_decode_matches_host_decode(model_files):
+    mp, _ = model_files
+    prompt = [3, 17, 99, 4]
+    a = InferenceEngine(mp, compute_dtype="float32", device_decode=True, decode_chunk_size=4)
+    b = InferenceEngine(mp, compute_dtype="float32", device_decode=False)
+    ra = a.generate(prompt, 20, sampler=None)
+    rb = b.generate(prompt, 20, sampler=None)
+    assert ra.tokens == rb.tokens
+
+
+def test_greedy_generation_matches_numpy_golden(model_files):
+    mp, _ = model_files
+    prompt = [3, 17, 99]
+    golden = NumpyModel(MFileReader(mp))
+    # steps counts sequence positions (reference: maxPos = min(seqLen, steps),
+    # dllama.cpp:97): steps = len(prompt) + 10 decodes positions
+    # len(prompt)-1 .. steps-1, i.e. 11 generated tokens.
+    want = golden.generate_greedy(prompt, 11)
+    eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
+    got = eng.generate(prompt, len(prompt) + 10, sampler=None)
+    assert got.tokens == want
+
+
+def test_stop_fn_cuts_generation(model_files):
+    mp, _ = model_files
+    eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
+    res = eng.generate([3, 17], 40, sampler=None, stop_fn=lambda t: True)
+    assert res.n_pred_tokens == 1
+
+
+def test_sampled_generation_reproducible(model_files):
+    mp, _ = model_files
+    eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
+    s1 = Sampler(eng.cfg.vocab_size, temperature=0.8, topp=0.9, seed=42)
+    r1 = eng.generate([3, 17], 20, sampler=s1)
+    eng.reset()
+    s2 = Sampler(eng.cfg.vocab_size, temperature=0.8, topp=0.9, seed=42)
+    r2 = eng.generate([3, 17], 20, sampler=s2)
+    assert r1.tokens == r2.tokens
+
+
+def test_cli_inference_smoke(model_files, capsys):
+    mp, tp = model_files
+    rc = cli.main(
+        [
+            "inference",
+            "--model", mp,
+            "--tokenizer", tp,
+            "--prompt", "hello world",
+            "--steps", "16",
+            "--temperature", "0",
+            "--compute-dtype", "float32",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Prediction" in out and "tokens/s:" in out and "ttftMs:" in out
+
+
+def test_cli_perplexity_smoke(model_files, capsys):
+    mp, tp = model_files
+    rc = cli.main(
+        [
+            "perplexity",
+            "--model", mp,
+            "--tokenizer", tp,
+            "--prompt", "hello world hello world",
+            "--compute-dtype", "float32",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "perplexity:" in out
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+    import jax
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 1
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)

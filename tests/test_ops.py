@@ -23,7 +23,13 @@ from distributed_llama_tpu.ops import (
     silu,
 )
 
-RNG = np.random.default_rng(7)
+
+@pytest.fixture()
+def rng(request):
+    """Per-test deterministic RNG: independent of execution order/selection."""
+    import zlib
+
+    return np.random.default_rng(zlib.crc32(request.node.name.encode()))
 
 
 def rope_header(rope_type, head_dim=8, seq_len=32, theta=10000.0, scaling=False):
@@ -45,9 +51,9 @@ def rope_header(rope_type, head_dim=8, seq_len=32, theta=10000.0, scaling=False)
     return h
 
 
-def test_rms_norm_matches_reference_formula():
-    x = RNG.standard_normal((2, 3, 64)).astype(np.float32)
-    w = RNG.standard_normal(64).astype(np.float32)
+def test_rms_norm_matches_reference_formula(rng):
+    x = rng.standard_normal((2, 3, 64)).astype(np.float32)
+    w = rng.standard_normal(64).astype(np.float32)
     eps = 1e-5
     # reference: invRms_F32 + rmsNorm_F32 (nn-cpu-ops.cpp:114-175)
     inv_rms = 1.0 / np.sqrt((x.astype(np.float64) ** 2).mean(-1, keepdims=True) + eps)
@@ -56,8 +62,8 @@ def test_rms_norm_matches_reference_formula():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
-def test_silu():
-    x = RNG.standard_normal(100).astype(np.float32)
+def test_silu(rng):
+    x = rng.standard_normal(100).astype(np.float32)
     want = x / (1.0 + np.exp(-x))
     np.testing.assert_allclose(np.asarray(silu(jnp.asarray(x))), want, rtol=1e-6, atol=1e-6)
 
@@ -95,10 +101,10 @@ def _numpy_rope_falcon(x, pos, head_dim, theta):
 
 
 @pytest.mark.parametrize("pos", [0, 1, 17])
-def test_rope_llama_matches_scalar(pos):
+def test_rope_llama_matches_scalar(rng, pos):
     h = rope_header(RopeType.LLAMA)
     tables = build_rope_tables(h)
-    x = RNG.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
+    x = rng.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
     want = _numpy_rope_llama(x[0, 0], pos, h.head_dim, h.rope_theta)
     got = np.asarray(
         apply_rope_llama(jnp.asarray(x), tables, jnp.full((1, 1), pos, jnp.int32))
@@ -107,10 +113,10 @@ def test_rope_llama_matches_scalar(pos):
 
 
 @pytest.mark.parametrize("pos", [0, 3, 29])
-def test_rope_falcon_matches_scalar(pos):
+def test_rope_falcon_matches_scalar(rng, pos):
     h = rope_header(RopeType.FALCON)
     tables = build_rope_tables(h)
-    x = RNG.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
+    x = rng.standard_normal((1, 1, 4, h.head_dim)).astype(np.float32)
     want = _numpy_rope_falcon(x[0, 0], pos, h.head_dim, h.rope_theta)
     got = np.asarray(
         apply_rope_falcon(jnp.asarray(x), tables, jnp.full((1, 1), pos, jnp.int32))
@@ -161,11 +167,11 @@ def _numpy_gqa(q, k_cache, v_cache, pos):
 
 
 @pytest.mark.parametrize("pos", [0, 5, 15])
-def test_gqa_attention_matches_scalar(pos):
+def test_gqa_attention_matches_scalar(rng, pos):
     n_heads, n_kv, head_dim, cache_len = 4, 2, 8, 16
-    q = RNG.standard_normal((n_heads, head_dim)).astype(np.float32)
-    k_cache = RNG.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
-    v_cache = RNG.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
+    q = rng.standard_normal((n_heads, head_dim)).astype(np.float32)
+    k_cache = rng.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
+    v_cache = rng.standard_normal((cache_len, n_kv, head_dim)).astype(np.float32)
     want = _numpy_gqa(q, k_cache, v_cache, pos)
     got = np.asarray(
         gqa_attention(
@@ -178,12 +184,12 @@ def test_gqa_attention_matches_scalar(pos):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
-def test_gqa_prefill_batch_matches_per_position():
+def test_gqa_prefill_batch_matches_per_position(rng):
     """A multi-token prefill call must equal token-by-token decode calls."""
     n_heads, n_kv, head_dim, cache_len, q_len = 4, 4, 8, 16, 6
-    q = RNG.standard_normal((1, q_len, n_heads, head_dim)).astype(np.float32)
-    k_cache = RNG.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
-    v_cache = RNG.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
+    q = rng.standard_normal((1, q_len, n_heads, head_dim)).astype(np.float32)
+    k_cache = rng.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
+    v_cache = rng.standard_normal((1, cache_len, n_kv, head_dim)).astype(np.float32)
     positions = jnp.arange(q_len, dtype=jnp.int32)[None, :]
     batched = np.asarray(
         gqa_attention(jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache), positions)
@@ -200,9 +206,9 @@ def test_gqa_prefill_batch_matches_per_position():
         np.testing.assert_allclose(batched[:, p : p + 1], single, rtol=1e-5, atol=1e-5)
 
 
-def test_quant_tensor_round_trip_and_matmul():
+def test_quant_tensor_round_trip_and_matmul(rng):
     out_f, in_f = 24, 64
-    w = RNG.standard_normal((out_f, in_f)).astype(np.float32) * 0.1
+    w = rng.standard_normal((out_f, in_f)).astype(np.float32) * 0.1
     raw = quantize_q40(w.reshape(-1))
     q, d = unpack_q40(raw, w.size)
     wt = quant_tensor_from_q40(q.reshape(out_f, in_f // 32, 32), d.reshape(out_f, in_f // 32))
@@ -212,25 +218,25 @@ def test_quant_tensor_round_trip_and_matmul():
 
     np.testing.assert_allclose(wf.reshape(-1), dequantize_q40(raw, w.size), rtol=1e-6, atol=1e-6)
     # matmul in f32 equals numpy on the dequantized weight
-    x = RNG.standard_normal((3, in_f)).astype(np.float32)
+    x = rng.standard_normal((3, in_f)).astype(np.float32)
     got = np.asarray(quant_matmul(jnp.asarray(x), wt, dtype=jnp.float32))
     np.testing.assert_allclose(got, x @ wf.T, rtol=1e-4, atol=1e-4)
 
 
-def test_q80_activation_round_trip_matches_host_codec():
+def test_q80_activation_round_trip_matches_host_codec(rng):
     from distributed_llama_tpu.formats.quants import dequantize_q80, quantize_q80
 
-    x = RNG.standard_normal(128).astype(np.float32)
+    x = rng.standard_normal(128).astype(np.float32)
     want = dequantize_q80(quantize_q80(x), x.size)
     got = np.asarray(quantize_q80_activations(jnp.asarray(x)))
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
 
 
-def test_moe_router_matches_scalar():
+def test_moe_router_matches_scalar(rng):
     """Mirror of softmax -> topk -> normTopk renorm (nn-cpu-ops.cpp:1462-1492)."""
     dim, n_experts, k = 16, 8, 3
-    x = RNG.standard_normal((5, dim)).astype(np.float32)
-    gate = RNG.standard_normal((n_experts, dim)).astype(np.float32)
+    x = rng.standard_normal((5, dim)).astype(np.float32)
+    gate = rng.standard_normal((n_experts, dim)).astype(np.float32)
     idx, wts = moe_router(jnp.asarray(x), jnp.asarray(gate), k)
     idx, wts = np.asarray(idx), np.asarray(wts)
     for b in range(x.shape[0]):

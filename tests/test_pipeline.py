@@ -1,0 +1,114 @@
+"""shard_map PPxTP pipeline tests on the 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.formats.mfile import ArchType, MFileReader, RopeType
+from distributed_llama_tpu.models import config_from_header, forward, init_kv_cache, load_params
+from distributed_llama_tpu.ops import build_rope_tables
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.parallel.pipeline import (
+    pipeline_forward,
+    pp_cache_sharding,
+    pp_param_shardings,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+KW = dict(
+    arch=ArchType.LLAMA, dim=128, hidden_dim=128, n_layers=4, n_heads=4, n_kv_heads=4,
+)
+
+
+def _build(tmp_path, mesh=None, **kw):
+    h = tiny_header(**kw)
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, h, seed=5)
+    reader = MFileReader(path)
+    cfg = config_from_header(reader.header, compute_dtype="float32")
+    sh = pp_param_shardings(mesh, moe=cfg.is_moe) if mesh is not None else None
+    params = load_params(reader, cfg, shardings=sh)
+    rope = build_rope_tables(reader.header)
+    return cfg, params, rope
+
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2), (4, 2), (2, 4)])
+def test_pipeline_matches_single_device(tmp_path, pp, tp):
+    tokens = [3, 99, 41, 7]
+    cfg, params, rope = _build(tmp_path, None, **KW)
+    cache = init_kv_cache(cfg, batch=1)
+    want, want_cache = forward(
+        cfg, params, rope, cache, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+
+    mesh = make_mesh(tp=tp, pp=pp)
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **KW)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+    got, got_cache = pipeline_forward(
+        cfg2, mesh, params2, rope2, cache2, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(got_cache.k), np.asarray(want_cache.k), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pipeline_decode_sequence(tmp_path):
+    """Prefill + several decode steps through the pipeline match the
+    single-device engine."""
+    tokens = [5, 42, 7, 12]
+    cfg, params, rope = _build(tmp_path, None, **KW)
+    cache = init_kv_cache(cfg, batch=1)
+
+    mesh = make_mesh(tp=2, pp=2)
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **KW)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+
+    for p, t in enumerate(tokens):
+        arr = jnp.asarray([[t]], jnp.int32)
+        want, cache = forward(cfg, params, rope, cache, arr, jnp.int32(p))
+        got, cache2 = pipeline_forward(cfg2, mesh, params2, rope2, cache2, arr, jnp.int32(p))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_microbatched_prefill(tmp_path):
+    """GPipe-style microbatching must equal the single-shot prefill."""
+    tokens = [3, 99, 41, 7, 5, 42, 7, 12]
+    cfg, params, rope = _build(tmp_path, None, **KW)
+    cache = init_kv_cache(cfg, batch=1)
+    want, _ = forward(
+        cfg, params, rope, cache, jnp.asarray([tokens], jnp.int32), jnp.int32(0),
+        logits_mode="all",
+    )
+
+    mesh = make_mesh(tp=2, pp=2)
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **KW)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+    got, _ = pipeline_forward(
+        cfg2, mesh, params2, rope2, cache2, jnp.asarray([tokens], jnp.int32), jnp.int32(0),
+        logits_mode="all", microbatches=4,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_qwen3_moe(tmp_path):
+    kw = dict(
+        arch=ArchType.QWEN3_MOE, dim=128, rope_type=RopeType.FALCON, n_layers=4,
+        n_heads=4, n_kv_heads=4, hidden_dim=128, n_experts=4, n_active_experts=2,
+        moe_hidden_dim=128,
+    )
+    tokens = [3, 99, 41, 7]
+    cfg, params, rope = _build(tmp_path, None, **kw)
+    cache = init_kv_cache(cfg, batch=1)
+    want, _ = forward(
+        cfg, params, rope, cache, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+
+    mesh = make_mesh(tp=2, pp=2)
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **kw)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+    got, _ = pipeline_forward(
+        cfg2, mesh, params2, rope2, cache2, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
