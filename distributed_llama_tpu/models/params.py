@@ -121,10 +121,13 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None) -> K
 
 
 def _load_one(reader: MFileReader, spec: TensorSpec, dense_dtype) -> Any:
-    """Host-side load of a single tensor: QuantTensor parts or dense ndarray."""
+    """Host-side load of a single tensor: QuantTensor parts (in the device T
+    layout, ops/quant.py) or a dense ndarray."""
     if spec.float_type == FloatType.Q40 and len(spec.shape) == 2:
-        q, d = reader.tensor_q40(spec)
-        return (q, d.astype(np.float32))
+        from ..ops.quant import q40_to_t_layout
+
+        q, d = reader.tensor_q40(spec)  # [out, in//32, 32], [out, in//32]
+        return q40_to_t_layout(q, d)
     x = reader.tensor_f32(spec)
     return x.astype(dense_dtype) if len(spec.shape) == 2 else x
 

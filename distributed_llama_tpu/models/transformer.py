@@ -68,18 +68,19 @@ def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
 
 
 def _expert_matmul(x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
-    """Per-token expert matmul: x [b,t,k,in] with gathered w [b,t,k,out,in...]."""
+    """Per-token expert matmul: x [b,t,k,in] with per-token gathered expert
+    weights — QuantTensor in the T layout ([...,nb,32,out]) or dense
+    [...,out,in]."""
+    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     if isinstance(w, QuantTensor):
-        wd = (w.q.astype(dtype) * w.d[..., None].astype(dtype)).reshape(*w.q.shape[:-2], -1)
+        wd = (w.q.astype(jnp.float32) * w.d[..., None, :]).astype(dtype)
+        wd = wd.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
+        eq = "btki,btkio->btko"
     else:
         wd = w.astype(dtype)
-    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+        eq = "btki,btkoi->btko"
     y = jnp.einsum(
-        "btki,btkoi->btko",
-        x.astype(dtype),
-        wd,
-        preferred_element_type=jnp.float32,
-        precision=precision,
+        eq, x.astype(dtype), wd, preferred_element_type=jnp.float32, precision=precision
     )
     return y.astype(x.dtype)
 

@@ -1,0 +1,103 @@
+"""Fused Q40 dequant-matmul Pallas TPU kernel.
+
+TPU-native replacement for the reference's hot loop, `matmul_Q80_Q40_F32`
+(reference: src/nn/nn-cpu-ops.cpp:231-449, NEON/AVX-512/AVX2 paths): instead
+of SIMD nibble tricks over CPU cache lines, the weight streams from HBM as
+int8 (the T layout, see ops/quant.py), is dequantized in VMEM with one
+broadcast-multiply, and hits the MXU as bf16 — HBM traffic is ~1 byte/weight
+instead of the 2-4 bytes the dequant-materialize XLA fallback pays.
+
+Tiling:
+  grid = (out/TILE_N, nb/TILE_KNB), k innermost (output tile revisited,
+  f32 accumulation in place);
+  qt block [TILE_KNB, 32, TILE_N] int8 — the 32-sublane dim is exactly
+  int8's min tile, TILE_N sits on the 128-lane dim;
+  dt block [TILE_KNB, TILE_N] f32 broadcasts over the sublane axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..formats.quants import Q_BLOCK
+
+LANE = 128
+DEFAULT_TILE_N = 256
+DEFAULT_TILE_KNB = 64  # 64 blocks = 2048 input features per k step
+
+
+def q40_matmul_aligned(x, w) -> bool:
+    """Kernel supports: lane-aligned out, k divisible into whole blocks, and
+    a 2D-flattenable x. (Unaligned/expert-stacked weights use the XLA path.)"""
+    return (
+        w.q.ndim == 3
+        and w.out_features % LANE == 0
+        and x.shape[-1] == w.in_features
+    )
+
+
+def _kernel(x_ref, qt_ref, dt_ref, out_ref):
+    k = pl.program_id(1)
+    # dequant: f32 multiply keeps full f16-scale precision, then cast once
+    w = (qt_ref[...].astype(jnp.float32) * dt_ref[...][:, None, :]).astype(
+        x_ref.dtype
+    )
+    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
+    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("dtype", "interpret"))
+def q40_matmul_pallas(
+    x: jnp.ndarray,  # [..., in_features]
+    qt: jnp.ndarray,  # [nb, 32, out]
+    dt: jnp.ndarray,  # [nb, out]
+    dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Returns x @ w (logical x @ w.T for the [out, in] weight), f32."""
+    nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    lead = x.shape[:-1]
+    b = 1
+    for s in lead:
+        b *= s
+    x2 = x.reshape(b, in_features).astype(dtype)
+
+    tile_n = min(DEFAULT_TILE_N, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = min(DEFAULT_TILE_KNB, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+
+    grid = (out // tile_n, nb // tile_knb)
+    out2 = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (b, tile_knb * Q_BLOCK), lambda j, k: (0, k), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, tile_n), lambda j, k: (0, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, out), jnp.float32),
+        interpret=interpret,
+    )(x2, qt, dt)
+    return out2.reshape(*lead, out)

@@ -5,20 +5,33 @@ row dotted against Q40 block-quantized weight rows with NEON/AVX intrinsics
 (reference: src/nn/nn-cpu-ops.cpp:231-449). On TPU the same math maps to:
 
 * weights stay resident in HBM as int8 values + per-block scales
-  (`QuantTensor`) — 4.5 bits/weight of traffic instead of 16/32;
-* the matmul dequantizes on the fly and accumulates in f32 on the MXU. Two
-  implementations: a plain-XLA path (`quant_matmul`, dequant fuses into the
-  matmul's operand load) and a fused Pallas kernel (ops/pallas_q40.py) that
-  dequantizes per-tile in VMEM.
+  (`QuantTensor`) — ~4.5 bits/weight of traffic instead of 16/32;
+* the matmul dequantizes on the fly and accumulates in f32 on the MXU, via
+  the fused Pallas kernel (ops/pallas_q40.py) on TPU or a plain-XLA
+  dequant+dot fallback.
+
+Device layout (the "T" layout, chosen for TPU tiling): a logical
+[out_features, in_features] Q40 weight is stored *transposed and
+block-major*:
+
+    q: [in_features // 32, 32, out_features]  int8  (values in [-8, 7])
+    d: [in_features // 32, out_features]      f32   (per-block scales)
+
+so that the innermost axis (out_features, the matmul's N) sits on the
+128-lane dimension, the 32 elements of a quantization block sit exactly on
+int8's 32-sublane min tile, and dequantization is a broadcast of d over the
+sublane axis — no lane shuffles. ``x @ w.T`` becomes ``x @ dequant(q, d)``
+with no transpose.
 
 Activation quantization to Q80 exists only to *emulate the reference's
-numerics* when bit-parity testing (`quantize_q80_activations`); the production
-path feeds bf16/f32 activations straight in — on TPU there is no bandwidth
-win from quantizing activations that are already on-chip.
+numerics* when parity testing (`quantize_q80_activations`); the production
+path feeds bf16/f32 activations straight in — there is no bandwidth win from
+quantizing activations that are already on-chip.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -32,14 +45,10 @@ from ..formats.quants import Q_BLOCK
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class QuantTensor:
-    """A Q40 weight on device: int8 values in [-8,7] + per-block f32 scales.
+    """A Q40 weight on device in the T layout (see module docstring).
 
-    q: [out_features, in_features // 32, 32] int8
-    d: [out_features, in_features // 32] f32 (converted from the file's f16)
-
-    Logical value = q * d (per block). Layout matches `unpack_q40`
-    (formats/quants.py) reshaped per row, i.e. exactly the reference's
-    NnBlockQ40 stream (reference: src/nn/nn-quants.hpp:64-67).
+    q: [..., in//32, 32, out] int8;  d: [..., in//32, out] f32.
+    Logical value[o, i] = q[i//32, i%32, o] * d[i//32, o].
     """
 
     q: jnp.ndarray
@@ -47,14 +56,15 @@ class QuantTensor:
 
     @property
     def out_features(self) -> int:
-        return self.q.shape[-3]
+        return self.q.shape[-1]
 
     @property
     def in_features(self) -> int:
-        return self.q.shape[-2] * self.q.shape[-1]
+        return self.q.shape[-3] * Q_BLOCK
 
     @property
     def shape(self) -> tuple:
+        """Logical [..., out_features, in_features] shape."""
         return (*self.q.shape[:-3], self.out_features, self.in_features)
 
     def tree_flatten(self):
@@ -65,27 +75,47 @@ class QuantTensor:
         return cls(*children)
 
 
+def q40_to_t_layout(q: np.ndarray, d: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side transform from the file layout ([out, in//32, 32] values +
+    [out, in//32] scales, `unpack_q40`) to the device T layout. The single
+    source of truth for the layout contract — used by both the param loader
+    and `quant_tensor_from_q40`."""
+    qt = np.ascontiguousarray(np.transpose(q, (1, 2, 0)))
+    dt = np.ascontiguousarray(np.transpose(d, (1, 0))).astype(np.float32)
+    return qt, dt
+
+
 def quant_tensor_from_q40(q: np.ndarray, d: np.ndarray) -> QuantTensor:
-    """From host-side unpack_q40 output reshaped to [out, in//32, 32]/[out, in//32]."""
-    return QuantTensor(q=jnp.asarray(q, dtype=jnp.int8), d=jnp.asarray(d, dtype=jnp.float32))
+    """From host-side `unpack_q40` output reshaped to [out, in//32, 32] /
+    [out, in//32] (the file layout): transpose into the device T layout."""
+    qt, dt = q40_to_t_layout(q, d)
+    return QuantTensor(q=jnp.asarray(qt), d=jnp.asarray(dt))
 
 
 def dequantize(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
-    """Materialize [..., out_features, in_features] in `dtype`."""
-    x = w.q.astype(dtype) * w.d[..., None].astype(dtype)
-    return x.reshape(w.shape)
+    """Materialize the logical [..., out_features, in_features] weight."""
+    x = w.q.astype(jnp.float32) * w.d[..., None, :]  # [..., nb, 32, out]
+    x = x.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
+    return jnp.swapaxes(x, -1, -2).astype(dtype)
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("DLT_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
 
 
 @partial(jax.jit, static_argnames=("dtype",))
 def _quant_matmul_xla(x, q, d, dtype):
-    w = (q.astype(dtype) * d[..., None].astype(dtype)).reshape(q.shape[-3], -1)
-    # f32 operands get full-precision accumulation (parity tests); bf16
-    # operands are the MXU-native fast path where precision is moot.
+    # w [in, out] dequantized on the fly; dequant multiply in f32 (scale
+    # precision), operands cast to `dtype` for the MXU
+    w = (q.astype(jnp.float32) * d[:, None, :]).astype(dtype)
+    w = w.reshape(q.shape[-3] * Q_BLOCK, q.shape[-1])
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     return jax.lax.dot_general(
         x.astype(dtype),
         w,
-        (((x.ndim - 1,), (1,)), ((), ())),
+        (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=precision,
     )
@@ -94,12 +124,18 @@ def _quant_matmul_xla(x, q, d, dtype):
 def quant_matmul(
     x: jnp.ndarray, w: QuantTensor, dtype=jnp.bfloat16, out_dtype=None
 ) -> jnp.ndarray:
-    """``x @ w.T`` for a Q40 weight; x: [..., in_features] -> [..., out_features].
+    """``x @ w.T`` (logical): x [..., in_features] -> [..., out_features].
 
-    `dtype` is the dequantized-operand dtype fed to the MXU (bf16 for speed,
-    f32 for parity tests); accumulation is always f32.
+    `dtype` is the MXU operand dtype (bf16 fast path, f32 parity path);
+    accumulation is always f32. Dispatches to the fused Pallas kernel on TPU
+    when shapes are tile-aligned, else the XLA dequant+dot fallback.
     """
-    out = _quant_matmul_xla(x, w.q, w.d, dtype)
+    from .pallas_q40 import q40_matmul_aligned, q40_matmul_pallas
+
+    if _use_pallas() and q40_matmul_aligned(x, w):
+        out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype)
+    else:
+        out = _quant_matmul_xla(x, w.q, w.d, dtype)
     return out.astype(out_dtype if out_dtype is not None else x.dtype)
 
 
@@ -108,7 +144,7 @@ def quantize_q80_activations(x: jnp.ndarray) -> jnp.ndarray:
 
     Emulates the reference's `--buffer-float-type q80` activation path
     (reference: quantizeF32toQ80, src/nn/nn-quants.cpp:67-…) for parity
-    testing: returns f32 values equal to dequantize(quantize(x)).
+    testing: returns values equal to dequantize(quantize(x)).
     """
     shape = x.shape
     xf = x.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // Q_BLOCK, Q_BLOCK)

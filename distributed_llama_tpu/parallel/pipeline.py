@@ -49,11 +49,12 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
     def entry(quant_pair, dense):
         return {"quant": quant_pair, "dense": dense}
 
-    row = entry((_ns("pp", "tp", None, None), _ns("pp", "tp", None)), _ns("pp", "tp", None))
-    col = entry((_ns("pp", None, "tp", None), _ns("pp", None, "tp")), _ns("pp", None, "tp"))
-    erow = entry((_ns("pp", None, "tp", None, None), _ns("pp", None, "tp", None)),
+    # T-layout quant pairs (ops/quant.py): q [L, nb, 32, out], d [L, nb, out]
+    row = entry((_ns("pp", None, None, "tp"), _ns("pp", None, "tp")), _ns("pp", "tp", None))
+    col = entry((_ns("pp", "tp", None, None), _ns("pp", "tp", None)), _ns("pp", None, "tp"))
+    erow = entry((_ns("pp", None, None, None, "tp"), _ns("pp", None, None, "tp")),
                  _ns("pp", None, "tp", None))
-    ecol = entry((_ns("pp", None, None, "tp", None), _ns("pp", None, None, "tp")),
+    ecol = entry((_ns("pp", None, "tp", None, None), _ns("pp", None, "tp", None)),
                  _ns("pp", None, None, "tp"))
     lrep = entry((_ns("pp"), _ns("pp")), _ns("pp"))  # per-layer vectors
     rep = entry((_ns(), _ns()), _ns())
@@ -66,7 +67,7 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "w1": erow if moe else row,
         "w3": erow if moe else row,
         "w2": ecol if moe else col,
-        "wcls": entry((_ns("tp", None, None), _ns("tp", None)), _ns("tp", None)),
+        "wcls": entry((_ns(None, None, "tp"), _ns(None, "tp")), _ns("tp", None)),
         "embedding": rep,
         "final_norm": rep,
         "norm0": lrep,
@@ -122,6 +123,11 @@ def pipeline_forward(
     shard_map program once per (cfg, mesh, mode, specs) and caches the
     jitted function.
     """
+    if jnp.shape(tokens)[-1] % max(microbatches, 1) != 0:
+        raise ValueError(
+            f"microbatches ({microbatches}) must divide the token length "
+            f"({jnp.shape(tokens)[-1]})"
+        )
     params_leaves, params_def = jax.tree.flatten(params)
     cache_leaves, cache_def = jax.tree.flatten(cache)
     params_spec = jax.tree.unflatten(params_def, [_spec_of(a) for a in params_leaves])
@@ -155,7 +161,7 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
     def run(params, rope_t, cache, tokens, pos_start):
         pp_rank = jax.lax.axis_index("pp")
         b, t = tokens.shape
-        n_micro = microbatches if t % max(microbatches, 1) == 0 else 1
+        n_micro = max(microbatches, 1)
         mt = t // n_micro
 
         k_cache, v_cache = cache.k, cache.v  # [L_local, b, seq, kvh_local, hd]

@@ -19,8 +19,10 @@ hand-codes: an all-reduce over the TP group after the attention and FFN
 output projections and after logits (reference: SYNC_NODE_SLICES at
 src/llm.cpp:418,569,633).
 
-Q40 weights are (q, d) component pairs; both components shard on the same
-logical axis (q: [L, out, in/32, 32], d: [L, out, in/32]).
+Q40 weights are (q, d) component pairs in the T layout (ops/quant.py):
+q: [L, in/32, 32, out], d: [L, in/32, out]. The out axis is the LAST axis
+(row-split shards it); the in axis is the blocks axis at index 1 (col-split
+shards it). Dense weights remain logical [L, out, in].
 
 Constraint carried over from the reference (src/app.cpp:341-343):
 tp must divide n_kv_heads (and the per-32-block count for col-splits).
@@ -45,16 +47,18 @@ def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
     def entry(quant_pair, dense):
         return {"quant": quant_pair, "dense": dense}
 
-    # [L, out, in] row-split -> shard axis 1; quant pair: q [L,out,b,32] d [L,out,b]
-    row = entry((_ns(mesh, None, "tp", None, None), _ns(mesh, None, "tp", None)),
+    # Quant weights use the T layout (ops/quant.py): q [L, nb, 32, out],
+    # d [L, nb, out]; dense weights stay [L, out, in].
+    # row-split = shard the out axis (q/d last axis; dense axis 1)
+    row = entry((_ns(mesh, None, None, None, "tp"), _ns(mesh, None, None, "tp")),
                 _ns(mesh, None, "tp", None))
-    # [L, out, in] col-split -> shard axis 2 (blocks axis for q components)
-    col = entry((_ns(mesh, None, None, "tp", None), _ns(mesh, None, None, "tp")),
+    # col-split = shard the in axis (q/d blocks axis; dense axis 2)
+    col = entry((_ns(mesh, None, "tp", None, None), _ns(mesh, None, "tp", None)),
                 _ns(mesh, None, None, "tp"))
-    # MoE expert stacks: [L, E, out, in] — ff axis sharded (TP-within-expert)
-    erow = entry((_ns(mesh, None, None, "tp", None, None), _ns(mesh, None, None, "tp", None)),
+    # MoE expert stacks: [L, E, ...] — ff axis sharded (TP-within-expert)
+    erow = entry((_ns(mesh, None, None, None, None, "tp"), _ns(mesh, None, None, None, "tp")),
                  _ns(mesh, None, None, "tp", None))
-    ecol = entry((_ns(mesh, None, None, None, "tp", None), _ns(mesh, None, None, None, "tp")),
+    ecol = entry((_ns(mesh, None, None, "tp", None, None), _ns(mesh, None, None, "tp", None)),
                  _ns(mesh, None, None, None, "tp"))
     rep = entry((_ns(mesh), _ns(mesh)), _ns(mesh))
 
@@ -66,8 +70,9 @@ def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "w1": erow if moe else row,
         "w3": erow if moe else row,
         "w2": ecol if moe else col,
-        # wcls: [vocab, dim] row-split over vocab; quant pair [vocab,b,32]/[vocab,b]
-        "wcls": entry((_ns(mesh, "tp", None, None), _ns(mesh, "tp", None)), _ns(mesh, "tp", None)),
+        # wcls row-split over vocab: quant q [nb, 32, vocab] / d [nb, vocab];
+        # dense [vocab, dim]
+        "wcls": entry((_ns(mesh, None, None, "tp"), _ns(mesh, None, "tp")), _ns(mesh, "tp", None)),
         "embedding": rep,
         "final_norm": rep,
         "norm0": rep,

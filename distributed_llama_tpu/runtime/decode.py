@@ -7,10 +7,11 @@ device->host logits fetch — tens of ms through the driver tunnel, dwarfing
 the ~1 ms of actual 1B-model compute.
 
 So the decode loop itself is a `lax.scan` on device: K forward steps +
-on-device sampling per host call, returning K tokens in one transfer. The
-host overlaps fetching chunk i with computing chunk i+1 (both live on
-device), making steady-state decode throughput compute-bound. EOS is checked
-between chunks; at most K-1 tokens of overrun compute are discarded.
+on-device sampling per host call, returning K tokens in one transfer — the
+per-token host cost is amortized by K. EOS is checked between chunks; at
+most K-1 tokens of overrun compute are discarded. (Planned: dispatch chunk
+i+1 before fetching chunk i's tokens — both inputs are device-resident — to
+overlap the fetch with compute entirely.)
 """
 
 from __future__ import annotations

@@ -150,14 +150,16 @@ class InferenceEngine:
         )
         return np.asarray(logits)
 
-    def prefill(self, tokens: list[int], pos_start: int = 0, on_chunk=None) -> np.ndarray | None:
+    def prefill(self, tokens: list[int], pos_start: int = 0, on_chunk=None) -> None:
         """Feed `tokens` through the model in padded power-of-two chunks.
 
-        Returns the logits after the final real token (or None if tokens is
-        empty). `on_chunk(timing)` is called per chunk with wall timing.
+        Only the KV cache matters here: logits for the first generated token
+        come from the subsequent decode step feeding the final prompt token
+        (the reference's shape: prefill covers nInputTokens-1 tokens,
+        dllama.cpp:44-85), so chunks run with logits_mode="last" (one wcls
+        row) and nothing is fetched to the host.
         """
         buckets = _chunk_buckets(self.max_chunk)
-        logits = None
         i = 0
         n = len(tokens)
         while i < n:
@@ -171,15 +173,13 @@ class InferenceEngine:
             arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
             out, self.cache = forward(
                 self.cfg, self.params, self.rope, self.cache, arr,
-                jnp.int32(pos_start + i), logits_mode="all",
+                jnp.int32(pos_start + i), logits_mode="last",
             )
             out.block_until_ready()
             dt = int((time.perf_counter() - t0) * 1e6)
             if on_chunk is not None:
                 on_chunk(StepTiming(eval_us=dt, n_tokens=n_real))
-            logits = np.asarray(out[:, n_real - 1, :])
             i += n_real
-        return logits
 
     def decode_one(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns host logits [batch, vocab]."""
@@ -268,9 +268,13 @@ class InferenceEngine:
         tok_arr = jnp.full((self.batch,), token, dtype=jnp.int32)
         first = True
         while pos < max_pos:
+            # largest power-of-two chunk that fits the remaining budget —
+            # O(log chunk) compiled programs, no per-token tail round trips
+            limit = min(max_pos, self.cfg.seq_len) - pos
             n = self.decode_chunk_size
-            if pos + n > max_pos or pos + n > self.cfg.seq_len:
-                n = 1  # tail: fall back to single-step chunks (bounded compiles)
+            while n > limit:
+                n //= 2
+            n = max(n, 1)
             t0 = time.perf_counter()
             key, sub = jax.random.split(key)
             toks, self.cache = decode_chunk(
