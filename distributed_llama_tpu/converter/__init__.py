@@ -1,0 +1,12 @@
+"""Offline tooling: HF checkpoint -> `.m`, HF/sentencepiece tokenizer -> `.t`.
+
+Python ports of the reference converter pipeline (reference: converter/
+convert-hf.py, convert-tokenizer-hf.py, writer.py, tokenizer-writer.py)
+built on this package's own format writers (formats/mfile.py, formats/
+tfile.py), so converted files are readable by both this framework and the
+reference engine.
+"""
+
+from .convert_hf import convert_hf, load_hf_config
+
+__all__ = ["convert_hf", "load_hf_config"]

@@ -1,0 +1,232 @@
+"""HF safetensors checkpoint -> `.m` model file.
+
+Mirrors the reference converter exactly (reference: converter/convert-hf.py):
+
+* same tensor plan order as `formats.mfile.tensor_walk`;
+* the Llama q/k **permute** (reference: convert-hf.py:13-16): HF stores q/k
+  for half-split (NeoX) rope; the reference's runtime rope is interleaved
+  (ropeLlama_F32), and the permute reorders head rows so the two are
+  equivalent. Qwen3 keeps HF layout (Falcon/NeoX rope at runtime);
+* `lm_head.weight` falls back to `model.embed_tokens.weight` for
+  tied-embedding checkpoints (reference: convert-hf.py plan tail);
+* header keys from config.json (arch/dims/rope/eps), f32 norm vectors, the
+  chosen weight float type for matmul weights.
+
+Implementation differences (host tooling, not TPU-relevant): tensors load
+via `safetensors.numpy` per-tensor instead of torch, and files stream
+one tensor at a time so peak memory is one tensor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..formats import mfile
+from ..formats.mfile import ArchType, MFileWriter
+from ..formats.quants import FloatType
+
+
+def permute_qk(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Reorder rows of a [heads*head_dim, dim] projection from NeoX-rope
+    layout to interleaved-rope layout (reference: convert-hf.py:13-16).
+
+    Per head: rows [0..hd/2) and [hd/2..hd) interleave to (0, hd/2, 1,
+    hd/2+1, ...), expressed as the reference's reshape/swapaxes dance.
+    """
+    rows = w.shape[0]
+    head_dim = rows // n_heads
+    return (
+        w.reshape(n_heads, 2, head_dim // 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+_ARCH = {
+    "llama": ArchType.LLAMA,
+    "mistral": ArchType.LLAMA,
+    "qwen3": ArchType.QWEN3,
+    "qwen3_moe": ArchType.QWEN3_MOE,
+}
+_ACT = {"gelu": 0, "silu": 1}
+
+
+def load_hf_config(folder: str) -> dict:
+    with open(os.path.join(folder, "config.json")) as f:
+        return json.load(f)
+
+
+def header_kv_from_config(config: dict, weight_type: int, max_seq_len: int = 0) -> dict:
+    arch = _ARCH.get(config["model_type"])
+    if arch is None:
+        raise ValueError(f"unsupported arch type: {config['model_type']}")
+    seq_len = config["max_position_embeddings"]
+    if max_seq_len and seq_len > max_seq_len:
+        seq_len = max_seq_len
+    kv = {
+        mfile.K_VERSION: 0,
+        mfile.K_ARCH_TYPE: arch,
+        mfile.K_DIM: config["hidden_size"],
+        mfile.K_HIDDEN_DIM: config["intermediate_size"],
+        mfile.K_N_LAYERS: config["num_hidden_layers"],
+        mfile.K_N_HEADS: config["num_attention_heads"],
+        mfile.K_N_KV_HEADS: config["num_key_value_heads"],
+        mfile.K_N_EXPERTS: int(config.get("num_experts") or 0),
+        mfile.K_N_ACTIVE_EXPERTS: int(config.get("num_experts_per_tok") or 0),
+        mfile.K_VOCAB_SIZE: config["vocab_size"],
+        mfile.K_SEQ_LEN: seq_len,
+        mfile.K_HIDDEN_ACT: _ACT[config["hidden_act"]],
+        mfile.K_WEIGHT_FLOAT_TYPE: weight_type,
+    }
+    if config.get("rope_theta") is not None:
+        kv[mfile.K_ROPE_THETA] = int(config["rope_theta"])
+    scaling = config.get("rope_scaling")
+    if scaling is not None:
+        if scaling.get("rope_type", scaling.get("type")) != "llama3":
+            raise ValueError(f"unsupported rope scaling: {scaling}")
+        kv[mfile.K_ROPE_SCALING_FACTOR] = int(scaling["factor"])
+        kv[mfile.K_ROPE_SCALING_LOW_FREQ_FACTOR] = int(scaling["low_freq_factor"])
+        kv[mfile.K_ROPE_SCALING_HIGH_FREQ_FACTORY] = int(scaling["high_freq_factor"])
+        kv[mfile.K_ROPE_SCALING_ORIG_MAX_SEQ_LEN] = int(
+            scaling["original_max_position_embeddings"]
+        )
+        kv[mfile.K_ROPE_TYPE] = mfile.RopeType.LLAMA3_1
+    if config.get("head_dim"):
+        kv[mfile.K_HEAD_DIM] = config["head_dim"]
+    eps = config.get("rms_norm_eps", 1e-5)
+    eps_code = round(-__import__("math").log10(eps))
+    if eps_code not in (5, 6) or abs(eps - 10.0**-eps_code) > 1e-12:
+        raise ValueError(
+            f"unsupported rms_norm_eps {eps}: the .m format encodes only 1e-5/1e-6 "
+            "(reference: src/llm.cpp:31-35)"
+        )
+    kv[mfile.K_NORM_EPSILON] = eps_code
+    if config.get("moe_intermediate_size"):
+        kv[mfile.K_MOE_HIDDEN_DIM] = config["moe_intermediate_size"]
+    return kv
+
+
+class _TensorSource:
+    """Lazy multi-file safetensors lookup (numpy framework, one file open at
+    a time — the reference converter's model-file walking, simplified)."""
+
+    def __init__(self, folder: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.files = sorted(
+            os.path.join(folder, f)
+            for f in os.listdir(folder)
+            if f.endswith(".safetensors") and not f.startswith(".")
+        )
+        if not self.files:
+            raise FileNotFoundError(f"no .safetensors files in {folder}")
+        self.key_to_file: dict[str, str] = {}
+        for path in self.files:
+            with self._safe_open(path, framework="numpy") as f:
+                for k in f.keys():
+                    self.key_to_file[k] = path
+        self._open_path = None
+        self._open_file = None
+
+    def get(self, *names: str) -> np.ndarray | None:
+        for name in names:
+            path = self.key_to_file.get(name)
+            if path is None:
+                continue
+            if self._open_path != path:
+                if self._open_file is not None:
+                    del self._open_file
+                self._open_file = self._safe_open(path, framework="numpy").__enter__()
+                self._open_path = path
+            return np.asarray(self._open_file.get_tensor(name), dtype=np.float32)
+        return None
+
+
+def convert_hf(
+    folder: str,
+    out_path: str,
+    weight_type_name: str = "q40",
+    max_seq_len: int = 0,
+    progress=print,
+) -> None:
+    """Convert an HF checkpoint folder to a `.m` file."""
+    config = load_hf_config(folder)
+    wt = FloatType.parse(weight_type_name)
+    kv = header_kv_from_config(config, wt, max_seq_len=max_seq_len)
+    arch = kv[mfile.K_ARCH_TYPE]
+    n_layers = kv[mfile.K_N_LAYERS]
+    n_heads = kv[mfile.K_N_HEADS]
+    n_kv_heads = kv[mfile.K_N_KV_HEADS]
+    n_experts = kv[mfile.K_N_EXPERTS]
+    is_qwen = arch in (ArchType.QWEN3, ArchType.QWEN3_MOE)
+    src = _TensorSource(folder)
+
+    def q_transform(w):
+        # reference permute() collapses to kv-heads for k; for q it uses
+        # n_heads (convert-hf.py:49-56)
+        return permute_qk(w, n_heads) if arch == ArchType.LLAMA else w
+
+    def k_transform(w):
+        return permute_qk(w, n_kv_heads) if arch == ArchType.LLAMA else w
+
+    with MFileWriter(out_path, kv) as out:
+        def write(ft, *names, transform=None):
+            w = src.get(*names)
+            if w is None:
+                raise KeyError(f"tensor not found: {names[0]}")
+            if transform is not None:
+                w = transform(w)
+            progress(f"🔶 writing {names[0]} {tuple(w.shape)}")
+            out.write_tensor(w, ft)
+
+        write(FloatType.F32, "model.embed_tokens.weight")
+        for l in range(n_layers):
+            pre = f"model.layers.{l}"
+            write(wt, f"{pre}.self_attn.q_proj.weight", transform=q_transform)
+            write(wt, f"{pre}.self_attn.k_proj.weight", transform=k_transform)
+            write(wt, f"{pre}.self_attn.v_proj.weight")
+            write(wt, f"{pre}.self_attn.o_proj.weight")
+            if n_experts > 0:
+                write(FloatType.F32, f"{pre}.mlp.gate.weight")
+                for e in range(n_experts):
+                    write(wt, f"{pre}.mlp.experts.{e}.gate_proj.weight")
+                    write(wt, f"{pre}.mlp.experts.{e}.down_proj.weight")
+                    write(wt, f"{pre}.mlp.experts.{e}.up_proj.weight")
+            else:
+                write(wt, f"{pre}.mlp.gate_proj.weight")
+                write(wt, f"{pre}.mlp.down_proj.weight")
+                write(wt, f"{pre}.mlp.up_proj.weight")
+            if is_qwen:
+                write(FloatType.F32, f"{pre}.self_attn.q_norm.weight")
+                write(FloatType.F32, f"{pre}.self_attn.k_norm.weight")
+            write(FloatType.F32, f"{pre}.input_layernorm.weight")
+            write(FloatType.F32, f"{pre}.post_attention_layernorm.weight")
+        write(FloatType.F32, "model.norm.weight")
+        write(wt, "lm_head.weight", "model.embed_tokens.weight")
+    progress(f"✅ wrote {out_path}")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="convert-hf")
+    p.add_argument("folder")
+    p.add_argument("weight_type", choices=["f32", "f16", "q40", "q80"])
+    p.add_argument("name")
+    p.add_argument("--max-seq-len", type=int, default=0)
+    args = p.parse_args(argv)
+    convert_hf(
+        args.folder,
+        f"dllama_model_{args.name}_{args.weight_type}.m",
+        args.weight_type,
+        max_seq_len=args.max_seq_len,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,102 @@
+"""HF fast-tokenizer folder -> `.t` tokenizer file.
+
+Port of the reference tokenizer converter (reference:
+converter/convert-tokenizer-hf.py): vocab ids decode through the GPT-2
+unicode->byte table, scores are ``-id`` (so BPE merge order follows id
+order), bos/eos come from tokenizer_config.json / config.json, and the HF
+chat template string ships inside the `.t` for runtime auto-detection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..formats.tfile import TokenizerData, write_tfile
+
+
+def unicode_to_bytes() -> dict[str, int]:
+    # GPT-2 byte-encoder table (reference: convert-tokenizer-hf.py:12-24)
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(2**8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2**8 + n)
+            n += 1
+    return dict(zip([chr(c) for c in cs], bs))
+
+
+def _open_json(path: str):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def convert_tokenizer_hf(folder: str, out_path: str) -> TokenizerData:
+    from transformers import PreTrainedTokenizerFast
+
+    utb = unicode_to_bytes()
+    tok = PreTrainedTokenizerFast(tokenizer_file=os.path.join(folder, "tokenizer.json"))
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for i in range(len(tok.get_vocab())):
+        chars = list(tok.convert_ids_to_tokens([i])[0])
+        token_bytes = b""
+        for ch in chars:
+            if ch in utb:
+                token_bytes += bytes([utb[ch]])
+            else:
+                token_bytes += ch.encode("utf-8")
+        vocab.append(token_bytes)
+        scores.append(-float(i))
+
+    bos_id = tok.bos_token_id
+    eos_ids = [tok.eos_token_id] if tok.eos_token_id is not None else None
+    if bos_id is None or eos_ids is None:
+        config = _open_json(os.path.join(folder, "config.json"))
+        if bos_id is None:
+            bos_id = config["bos_token_id"]
+        if eos_ids is None:
+            e = config["eos_token_id"]
+            eos_ids = e if isinstance(e, list) else [e]
+
+    chat_template = None
+    tc_path = os.path.join(folder, "tokenizer_config.json")
+    if os.path.exists(tc_path):
+        tc = _open_json(tc_path)
+        chat_template = tc.get("chat_template")
+        add_bos = tc.get("add_bos_token", True)
+    else:
+        add_bos = True
+
+    data = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id if bos_id is not None else -1,
+        eos_token_ids=eos_ids,
+        add_bos=bool(add_bos),
+        chat_template=chat_template,
+        max_token_length=max((len(v) for v in vocab), default=1),
+    )
+    write_tfile(out_path, data)
+    return data
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="convert-tokenizer-hf")
+    p.add_argument("folder")
+    p.add_argument("name")
+    args = p.parse_args(argv)
+    convert_tokenizer_hf(args.folder, f"dllama_tokenizer_{args.name}.t")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

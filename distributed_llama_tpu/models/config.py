@@ -35,6 +35,11 @@ class ModelConfig:
     # cache_dtype: KV cache storage dtype (the reference caches f32;
     # bf16 halves HBM traffic at negligible quality cost).
     cache_dtype: str = "bfloat16"
+    # use_pallas: None = auto (on when running on TPU). The GSPMD engine path
+    # forces False — XLA cannot partition a pallas_call over NamedSharding-ed
+    # operands, so sharded-jit execution must use the XLA dequant path; the
+    # shard_map pipeline path re-enables it (kernels see local shards there).
+    use_pallas: bool | None = None
 
     @property
     def q_dim(self) -> int:

@@ -36,10 +36,10 @@ from .config import ModelConfig
 from .params import KVCache, LayerParams, ModelParams
 
 
-def linear(x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
+def linear(x: jnp.ndarray, w: Any, dtype, pallas=None) -> jnp.ndarray:
     """x @ w.T for a dense or Q40 weight; returns x.dtype."""
     if isinstance(w, QuantTensor):
-        return quant_matmul(x, w, dtype=dtype)
+        return quant_matmul(x, w, dtype=dtype, pallas=pallas)
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     y = jax.lax.dot_general(
         x.astype(dtype),
@@ -56,8 +56,8 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
-    h = _activation(cfg, linear(y, lp.w1, cfg.dtype)) * linear(y, lp.w3, cfg.dtype)
-    return linear(h, lp.w2, cfg.dtype)
+    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas)
+    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas)
 
 
 def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
@@ -128,9 +128,9 @@ def _layer(
     # head counts come from the weight shapes, not cfg: under shard_map the
     # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
     # src/nn/nn-core.cpp:280-287)
-    q = linear(y, lp.q, cfg.dtype)
-    k = linear(y, lp.k, cfg.dtype)
-    v = linear(y, lp.v, cfg.dtype)
+    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas)
+    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas)
+    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas)
     q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
     k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
     v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
@@ -151,7 +151,7 @@ def _layer(
 
     a = gqa_attention(q, k_cache, v_cache, positions)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
-    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype)
+    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas)
     x = x + reduce_fn(att_out).astype(x.dtype)
 
     # --- ffn block ---
@@ -193,7 +193,7 @@ def forward_uncompiled(
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":
         x = x[:, -1, :]
-    logits = linear(x, params.wcls, cfg.dtype)
+    logits = linear(x, params.wcls, cfg.dtype, cfg.use_pallas)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
 
 

@@ -32,8 +32,10 @@ DEFAULT_TILE_KNB = 64  # 64 blocks = 2048 input features per k step
 
 
 def q40_matmul_aligned(x, w) -> bool:
-    """Kernel supports: lane-aligned out, k divisible into whole blocks, and
-    a 2D-flattenable x. (Unaligned/expert-stacked weights use the XLA path.)"""
+    """Kernel supports: an unstacked (3D) weight with lane-aligned
+    out_features and a matching x. (Unaligned weights fall back to the XLA
+    dequant path; expert stacks never reach quant_matmul — they go through
+    models.transformer._expert_matmul.)"""
     return (
         w.q.ndim == 3
         and w.out_features % LANE == 0

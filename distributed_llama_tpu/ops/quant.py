@@ -122,17 +122,23 @@ def _quant_matmul_xla(x, q, d, dtype):
 
 
 def quant_matmul(
-    x: jnp.ndarray, w: QuantTensor, dtype=jnp.bfloat16, out_dtype=None
+    x: jnp.ndarray, w: QuantTensor, dtype=jnp.bfloat16, out_dtype=None, pallas=None
 ) -> jnp.ndarray:
     """``x @ w.T`` (logical): x [..., in_features] -> [..., out_features].
+    Only 3D (unstacked) QuantTensors are supported here — expert stacks go
+    through models.transformer._expert_matmul.
 
     `dtype` is the MXU operand dtype (bf16 fast path, f32 parity path);
-    accumulation is always f32. Dispatches to the fused Pallas kernel on TPU
-    when shapes are tile-aligned, else the XLA dequant+dot fallback.
+    accumulation is always f32. `pallas`: None = auto (fused Pallas kernel on
+    TPU when tile-aligned), False = force the XLA dequant+dot path (required
+    under GSPMD sharding — see ModelConfig.use_pallas), True = force-enable.
     """
     from .pallas_q40 import q40_matmul_aligned, q40_matmul_pallas
 
-    if _use_pallas() and q40_matmul_aligned(x, w):
+    assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
+    if pallas is None:
+        pallas = _use_pallas()
+    if pallas and q40_matmul_aligned(x, w):
         out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype)
     else:
         out = _quant_matmul_xla(x, w.q, w.d, dtype)

@@ -106,6 +106,9 @@ class InferenceEngine:
         if mesh is not None:
             from ..parallel import cache_shardings, param_shardings
 
+            # GSPMD cannot partition a pallas_call over sharded operands —
+            # force the XLA dequant path (ModelConfig.use_pallas docstring)
+            self.cfg = self.cfg.with_(use_pallas=False)
             shardings = param_shardings(mesh, moe=self.cfg.is_moe)
             self._cache_sharding = cache_shardings(mesh)
         self.params = load_params(self.reader, self.cfg, shardings=shardings)

@@ -1,0 +1,8 @@
+"""Serving layer: OpenAI-compatible HTTP API + load-balancer gateway.
+
+Python re-implementations of the reference's hand-rolled C++ servers
+(reference: src/dllama-api.cpp, src/dllama-gateway.cpp) with the same wire
+behavior: `/v1/chat/completions` (stream + non-stream), `/v1/models`, the
+naive KV-prefix cache across chat turns, and least-inflight backend
+selection with failure cooldown.
+"""

@@ -1,0 +1,338 @@
+"""OpenAI-compatible HTTP API server.
+
+Wire-compatible with the reference server (reference: src/dllama-api.cpp):
+
+* ``POST /v1/chat/completions`` — stream (SSE ``data: {chunk}\\r\\n\\r\\n``
+  terminated by ``data: [DONE]``) and non-stream; params `messages`,
+  `temperature`, `top_p`, `seed`, `max_tokens`, `stream`
+  (reference: parseRequest, dllama-api.cpp:501-530);
+* ``GET /v1/models`` — single-model list;
+* **naive prefix cache**: successive chat turns whose message prefix matches
+  the cached conversation resume decoding from the cached KV position
+  instead of re-prefilling (reference: NaiveCache, dllama-api.cpp:296-341).
+
+Requests are served sequentially (one engine, one KV cache) exactly like the
+reference's accept loop; horizontal scale comes from the gateway
+(server/gateway.py) across replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+
+from ..runtime.engine import InferenceEngine
+from ..tokenizer import (
+    ChatItem,
+    ChatTemplateGenerator,
+    EOS_FOUND,
+    EOS_MAYBE,
+    EosDetector,
+    Sampler,
+    TEMPLATE_UNKNOWN,
+    Tokenizer,
+)
+
+MODEL_NAME = "Distributed Model"
+
+
+class PromptTooLong(ValueError):
+    pass
+
+
+@dataclass
+class CacheItem:
+    end_pos: int
+    role: str
+    content: str
+
+
+class NaiveCache:
+    """KV-prefix reuse across chat turns (reference: dllama-api.cpp:296-341)."""
+
+    def __init__(self):
+        self.items: list[CacheItem] = []
+
+    def clear(self):
+        self.items = []
+
+    def push(self, end_pos: int, role: str, content: str):
+        self.items.append(CacheItem(end_pos, role, content))
+
+    def resolve_delta_prompt(self, messages: list[dict]) -> tuple[list[dict], int]:
+        """Returns (delta messages to prefill, start position)."""
+        n = len(self.items)
+        if n == 0:
+            return messages, 0
+        if len(messages) > n:
+            i = 0
+            while i < n:
+                if (
+                    self.items[i].role != messages[i]["role"]
+                    or self.items[i].content != messages[i]["content"]
+                ):
+                    break
+                i += 1
+            if i == n:
+                start = self.items[i - 1].end_pos
+                return messages[i:], start
+        self.cache_miss()
+        return messages, 0
+
+    def cache_miss(self):
+        self.items = []
+
+
+def chunk_json(delta: str | None, stop: bool) -> dict:
+    choice = {"index": 0, "finish_reason": "stop" if stop else ""}
+    if not stop:
+        choice["delta"] = {"role": "assistant", "content": delta or ""}
+    return {
+        "id": "cmpl-c0",
+        "object": "chat.completion",
+        "created": 0,
+        "model": MODEL_NAME,
+        "choices": [choice],
+    }
+
+
+class ApiState:
+    """Engine + tokenizer + cache shared by all requests (serialized)."""
+
+    def __init__(self, engine: InferenceEngine, tokenizer: Tokenizer, args):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.args = args
+        self.lock = threading.Lock()
+        self.naive_cache = NaiveCache()
+        self.sampler = Sampler(
+            engine.cfg.vocab_size,
+            args.temperature,
+            args.topp,
+            args.seed if args.seed is not None else 12345,
+        )
+        template_type = (
+            ChatTemplateGenerator.parse_type(args.chat_template)
+            if args.chat_template
+            else TEMPLATE_UNKNOWN
+        )
+        self.stops = [
+            tokenizer.piece(t).decode("utf-8", errors="replace")
+            for t in tokenizer.eos_token_ids
+        ]
+        self.template = ChatTemplateGenerator(
+            template_type, tokenizer.chat_template, self.stops[0] if self.stops else ""
+        )
+
+    def complete(self, params: dict, emit):
+        """Run one completion; calls emit(delta_text) per safe-to-send chunk.
+        Returns (full_text, n_prompt_tokens, n_completion_tokens)."""
+        engine, tok = self.engine, self.tokenizer
+        messages = params["messages"]
+        delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(messages)
+        if start_pos == 0:
+            engine.reset()
+
+        items = [ChatItem(m["role"], m["content"]) for m in delta_prompt]
+        prompt = self.template.generate(items, True)
+        ids = tok.encode(prompt.content, is_start=(start_pos == 0))
+        seq_len = engine.cfg.seq_len
+        if start_pos + len(ids) - 1 >= seq_len:
+            # the reference clamps silently and returns an empty completion
+            # (dllama-api.cpp:390-391); surface it as a client error instead
+            raise PromptTooLong(
+                f"prompt ({start_pos + len(ids)} tokens with cached prefix) "
+                f"exceeds the context window ({seq_len})"
+            )
+
+        prompt_end = start_pos + len(ids) - 1
+        max_tokens = params.get("max_tokens", -1)
+        max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
+
+        for m in delta_prompt:
+            self.naive_cache.push(prompt_end, m["role"], m["content"])
+
+        buffer = []
+        if prompt.public_prompt:
+            emit(prompt.public_prompt)
+            buffer.append(prompt.public_prompt)
+
+        engine.prefill(ids[: prompt_end - start_pos], start_pos)
+        token = ids[-1]
+
+        tok.reset_decoder()
+        detector = EosDetector(
+            tok.eos_token_ids,
+            self.stops,
+            max((len(s) for s in self.stops), default=0),
+            max((len(s) for s in self.stops), default=0),
+        )
+        self.sampler.set_temp(params.get("temperature", self.args.temperature))
+        if params.get("seed") is not None:
+            self.sampler.set_seed(params["seed"])
+        self.sampler.topp = params.get("top_p", self.args.topp)
+
+        pos = prompt_end
+        n_completion = 0
+        while pos < max_pred:
+            logits = engine.decode_one(token, pos)
+            token = self.sampler.sample(logits[0].copy())
+            piece = tok.decode(token)
+            eos_type = detector.append(token, piece)
+            if eos_type != EOS_MAYBE:
+                delta = detector.get_delta()
+                if delta:
+                    emit(delta)
+                    buffer.append(delta)
+                detector.reset()
+            pos += 1
+            n_completion += 1
+            if eos_type == EOS_FOUND:
+                break
+
+        text = "".join(buffer)
+        if pos >= seq_len:
+            self.naive_cache.clear()
+        else:
+            self.naive_cache.push(pos, "assistant", text)
+        return text, len(ids), n_completion
+
+
+class Handler(BaseHTTPRequestHandler):
+    state: ApiState = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if self.path == "/v1/models":
+            body = json.dumps(
+                {
+                    "object": "list",
+                    "data": [
+                        {"id": MODEL_NAME, "object": "model", "created": 0, "owned_by": "user"}
+                    ],
+                }
+            ).encode()
+            self._json(200, body)
+        elif self.path == "/health":
+            self._json(200, b'{"status":"ok"}')
+        else:
+            self._json(404, b'{"error":"not found"}')
+
+    def do_POST(self):
+        if self.path != "/v1/chat/completions":
+            self._json(404, b'{"error":"not found"}')
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            params = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._json(400, b'{"error":"bad json"}')
+            return
+        if "messages" not in params:
+            self._json(400, b'{"error":"messages required"}')
+            return
+
+        stream = bool(params.get("stream", False))
+        st = self.state
+        with st.lock:
+            if stream:
+                # headers go out lazily on the first emitted chunk, so a
+                # validation failure (e.g. prompt too long) can still return
+                # a clean 400 instead of a broken SSE stream
+                started = [False]
+
+                def start_stream():
+                    if not started[0]:
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/event-stream")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        started[0] = True
+
+                def emit(delta):
+                    start_stream()
+                    data = json.dumps(chunk_json(delta, False))
+                    self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                    self.wfile.flush()
+
+                try:
+                    text, n_prompt, n_completion = st.complete(params, emit)
+                except PromptTooLong as e:
+                    if not started[0]:
+                        self._json(400, json.dumps({"error": str(e)}).encode())
+                        return
+                    raise
+                start_stream()
+                data = json.dumps(chunk_json(None, True))
+                self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                self.wfile.write(b"data: [DONE]")
+                self.close_connection = True
+            else:
+                try:
+                    text, n_prompt, n_completion = st.complete(params, lambda d: None)
+                except PromptTooLong as e:
+                    self._json(400, json.dumps({"error": str(e)}).encode())
+                    return
+                body = json.dumps(
+                    {
+                        "id": "cmpl-j0",
+                        "object": "chat.completion",
+                        "created": 0,
+                        "model": MODEL_NAME,
+                        "usage": {
+                            "prompt_tokens": n_prompt,
+                            "completion_tokens": n_completion,
+                            "total_tokens": n_prompt + n_completion,
+                        },
+                        "choices": [
+                            {
+                                "index": 0,
+                                "message": {"role": "assistant", "content": text},
+                                "finish_reason": "",
+                            }
+                        ],
+                    }
+                ).encode()
+                self._json(200, body)
+
+    def _json(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve(args) -> HTTPServer:
+    """Build state and return a configured (unstarted) HTTPServer."""
+    from ..cli import make_engine
+
+    engine = make_engine(args)
+    tokenizer = Tokenizer(args.tokenizer)
+    Handler.state = ApiState(engine, tokenizer, args)
+    return HTTPServer(("0.0.0.0", args.port), Handler)
+
+
+def main(argv=None) -> int:
+    from ..cli import build_arg_parser
+
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=9990)
+    # mode positional comes from the shared parser; default it away
+    argv = ["inference"] + (argv if argv is not None else __import__("sys").argv[1:])
+    args = p.parse_args(argv)
+    httpd = serve(args)
+    print(f"🚧 Listening on port {args.port}...")
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

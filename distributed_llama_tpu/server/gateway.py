@@ -1,0 +1,211 @@
+"""Load-balancer gateway: reverse proxy over dllama-api replicas.
+
+Behavior-parity port of the reference gateway (reference:
+src/dllama-gateway.cpp):
+
+* backend selection: among healthy backends under their inflight cap, pick
+  least-inflight, tie-broken by a round-robin cursor
+  (selectBackendAndAcquire, dllama-gateway.cpp:266-301);
+* a failed backend is marked unhealthy for `health_retry_ms` and routed
+  around (releaseBackend, dllama-gateway.cpp:303-316);
+* all backends busy -> 429; backend I/O failure -> 502;
+* thread-per-connection, streaming the backend response through unchanged
+  (SSE included).
+
+On TPU serving this is the data-parallel axis: each backend is an
+independent engine replica (one chip or one mesh), exactly like the
+reference's replica-level DP (SURVEY.md §2 "DP / replica parallel").
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Backend:
+    host: str
+    port: int
+    inflight: int = 0
+    unhealthy_until: float = 0.0
+
+
+@dataclass
+class GatewayConfig:
+    backends: list
+    max_inflight_per_backend: int = 4
+    health_retry_ms: int = 3000
+    connect_timeout_s: float = 5.0
+
+
+class Balancer:
+    def __init__(self, config: GatewayConfig):
+        self.config = config
+        self.lock = threading.Lock()
+        self.rr_cursor = 0
+
+    def acquire(self) -> int:
+        """Returns backend index or -1 (all busy/unhealthy)."""
+        with self.lock:
+            now = time.monotonic()
+            n = len(self.config.backends)
+            selected, min_inflight = -1, None
+            for i in range(n):
+                idx = (self.rr_cursor + i) % n
+                b = self.config.backends[idx]
+                if b.unhealthy_until > now:
+                    continue
+                if b.inflight >= self.config.max_inflight_per_backend:
+                    continue
+                if min_inflight is None or b.inflight < min_inflight:
+                    min_inflight = b.inflight
+                    selected = idx
+            if selected >= 0:
+                self.config.backends[selected].inflight += 1
+                self.rr_cursor = (selected + 1) % n
+            return selected
+
+    def release(self, idx: int, mark_unhealthy: bool):
+        if idx < 0:
+            return
+        with self.lock:
+            b = self.config.backends[idx]
+            if b.inflight > 0:
+                b.inflight -= 1
+            if mark_unhealthy:
+                b.unhealthy_until = time.monotonic() + self.config.health_retry_ms / 1000.0
+
+
+def _read_http_request(sock: socket.socket) -> bytes | None:
+    """Read one full HTTP request (headers + Content-Length body)."""
+    sock.settimeout(30)
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = sock.recv(16384)
+        if not chunk:
+            return None if not data else data
+        data += chunk
+    head, _, rest = data.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n")[1:]:
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1].strip())
+    while len(rest) < length:
+        chunk = sock.recv(16384)
+        if not chunk:
+            break
+        rest += chunk
+    # force Connection: close on the upstream leg — the proxy streams until
+    # EOF, so a keep-alive backend response would hang it (clients sending
+    # keep-alive, e.g. curl, would otherwise stall here)
+    lines = [l for l in head.split(b"\r\n") if not l.lower().startswith(b"connection:")]
+    lines.append(b"Connection: close")
+    return b"\r\n".join(lines) + b"\r\n\r\n" + rest
+
+
+def _plain_response(sock: socket.socket, code: int, text: str, body: str):
+    payload = body.encode()
+    resp = (
+        f"HTTP/1.1 {code} {text}\r\n"
+        "Content-Type: application/json; charset=utf-8\r\n"
+        "Connection: close\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+    try:
+        sock.sendall(resp)
+    except OSError:
+        pass
+
+
+def handle_client(client: socket.socket, balancer: Balancer):
+    config = balancer.config
+    backend_idx = -1
+    try:
+        request = _read_http_request(client)
+        if not request:
+            return
+        backend_idx = balancer.acquire()
+        if backend_idx < 0:
+            _plain_response(client, 429, "Too Many Requests", '{"error":"all backends busy"}')
+            return
+        b = config.backends[backend_idx]
+        failed = False
+        forwarded = False
+        try:
+            with socket.create_connection(
+                (b.host, b.port), timeout=config.connect_timeout_s
+            ) as upstream:
+                upstream.sendall(request)
+                upstream.settimeout(600)
+                while True:
+                    chunk = upstream.recv(16384)
+                    if not chunk:
+                        break
+                    client.sendall(chunk)
+                    forwarded = True
+        except OSError:
+            failed = True
+            # only emit a 502 if nothing was forwarded yet — appending a
+            # second status line to a partially streamed response would
+            # corrupt the client's stream; mid-stream failures surface as EOF
+            if not forwarded:
+                _plain_response(client, 502, "Bad Gateway", '{"error":"backend failure"}')
+        balancer.release(backend_idx, mark_unhealthy=failed)
+        backend_idx = -1
+    finally:
+        if backend_idx >= 0:
+            balancer.release(backend_idx, mark_unhealthy=False)
+        try:
+            client.close()
+        except OSError:
+            pass
+
+
+def serve(port: int, balancer: Balancer) -> socket.socket:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(64)
+    return srv
+
+
+def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
+    srv = serve(port, balancer)
+    srv.settimeout(0.5)
+    print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
+    while stop_event is None or not stop_event.is_set():
+        try:
+            client, _ = srv.accept()
+        except socket.timeout:
+            continue
+        threading.Thread(target=handle_client, args=(client, balancer), daemon=True).start()
+    srv.close()
+
+
+def parse_backend(s: str) -> Backend:
+    host, port = s.rsplit(":", 1)
+    return Backend(host, int(port))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dllama-gateway")
+    p.add_argument("--port", type=int, default=9999)
+    p.add_argument("--backend", action="append", required=True, help="host:port (repeatable)")
+    p.add_argument("--max-inflight-per-backend", type=int, default=4)
+    p.add_argument("--health-retry-ms", type=int, default=3000)
+    args = p.parse_args(argv)
+    config = GatewayConfig(
+        backends=[parse_backend(b) for b in args.backend],
+        max_inflight_per_backend=args.max_inflight_per_backend,
+        health_retry_ms=args.health_retry_ms,
+    )
+    run(args.port, Balancer(config))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

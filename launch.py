@@ -1,0 +1,156 @@
+"""Model zoo launcher — download a converted model + tokenizer and run it.
+
+Port of the reference launcher (reference: launch.py): the same 11-model zoo
+of pre-converted `.m`/`.t` files (multi-part models are chunked `aa`, `ab`,
+... suffixes concatenated into one file), resumable chunked downloads with
+retries, then exec of the inference runtime — here
+`python -m distributed_llama_tpu.cli` instead of the `dllama` binary.
+
+Note: this build environment has no network egress; downloads will fail
+here, but the launcher is the supported path on a real TPU VM.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from urllib.request import urlopen
+
+
+def parts(length: int) -> list[str]:
+    return [chr(97 + i // 26) + chr(97 + i % 26) for i in range(length)]
+
+
+def _hf(repo: str, f: str) -> str:
+    return f"https://huggingface.co/{repo}/resolve/main/{f}?download=true"
+
+
+# name -> (model-part-urls, tokenizer-url, run-mode, extra-args)
+MODELS = {
+    "llama3_1_8b_instruct_q40": (
+        [_hf("b4rtaz/Llama-3_1-8B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.1_instruct_q40.m")],
+        _hf("b4rtaz/Llama-3_1-8B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama_3_1.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "llama3_1_405b_instruct_q40": (
+        [_hf("b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama", f"dllama_model_llama31_405b_q40_{s}") for s in parts(56)],
+        _hf("b4rtaz/Llama-3_1-405B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama_3_1.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "llama3_2_1b_instruct_q40": (
+        [_hf("b4rtaz/Llama-3_2-1B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.2-1b-instruct_q40.m")],
+        _hf("b4rtaz/Llama-3_2-1B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama3_2.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "llama3_2_3b_instruct_q40": (
+        [_hf("b4rtaz/Llama-3_2-3B-Q40-Instruct-Distributed-Llama", "dllama_model_llama3.2-3b-instruct_q40.m")],
+        _hf("b4rtaz/Llama-3_2-3B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama3_2.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "llama3_3_70b_instruct_q40": (
+        [_hf("b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama", f"dllama_model_llama-3.3-70b_q40{s}") for s in parts(11)],
+        _hf("b4rtaz/Llama-3_3-70B-Q40-Instruct-Distributed-Llama", "dllama_tokenizer_llama-3.3-70b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "deepseek_r1_distill_llama_8b_q40": (
+        [_hf("b4rtaz/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama", "dllama_model_deepseek-r1-distill-llama-8b_q40.m")],
+        _hf("b4rtaz/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama", "dllama_tokenizer_deepseek-r1-distill-llama-8b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "qwen3_0.6b_q40": (
+        [_hf("b4rtaz/Qwen3-0.6B-Q40-Distributed-Llama", "dllama_model_qwen3_0.6b_q40.m")],
+        _hf("b4rtaz/Qwen3-0.6B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_0.6b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "qwen3_1.7b_q40": (
+        [_hf("b4rtaz/Qwen3-1.7B-Q40-Distributed-Llama", "dllama_model_qwen3_1.7b_q40.m")],
+        _hf("b4rtaz/Qwen3-1.7B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_1.7b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "qwen3_8b_q40": (
+        [_hf("b4rtaz/Qwen3-8B-Q40-Distributed-Llama", "dllama_model_qwen3_8b_q40.m")],
+        _hf("b4rtaz/Qwen3-8B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_8b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "qwen3_14b_q40": (
+        [_hf("b4rtaz/Qwen3-14B-Q40-Distributed-Llama", f"dllama_model_qwen3_14b_q40_{s}") for s in parts(2)],
+        _hf("b4rtaz/Qwen3-14B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_14b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+    "qwen3_30b_a3b_q40": (
+        [_hf("b4rtaz/Qwen3-30B-A3B-Q40-Distributed-Llama", f"dllama_model_qwen3_30b_a3b_{s}") for s in parts(5)],
+        _hf("b4rtaz/Qwen3-30B-A3B-Q40-Distributed-Llama", "dllama_tokenizer_qwen3_30b_a3b.t"),
+        "chat", ["--max-seq-len", "4096"],
+    ),
+}
+
+
+def confirm(message: str) -> bool:
+    if "-y" in sys.argv:
+        return True
+    return input(f'❓ {message} ("Y" if yes): ').upper() in ("Y", "YES")
+
+
+def download_file(urls: list[str], path: str):
+    """Concatenate all `urls` into `path`, retrying each part with resume
+    (reference: launch.py downloadFile)."""
+    if os.path.isfile(path):
+        if not confirm(f"{os.path.basename(path)} already exists, download again?"):
+            return
+    socket.setdefaulttimeout(30)
+    # write to a .part file and rename only on success, so an interrupted
+    # download can never be mistaken for a complete model on the next run
+    part = path + ".part"
+    with open(part, "wb") as f:
+        for url in urls:
+            start = f.tell()
+            for attempt in range(8):
+                print(f"📄 {url} (attempt: {attempt})")
+                try:
+                    with urlopen(url) as response:
+                        while True:
+                            chunk = response.read(1 << 16)
+                            if not chunk:
+                                break
+                            f.write(chunk)
+                    break
+                except OSError as e:
+                    print(f"🚨 download error: {e}; retrying")
+                    f.seek(start)
+                    f.truncate()
+            else:
+                raise RuntimeError(f"failed to download {url}")
+    os.replace(part, path)
+
+
+def run(name: str):
+    model_urls, tok_url, mode, extra = MODELS[name]
+    os.makedirs("models", exist_ok=True)
+    model_path = os.path.join("models", f"{name}.m")
+    tok_path = os.path.join("models", f"{name}.t")
+    if not os.path.isfile(model_path):
+        download_file(model_urls, model_path)
+    if not os.path.isfile(tok_path):
+        download_file([tok_url], tok_path)
+    cmd = [
+        sys.executable, "-m", "distributed_llama_tpu.cli", mode,
+        "--model", model_path, "--tokenizer", tok_path,
+    ] + extra
+    print("🚀", " ".join(cmd))
+    os.execv(sys.executable, cmd)
+
+
+def main() -> int:
+    names = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if not names or names[0] not in MODELS:
+        print("usage: python launch.py <model> [-y]\n\nAvailable models:")
+        for n in MODELS:
+            print(f"  {n}")
+        return 1
+    run(names[0])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

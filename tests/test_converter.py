@@ -1,0 +1,167 @@
+"""Converter tests: a fabricated tiny HF checkpoint -> .m -> framework
+forward must equal an HF-convention numpy forward (NeoX rope, unpermuted
+q/k). This validates the q/k permute <-> interleaved-rope interplay
+(reference: converter/convert-hf.py:13-16 with ropeLlama_F32), SURVEY.md
+§7 "hard part (e)"."""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+safetensors = pytest.importorskip("safetensors.numpy")
+
+from distributed_llama_tpu.converter.convert_hf import convert_hf
+from distributed_llama_tpu.formats.mfile import MFileReader
+from distributed_llama_tpu.models import config_from_header, forward, init_kv_cache, load_params
+from distributed_llama_tpu.ops import build_rope_tables
+
+DIM, N_HEADS, N_KV, HIDDEN, VOCAB, LAYERS, SEQ = 64, 4, 2, 96, 128, 2, 64
+HEAD_DIM = DIM // N_HEADS
+
+
+def make_hf_checkpoint(d, rng):
+    cfg = {
+        "model_type": "llama",
+        "hidden_size": DIM,
+        "intermediate_size": HIDDEN,
+        "num_hidden_layers": LAYERS,
+        "num_attention_heads": N_HEADS,
+        "num_key_value_heads": N_KV,
+        "vocab_size": VOCAB,
+        "max_position_embeddings": SEQ,
+        "hidden_act": "silu",
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+    }
+    (d / "config.json").write_text(json.dumps(cfg))
+    t = {}
+    t["model.embed_tokens.weight"] = rng.standard_normal((VOCAB, DIM)).astype(np.float32) * 0.05
+    for l in range(LAYERS):
+        p = f"model.layers.{l}"
+        t[f"{p}.self_attn.q_proj.weight"] = rng.standard_normal((DIM, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.self_attn.k_proj.weight"] = rng.standard_normal((N_KV * HEAD_DIM, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.self_attn.v_proj.weight"] = rng.standard_normal((N_KV * HEAD_DIM, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.self_attn.o_proj.weight"] = rng.standard_normal((DIM, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.mlp.gate_proj.weight"] = rng.standard_normal((HIDDEN, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.mlp.down_proj.weight"] = rng.standard_normal((DIM, HIDDEN)).astype(np.float32) * 0.05
+        t[f"{p}.mlp.up_proj.weight"] = rng.standard_normal((HIDDEN, DIM)).astype(np.float32) * 0.05
+        t[f"{p}.input_layernorm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+        t[f"{p}.post_attention_layernorm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+    t["model.norm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+    # no lm_head -> tied embeddings fallback path
+    safetensors.save_file(t, str(d / "model.safetensors"))
+    return cfg, t
+
+
+def hf_numpy_forward(t, tokens):
+    """HF llama conventions: NeoX (half-split) rope on unpermuted q/k."""
+
+    def rms(x, w):
+        return w * x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5)
+
+    def rope_neox(x, pos):  # x [heads, hd]
+        half = HEAD_DIM // 2
+        out = x.copy()
+        for h in range(x.shape[0]):
+            for j in range(half):
+                freq = 1.0 / 10000.0 ** (2.0 * j / HEAD_DIM)
+                c, s = np.cos(pos * freq), np.sin(pos * freq)
+                a, b = x[h, j], x[h, j + half]
+                out[h, j] = a * c - b * s
+                out[h, j + half] = a * s + b * c
+        return out
+
+    kv_mul = N_HEADS // N_KV
+    caches = [([], []) for _ in range(LAYERS)]
+    logits = None
+    for pos, tok in enumerate(tokens):
+        x = t["model.embed_tokens.weight"][tok].astype(np.float64)
+        for l in range(LAYERS):
+            p = f"model.layers.{l}"
+            y = rms(x, t[f"{p}.input_layernorm.weight"])
+            q = (t[f"{p}.self_attn.q_proj.weight"] @ y).reshape(N_HEADS, HEAD_DIM)
+            k = (t[f"{p}.self_attn.k_proj.weight"] @ y).reshape(N_KV, HEAD_DIM)
+            v = (t[f"{p}.self_attn.v_proj.weight"] @ y).reshape(N_KV, HEAD_DIM)
+            q, k = rope_neox(q, pos), rope_neox(k, pos)
+            caches[l][0].append(k)
+            caches[l][1].append(v)
+            att = np.zeros((N_HEADS, HEAD_DIM))
+            for h in range(N_HEADS):
+                kh = h // kv_mul
+                sc = np.array(
+                    [q[h] @ caches[l][0][tt][kh] / np.sqrt(HEAD_DIM) for tt in range(pos + 1)]
+                )
+                e = np.exp(sc - sc.max())
+                a = e / e.sum()
+                for tt in range(pos + 1):
+                    att[h] += a[tt] * caches[l][1][tt][kh]
+            x = x + t[f"{p}.self_attn.o_proj.weight"] @ att.reshape(-1)
+            y = rms(x, t[f"{p}.post_attention_layernorm.weight"])
+            g = t[f"{p}.mlp.gate_proj.weight"] @ y
+            h_ = (g / (1 + np.exp(-g))) * (t[f"{p}.mlp.up_proj.weight"] @ y)
+            x = x + t[f"{p}.mlp.down_proj.weight"] @ h_
+        xf = rms(x, t["model.norm.weight"])
+        logits = t["model.embed_tokens.weight"] @ xf  # tied lm_head
+    return logits
+
+
+def test_convert_and_forward_matches_hf_semantics(tmp_path):
+    rng = np.random.default_rng(9)
+    cfg_json, tensors = make_hf_checkpoint(tmp_path, rng)
+    out = str(tmp_path / "model.m")
+    convert_hf(str(tmp_path), out, "f32", progress=lambda *a: None)
+
+    reader = MFileReader(out)
+    h = reader.header
+    assert h.dim == DIM and h.n_layers == LAYERS and h.n_kv_heads == N_KV
+
+    tokens = [3, 17, 90, 5]
+    want = hf_numpy_forward(tensors, tokens)
+
+    cfg = config_from_header(h, compute_dtype="float32")
+    params = load_params(reader, cfg)
+    rope = build_rope_tables(h)
+    cache = init_kv_cache(cfg, batch=1)
+    logits, _ = forward(
+        cfg, params, rope, cache, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits[0]), want, rtol=2e-3, atol=2e-3)
+
+
+def test_convert_q40_loads(tmp_path):
+    rng = np.random.default_rng(10)
+    make_hf_checkpoint(tmp_path, rng)
+    out = str(tmp_path / "model_q40.m")
+    convert_hf(str(tmp_path), out, "q40", progress=lambda *a: None)
+    reader = MFileReader(out)
+    cfg = config_from_header(reader.header, compute_dtype="float32")
+    params = load_params(reader, cfg)  # parses + unpacks every tensor
+    assert params.layers.norm0.shape == (LAYERS, DIM)
+
+
+def test_tokenizer_converter_round_trip(tmp_path):
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer as HFTok, models, pre_tokenizers
+
+    vocab = {chr(97 + i): i for i in range(26)}
+    vocab["ab"] = 26
+    vocab["<s>"] = 27
+    vocab["</s>"] = 28
+    hf = HFTok(models.BPE(vocab=vocab, merges=[("a", "b")]))
+    hf.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    hf.save(str(tmp_path / "tokenizer.json"))
+    (tmp_path / "config.json").write_text(json.dumps({"bos_token_id": 27, "eos_token_id": 28}))
+    (tmp_path / "tokenizer_config.json").write_text(json.dumps({"add_bos_token": True}))
+
+    from distributed_llama_tpu.converter.convert_tokenizer_hf import convert_tokenizer_hf
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    out = str(tmp_path / "t.t")
+    data = convert_tokenizer_hf(str(tmp_path), out)
+    assert data.bos_id == 27 and data.eos_token_ids == [28]
+    tok = Tokenizer(out)
+    assert tok.vocab_size == 29
+    ids = tok.encode("ab", add_special_tokens=False)
+    assert ids[-1] == 26  # merged pair wins (scores follow id order)
