@@ -73,6 +73,7 @@ def make_engine(args) -> InferenceEngine:
         max_seq_len=args.max_seq_len,
         max_chunk=max_chunk,
         mesh=mesh,
+        verbose=True,
     )
 
 
@@ -126,6 +127,8 @@ def run_inference(args) -> int:
     print(f"     ttftMs: {(res.ttft_us or res.prefill_us) / 1000.0:3.2f}")
     print(f"   decodeMs: {res.decode_us / 1000.0:3.2f}")
     print(f"    totalMs: {res.total_us / 1000.0:3.2f}")
+    print()
+    print(engine.stats.report())
     return 0
 
 

@@ -1,0 +1,102 @@
+"""ctypes loader for the native Q40 codec (native/q40_codec.cpp).
+
+Builds the shared library on first use with g++ (cached next to the source;
+rebuilt when the source is newer) and exposes `q40_unpack_t_native`. All
+callers must tolerate `available() == False` (no compiler, sandboxed fs) and
+fall back to the numpy codec in formats/quants.py — the native path is a
+load-time accelerator, not a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "q40_codec.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "libq40codec.so")
+
+
+def _build() -> str | None:
+    if not os.path.exists(_SRC):
+        return None
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DLT_NO_NATIVE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.q40_unpack_t.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.q40_unpack_t.restype = None
+        lib.q40_dequant.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.q40_dequant.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def q40_unpack_t_native(
+    raw, out_f: int, in_f: int, n_threads: int = 0
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Q40 file bytes -> (qt [in_f//32, 32, out_f] int8, dt [in_f//32, out_f]
+    f32) — the device T layout, in one pass. None if the codec is missing."""
+    lib = _load()
+    if lib is None:
+        return None
+    bpr = in_f // 32
+    buf = np.frombuffer(raw, dtype=np.uint8, count=out_f * bpr * 18)
+    qt = np.empty((bpr, 32, out_f), dtype=np.int8)
+    dt = np.empty((bpr, out_f), dtype=np.float32)
+    lib.q40_unpack_t(
+        buf.ctypes.data, out_f, bpr,
+        qt.ctypes.data, dt.ctypes.data, n_threads,
+    )
+    return qt, dt
+
+
+def q40_dequant_native(raw, n_elements: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    n_blocks = n_elements // 32
+    buf = np.frombuffer(raw, dtype=np.uint8, count=n_blocks * 18)
+    out = np.empty(n_elements, dtype=np.float32)
+    lib.q40_dequant(buf.ctypes.data, n_blocks, out.ctypes.data)
+    return out

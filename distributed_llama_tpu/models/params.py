@@ -124,6 +124,14 @@ def _load_one(reader: MFileReader, spec: TensorSpec, dense_dtype) -> Any:
     """Host-side load of a single tensor: QuantTensor parts (in the device T
     layout, ops/quant.py) or a dense ndarray."""
     if spec.float_type == FloatType.Q40 and len(spec.shape) == 2:
+        out_f, in_f = spec.shape
+        # fast path: the native codec unpacks + transposes in one
+        # multithreaded C++ pass (native/q40_codec.cpp)
+        from ..formats.native import q40_unpack_t_native
+
+        nat = q40_unpack_t_native(reader.raw(spec), out_f, in_f)
+        if nat is not None:
+            return nat
         from ..ops.quant import q40_to_t_layout
 
         q, d = reader.tensor_q40(spec)  # [out, in//32, 32], [out, in//32]

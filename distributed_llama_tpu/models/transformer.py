@@ -118,6 +118,10 @@ def _layer(
     # the attention and ffn output projections. None under GSPMD — XLA
     # inserts the psum itself from the shardings (the reference's explicit
     # SYNC_NODE_SLICES after att/ff, src/llm.cpp:418,569).
+    sp_ctx=None,  # (axis_name, shard_offset) when the cache's seq axis is
+    # sharded under shard_map (long-context sequence parallelism): cache
+    # writes become boundary-safe scatters and attention combines partial
+    # online-softmax stats across the axis (ops/attention.py gqa_attention_sp)
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
@@ -142,14 +146,21 @@ def _layer(
     q = apply_rope(q, rope, positions, cfg.rope_type)
     k = apply_rope(k, rope, positions, cfg.rope_type)
 
-    k_cache = jax.lax.dynamic_update_slice_in_dim(
-        k_cache, k.astype(k_cache.dtype), pos_start, axis=1
-    )
-    v_cache = jax.lax.dynamic_update_slice_in_dim(
-        v_cache, v.astype(v_cache.dtype), pos_start, axis=1
-    )
+    if sp_ctx is None:
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), pos_start, axis=1
+        )
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), pos_start, axis=1
+        )
+        a = gqa_attention(q, k_cache, v_cache, positions)
+    else:
+        from ..ops.attention import gqa_attention_sp, scatter_cache_update_sp
 
-    a = gqa_attention(q, k_cache, v_cache, positions)
+        axis_name, shard_offset = sp_ctx
+        k_cache = scatter_cache_update_sp(k_cache, k, positions, shard_offset)
+        v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
+        a = gqa_attention_sp(q, k_cache, v_cache, positions, shard_offset, axis_name)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
     att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas)
     x = x + reduce_fn(att_out).astype(x.dtype)

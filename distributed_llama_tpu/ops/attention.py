@@ -25,6 +25,99 @@ import jax.numpy as jnp
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def gqa_attention_sp(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,
+    shard_offset: jnp.ndarray,
+    axis_name: str = "sp",
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Sequence-parallel GQA attention (long-context path).
+
+    Runs under shard_map with the cache's seq axis sharded over `axis_name`:
+    each shard computes unnormalized attention over its local cache slice
+    with online-softmax statistics (local max m, exp-sum s, weighted-V o),
+    then the shards combine exactly via
+
+        M = pmax(m);  out = psum(o * e^(m-M)) / psum(s * e^(m-M))
+
+    — three tiny collectives of [b, heads, t(, head_dim)] partials per layer
+    instead of moving any KV. This is the all-to-all-free alternative to ring
+    attention; it has no reference analogue (the reference caps context
+    instead — SURVEY.md §5 "Long-context: absent").
+
+    q: [b, t, n_heads, head_dim]; k/v_cache: [b, local_seq, n_kv, head_dim];
+    positions: [b, t] GLOBAL positions; shard_offset: scalar — global index
+    of this shard's cache row 0.
+    """
+    b, t, n_heads, head_dim = q.shape
+    local_seq = k_cache.shape[1]
+    n_kv_heads = k_cache.shape[2]
+    kv_mul = n_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
+
+    qg = q.reshape(b, t, n_kv_heads, kv_mul, head_dim)
+    scores = jnp.einsum(
+        "bqhgd,bthd->bhgqt",
+        qg,
+        k_cache,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.float32) * scale
+
+    t_global = shard_offset + jnp.arange(local_seq, dtype=jnp.int32)
+    mask = t_global[None, None, :] <= positions[:, :, None]  # [b, t, local_seq]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    m = jnp.max(scores, axis=-1)  # [b, h, g, t]
+    # a shard whose slice is entirely masked contributes nothing: clamp m so
+    # exp() stays finite, and its s/o terms are exactly 0
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    e = jnp.exp(scores - m_safe[..., None])
+    e = jnp.where(mask[:, None, None, :, :], e, 0.0)
+    s = jnp.sum(e, axis=-1)  # [b, h, g, t]
+    o = jnp.einsum(
+        "bhgqt,bthd->bhgqd",
+        e,
+        v_cache.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [b, h, g, t, d]
+
+    m_max = jax.lax.pmax(m_safe, axis_name)
+    corr = jnp.exp(m_safe - m_max)
+    o_sum = jax.lax.psum(o * corr[..., None], axis_name)
+    s_sum = jax.lax.psum(s * corr, axis_name)
+    out = o_sum / jnp.maximum(s_sum, 1e-30)[..., None]
+    # [b, h, g, t, d] -> [b, t, h*g, d]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, t, n_heads, head_dim)
+    return out.astype(q.dtype)
+
+
+def scatter_cache_update_sp(
+    cache: jnp.ndarray,  # [b, local_seq, n_kv, head_dim] — this shard's slice
+    new: jnp.ndarray,  # [b, t, n_kv, head_dim]
+    positions: jnp.ndarray,  # [b, t] GLOBAL positions of the new rows
+    shard_offset: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write new KV rows into a seq-sharded cache slice.
+
+    A token chunk may straddle shard boundaries, so instead of a
+    dynamic-update-slice this builds a one-hot [local_seq, t] scatter per
+    shard — rows outside this shard's range match nothing and write nothing.
+    Cost is local_seq*t mask elements (tiny next to attention itself).
+    """
+    local_seq = cache.shape[1]
+    local_rows = shard_offset + jnp.arange(local_seq, dtype=jnp.int32)
+    onehot = (local_rows[None, :, None] == positions[:, None, :]).astype(cache.dtype)
+    # [b, local_seq, t] x [b, t, n_kv, hd] -> [b, local_seq, n_kv, hd]
+    written = jnp.einsum("bst,bthd->bshd", onehot, new.astype(cache.dtype))
+    hit = jnp.sum(onehot, axis=-1, keepdims=True)[..., None]  # [b, local_seq, 1, 1]
+    return cache * (1 - hit) + written
+
+
 def gqa_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

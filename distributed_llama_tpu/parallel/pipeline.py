@@ -82,7 +82,7 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
 
 
-def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache):
+def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx):
     """Run this device's resident layers over x (a scan, like the global
     forward but over the local slice)."""
     reduce_fn = lambda z: jax.lax.psum(z, "tp")
@@ -91,7 +91,8 @@ def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache):
         x = carry
         lp, k_c, v_c = per_layer
         x, k_c, v_c = _layer(
-            cfg, rope, x, positions, pos_start, lp, k_c, v_c, reduce_fn=reduce_fn
+            cfg, rope, x, positions, pos_start, lp, k_c, v_c,
+            reduce_fn=reduce_fn, sp_ctx=sp_ctx,
         )
         return x, (k_c, v_c)
 
@@ -164,7 +165,14 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
         n_micro = max(microbatches, 1)
         mt = t // n_micro
 
-        k_cache, v_cache = cache.k, cache.v  # [L_local, b, seq, kvh_local, hd]
+        k_cache, v_cache = cache.k, cache.v  # [L_local, b, local_seq, kvh_local, hd]
+        # sequence parallelism: the cache's seq axis is sharded over `sp`;
+        # attention combines partial softmax stats across the axis
+        sp = mesh.shape["sp"]
+        sp_ctx = None
+        if sp > 1:
+            local_seq = k_cache.shape[2]
+            sp_ctx = ("sp", jax.lax.axis_index("sp") * local_seq)
 
         emb = params.embedding
         x_all = emb[tokens].astype(jnp.float32)  # [b, t, dim]
@@ -185,7 +193,7 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
             positions = jnp.broadcast_to(positions, (b, mt))
 
             y, k_upd, v_upd = _local_stage(
-                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache
+                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache, sp_ctx
             )
             # commit cache only when this stage held a real microbatch
             active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)

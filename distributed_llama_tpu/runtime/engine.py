@@ -32,6 +32,7 @@ from ..formats.mfile import MFileReader
 from ..models import KVCache, config_from_header, forward, init_kv_cache, load_params
 from ..ops import build_rope_tables
 from ..tokenizer import Sampler
+from .telemetry import StepStats, memory_report, watchdog
 
 
 @dataclass
@@ -94,6 +95,7 @@ class InferenceEngine:
         cache_dtype: str | None = None,
         device_decode: bool = True,
         decode_chunk_size: int = 32,
+        verbose: bool = False,
     ):
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
         self.header = self.reader.header
@@ -103,7 +105,20 @@ class InferenceEngine:
         self.mesh = mesh
         shardings = None
         self._cache_sharding = None
-        if mesh is not None:
+        # pipeline execution (shard_map PPxTP[xSP]) when the mesh has pp or
+        # sp extent: layer/seq axes shard only under the explicit path.
+        # TP-only (or dp) meshes run GSPMD.
+        self.use_pipeline = mesh is not None and (
+            mesh.shape["pp"] > 1 or mesh.shape["sp"] > 1
+        )
+        if self.use_pipeline:
+            from ..parallel.pipeline import pp_cache_sharding, pp_param_shardings
+
+            # shard_map kernels see local shards — the pallas path stays
+            # available
+            shardings = pp_param_shardings(mesh, moe=self.cfg.is_moe)
+            self._cache_sharding = pp_cache_sharding(mesh)
+        elif mesh is not None:
             from ..parallel import cache_shardings, param_shardings
 
             # GSPMD cannot partition a pallas_call over sharded operands —
@@ -119,12 +134,30 @@ class InferenceEngine:
         # False = per-token host loop with the reference's exact RNG stream.
         self.device_decode = device_decode
         self.decode_chunk_size = decode_chunk_size
+        self.stats = StepStats()
         self.cache = self._new_cache()
+        if verbose:
+            print(memory_report(self.params, self.cache))
         self._argmax_step = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
         )
 
     # -- low-level steps ----------------------------------------------------
+
+    def _forward(self, tokens_arr, pos_start, logits_mode="last"):
+        """Dispatch one forward step to the GSPMD jit or the shard_map
+        pipeline depending on the mesh shape."""
+        if self.use_pipeline:
+            from ..parallel.pipeline import pipeline_forward
+
+            return pipeline_forward(
+                self.cfg, self.mesh, self.params, self.rope, self.cache,
+                tokens_arr, pos_start, logits_mode=logits_mode,
+            )
+        return forward(
+            self.cfg, self.params, self.rope, self.cache, tokens_arr,
+            pos_start, logits_mode=logits_mode,
+        )
 
     def _new_cache(self):
         cache = init_kv_cache(self.cfg, self.batch)
@@ -147,10 +180,7 @@ class InferenceEngine:
         """Run one (unpadded, caller-shaped) forward over `tokens` for every
         batch row; returns host logits."""
         arr = jnp.asarray([tokens] * self.batch, dtype=jnp.int32)
-        logits, self.cache = forward(
-            self.cfg, self.params, self.rope, self.cache, arr,
-            jnp.int32(pos_start), logits_mode=logits_mode,
-        )
+        logits, self.cache = self._forward(arr, jnp.int32(pos_start), logits_mode)
         return np.asarray(logits)
 
     def prefill(self, tokens: list[int], pos_start: int = 0, on_chunk=None) -> None:
@@ -174,12 +204,11 @@ class InferenceEngine:
             chunk = chunk + [0] * pad
             t0 = time.perf_counter()
             arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
-            out, self.cache = forward(
-                self.cfg, self.params, self.rope, self.cache, arr,
-                jnp.int32(pos_start + i), logits_mode="last",
-            )
-            out.block_until_ready()
+            with watchdog(f"prefill[{size}]"):
+                out, self.cache = self._forward(arr, jnp.int32(pos_start + i))
+                out.block_until_ready()
             dt = int((time.perf_counter() - t0) * 1e6)
+            self.stats.record(f"prefill[{size}]", dt)
             if on_chunk is not None:
                 on_chunk(StepTiming(eval_us=dt, n_tokens=n_real))
             i += n_real
@@ -187,9 +216,7 @@ class InferenceEngine:
     def decode_one(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns host logits [batch, vocab]."""
         arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
-        logits, self.cache = forward(
-            self.cfg, self.params, self.rope, self.cache, arr, jnp.int32(pos)
-        )
+        logits, self.cache = self._forward(arr, jnp.int32(pos))
         return np.asarray(logits)
 
     # -- generation driver --------------------------------------------------
@@ -221,7 +248,7 @@ class InferenceEngine:
         pos = len(prompt_tokens) - 1
         token = prompt_tokens[-1]
         max_pos = min(self.cfg.seq_len, steps)
-        if self.device_decode:
+        if self.device_decode and not self.use_pipeline:
             self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         else:
             self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
@@ -238,9 +265,7 @@ class InferenceEngine:
             t0 = time.perf_counter()
             if greedy:
                 arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
-                logits, self.cache = forward(
-                    self.cfg, self.params, self.rope, self.cache, arr, jnp.int32(pos)
-                )
+                logits, self.cache = self._forward(arr, jnp.int32(pos))
                 token = int(self._argmax_step(logits)[0])
             else:
                 logits = self.decode_one(token, pos)
@@ -280,15 +305,17 @@ class InferenceEngine:
             n = max(n, 1)
             t0 = time.perf_counter()
             key, sub = jax.random.split(key)
-            toks, self.cache = decode_chunk(
-                self.cfg, self.params, self.rope, self.cache, tok_arr, jnp.int32(pos),
-                sub, n_steps=n, temperature=temperature, topp=topp,
-            )
-            tok_arr = toks[:, -1]
-            # single bulk fetch — per-element indexing would issue one
-            # device->host transfer per token (ruinous through the tunnel)
-            host_toks = np.asarray(toks[0]).tolist()
+            with watchdog(f"decode[{n}]"):
+                toks, self.cache = decode_chunk(
+                    self.cfg, self.params, self.rope, self.cache, tok_arr, jnp.int32(pos),
+                    sub, n_steps=n, temperature=temperature, topp=topp,
+                )
+                tok_arr = toks[:, -1]
+                # single bulk fetch — per-element indexing would issue one
+                # device->host transfer per token (ruinous through the tunnel)
+                host_toks = np.asarray(toks[0]).tolist()
             dt = int((time.perf_counter() - t0) * 1e6)
+            self.stats.record(f"decode[{n}]", dt)
             if first:
                 res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
                 first = False

@@ -1,0 +1,182 @@
+"""Observability: stall watchdog, step-latency statistics, memory report.
+
+TPU equivalents of the reference's aux subsystems (SURVEY.md §5):
+
+* **Stall watchdog** — the reference's executor logs `[EXEC_STALL]` after a
+  soft timeout and aborts after a hard one, both env-tunable
+  (reference: src/nn/nn-executor.cpp:9-33,276-353, env
+  `DLLAMA_EXEC_STALL_LOG_MS` / `DLLAMA_EXEC_STALL_TIMEOUT_MS`). Here the
+  equivalent hazard is a device step that never completes (wedged runtime /
+  dead tunnel): `watchdog()` wraps a blocking device call, logs after
+  `DLT_STALL_LOG_MS` (default 2000) and raises `StallError` after
+  `DLT_STALL_TIMEOUT_MS` (default 180000).
+* **Step statistics** — the reference's network performance monitor keeps
+  per-op latency min/avg/max and P50/P95/P99 with a recent-window
+  (reference: src/nn/nn-network.cpp:883-1053). `StepStats` does the same for
+  named step types (prefill/decode chunks), printable via `report()`.
+* **Memory report** — the reference prints the per-node RAM requirement at
+  graph build (reference: src/nn/nn-core.cpp:177-191); `memory_report`
+  totals device bytes of params and cache pytrees.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+
+class StallError(RuntimeError):
+    pass
+
+
+def _env_ms(name: str, default: int) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+class watchdog:
+    """Context manager guarding a blocking device call.
+
+    >>> with watchdog("decode"):
+    ...     out.block_until_ready()
+
+    Logs `[EXEC_STALL]` after DLT_STALL_LOG_MS, raises StallError in the
+    *watchdog thread's* place after DLT_STALL_TIMEOUT_MS by interrupting the
+    main thread (the blocking jax call itself cannot be cancelled; the
+    interrupt surfaces as soon as it returns — same semantics as the
+    reference, which also only detects, not cancels).
+    """
+
+    def __init__(self, what: str, log_fn=print):
+        self.what = what
+        self.log_fn = log_fn
+        # defaults are wider than the reference's 2s/180s because a first
+        # call legitimately spends 20-40s in XLA compilation
+        self.log_ms = _env_ms("DLT_STALL_LOG_MS", 60000)
+        self.timeout_ms = _env_ms("DLT_STALL_TIMEOUT_MS", 600000)
+        self._done = threading.Event()
+        self._timed_out = False
+        self._thread = None
+
+    def _watch(self, t0: float):
+        logged = False
+        while True:
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            # wake at whichever deadline comes first so a timeout shorter
+            # than the log interval is still honored on time
+            next_ms = min(
+                self.log_ms if not logged else self.timeout_ms,
+                max(self.timeout_ms - elapsed_ms, 1.0),
+            )
+            if self._done.wait(next_ms / 1000.0):
+                return
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            if not logged and elapsed_ms >= self.log_ms:
+                self.log_fn(
+                    f"⏳ [EXEC_STALL] {self.what} exceeded {self.log_ms:.0f} ms "
+                    f"(elapsed {elapsed_ms:.0f} ms)"
+                )
+                logged = True
+            if elapsed_ms >= self.timeout_ms:
+                self._timed_out = True
+                self.log_fn(
+                    f"🚨 [EXEC_STALL] {self.what} exceeded hard timeout "
+                    f"{self.timeout_ms:.0f} ms"
+                )
+                return
+
+    def __enter__(self):
+        self._thread = threading.Thread(
+            target=self._watch, args=(time.perf_counter(),), daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        self._done.set()
+        self._thread.join(timeout=1)
+        if self._timed_out and exc_type is None:
+            raise StallError(f"{self.what} exceeded {self.timeout_ms:.0f} ms")
+        return False
+
+
+@dataclass
+class _Series:
+    count: int = 0
+    total_us: float = 0.0
+    min_us: float = float("inf")
+    max_us: float = 0.0
+    recent: list = field(default_factory=list)  # recent-window latencies
+    window: int = 100
+
+
+class StepStats:
+    """Per-step-type latency aggregation with percentile report
+    (the reference's NetworkPerfMonitor shape, applied to device steps)."""
+
+    def __init__(self, window: int = 100):
+        self.series: dict[str, _Series] = defaultdict(lambda: _Series(window=window))
+
+    def record(self, kind: str, us: float):
+        s = self.series[kind]
+        s.count += 1
+        s.total_us += us
+        s.min_us = min(s.min_us, us)
+        s.max_us = max(s.max_us, us)
+        s.recent.append(us)
+        if len(s.recent) > s.window:
+            s.recent.pop(0)
+
+    def percentiles(self, kind: str) -> dict:
+        s = self.series.get(kind)
+        if not s or not s.recent:
+            return {}
+        arr = np.sort(np.asarray(s.recent))
+        pick = lambda p: float(arr[min(len(arr) - 1, int(len(arr) * p))])
+        return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
+
+    def report(self) -> str:
+        lines = ["📊 Step performance report:"]
+        for kind, s in sorted(self.series.items()):
+            if s.count == 0:
+                continue
+            avg = s.total_us / s.count
+            p = self.percentiles(kind)
+            lines.append(
+                f"  {kind:<16} n={s.count:<6} avg={avg/1000:8.2f}ms "
+                f"min={s.min_us/1000:8.2f}ms max={s.max_us/1000:8.2f}ms "
+                f"p50={p.get('p50', 0)/1000:8.2f}ms p95={p.get('p95', 0)/1000:8.2f}ms "
+                f"p99={p.get('p99', 0)/1000:8.2f}ms"
+            )
+        return "\n".join(lines)
+
+
+def _tree_bytes(tree) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total
+
+
+def memory_report(params, cache) -> str:
+    """Device-memory footprint summary (reference: per-node RAM requirement
+    print, src/nn/nn-core.cpp:177-191)."""
+    pb = _tree_bytes(params)
+    cb = _tree_bytes(cache)
+
+    def fmt(n):
+        return f"{n / 1e9:.2f} GB" if n >= 1e8 else f"{n / 1e6:.1f} MB"
+
+    return (
+        f"💿 Device memory: weights {fmt(pb)}, kv cache {fmt(cb)}, "
+        f"total {fmt(pb + cb)}"
+    )

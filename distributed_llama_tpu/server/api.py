@@ -306,8 +306,13 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
+        # close after every response (reference: dllama-api.cpp:202-235):
+        # the server handles one connection at a time, so a pooled keep-alive
+        # client would otherwise wedge it for everyone else
+        self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+        self.close_connection = True
 
 
 def serve(args) -> HTTPServer:

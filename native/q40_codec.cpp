@@ -1,0 +1,134 @@
+// Native Q40 codec: file-layout Q40 bytes -> device T layout, multithreaded.
+//
+// The host-side analogue of the reference's weight pipeline: where the
+// reference streams raw Q40 slices over TCP and computes on them directly
+// (reference: src/nn/nn-network.cpp:1818-1943, src/nn/nn-quants.cpp), the
+// TPU build must unpack nibbles to int8 and transpose into the device
+// layout (ops/quant.py "T layout") before device_put. For a 70B-class model
+// that is tens of GB through the pure-numpy path; this codec does it in
+// C++ with one thread per core. Loaded via ctypes (formats/native.py) with
+// a transparent numpy fallback.
+//
+// Layouts:
+//   input:  out_f rows x bpr blocks/row; each block = 18 bytes
+//           (f16 scale, 16 nibble-pair bytes; byte j holds elem j in the low
+//           nibble and elem j+16 in the high nibble —
+//           reference: src/nn/nn-quants.hpp:64-67)
+//   output: qt[bpr][32][out_f] int8 (values in [-8, 7])
+//           dt[bpr][out_f] float32
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr int Q40_BLOCK = 32;
+constexpr int Q40_BLOCK_BYTES = 18;
+
+float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (mant == 0) {
+            bits = sign;
+        } else {
+            // subnormal: value = mant * 2^-24 = 1.xxx * 2^(-15-shift) after
+            // normalizing the leading 1 into bit 10
+            int shift = 0;
+            while (!(mant & 0x400)) {
+                mant <<= 1;
+                shift++;
+            }
+            mant &= 0x3FF;
+            bits = sign | ((uint32_t)(127 - 15 - shift + 1) << 23) | (mant << 13);
+        }
+    } else if (exp == 31) {
+        bits = sign | 0x7F800000u | (mant << 13);
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float out;
+    std::memcpy(&out, &bits, sizeof(out));
+    return out;
+}
+
+// Tiled transpose: decode a TILE-row strip of one block column into an
+// L1-resident [32][TILE] buffer, then write each of the 32 rows as one
+// contiguous run — avoids the out_f-strided scatter writes that make the
+// naive loop memory-bound.
+constexpr int64_t TILE = 128;
+
+void unpack_block_cols(const uint8_t* raw, int64_t out_f, int64_t bpr,
+                       int8_t* qt, float* dt, int64_t b_start, int64_t b_end) {
+    int8_t tile[Q40_BLOCK][TILE];
+    for (int64_t b = b_start; b < b_end; b++) {
+        for (int64_t o0 = 0; o0 < out_f; o0 += TILE) {
+            int64_t tn = std::min(TILE, out_f - o0);
+            for (int64_t i = 0; i < tn; i++) {
+                const uint8_t* blk =
+                    raw + ((o0 + i) * bpr + b) * Q40_BLOCK_BYTES;
+                uint16_t h;
+                std::memcpy(&h, blk, 2);
+                dt[b * out_f + o0 + i] = f16_to_f32(h);
+                const uint8_t* packed = blk + 2;
+                for (int j = 0; j < 16; j++) {
+                    uint8_t byte = packed[j];
+                    tile[j][i] = (int8_t)(byte & 0x0F) - 8;
+                    tile[j + 16][i] = (int8_t)(byte >> 4) - 8;
+                }
+            }
+            int8_t* base = qt + b * Q40_BLOCK * out_f + o0;
+            for (int j = 0; j < Q40_BLOCK; j++)
+                std::memcpy(base + (int64_t)j * out_f, tile[j], tn);
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// raw: out_f*bpr Q40 blocks (18B each, row-major); qt: [bpr,32,out_f] int8;
+// dt: [bpr,out_f] f32. n_threads <= 0 means hardware_concurrency.
+void q40_unpack_t(const uint8_t* raw, int64_t out_f, int64_t bpr,
+                  int8_t* qt, float* dt, int32_t n_threads) {
+    int64_t nt = n_threads > 0 ? n_threads : (int64_t)std::thread::hardware_concurrency();
+    nt = std::max<int64_t>(1, std::min<int64_t>(nt, bpr));
+    if (nt == 1) {
+        unpack_block_cols(raw, out_f, bpr, qt, dt, 0, bpr);
+        return;
+    }
+    std::vector<std::thread> threads;
+    int64_t chunk = (bpr + nt - 1) / nt;
+    for (int64_t t = 0; t < nt; t++) {
+        int64_t s = t * chunk;
+        int64_t e = std::min(bpr, s + chunk);
+        if (s >= e) break;
+        threads.emplace_back(unpack_block_cols, raw, out_f, bpr, qt, dt, s, e);
+    }
+    for (auto& th : threads) th.join();
+}
+
+// Dequantize a flat Q40 stream to f32 (for F32 load paths / validation).
+void q40_dequant(const uint8_t* raw, int64_t n_blocks, float* out) {
+    for (int64_t i = 0; i < n_blocks; i++) {
+        const uint8_t* blk = raw + i * Q40_BLOCK_BYTES;
+        uint16_t h;
+        std::memcpy(&h, blk, 2);
+        float d = f16_to_f32(h);
+        const uint8_t* packed = blk + 2;
+        float* dst = out + i * Q40_BLOCK;
+        for (int j = 0; j < 16; j++) {
+            uint8_t byte = packed[j];
+            dst[j] = (float)((int8_t)(byte & 0x0F) - 8) * d;
+            dst[j + 16] = (float)((int8_t)(byte >> 4) - 8) * d;
+        }
+    }
+}
+
+}  // extern "C"

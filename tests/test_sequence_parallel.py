@@ -1,0 +1,125 @@
+"""Sequence-parallel (long-context) attention tests on the 8-device mesh.
+
+No reference analogue exists (the reference caps context length instead —
+SURVEY.md §5); correctness is asserted against single-device execution.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from distributed_llama_tpu.formats.mfile import ArchType, MFileReader
+from distributed_llama_tpu.models import config_from_header, forward, init_kv_cache, load_params
+from distributed_llama_tpu.ops import build_rope_tables
+from distributed_llama_tpu.ops.attention import (
+    gqa_attention,
+    gqa_attention_sp,
+    scatter_cache_update_sp,
+)
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.parallel.pipeline import (
+    pipeline_forward,
+    pp_cache_sharding,
+    pp_param_shardings,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+KW = dict(
+    arch=ArchType.LLAMA, dim=128, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+    seq_len=64,
+)
+
+
+def test_sp_attention_matches_full(tmp_path):
+    """Partial-softmax combine over sp == unsharded attention, for query
+    positions landing in every shard."""
+    rng = np.random.default_rng(4)
+    b, t, n_heads, n_kv, hd, seq = 1, 4, 4, 2, 8, 32
+    mesh = make_mesh(sp=4)
+    q = jnp.asarray(rng.standard_normal((b, t, n_heads, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, seq, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, seq, n_kv, hd)), jnp.float32)
+    for pos0 in [0, 6, 17, 27]:  # spans shard boundaries (8 rows per shard)
+        positions = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+        want = gqa_attention(q, k, v, positions)
+
+        @jax.jit
+        @lambda f: shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), P(None, "sp", None, None), P(None, "sp", None, None), P()),
+            out_specs=P(), check_vma=False,
+        )
+        def run(q, k_l, v_l, positions):
+            offset = jax.lax.axis_index("sp") * (seq // 4)
+            return gqa_attention_sp(q, k_l, v_l, positions, offset)
+
+        got = run(q, k, v, positions)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+                                   err_msg=f"pos0={pos0}")
+
+
+def test_sp_scatter_update_straddles_shards():
+    """A token chunk crossing a shard boundary writes each row to the right
+    shard and nothing else."""
+    b, t, n_kv, hd, seq, sp = 1, 4, 2, 8, 32, 4
+    mesh = make_mesh(sp=sp)
+    rng = np.random.default_rng(5)
+    cache = jnp.zeros((b, seq, n_kv, hd), jnp.float32)
+    new = jnp.asarray(rng.standard_normal((b, t, n_kv, hd)), jnp.float32)
+    pos0 = 6  # rows 6,7 in shard 0; rows 8,9 in shard 1
+    positions = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+
+    @jax.jit
+    @lambda f: shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, "sp", None, None), P(), P()),
+        out_specs=P(None, "sp", None, None), check_vma=False,
+    )
+    def run(cache_l, new, positions):
+        offset = jax.lax.axis_index("sp") * (seq // sp)
+        return scatter_cache_update_sp(cache_l, new, positions, offset)
+
+    got = np.asarray(run(cache, new, positions))
+    want = np.zeros((b, seq, n_kv, hd), np.float32)
+    want[:, pos0 : pos0 + t] = np.asarray(new)
+    np.testing.assert_array_equal(got, want)
+
+
+def _build(tmp_path, mesh=None, **kw):
+    h = tiny_header(**kw)
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, h, seed=5)
+    reader = MFileReader(path)
+    cfg = config_from_header(reader.header, compute_dtype="float32")
+    sh = pp_param_shardings(mesh, moe=cfg.is_moe) if mesh is not None else None
+    params = load_params(reader, cfg, shardings=sh)
+    rope = build_rope_tables(reader.header)
+    return cfg, params, rope
+
+
+@pytest.mark.parametrize("axes", [dict(sp=4), dict(sp=2, tp=2), dict(sp=2, pp=2)])
+def test_pipeline_with_sequence_parallel(tmp_path, axes):
+    """Full forward with the cache's seq axis sharded matches single-device,
+    through prefill + decode."""
+    tokens = [3, 99, 41, 7]
+    cfg, params, rope = _build(tmp_path, None, **KW)
+    cache = init_kv_cache(cfg, batch=1)
+
+    mesh = make_mesh(**axes)
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **KW)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+
+    arr = jnp.asarray([tokens], jnp.int32)
+    want, cache = forward(cfg, params, rope, cache, arr, jnp.int32(0))
+    got, cache2 = pipeline_forward(cfg2, mesh, params2, rope2, cache2, arr, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    # decode a few tokens crossing the shard-0/1 cache boundary (16 rows/shard)
+    for p, t in enumerate([5, 42, 7], start=len(tokens)):
+        arr = jnp.asarray([[t]], jnp.int32)
+        want, cache = forward(cfg, params, rope, cache, arr, jnp.int32(p))
+        got, cache2 = pipeline_forward(cfg2, mesh, params2, rope2, cache2, arr, jnp.int32(p))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
