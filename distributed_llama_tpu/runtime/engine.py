@@ -292,42 +292,60 @@ class InferenceEngine:
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9
         seed = getattr(sampler, "_state", None)
-        key = jax.random.PRNGKey(int(seed) if seed is not None else 0)
-        tok_arr = jnp.full((self.batch,), token, dtype=jnp.int32)
-        first = True
-        while pos < max_pos:
+        key = [jax.random.PRNGKey(int(seed) if seed is not None else 0)]
+
+        def dispatch(at_pos, tok_arr):
+            """Queue one device chunk (async); returns (tokens_device, n)."""
+            limit = min(max_pos, self.cfg.seq_len) - at_pos
+            n = self.decode_chunk_size
             # largest power-of-two chunk that fits the remaining budget —
             # O(log chunk) compiled programs, no per-token tail round trips
-            limit = min(max_pos, self.cfg.seq_len) - pos
-            n = self.decode_chunk_size
             while n > limit:
                 n //= 2
             n = max(n, 1)
-            t0 = time.perf_counter()
-            key, sub = jax.random.split(key)
+            key[0], sub = jax.random.split(key[0])
+            toks, self.cache = decode_chunk(
+                self.cfg, self.params, self.rope, self.cache, tok_arr,
+                jnp.int32(at_pos), sub, n_steps=n, temperature=temperature, topp=topp,
+            )
+            return toks, n
+
+        if pos >= max_pos:
+            return  # no decode budget (steps <= prompt length)
+        # one-chunk lookahead: chunk i+1 is dispatched (its inputs are all
+        # device-resident) before chunk i's tokens are fetched, so the
+        # ~tens-of-ms device->host transfer overlaps the next chunk's compute
+        first = True
+        t_prev = time.perf_counter()
+        pending = dispatch(pos, jnp.full((self.batch,), token, dtype=jnp.int32))
+        dispatched = pos + pending[1]
+        while pending is not None:
+            toks, n = pending
+            nxt = None
+            if dispatched < max_pos:
+                nxt = dispatch(dispatched, toks[:, -1])
+                dispatched += nxt[1]
             with watchdog(f"decode[{n}]"):
-                toks, self.cache = decode_chunk(
-                    self.cfg, self.params, self.rope, self.cache, tok_arr, jnp.int32(pos),
-                    sub, n_steps=n, temperature=temperature, topp=topp,
-                )
-                tok_arr = toks[:, -1]
                 # single bulk fetch — per-element indexing would issue one
                 # device->host transfer per token (ruinous through the tunnel)
                 host_toks = np.asarray(toks[0]).tolist()
-            dt = int((time.perf_counter() - t0) * 1e6)
+            now = time.perf_counter()
+            dt = int((now - t_prev) * 1e6)
+            t_prev = now
             self.stats.record(f"decode[{n}]", dt)
             if first:
-                res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
+                res.ttft_us = int((now - wall0) * 1e6)
                 first = False
-            for j, t in enumerate(host_toks):
+            for t in host_toks:
                 res.pred_steps.append(StepTiming(eval_us=dt // n, n_tokens=1))
                 res.tokens.append(t)
                 pos += 1
                 if on_token is not None:
                     on_token(t)
                 if stop_fn is not None and stop_fn(t):
-                    # tokens past the stop within this chunk are never
-                    # appended; the cache overran by up to n-j-1 positions,
-                    # which is harmless — a continuation re-writes those
-                    # slots before reading them
+                    # tokens past the stop are never appended; the cache
+                    # overran by up to 2*chunk positions (this chunk's tail
+                    # plus the in-flight lookahead), which is harmless — a
+                    # continuation re-writes those slots before reading them
                     return
+            pending = nxt

@@ -8,8 +8,9 @@ TPU equivalents of the reference's aux subsystems (SURVEY.md §5):
   `DLLAMA_EXEC_STALL_LOG_MS` / `DLLAMA_EXEC_STALL_TIMEOUT_MS`). Here the
   equivalent hazard is a device step that never completes (wedged runtime /
   dead tunnel): `watchdog()` wraps a blocking device call, logs after
-  `DLT_STALL_LOG_MS` (default 2000) and raises `StallError` after
-  `DLT_STALL_TIMEOUT_MS` (default 180000).
+  `DLT_STALL_LOG_MS` (default 60000) and raises `StallError` after
+  `DLT_STALL_TIMEOUT_MS` (default 600000) — wider than the reference's
+  2s/180s because a first call legitimately spends 20-40s compiling.
 * **Step statistics** — the reference's network performance monitor keeps
   per-op latency min/avg/max and P50/P95/P99 with a recent-window
   (reference: src/nn/nn-network.cpp:883-1053). `StepStats` does the same for

@@ -48,6 +48,18 @@ def test_greedy_generation_matches_numpy_golden(model_files):
     assert got.tokens == want
 
 
+def test_steps_not_exceeding_prompt_returns_no_decode(model_files):
+    """steps <= prompt length: prefill only, zero generated tokens (the
+    pre-overlap loop guard; regression for a dispatch-before-budget hang)."""
+    mp, _ = model_files
+    eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
+    res = eng.generate([1, 2, 3, 4, 5], 3, sampler=None)
+    assert res.n_pred_tokens == 0
+    eng.reset()
+    res = eng.generate([1, 2, 3, 4, 5], 4, sampler=None)
+    assert res.n_pred_tokens == 0
+
+
 def test_stop_fn_cuts_generation(model_files):
     mp, _ = model_files
     eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
