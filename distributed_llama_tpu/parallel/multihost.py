@@ -1,0 +1,75 @@
+"""Multi-host execution: process initialization and DCN-aware meshes.
+
+The reference scales across hosts with its TCP full mesh + worker processes
+(reference: NnNetwork::serve/connect, src/nn/nn-network.cpp:516-629; workers
+run `dllama worker`). The TPU equivalent is JAX multi-controller SPMD: every
+host runs the SAME program, `jax.distributed.initialize` wires the runtime
+(coordinator address from env or args, like the reference's --workers list),
+and `jax.devices()` becomes the global device set. There is no root/worker
+asymmetry and no weight streaming — each process `device_put`s the shards its
+local chips own.
+
+Mesh placement policy (the scaling-book recipe): axes that carry per-token
+collectives (tp, sp — all-reduce/softmax-combine every layer) must ride ICI
+inside a slice; axes with rare or point-to-point transfers (pp stage handoff
+once per step, dp never) may span the slower DCN between slices. That is the
+same conclusion the reference reached empirically on slow Ethernet — TP
+stops scaling at 4 nodes while PP=4 gives 21x (SURVEY.md §6) — promoted to a
+placement rule.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .mesh import AXES
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the multi-controller runtime (no-op if single-process or
+    already initialized). Arguments default to the JAX_* env vars / TPU
+    metadata, so on a TPU pod slice a bare call suffices."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    kw = {}
+    if coordinator_address:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if kw or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(**kw)
+
+
+def make_multihost_mesh(
+    tp: int = 0, pp: int = 1, dp: int = 1, sp: int = 1
+) -> Mesh:
+    """Global ("dp","pp","tp","sp") mesh over all hosts' devices.
+
+    tp=0 means "all remaining devices". Device order: JAX enumerates TPU
+    devices so that consecutive devices share ICI; keeping tp/sp innermost
+    (fastest-varying) puts the per-layer collectives on ICI links, and
+    pp/dp split across hosts/slices where only stage handoffs (ppermute)
+    or nothing cross DCN.
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if tp == 0:
+        denom = pp * dp * sp
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by pp*dp*sp={denom}")
+        tp = n // denom
+    need = dp * pp * tp * sp
+    if need != n:
+        raise ValueError(f"mesh {dp}x{pp}x{tp}x{sp} != {n} global devices")
+    arr = np.asarray(devices).reshape(dp, pp, tp, sp)
+    return Mesh(arr, AXES)
