@@ -228,24 +228,26 @@ class InferenceEngine:
         sampler: Sampler | None = None,
         on_token=None,
         stop_fn=None,
+        pos_start: int = 0,
     ) -> GenerationResult:
         """The reference `inference()` loop (dllama.cpp:13-151): prefill all
-        but the last prompt token, then decode until `steps` total tokens or
-        `stop_fn(token)` says stop.
+        but the last prompt token, then decode until position `steps` or
+        `stop_fn(token)` says stop. `pos_start` > 0 continues an existing
+        cache (the API server's naive-prefix-cache path).
         """
         if not prompt_tokens:
             raise ValueError("prompt tokens required")
-        if len(prompt_tokens) > self.cfg.seq_len:
+        if pos_start + len(prompt_tokens) > self.cfg.seq_len:
             raise ValueError("prompt is longer than the sequence length")
         res = GenerationResult(tokens=list(prompt_tokens), n_prompt_tokens=len(prompt_tokens))
         wall0 = time.perf_counter()
 
         # prefill all but the last prompt token (its logits come from the
         # first decode step, reference dllama.cpp:44-85)
-        self.prefill(prompt_tokens[:-1], 0, on_chunk=res.eval_steps.append)
+        self.prefill(prompt_tokens[:-1], pos_start, on_chunk=res.eval_steps.append)
         res.prefill_us = int((time.perf_counter() - wall0) * 1e6)
 
-        pos = len(prompt_tokens) - 1
+        pos = pos_start + len(prompt_tokens) - 1
         token = prompt_tokens[-1]
         max_pos = min(self.cfg.seq_len, steps)
         if self.device_decode and not self.use_pipeline:

@@ -161,9 +161,6 @@ class ApiState:
             emit(prompt.public_prompt)
             buffer.append(prompt.public_prompt)
 
-        engine.prefill(ids[: prompt_end - start_pos], start_pos)
-        token = ids[-1]
-
         tok.reset_decoder()
         detector = EosDetector(
             tok.eos_token_ids,
@@ -176,30 +173,37 @@ class ApiState:
             self.sampler.set_seed(params["seed"])
         self.sampler.topp = params.get("top_p", self.args.topp)
 
-        pos = prompt_end
-        n_completion = 0
-        while pos < max_pred:
-            logits = engine.decode_one(token, pos)
-            token = self.sampler.sample(logits[0].copy())
-            piece = tok.decode(token)
-            eos_type = detector.append(token, piece)
+        # drive the engine's generation loop (chunked on-device decode — one
+        # host round trip per K tokens; with on-device sampling the RNG
+        # stream differs from the reference's host xorshift*, temperature 0
+        # remains bit-identical)
+        state = {"stop": False, "n": 0}
+
+        def on_token(t):
+            state["n"] += 1
+            piece = tok.decode(t)
+            eos_type = detector.append(t, piece)
             if eos_type != EOS_MAYBE:
                 delta = detector.get_delta()
                 if delta:
                     emit(delta)
                     buffer.append(delta)
                 detector.reset()
-            pos += 1
-            n_completion += 1
             if eos_type == EOS_FOUND:
-                break
+                state["stop"] = True
+
+        res = engine.generate(
+            ids, max_pred, sampler=self.sampler, pos_start=start_pos,
+            on_token=on_token, stop_fn=lambda t: state["stop"],
+        )
+        pos = prompt_end + res.n_pred_tokens
 
         text = "".join(buffer)
         if pos >= seq_len:
             self.naive_cache.clear()
         else:
             self.naive_cache.push(pos, "assistant", text)
-        return text, len(ids), n_completion
+        return text, len(ids), res.n_pred_tokens
 
 
 class Handler(BaseHTTPRequestHandler):
