@@ -40,6 +40,13 @@ class ModelConfig:
     # operands, so sharded-jit execution must use the XLA dequant path; the
     # shard_map pipeline path re-enables it (kernels see local shards there).
     use_pallas: bool | None = None
+    # q80_activations: parity mode emulating the reference's
+    # `--buffer-float-type q80` numerics — every Q40 matmul input is
+    # round-tripped through Q80 quantization (the reference casts activations
+    # into q80 buffers before each Q40 matmul, src/llm.cpp:221-255; pipes and
+    # everything else stay f32). Off in production: activations already live
+    # on-chip, quantizing them buys no bandwidth.
+    q80_activations: bool = False
 
     @property
     def q_dim(self) -> int:

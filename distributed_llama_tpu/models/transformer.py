@@ -30,15 +30,19 @@ import jax.numpy as jnp
 from ..formats.mfile import HiddenAct
 from ..ops import gqa_attention, moe_router, rms_norm
 from ..ops.activations import gelu, silu
-from ..ops.quant import QuantTensor, quant_matmul
+from ..ops.quant import QuantTensor, quant_matmul, quantize_q80_activations
 from ..ops.rope import RopeTables, apply_rope
 from .config import ModelConfig
 from .params import KVCache, LayerParams, ModelParams
 
 
-def linear(x: jnp.ndarray, w: Any, dtype, pallas=None) -> jnp.ndarray:
-    """x @ w.T for a dense or Q40 weight; returns x.dtype."""
+def linear(x: jnp.ndarray, w: Any, dtype, pallas=None, q80: bool = False) -> jnp.ndarray:
+    """x @ w.T for a dense or Q40 weight; returns x.dtype. `q80` is the
+    reference-parity mode: the Q40 matmul input is round-tripped through Q80
+    (ModelConfig.q80_activations)."""
     if isinstance(w, QuantTensor):
+        if q80:
+            x = quantize_q80_activations(x)
         return quant_matmul(x, w, dtype=dtype, pallas=pallas)
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     y = jax.lax.dot_general(
@@ -56,8 +60,9 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
-    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas)
-    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas)
+    q80 = cfg.q80_activations
+    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas, q80)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas, q80)
+    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas, q80)
 
 
 def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
@@ -67,12 +72,14 @@ def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
     return w[idx]
 
 
-def _expert_matmul(x: jnp.ndarray, w: Any, dtype) -> jnp.ndarray:
+def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndarray:
     """Per-token expert matmul: x [b,t,k,in] with per-token gathered expert
     weights — QuantTensor in the T layout ([...,nb,32,out]) or dense
     [...,out,in]."""
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     if isinstance(w, QuantTensor):
+        if q80:
+            x = quantize_q80_activations(x)
         wd = (w.q.astype(jnp.float32) * w.d[..., None, :]).astype(dtype)
         wd = wd.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
         eq = "btki,btkio->btko"
@@ -100,8 +107,9 @@ def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
     w3 = _gather_expert(lp.w3, idx)
     w2 = _gather_expert(lp.w2, idx)
     xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
-    h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype)) * _expert_matmul(xk, w3, cfg.dtype)
-    out = _expert_matmul(h, w2, cfg.dtype)  # [b,t,k,dim]
+    q80 = cfg.q80_activations
+    h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
+    out = _expert_matmul(h, w2, cfg.dtype, q80)  # [b,t,k,dim]
     return jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts).astype(y.dtype)
 
 
@@ -132,9 +140,9 @@ def _layer(
     # head counts come from the weight shapes, not cfg: under shard_map the
     # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
     # src/nn/nn-core.cpp:280-287)
-    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas)
-    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas)
-    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas)
+    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
+    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
+    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
     q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
     k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
     v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
@@ -162,7 +170,7 @@ def _layer(
         v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
         a = gqa_attention_sp(q, k_cache, v_cache, positions, shard_offset, axis_name)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
-    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas)
+    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
     x = x + reduce_fn(att_out).astype(x.dtype)
 
     # --- ffn block ---
@@ -204,7 +212,7 @@ def forward_uncompiled(
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":
         x = x[:, -1, :]
-    logits = linear(x, params.wcls, cfg.dtype, cfg.use_pallas)
+    logits = linear(x, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
 
 

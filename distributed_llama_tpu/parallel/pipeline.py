@@ -214,7 +214,9 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
         x_out = rms_norm(x_out, params.final_norm, cfg.norm_epsilon)
         if logits_mode == "last":
             x_out = x_out[:, -1, :]
-        logits_local = linear(x_out, params.wcls, cfg.dtype)  # vocab/tp slice
+        logits_local = linear(
+            x_out, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations
+        )  # vocab/tp slice
         logits = jax.lax.all_gather(logits_local, "tp", axis=-1, tiled=True)
         return logits.astype(jnp.float32), KVCache(k=k_cache, v=v_cache)
 

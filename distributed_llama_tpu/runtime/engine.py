@@ -96,12 +96,15 @@ class InferenceEngine:
         device_decode: bool = True,
         decode_chunk_size: int = 32,
         verbose: bool = False,
+        q80_activations: bool = False,
     ):
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
         self.header = self.reader.header
         self.cfg = config_from_header(
             self.header, compute_dtype=compute_dtype, cache_dtype=cache_dtype
         )
+        if q80_activations:
+            self.cfg = self.cfg.with_(q80_activations=True)
         self.mesh = mesh
         shardings = None
         self._cache_sharding = None

@@ -105,19 +105,20 @@ def write_tiny_model(path: str, h: ModelHeader, seed: int = 0, scale: float = 0.
     return h
 
 
-def byte_vocab_tokenizer(
-    n_special: int = 8, chat_template: str | None = None, pad_to: int = 0
+def _vocab_tokenizer(
+    base_vocab: list[bytes],
+    n_special: int = 3,
+    chat_template: str | None = None,
+    pad_to: int = 0,
+    filler: str = "<pad{}>",
 ) -> TokenizerData:
-    """A 256-byte-vocabulary tokenizer plus a few special tokens.
-
-    Regular tokens are the 256 single bytes (scores favor nothing, so encoding
-    degenerates to bytes — deterministic and adequate for pipeline tests);
-    special tokens sit after bos, mirroring the reference's layout assumption
-    that ``bos_id`` splits regular from special vocab.
-    """
-    vocab = [bytes([i]) for i in range(256)]
-    scores = [0.0] * 256
-    # a couple of merged tokens so BPE has something to do
+    """Shared BPE fixture scaffolding: `base_vocab` single-unit tokens, a few
+    merged words (so BPE has something to do), bos + specials after the
+    regular vocab (mirroring the reference's layout assumption that ``bos_id``
+    splits regular from special vocab), then filler tokens up to ``pad_to`` so
+    any sampled id stays decodable."""
+    vocab = list(base_vocab)
+    scores = [0.0] * len(vocab)
     for word, sc in ((b"he", 1.0), (b"ll", 1.1), (b"hell", 2.0), (b"hello", 3.0), (b" wo", 1.2), (b"world", 3.0)):
         vocab.append(word)
         scores.append(sc)
@@ -125,11 +126,8 @@ def byte_vocab_tokenizer(
     specials = [b"<s>", b"</s>", b"<|eot|>"] + [f"<sp{i}>".encode() for i in range(max(0, n_special - 3))]
     vocab += specials
     scores += [0.0] * len(specials)
-    # pad_to: extend with unused filler tokens so the tokenizer's vocab covers
-    # a model with a larger (rounded-up) vocab_size — a sampled filler id must
-    # still be decodable
     while pad_to > len(vocab):
-        vocab.append(f"<pad{len(vocab)}>".encode())
+        vocab.append(filler.format(len(vocab)).encode())
         scores.append(0.0)
     return TokenizerData(
         vocab=vocab,
@@ -139,6 +137,27 @@ def byte_vocab_tokenizer(
         add_bos=True,
         chat_template=chat_template,
         max_token_length=max(len(v) for v in vocab),
+    )
+
+
+def byte_vocab_tokenizer(
+    n_special: int = 8, chat_template: str | None = None, pad_to: int = 0
+) -> TokenizerData:
+    """A 256-byte-vocabulary tokenizer plus a few special tokens — any byte
+    string encodes; decoding may produce raw/invalid UTF-8."""
+    return _vocab_tokenizer(
+        [bytes([i]) for i in range(256)], n_special, chat_template, pad_to
+    )
+
+
+def ascii_vocab_tokenizer(pad_to: int = 0, chat_template: str | None = None) -> TokenizerData:
+    """A printable-ASCII vocabulary: every token decodes to a unique printable
+    piece with no raw bytes, so a decoded stream (e.g. the reference CLI's
+    per-token output, reference dllama.cpp:95-121) maps back to token ids
+    unambiguously — the tool for cross-engine token-parity tests."""
+    return _vocab_tokenizer(
+        [bytes([i]) for i in range(32, 127)], 3, chat_template, pad_to,
+        filler="<f{:04d}>",
     )
 
 

@@ -1,0 +1,168 @@
+"""Component-level timing of the decode path on the real chip.
+
+On the axon tunnel platform, `block_until_ready` is not a reliable sync and
+host fetches cost ~100 ms, so every measurement here runs the candidate
+subgraph N times *inside* one jitted `lax.scan` with a chained carry (nothing
+can be hoisted or elided) and syncs once with a tiny np.asarray fetch; the
+fetch cost is amortized over N. Not a test — a diagnostic.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dev_ms(label, make_fn, n=64, trials=3):
+    """make_fn() -> (jitted_fn, args). jitted_fn must contain its own
+    n-iteration device loop. Returns device ms per iteration."""
+    fn, args = make_fn()
+    r = fn(*args)
+    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]  # compile + sync
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, (time.perf_counter() - t0))
+    ms = best / n * 1e3
+    print(f"{label}: {ms:.4f} ms/iter  ({best*1e3:.1f} ms / {n} iters)")
+    return ms
+
+
+def main():
+    from bench import ensure_model
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.runtime.decode import decode_chunk
+    from distributed_llama_tpu.models.transformer import forward_uncompiled
+    from distributed_llama_tpu.ops.quant import quant_matmul
+    from distributed_llama_tpu.ops.attention import gqa_attention
+
+    path = ensure_model()
+    engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=64)
+    cfg, params, rope = engine.cfg, engine.params, engine.rope
+    print(f"cfg: dim={cfg.dim} layers={cfg.n_layers} heads={cfg.n_heads}/{cfg.n_kv_heads} "
+          f"hd={cfg.head_dim} hidden={cfg.hidden_dim} vocab={cfg.vocab_size} seq={cfg.seq_len} "
+          f"cache_dtype={cfg.cache_dtype}")
+    N = 64
+
+    # ---- full decode step (forward t=1 + argmax), chained ----
+    def mk_decode(use_pallas):
+        c = cfg.with_(use_pallas=use_pallas)
+        @jax.jit
+        def fn(cache_k, cache_v, tok):
+            from distributed_llama_tpu.models.params import KVCache
+            def body(carry, _):
+                tok, pos, ck, cv = carry
+                logits, cache = forward_uncompiled(
+                    c, params, rope, KVCache(k=ck, v=cv), tok[:, None], pos)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, pos + 1, cache.k, cache.v), None
+            (tok, _, ck, cv), _ = jax.lax.scan(
+                body, (tok, jnp.int32(100), cache_k, cache_v), None, length=N)
+            return tok
+        cache = engine._new_cache()
+        return fn, (cache.k, cache.v, jnp.zeros((1,), jnp.int32))
+
+    full_p = dev_ms("decode step (pallas)", lambda: mk_decode(True), N)
+    full_x = dev_ms("decode step (xla dequant)", lambda: mk_decode(False), N)
+
+    # ---- matmuls only: the 16-layer x 7-matmul chain + wcls ----
+    def mk_matmuls(use_pallas):
+        pallas = use_pallas
+        @jax.jit
+        def fn(x):
+            def layer_body(x, lp):
+                y = quant_matmul(x, lp.q, pallas=pallas)
+                y = y + quant_matmul(x, lp.k, pallas=pallas, out_dtype=x.dtype).sum() * 1e-30
+                y = y + quant_matmul(x, lp.v, pallas=pallas, out_dtype=x.dtype).sum() * 1e-30
+                x = quant_matmul(y, lp.wo, pallas=pallas)
+                h1 = quant_matmul(x, lp.w1, pallas=pallas)
+                h3 = quant_matmul(x, lp.w3, pallas=pallas)
+                x = quant_matmul(h1 * h3, lp.w2, pallas=pallas)
+                return x, None
+            def body(x, _):
+                x, _ = jax.lax.scan(layer_body, x, params.layers)
+                lg = quant_matmul(x, params.wcls, pallas=pallas)
+                return x + lg[..., :1] * 1e-30, None
+            x, _ = jax.lax.scan(body, x, None, length=N)
+            return x
+        return fn, (jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
+
+    mm_p = dev_ms("matmul chain (pallas)", lambda: mk_matmuls(True), N)
+    mm_x = dev_ms("matmul chain (xla)", lambda: mk_matmuls(False), N)
+
+    # ---- attention only, 16 layers over the full cache ----
+    def mk_att():
+        @jax.jit
+        def fn(q, kc, vc, pos):
+            def body(q, _):
+                def layer(q, _):
+                    a = gqa_attention(q, kc, vc, pos)
+                    return q + a * 1e-30, None
+                q, _ = jax.lax.scan(layer, q, None, length=cfg.n_layers)
+                return q, None
+            q, _ = jax.lax.scan(body, q, None, length=N)
+            return q
+        q = jnp.ones((1, 1, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
+        kc = jnp.ones((1, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
+        pos = jnp.full((1, 1), 100, jnp.int32)
+        return fn, (q, kc, kc, pos)
+
+    att = dev_ms("attention x16 (full cache)", mk_att, N)
+
+    # ---- cache scan-update only (the per-step KV copy) ----
+    def mk_cache():
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def fn(ck, cv, newk):
+            def body(carry, _):
+                ck, cv, newk = carry
+                def layer(c2, xs):
+                    k, v = xs
+                    k = jax.lax.dynamic_update_slice_in_dim(k, newk, 100, axis=1)
+                    v = jax.lax.dynamic_update_slice_in_dim(v, newk, 100, axis=1)
+                    return c2, (k, v)
+                _, (ck, cv) = jax.lax.scan(layer, 0, (ck, cv))
+                newk = newk + ck[0, :1, 100:101] * 1e-30
+                return (ck, cv, newk), None
+            (ck, cv, _), _ = jax.lax.scan(body, (ck, cv, newk), None, length=N)
+            return ck
+        cache = engine._new_cache()
+        newk = jnp.ones((1, 1, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
+        return fn, (cache.k, cache.v, newk)
+
+    cache_ms = dev_ms("cache scan-update x16", mk_cache, N)
+
+    # ---- single pallas matmul bandwidth at each shape ----
+    for name, w in [("qkvo 2048x2048", params.layers.q), ("ffn 8192x2048", params.layers.w1),
+                    ("wcls 32768x2048", params.wcls)]:
+        wq = w.q[0] if w.q.ndim == 4 else w.q
+        wd = w.d[0] if w.d.ndim == 3 else w.d
+        from distributed_llama_tpu.ops.quant import QuantTensor
+        ww = QuantTensor(q=wq, d=wd)
+        def mk():
+            @jax.jit
+            def fn(x):
+                def body(x, _):
+                    y = quant_matmul(x, ww, pallas=True)
+                    return x + y[..., :1] * 1e-30, None
+                x, _ = jax.lax.scan(body, x, None, length=N)
+                return x
+            return fn, (jnp.ones((1, ww.in_features), jnp.bfloat16),)
+        ms = dev_ms(f"pallas {name}", mk, N)
+        mb = ww.q.size / 1e6
+        print(f"    -> {mb/ms:.0f} GB/s effective ({mb:.1f} MB)")
+
+    print(f"\nsummary ms/token: full={full_p:.3f} matmuls={mm_p:.3f} att={att:.3f} "
+          f"cacheupd={cache_ms:.3f} other={full_p-mm_p-att-cache_ms:.3f}")
+    print(f"xla-dequant full={full_x:.3f} matmuls={mm_x:.3f}")
+
+
+if __name__ == "__main__":
+    main()
