@@ -190,22 +190,29 @@ def load_params(
     if cfg.is_moe:
         roles += ["moe_gate"]
 
+    # the embedding and the MoE router gate stay f32 regardless of the
+    # compute dtype (the reference keeps both f32 — gate is loadAll F32,
+    # src/llm.cpp:680; bf16 router logits can flip expert selection on
+    # near-ties)
+    f32_roles = {"moe_gate"}
+
     per_role: dict[str, list] = {r: [] for r in roles}
     for l in range(cfg.n_layers):
         for r in roles:
+            role_dtype = np.float32 if r in f32_roles else dense
             if r in ("w1", "w2", "w3") and cfg.is_moe:
                 experts = [
-                    _load_one(reader, reader.by_name[f"{r}.l{l}.e{e}"], dense)
+                    _load_one(reader, reader.by_name[f"{r}.l{l}.e{e}"], role_dtype)
                     for e in range(cfg.n_experts)
                 ]
                 per_role[r].append(_stack(experts))
             else:
-                per_role[r].append(_load_one(reader, reader.by_name[f"{r}.l{l}"], dense))
+                per_role[r].append(_load_one(reader, reader.by_name[f"{r}.l{l}"], role_dtype))
 
     layer_kw = {r: put(r, _stack(per_role[r])) for r in roles}
     layers = LayerParams(**layer_kw)
 
-    embedding = put("embedding", _load_one(reader, reader.by_name["embedding"], dense))
+    embedding = put("embedding", _load_one(reader, reader.by_name["embedding"], np.float32))
     final_norm = put("final_norm", _load_one(reader, reader.by_name["final_norm"], dense))
     wcls = put("wcls", _load_one(reader, reader.by_name["wcls"], dense))
     return ModelParams(embedding=embedding, layers=layers, final_norm=final_norm, wcls=wcls)

@@ -36,14 +36,22 @@ from .config import ModelConfig
 from .params import KVCache, LayerParams, ModelParams
 
 
-def linear(x: jnp.ndarray, w: Any, dtype, pallas=None, q80: bool = False) -> jnp.ndarray:
+def linear(
+    x: jnp.ndarray, w: Any, dtype, pallas=None, q80: bool = False, layer=None
+) -> jnp.ndarray:
     """x @ w.T for a dense or Q40 weight; returns x.dtype. `q80` is the
     reference-parity mode: the Q40 matmul input is round-tripped through Q80
-    (ModelConfig.q80_activations)."""
+    (ModelConfig.q80_activations). `layer`: use w[layer] of an all-layers
+    stacked weight — the Q40/Pallas path selects the layer inside the kernel
+    without materializing the slice (ops/quant.py)."""
     if isinstance(w, QuantTensor):
         if q80:
             x = quantize_q80_activations(x)
-        return quant_matmul(x, w, dtype=dtype, pallas=pallas)
+        return quant_matmul(
+            x, w, dtype=dtype, pallas=pallas, layer=layer if w.q.ndim == 4 else None
+        )
+    if layer is not None and w.ndim == 3:
+        w = jax.lax.dynamic_index_in_dim(w, layer, 0, keepdims=False)
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     y = jax.lax.dot_general(
         x.astype(dtype),
@@ -59,10 +67,23 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     return silu(x) if cfg.hidden_act == HiddenAct.SILU else gelu(x)
 
 
-def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
+def _sel_layer(w: Any, i) -> Any:
+    """w[i] for a stacked per-layer weight (QuantTensor-aware); identity when
+    i is None (w already belongs to one layer)."""
+    if i is None or w is None:
+        return w
+    if isinstance(w, QuantTensor):
+        return QuantTensor(
+            q=jax.lax.dynamic_index_in_dim(w.q, i, 0, keepdims=False),
+            d=jax.lax.dynamic_index_in_dim(w.d, i, 0, keepdims=False),
+        )
+    return jax.lax.dynamic_index_in_dim(w, i, 0, keepdims=False)
+
+
+def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:
     q80 = cfg.q80_activations
-    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas, q80)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas, q80)
-    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas, q80)
+    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas, q80, layer)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas, q80, layer)
+    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas, q80, layer)
 
 
 def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
@@ -92,7 +113,7 @@ def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndar
     return y.astype(x.dtype)
 
 
-def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
+def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:
     """Top-k expert SwiGLU, matching the reference MoE graph
     (src/llm.cpp:440-514): router on the *normed* activation, per-token
     expert weight indexing, weighted merge-sum.
@@ -102,10 +123,10 @@ def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams) -> jnp.ndarray:
     enough for this. (A sort-based ragged dispatch is the planned upgrade for
     large-batch prefill.)
     """
-    idx, wts = moe_router(y, lp.moe_gate, cfg.n_active_experts)  # [b,t,k]
-    w1 = _gather_expert(lp.w1, idx)
-    w3 = _gather_expert(lp.w3, idx)
-    w2 = _gather_expert(lp.w2, idx)
+    idx, wts = moe_router(y, _sel_layer(lp.moe_gate, layer), cfg.n_active_experts)  # [b,t,k]
+    w1 = _gather_expert(_sel_layer(lp.w1, layer), idx)
+    w3 = _gather_expert(_sel_layer(lp.w3, layer), idx)
+    w2 = _gather_expert(_sel_layer(lp.w2, layer), idx)
     xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
     q80 = cfg.q80_activations
     h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
@@ -130,26 +151,31 @@ def _layer(
     # sharded under shard_map (long-context sequence parallelism): cache
     # writes become boundary-safe scatters and attention combines partial
     # online-softmax stats across the axis (ops/attention.py gqa_attention_sp)
+    layer_idx=None,  # scalar int32 when `lp` holds ALL layers stacked: the
+    # big matmuls select the layer inside the Pallas kernel (no weight-slice
+    # copy — see quant_matmul) and the small per-layer tensors are sliced
+    # here. None = `lp` is already a single layer's weights.
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
     b, t, _ = x.shape
+    q80 = cfg.q80_activations
 
     # --- attention block ---
-    y = rms_norm(x, lp.norm0, cfg.norm_epsilon)
+    y = rms_norm(x, _sel_layer(lp.norm0, layer_idx), cfg.norm_epsilon)
     # head counts come from the weight shapes, not cfg: under shard_map the
     # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
     # src/nn/nn-core.cpp:280-287)
-    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
-    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
-    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
+    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas, q80, layer_idx)
+    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas, q80, layer_idx)
+    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas, q80, layer_idx)
     q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
     k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
     v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
 
     if cfg.is_qwen3:
-        q = rms_norm(q, lp.q_norm, cfg.norm_epsilon)
-        k = rms_norm(k, lp.k_norm, cfg.norm_epsilon)
+        q = rms_norm(q, _sel_layer(lp.q_norm, layer_idx), cfg.norm_epsilon)
+        k = rms_norm(k, _sel_layer(lp.k_norm, layer_idx), cfg.norm_epsilon)
 
     q = apply_rope(q, rope, positions, cfg.rope_type)
     k = apply_rope(k, rope, positions, cfg.rope_type)
@@ -170,12 +196,14 @@ def _layer(
         v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
         a = gqa_attention_sp(q, k_cache, v_cache, positions, shard_offset, axis_name)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
-    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
+    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas, q80, layer_idx)
     x = x + reduce_fn(att_out).astype(x.dtype)
 
     # --- ffn block ---
-    y = rms_norm(x, lp.norm1, cfg.norm_epsilon)
-    ff = _moe_ffn(cfg, y, lp) if cfg.is_moe else _dense_ffn(cfg, y, lp)
+    y = rms_norm(x, _sel_layer(lp.norm1, layer_idx), cfg.norm_epsilon)
+    ff = (
+        _moe_ffn(cfg, y, lp, layer_idx) if cfg.is_moe else _dense_ffn(cfg, y, lp, layer_idx)
+    )
     x = x + reduce_fn(ff).astype(x.dtype)
     return x, k_cache, v_cache
 
@@ -201,13 +229,22 @@ def forward_uncompiled(
 
     x = params.embedding[tokens].astype(jnp.float32)
 
+    # the scan's xs carry only the layer index and this layer's cache slice;
+    # the stacked weights ride in via closure and each matmul selects its
+    # layer inside the kernel — scanning over sliced weights instead would
+    # copy every layer's weights out of the stack on every step (a
+    # dynamic-slice cannot fuse into a pallas_call)
     def body(carry, per_layer):
         x = carry
-        lp, k_c, v_c = per_layer
-        x, k_c, v_c = _layer(cfg, rope, x, positions, pos_start, lp, k_c, v_c)
+        li, k_c, v_c = per_layer
+        x, k_c, v_c = _layer(
+            cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
+            layer_idx=li,
+        )
         return x, (k_c, v_c)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params.layers, cache.k, cache.v))
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layer_ids, cache.k, cache.v))
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":

@@ -61,6 +61,94 @@ def _kernel(x_ref, qt_ref, dt_ref, out_ref):
         out_ref[...] += acc
 
 
+def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
+    # identical math to _kernel — the layer offset was folded into the block
+    # index by the scalar-prefetch index_map (the stacked array arrives
+    # flattened to 3D so the blocks match the unstacked kernel exactly)
+    k = pl.program_id(1)
+    if x_ref.dtype == jnp.bfloat16:
+        # dequant in bf16: the weight lands in bf16 either way (x's dtype);
+        # multiplying in bf16 vs f32-then-cast differs only by one rounding
+        w = qt_ref[...].astype(jnp.bfloat16) * dt_ref[...][:, None, :].astype(jnp.bfloat16)
+    else:
+        w = (qt_ref[...].astype(jnp.float32) * dt_ref[...][:, None, :]).astype(x_ref.dtype)
+    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
+    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("dtype", "interpret"))
+def q40_matmul_pallas_stacked(
+    x: jnp.ndarray,  # [..., in_features]
+    qt: jnp.ndarray,  # [L, nb, 32, out] — all layers, resident in HBM
+    dt: jnp.ndarray,  # [L, nb, out]
+    layer: jnp.ndarray,  # scalar int32 — which layer's weight to use
+    dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ w[layer] for a stacked Q40 weight, without materializing the
+    layer's slice.
+
+    The layer index rides in as a scalar-prefetch argument and offsets the
+    BlockSpec index_maps, so the kernel DMAs only layer `layer`'s tiles out
+    of the full stacked array. This is what lets the transformer `lax.scan`
+    over layers (one compiled body) while keeping weight traffic at ~1
+    byte/weight: scanning over sliced weights instead would force XLA to
+    materialize a full copy of every layer's weights each step, because a
+    dynamic-slice cannot fuse into an opaque pallas_call (the copies dominated
+    the round-1 decode profile).
+    """
+    L, nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    lead = x.shape[:-1]
+    b = 1
+    for s in lead:
+        b *= s
+    x2 = x.reshape(b, in_features).astype(dtype)
+
+    tile_n = min(DEFAULT_TILE_N, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = min(DEFAULT_TILE_KNB, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+
+    # flatten the layer axis into the block-row axis (a free bitcast — the
+    # memory is contiguous) so the kernel sees the same 3D blocks as the
+    # unstacked kernel; the layer offset folds into the block index
+    k_steps = nb // tile_knb
+    qt3 = qt.reshape(L * nb, Q_BLOCK, out)
+    dt3 = dt.reshape(L * nb, out)
+
+    grid = (out // tile_n, k_steps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
+            pl.BlockSpec(
+                (tile_knb, Q_BLOCK, tile_n), lambda j, k, l: (l[0] * k_steps + k, 0, j)
+            ),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)),
+        ],
+        out_specs=pl.BlockSpec((b, tile_n), lambda j, k, l: (0, j)),
+    )
+    out2 = pl.pallas_call(
+        _kernel_stacked,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, out), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x2, qt3, dt3)
+    return out2.reshape(*lead, out)
+
+
 @partial(jax.jit, static_argnames=("dtype", "interpret"))
 def q40_matmul_pallas(
     x: jnp.ndarray,  # [..., in_features]

@@ -122,22 +122,45 @@ def _quant_matmul_xla(x, q, d, dtype):
 
 
 def quant_matmul(
-    x: jnp.ndarray, w: QuantTensor, dtype=jnp.bfloat16, out_dtype=None, pallas=None
+    x: jnp.ndarray,
+    w: QuantTensor,
+    dtype=jnp.bfloat16,
+    out_dtype=None,
+    pallas=None,
+    layer=None,
 ) -> jnp.ndarray:
     """``x @ w.T`` (logical): x [..., in_features] -> [..., out_features].
-    Only 3D (unstacked) QuantTensors are supported here — expert stacks go
-    through models.transformer._expert_matmul.
+
+    `w` is either an unstacked (3D q) QuantTensor, or — with `layer` given —
+    an all-layers stack (4D q, [L, nb, 32, out]): the matmul then uses
+    ``w[layer]`` *without materializing the slice* (the Pallas kernel offsets
+    its DMA by a scalar-prefetched layer index; the XLA fallback pays a
+    dynamic-slice). This is how the transformer's `lax.scan` over layers
+    avoids copying every layer's weights each step. Expert stacks go through
+    models.transformer._expert_matmul.
 
     `dtype` is the MXU operand dtype (bf16 fast path, f32 parity path);
     accumulation is always f32. `pallas`: None = auto (fused Pallas kernel on
     TPU when tile-aligned), False = force the XLA dequant+dot path (required
     under GSPMD sharding — see ModelConfig.use_pallas), True = force-enable.
     """
-    from .pallas_q40 import q40_matmul_aligned, q40_matmul_pallas
+    from .pallas_q40 import (
+        q40_matmul_aligned,
+        q40_matmul_pallas,
+        q40_matmul_pallas_stacked,
+    )
 
-    assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
     if pallas is None:
         pallas = _use_pallas()
+    if layer is not None and w.q.ndim == 4:
+        if pallas and w.out_features % 128 == 0 and x.shape[-1] == w.in_features:
+            out = q40_matmul_pallas_stacked(x, w.q, w.d, layer, dtype=dtype)
+        else:
+            q = jax.lax.dynamic_index_in_dim(w.q, layer, 0, keepdims=False)
+            d = jax.lax.dynamic_index_in_dim(w.d, layer, 0, keepdims=False)
+            out = _quant_matmul_xla(x, q, d, dtype)
+        return out.astype(out_dtype if out_dtype is not None else x.dtype)
+    assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
     if pallas and q40_matmul_aligned(x, w):
         out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype)
     else:

@@ -137,3 +137,20 @@ def test_batched_sequences_independent(tmp_path):
             cfg, params, rope, solo_cache, jnp.asarray([seq], jnp.int32), jnp.int32(0)
         )
         np.testing.assert_allclose(np.asarray(logits[i]), np.asarray(solo[0]), rtol=1e-4, atol=1e-4)
+
+
+def test_f32_roles_survive_bf16_load(tmp_path):
+    """The embedding and MoE router gate stay f32 even when the compute dtype
+    is bfloat16 (the reference keeps both f32; bf16 router logits can flip
+    expert selection on near-ties)."""
+    h = tiny_header(
+        arch=ArchType.QWEN3_MOE, rope_type=RopeType.FALCON,
+        n_experts=4, n_active_experts=2, moe_hidden_dim=64,
+    )
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, h, seed=3)
+    reader = MFileReader(path)
+    cfg = config_from_header(reader.header, compute_dtype="bfloat16")
+    params = load_params(reader, cfg)
+    assert params.embedding.dtype == jnp.float32
+    assert params.layers.moe_gate.dtype == jnp.float32
