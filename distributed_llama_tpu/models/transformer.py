@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from ..formats.mfile import HiddenAct
 from ..ops import gqa_attention, moe_router, rms_norm
 from ..ops.activations import gelu, silu
-from ..ops.quant import QuantTensor, quant_matmul, quantize_q80_activations
+from ..ops.quant import QuantTensor, dequantize_t, quant_matmul, quantize_q80_activations
 from ..ops.rope import RopeTables, apply_rope
 from .config import ModelConfig
 from .params import KVCache, LayerParams, ModelParams
@@ -101,8 +101,7 @@ def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndar
     if isinstance(w, QuantTensor):
         if q80:
             x = quantize_q80_activations(x)
-        wd = (w.q.astype(jnp.float32) * w.d[..., None, :]).astype(dtype)
-        wd = wd.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
+        wd = dequantize_t(w, dtype)
         eq = "btki,btkio->btko"
     else:
         wd = w.astype(dtype)
@@ -113,25 +112,71 @@ def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndar
     return y.astype(x.dtype)
 
 
-def _moe_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:
-    """Top-k expert SwiGLU, matching the reference MoE graph
-    (src/llm.cpp:440-514): router on the *normed* activation, per-token
-    expert weight indexing, weighted merge-sum.
+def _n_local_experts(w: Any) -> int:
+    """Expert count of a (layer-selected) stacked expert weight."""
+    return w.q.shape[0] if isinstance(w, QuantTensor) else w.shape[0]
 
-    Formulation: gather the k active experts' weights per token. Memory is
-    O(tokens * k * expert_params); the engine keeps prefill chunks small
-    enough for this. (A sort-based ragged dispatch is the planned upgrade for
-    large-batch prefill.)
+
+def _moe_ffn(
+    cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None, ep_axis=None
+) -> jnp.ndarray:
+    """Top-k expert SwiGLU, matching the reference MoE graph
+    (src/llm.cpp:440-514): router on the *normed* activation, top-k expert
+    selection, weighted merge-sum.
+
+    Two formulations, chosen at trace time (token count is static under jit)
+    by comparing weight traffic: the ragged path streams ALL n_experts'
+    weights once, the gather path reads (and materializes) one expert weight
+    set per (token, slot) row — so ragged wins iff rows >= n_experts:
+    * rows >= E (prefill chunks): sort-based ragged dispatch (ops/moe.py
+      moe_ffn_ragged) — `lax.ragged_dot` against the HBM-resident expert
+      stacks; flat O(rows) activation memory at any chunk size.
+    * rows < E (decode, tiny tail chunks): gather the active experts'
+      weights per token — reads only the weights the math needs, the
+      bandwidth-optimal decode shape (the reference's per-expert indexed
+      matmul, src/nn/nn-cpu-ops.cpp:1166-1192).
+
+    `ep_axis`: shard_map expert parallelism — the expert axis of w1/w2/w3 is
+    sharded over that mesh axis (gate stays replicated, so routing is
+    global); each shard computes its resident experts' contributions and the
+    results combine with one psum.
     """
     idx, wts = moe_router(y, _sel_layer(lp.moe_gate, layer), cfg.n_active_experts)  # [b,t,k]
-    w1 = _gather_expert(_sel_layer(lp.w1, layer), idx)
-    w3 = _gather_expert(_sel_layer(lp.w3, layer), idx)
-    w2 = _gather_expert(_sel_layer(lp.w2, layer), idx)
-    xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
+    w1 = _sel_layer(lp.w1, layer)
+    w3 = _sel_layer(lp.w3, layer)
+    w2 = _sel_layer(lp.w2, layer)
     q80 = cfg.q80_activations
+
+    rows = y.shape[0] * y.shape[1] * cfg.n_active_experts
+    if rows >= cfg.n_experts:
+        from ..ops.moe import moe_ffn_ragged
+
+        return moe_ffn_ragged(
+            y, idx, wts, w1, w3, w2, partial(_activation, cfg), cfg.dtype,
+            q80=q80, ep_axis=ep_axis,
+        )
+
+    if ep_axis is not None:
+        # small-chunk under EP: gather against the LOCAL expert slice — slots
+        # routed to another shard's experts are clamped and zero-weighted,
+        # and the shards' partials psum-combine
+        n_local = _n_local_experts(w1)
+        e0 = jax.lax.axis_index(ep_axis) * n_local
+        idx_local = idx - e0
+        valid = (idx_local >= 0) & (idx_local < n_local)
+        idx = jnp.clip(idx_local, 0, n_local - 1)
+        wts = wts * valid.astype(wts.dtype)
+
+    w1 = _gather_expert(w1, idx)
+    w3 = _gather_expert(w3, idx)
+    w2 = _gather_expert(w2, idx)
+    xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
     h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
     out = _expert_matmul(h, w2, cfg.dtype, q80)  # [b,t,k,dim]
-    return jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts).astype(y.dtype)
+    out = jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts)
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    return out.astype(y.dtype)
 
 
 def _layer(
@@ -151,6 +196,9 @@ def _layer(
     # sharded under shard_map (long-context sequence parallelism): cache
     # writes become boundary-safe scatters and attention combines partial
     # online-softmax stats across the axis (ops/attention.py gqa_attention_sp)
+    ep_axis=None,  # mesh axis name when the MoE expert stacks are sharded
+    # under shard_map (expert parallelism — see _moe_ffn); attention weights
+    # are replicated over this axis and the MoE output psums over it
     layer_idx=None,  # scalar int32 when `lp` holds ALL layers stacked: the
     # big matmuls select the layer inside the Pallas kernel (no weight-slice
     # copy — see quant_matmul) and the small per-layer tensors are sliced
@@ -202,7 +250,9 @@ def _layer(
     # --- ffn block ---
     y = rms_norm(x, _sel_layer(lp.norm1, layer_idx), cfg.norm_epsilon)
     ff = (
-        _moe_ffn(cfg, y, lp, layer_idx) if cfg.is_moe else _dense_ffn(cfg, y, lp, layer_idx)
+        _moe_ffn(cfg, y, lp, layer_idx, ep_axis=ep_axis)
+        if cfg.is_moe
+        else _dense_ffn(cfg, y, lp, layer_idx)
     )
     x = x + reduce_fn(ff).astype(x.dtype)
     return x, k_cache, v_cache

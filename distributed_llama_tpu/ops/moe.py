@@ -1,7 +1,8 @@
-"""MoE router (Qwen3-MoE style).
+"""MoE router and ragged expert dispatch (Qwen3-MoE style).
 
-Semantics match the reference graph exactly (reference: src/llm.cpp:440-514 +
-moeGateForward_F32_F32, src/nn/nn-cpu-ops.cpp:1462-1492):
+Router semantics match the reference graph exactly (reference:
+src/llm.cpp:440-514 + moeGateForward_F32_F32,
+src/nn/nn-cpu-ops.cpp:1462-1492):
 
     probs  = softmax(x @ gate.T)            # full softmax over all experts
     topk   = top-k of probs
@@ -9,16 +10,32 @@ moeGateForward_F32_F32, src/nn/nn-cpu-ops.cpp:1462-1492):
 
 The reference then runs each active expert's SwiGLU through matmul kernels
 that index a stacked weight tensor by expert id
-(reference: src/nn/nn-cpu-ops.cpp:1166-1192). On TPU the equivalent is a
-gather-free einsum over one-hot combine weights (small models / tiny batch)
-or a sort-based ragged dispatch; models/transformer.py uses the dense
-einsum formulation, which XLA turns into gathered matmuls.
+(reference: src/nn/nn-cpu-ops.cpp:1166-1192). The TPU-native equivalent here
+is a *sort-based ragged dispatch*: flatten the (token, slot) pairs, sort them
+by expert id, and run the three FFN matmuls as `lax.ragged_dot` grouped
+matmuls against the stacked expert weights resident in HBM. Memory is
+O(rows * ff) activations and the weights are never gathered per token —
+exact (no capacity factor, no dropped tokens), static shapes, MXU-tiled.
+Single-token decode keeps the per-token gather formulation
+(models/transformer.py) — reading only the k active experts' weights is
+bandwidth-optimal there.
+
+Expert parallelism: `moe_ffn_ragged(..., ep_axis=...)` runs under shard_map
+with the expert axis of the stacked weights sharded over the mesh's `ep`
+axis. Each shard sorts the GLOBAL row list, folds the rows belonging to
+other shards into two zero-weight boundary groups (a padded [1+E_local+1]
+group vector against a zero-padded weight stack — those rows produce exact
+zeros), and the shards' partial outputs combine with one psum. This replaces
+the reference's TP-within-expert-only layout (every node holds a slice of
+every expert) with true expert placement; there is no reference analogue.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from .quant import QuantTensor, dequantize_t, quantize_q80_activations
 
 
 def moe_router(
@@ -40,3 +57,95 @@ def moe_router(
     if norm_topk:
         top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
     return top_i.astype(jnp.int32), top_p
+
+
+def expert_stack_matrix(w, dtype) -> jnp.ndarray:
+    """[E, in, out] dense matrix from a stacked expert weight — QuantTensor
+    in the T layout (via quant.dequantize_t) or dense [E, out, in]. The
+    contracting (`in`) axis lands in the middle, the shape `lax.ragged_dot`
+    wants for its rhs."""
+    if isinstance(w, QuantTensor):
+        return dequantize_t(w, dtype)
+    return jnp.swapaxes(w, -1, -2).astype(dtype)
+
+
+def moe_ffn_ragged(
+    y: jnp.ndarray,  # [b, t, dim] normed activations
+    idx: jnp.ndarray,  # [b, t, k] int32 expert ids (GLOBAL, from moe_router)
+    wts: jnp.ndarray,  # [b, t, k] f32 combine weights
+    w1,
+    w3,
+    w2,  # stacked expert weights (QuantTensor T layout or dense [E?,out,in])
+    act_fn,  # hidden activation (silu/gelu)
+    dtype,  # MXU operand dtype
+    q80: bool = False,  # reference-parity Q80 activation round-trip
+    ep_axis: str | None = None,  # shard_map axis name when experts are sharded
+) -> jnp.ndarray:
+    """Exact top-k expert SwiGLU via sort + grouped (ragged) matmuls.
+
+    Math identical to the per-token gather formulation
+    (models/transformer.py _moe_ffn): for every (token, slot) row,
+    h = act(y@w1[e]) * (y@w3[e]); out = sum_k wts * (h@w2[e]) — but executed
+    as three `lax.ragged_dot`s over expert-sorted rows, so the expert weights
+    stream from HBM once per chunk instead of being gathered per token.
+    """
+    b, t, dim = y.shape
+    k = idx.shape[-1]
+    n_tok = b * t
+    rows = n_tok * k
+
+    e_flat = idx.reshape(rows)
+    order = jnp.argsort(e_flat, stable=True)  # row r -> (token r//k, slot r%k)
+    tok = order // k
+    xs = y.reshape(n_tok, dim)[tok]  # [rows, dim] expert-sorted inputs
+
+    w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
+    w3m = expert_stack_matrix(w3, dtype)
+    w2m = expert_stack_matrix(w2, dtype)  # [E_local, ff, dim]
+    n_local = w1m.shape[0]
+
+    if ep_axis is None:
+        group_sizes = jnp.bincount(e_flat, length=n_local).astype(jnp.int32)
+    else:
+        # this shard owns experts [e0, e0 + n_local); rows for other shards'
+        # experts are contiguous prefix/suffix runs of the sorted order —
+        # fold them into two zero-weight boundary groups so they contribute
+        # exact zeros, then psum the shards' partials
+        ep = jax.lax.psum(1, ep_axis)
+        n_experts = n_local * ep
+        counts = jnp.bincount(e_flat, length=n_experts)
+        e0 = jax.lax.axis_index(ep_axis) * n_local
+        ar = jnp.arange(n_experts)
+        before = jnp.sum(jnp.where(ar < e0, counts, 0))
+        after = jnp.sum(jnp.where(ar >= e0 + n_local, counts, 0))
+        local = jax.lax.dynamic_slice(counts, (e0,), (n_local,))
+        group_sizes = jnp.concatenate(
+            [before[None], local, after[None]]
+        ).astype(jnp.int32)
+
+        def pad(w):
+            z = jnp.zeros((1,) + w.shape[1:], w.dtype)
+            return jnp.concatenate([z, w, z], axis=0)
+
+        w1m, w3m, w2m = pad(w1m), pad(w3m), pad(w2m)
+
+    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+
+    def rdot(x_, w_):
+        return jax.lax.ragged_dot(
+            x_.astype(dtype), w_, group_sizes,
+            precision=precision, preferred_element_type=jnp.float32,
+        )
+
+    xq = quantize_q80_activations(xs) if q80 else xs
+    h = (act_fn(rdot(xq, w1m)) * rdot(xq, w3m)).astype(y.dtype)
+    hq = quantize_q80_activations(h) if q80 else h
+    out_rows = rdot(hq, w2m)  # [rows, dim] f32
+
+    w_flat = wts.reshape(rows)[order].astype(jnp.float32)
+    out = jnp.zeros((n_tok, dim), jnp.float32).at[tok].add(
+        out_rows * w_flat[:, None]
+    )
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    return out.reshape(b, t, dim).astype(y.dtype)

@@ -92,11 +92,18 @@ def quant_tensor_from_q40(q: np.ndarray, d: np.ndarray) -> QuantTensor:
     return QuantTensor(q=jnp.asarray(qt), d=jnp.asarray(dt))
 
 
+def dequantize_t(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
+    """Materialize the [..., in_features, out_features] matmul-ready matrix
+    (the T layout's natural orientation). Single owner of the dequant
+    formula: value = q * d broadcast over the 32-sublane axis, scale multiply
+    in f32, one cast at the end."""
+    x = (w.q.astype(jnp.float32) * w.d[..., None, :]).astype(dtype)
+    return x.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
+
+
 def dequantize(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
     """Materialize the logical [..., out_features, in_features] weight."""
-    x = w.q.astype(jnp.float32) * w.d[..., None, :]  # [..., nb, 32, out]
-    x = x.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
-    return jnp.swapaxes(x, -1, -2).astype(dtype)
+    return jnp.swapaxes(dequantize_t(w, dtype), -1, -2)
 
 
 def _use_pallas() -> bool:

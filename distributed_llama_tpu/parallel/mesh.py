@@ -7,6 +7,8 @@ axes carry the roles the reference encoded in its PPxTP rank layout:
 
   dp — data/replica parallel (reference: gateway-level request DP)
   pp — pipeline stages       (reference: ppRank, layer ranges)
+  ep — expert parallel       (reference: TP-within-expert only; true expert
+                              placement has no reference analogue)
   tp — tensor parallel       (reference: tpRank, head/ff split + all-reduce)
   sp — sequence parallel     (no reference analogue; long-context sharding)
 """
@@ -17,21 +19,23 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "pp", "tp", "sp")
+AXES = ("dp", "pp", "ep", "tp", "sp")
 
 
 def make_mesh(
-    tp: int = 1, pp: int = 1, dp: int = 1, sp: int = 1, devices=None
+    tp: int = 1, pp: int = 1, dp: int = 1, sp: int = 1, ep: int = 1, devices=None
 ) -> Mesh:
-    """Build a ("dp","pp","tp","sp") mesh over the first dp*pp*tp*sp devices.
+    """Build a ("dp","pp","ep","tp","sp") mesh over the first
+    dp*pp*ep*tp*sp devices.
 
-    Axis order puts tp/sp innermost so TP/SP collectives ride the
-    fastest/nearest ICI links under the default device enumeration.
+    Axis order puts ep/tp/sp innermost so the per-layer collectives (TP
+    all-reduce, EP combine-psum, SP softmax-combine) ride the fastest/nearest
+    ICI links under the default device enumeration.
     """
-    n = dp * pp * tp * sp
+    n = dp * pp * ep * tp * sp
     if devices is None:
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, pp, tp, sp)
+    arr = np.asarray(devices[:n]).reshape(dp, pp, ep, tp, sp)
     return Mesh(arr, AXES)

@@ -29,6 +29,19 @@ from jax.sharding import Mesh
 from .mesh import AXES
 
 
+def _distributed_client_active() -> bool:
+    """Whether jax.distributed.initialize has already run — checked WITHOUT
+    touching the local backend. (`jax.process_count()` would initialize the
+    backend as a side effect, and on a real pod `jax.distributed.initialize`
+    must run *before* any backend initialization or bring-up fails.)"""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -36,8 +49,12 @@ def initialize_distributed(
 ) -> None:
     """Initialize the multi-controller runtime (no-op if single-process or
     already initialized). Arguments default to the JAX_* env vars / TPU
-    metadata, so on a TPU pod slice a bare call suffices."""
-    if jax.process_count() > 1:
+    metadata, so on a TPU pod slice a bare call suffices.
+
+    Must be called before anything initializes the local backend (first
+    `jax.devices()` / array op) — same ordering contract as
+    `jax.distributed.initialize` itself."""
+    if _distributed_client_active():
         return  # already initialized
     kw = {}
     if coordinator_address:
@@ -47,16 +64,22 @@ def initialize_distributed(
     if process_id is not None:
         kw["process_id"] = process_id
     if kw or os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize(**kw)
+        try:
+            jax.distributed.initialize(**kw)
+        except RuntimeError as e:
+            # keep the documented no-op contract even if the private
+            # global_state probe above stops working in a future JAX
+            if "already initialized" not in str(e).lower():
+                raise
 
 
 def make_multihost_mesh(
-    tp: int = 0, pp: int = 1, dp: int = 1, sp: int = 1
+    tp: int = 0, pp: int = 1, dp: int = 1, sp: int = 1, ep: int = 1
 ) -> Mesh:
-    """Global ("dp","pp","tp","sp") mesh over all hosts' devices.
+    """Global ("dp","pp","ep","tp","sp") mesh over all hosts' devices.
 
     tp=0 means "all remaining devices". Device order: JAX enumerates TPU
-    devices so that consecutive devices share ICI; keeping tp/sp innermost
+    devices so that consecutive devices share ICI; keeping ep/tp/sp innermost
     (fastest-varying) puts the per-layer collectives on ICI links, and
     pp/dp split across hosts/slices where only stage handoffs (ppermute)
     or nothing cross DCN.
@@ -64,12 +87,12 @@ def make_multihost_mesh(
     devices = jax.devices()
     n = len(devices)
     if tp == 0:
-        denom = pp * dp * sp
+        denom = pp * dp * sp * ep
         if n % denom:
-            raise ValueError(f"{n} devices not divisible by pp*dp*sp={denom}")
+            raise ValueError(f"{n} devices not divisible by pp*dp*sp*ep={denom}")
         tp = n // denom
-    need = dp * pp * tp * sp
+    need = dp * pp * ep * tp * sp
     if need != n:
-        raise ValueError(f"mesh {dp}x{pp}x{tp}x{sp} != {n} global devices")
-    arr = np.asarray(devices).reshape(dp, pp, tp, sp)
+        raise ValueError(f"mesh {dp}x{pp}x{ep}x{tp}x{sp} != {n} global devices")
+    arr = np.asarray(devices).reshape(dp, pp, ep, tp, sp)
     return Mesh(arr, AXES)

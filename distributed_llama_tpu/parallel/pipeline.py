@@ -52,10 +52,12 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
     # T-layout quant pairs (ops/quant.py): q [L, nb, 32, out], d [L, nb, out]
     row = entry((_ns("pp", None, None, "tp"), _ns("pp", None, "tp")), _ns("pp", "tp", None))
     col = entry((_ns("pp", "tp", None, None), _ns("pp", "tp", None)), _ns("pp", None, "tp"))
-    erow = entry((_ns("pp", None, None, None, "tp"), _ns("pp", None, None, "tp")),
-                 _ns("pp", None, "tp", None))
-    ecol = entry((_ns("pp", None, "tp", None, None), _ns("pp", None, "tp", None)),
-                 _ns("pp", None, None, "tp"))
+    # expert stacks [L, E, ...]: expert axis over `ep` (true expert
+    # placement), ff axis over `tp` (the reference's TP-within-expert)
+    erow = entry((_ns("pp", "ep", None, None, "tp"), _ns("pp", "ep", None, "tp")),
+                 _ns("pp", "ep", "tp", None))
+    ecol = entry((_ns("pp", "ep", "tp", None, None), _ns("pp", "ep", "tp", None)),
+                 _ns("pp", "ep", None, "tp"))
     lrep = entry((_ns("pp"), _ns("pp")), _ns("pp"))  # per-layer vectors
     rep = entry((_ns(), _ns()), _ns())
 
@@ -82,7 +84,7 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
 
 
-def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx):
+def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx, ep_axis=None):
     """Run this device's resident layers over x (a scan, like the global
     forward but over the local slice)."""
     reduce_fn = lambda z: jax.lax.psum(z, "tp")
@@ -92,7 +94,7 @@ def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, s
         lp, k_c, v_c = per_layer
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, lp, k_c, v_c,
-            reduce_fn=reduce_fn, sp_ctx=sp_ctx,
+            reduce_fn=reduce_fn, sp_ctx=sp_ctx, ep_axis=ep_axis,
         )
         return x, (k_c, v_c)
 
@@ -173,6 +175,7 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
         if sp > 1:
             local_seq = k_cache.shape[2]
             sp_ctx = ("sp", jax.lax.axis_index("sp") * local_seq)
+        ep_axis = "ep" if mesh.shape.get("ep", 1) > 1 else None
 
         emb = params.embedding
         x_all = emb[tokens].astype(jnp.float32)  # [b, t, dim]
@@ -193,7 +196,8 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
             positions = jnp.broadcast_to(positions, (b, mt))
 
             y, k_upd, v_upd = _local_stage(
-                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache, sp_ctx
+                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
+                sp_ctx, ep_axis=ep_axis,
             )
             # commit cache only when this stage held a real microbatch
             active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)

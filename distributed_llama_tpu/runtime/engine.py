@@ -108,11 +108,13 @@ class InferenceEngine:
         self.mesh = mesh
         shardings = None
         self._cache_sharding = None
-        # pipeline execution (shard_map PPxTP[xSP]) when the mesh has pp or
-        # sp extent: layer/seq axes shard only under the explicit path.
-        # TP-only (or dp) meshes run GSPMD.
+        # pipeline execution (shard_map PPxTP[xSPxEP]) when the mesh has pp,
+        # sp, or ep extent: layer/seq/expert axes shard only under the
+        # explicit path. TP-only (or dp) meshes run GSPMD.
         self.use_pipeline = mesh is not None and (
-            mesh.shape["pp"] > 1 or mesh.shape["sp"] > 1
+            mesh.shape["pp"] > 1
+            or mesh.shape["sp"] > 1
+            or mesh.shape.get("ep", 1) > 1
         )
         if self.use_pipeline:
             from ..parallel.pipeline import pp_cache_sharding, pp_param_shardings

@@ -1,0 +1,137 @@
+"""MoE ragged dispatch + expert parallelism.
+
+The ragged path (ops/moe.py moe_ffn_ragged: sort by expert + lax.ragged_dot
+grouped matmuls) must be numerically equivalent to the per-token gather
+formulation at every chunk size, and the ep-sharded variant must match the
+unsharded one exactly. (Reference MoE graph: src/llm.cpp:440-514; the
+reference has no expert placement — every node holds a slice of every
+expert — so EP correctness is tested against our own single-device path.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models import config_from_header, forward, init_kv_cache, load_params
+from distributed_llama_tpu.formats.mfile import ArchType, MFileReader, RopeType
+from distributed_llama_tpu.ops import build_rope_tables
+from distributed_llama_tpu.ops.moe import moe_ffn_ragged, moe_router
+from distributed_llama_tpu.parallel import make_mesh
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+
+def _moe_model(tmp_path, n_layers=2, n_experts=4, seq_len=64):
+    h = tiny_header(
+        arch=ArchType.QWEN3_MOE,
+        rope_type=RopeType.FALCON,
+        dim=64,
+        hidden_dim=96,
+        n_layers=n_layers,
+        n_heads=4,
+        n_kv_heads=2,
+        n_experts=n_experts,
+        n_active_experts=2,
+        moe_hidden_dim=64,  # Q40 needs in_features % 32 == 0 (w2's in axis)
+        seq_len=seq_len,
+    )
+    path = str(tmp_path / "moe.m")
+    write_tiny_model(path, h, seed=11)
+    return path
+
+
+def _gather_ffn(y, idx, wts, w1m, w3m, w2m):
+    """Straight-line per-row reference: for each (token, slot) row compute
+    silu(y@w1[e]) * (y@w3[e]) @ w2[e], then the weighted sum."""
+    b, t, dim = y.shape
+    k = idx.shape[-1]
+    out = np.zeros((b, t, dim), np.float32)
+    for bi in range(b):
+        for ti in range(t):
+            for ki in range(k):
+                e = int(idx[bi, ti, ki])
+                x = np.asarray(y[bi, ti], np.float32)
+                h = (x @ w1m[e]) * (1 / (1 + np.exp(-(x @ w1m[e])))) * (x @ w3m[e])
+                out[bi, ti] += float(wts[bi, ti, ki]) * (h @ w2m[e])
+    return out
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (1, 7), (2, 16)])
+def test_ragged_matches_dense_reference(shape):
+    """moe_ffn_ragged == the per-row dense math, at decode and prefill shapes."""
+    b, t = shape
+    dim, ff, E, k = 32, 24, 5, 2
+    rng = np.random.default_rng(7)
+    y = jnp.asarray(rng.normal(size=(b, t, dim)).astype(np.float32))
+    gate = jnp.asarray(rng.normal(size=(E, dim)).astype(np.float32))
+    w1 = rng.normal(size=(E, ff, dim)).astype(np.float32) * 0.2
+    w3 = rng.normal(size=(E, ff, dim)).astype(np.float32) * 0.2
+    w2 = rng.normal(size=(E, dim, ff)).astype(np.float32) * 0.2
+
+    idx, wts = moe_router(y, gate, k)
+    got = moe_ffn_ragged(
+        y, idx, wts, jnp.asarray(w1), jnp.asarray(w3), jnp.asarray(w2),
+        jax.nn.silu, jnp.float32,
+    )
+    # dense reference: [E, in, out] matrices
+    w1m = np.swapaxes(w1, 1, 2)
+    w3m = np.swapaxes(w3, 1, 2)
+    w2m = np.swapaxes(w2, 1, 2)
+    want = _gather_ffn(np.asarray(y), np.asarray(idx), np.asarray(wts), w1m, w3m, w2m)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_prefill_chunk_sizes_agree(tmp_path):
+    """Prefill in one big chunk (ragged path) must equal token-by-token decode
+    (gather path) — the trace-time formulation switch is invisible."""
+    path = _moe_model(tmp_path)
+    reader = MFileReader(path)
+    cfg = config_from_header(reader.header, compute_dtype="float32")
+    params = load_params(reader, cfg)
+    rope = build_rope_tables(reader.header)
+    tokens = [5, 42, 7, 199, 23, 8, 101, 54]
+
+    cache_a = init_kv_cache(cfg, batch=1)
+    logits_a, cache_a = forward(
+        cfg, params, rope, cache_a, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+
+    cache_b = init_kv_cache(cfg, batch=1)
+    for p, t in enumerate(tokens):
+        logits_b, cache_b = forward(
+            cfg, params, rope, cache_b, jnp.asarray([[t]], jnp.int32), jnp.int32(p)
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache_a.k), np.asarray(cache_b.k), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_engine_ep_mesh_matches_single_device(tmp_path):
+    """ep=2 x tp=2 engine generations == single-device generations (prefill
+    exercises the ep-ragged path, decode the masked-gather path)."""
+    path = _moe_model(tmp_path, n_layers=2, n_experts=4)
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4, 56], 16, sampler=None).tokens
+
+    eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(ep=2, tp=2))
+    assert eng.use_pipeline  # ep routes through the explicit shard_map path
+    # expert axis is genuinely placed: each device holds E/ep experts
+    w1q = eng.params.layers.w1.q
+    assert w1q.sharding.spec[1] == "ep"
+    got = eng.generate([3, 17, 99, 4, 56], 16, sampler=None).tokens
+    assert got == want
+
+
+def test_engine_ep_pp_mesh_matches(tmp_path):
+    """ep composed with pp (2 stages x 2 expert shards)."""
+    path = _moe_model(tmp_path, n_layers=4, n_experts=4)
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4], 12, sampler=None).tokens
+
+    eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(ep=2, pp=2))
+    got = eng.generate([3, 17, 99, 4], 12, sampler=None).tokens
+    assert got == want
