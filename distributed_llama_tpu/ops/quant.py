@@ -159,9 +159,14 @@ def quant_matmul(
 
     if pallas is None:
         pallas = _use_pallas()
+    # interpret mode: lets CPU tests drive the exact Pallas kernel code path
+    # (pallas=True forced) without TPU hardware
+    interpret = bool(os.environ.get("DLT_PALLAS_INTERPRET"))
     if layer is not None and w.q.ndim == 4:
         if pallas and w.out_features % 128 == 0 and x.shape[-1] == w.in_features:
-            out = q40_matmul_pallas_stacked(x, w.q, w.d, layer, dtype=dtype)
+            out = q40_matmul_pallas_stacked(
+                x, w.q, w.d, layer, dtype=dtype, interpret=interpret
+            )
         else:
             q = jax.lax.dynamic_index_in_dim(w.q, layer, 0, keepdims=False)
             d = jax.lax.dynamic_index_in_dim(w.d, layer, 0, keepdims=False)
@@ -169,7 +174,7 @@ def quant_matmul(
         return out.astype(out_dtype if out_dtype is not None else x.dtype)
     assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
     if pallas and q40_matmul_aligned(x, w):
-        out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype)
+        out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype, interpret=interpret)
     else:
         out = _quant_matmul_xla(x, w.q, w.d, dtype)
     return out.astype(out_dtype if out_dtype is not None else x.dtype)

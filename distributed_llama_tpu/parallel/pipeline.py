@@ -26,6 +26,7 @@ for exactly this reason).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -131,98 +132,207 @@ def pipeline_forward(
             f"microbatches ({microbatches}) must divide the token length "
             f"({jnp.shape(tokens)[-1]})"
         )
+    fn = _cached_pipeline_fn(
+        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches),
+        lambda ps, cs: _build_pipeline_fn(cfg, mesh, ps, cs, logits_mode, microbatches),
+    )
+    return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
+
+
+def _cached_pipeline_fn(cfg, mesh, params, cache, extra_key, builder):
+    """Build-once cache for the jitted shard_map programs.
+
+    Partition specs must be read off the *concrete* input arrays (inside jit
+    they are tracers without NamedShardings), so the program is built once
+    per (cfg, mesh, variant, specs) and cached. The Pallas interpret-mode
+    env toggle participates in the key — a program traced in one mode must
+    not be replayed in the other.
+    """
     params_leaves, params_def = jax.tree.flatten(params)
     cache_leaves, cache_def = jax.tree.flatten(cache)
-    params_spec = jax.tree.unflatten(params_def, [_spec_of(a) for a in params_leaves])
-    cache_spec = jax.tree.unflatten(cache_def, [_spec_of(a) for a in cache_leaves])
     key = (
         cfg,
         mesh,
-        logits_mode,
-        microbatches,
+        extra_key,
+        bool(os.environ.get("DLT_PALLAS_INTERPRET")),
         tuple(_spec_of(a) for a in params_leaves),
         tuple(_spec_of(a) for a in cache_leaves),
     )
     fn = _COMPILED.get(key)
     if fn is None:
-        fn = _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbatches)
+        params_spec = jax.tree.unflatten(params_def, [_spec_of(a) for a in params_leaves])
+        cache_spec = jax.tree.unflatten(cache_def, [_spec_of(a) for a in cache_leaves])
+        fn = builder(params_spec, cache_spec)
         _COMPILED[key] = fn
-    return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
+    return fn
+
+
+def _mesh_ctx(mesh, k_cache):
+    """(sp_ctx, ep_axis) for a shard_map body over this mesh."""
+    sp_ctx = None
+    if mesh.shape["sp"] > 1:
+        local_seq = k_cache.shape[2]
+        sp_ctx = ("sp", jax.lax.axis_index("sp") * local_seq)
+    ep_axis = "ep" if mesh.shape.get("ep", 1) > 1 else None
+    return sp_ctx, ep_axis
+
+
+def _stage_rounds(
+    cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start, n_micro, sp_ctx, ep_axis
+):
+    """Push x_all [b, t, dim] through the GPipe schedule; returns
+    (x_out [b, t, dim] — valid on every stage, k_cache, v_cache).
+
+    Microbatch m enters stage 0 in round m; stage s processes it in round
+    m+s; total rounds = n_micro + pp - 1. Each device carries one in-flight
+    activation slot `x`.
+    """
+    pp_rank = jax.lax.axis_index("pp")
+    b, t, _ = x_all.shape
+    mt = t // n_micro
+
+    x = jnp.zeros((b, mt, cfg.dim), jnp.float32)
+    done = []
+    for r in range(n_micro + pp - 1):
+        # inject microbatch r into stage 0's slot
+        if r < n_micro:
+            x_in = jax.lax.dynamic_slice_in_dim(x_all, r * mt, mt, axis=1)
+            x = jnp.where(pp_rank == 0, x_in, x)
+        mb_idx = r - pp_rank  # which microbatch this stage holds this round
+        pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
+        positions = pos0 + jnp.arange(mt, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, mt))
+
+        y, k_upd, v_upd = _local_stage(
+            cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
+            sp_ctx, ep_axis=ep_axis,
+        )
+        # commit cache only when this stage held a real microbatch
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+        k_cache = jnp.where(active, k_upd, k_cache)
+        v_cache = jnp.where(active, v_upd, v_cache)
+        # last stage's output for microbatch (r - pp + 1) is final
+        if r >= pp - 1:
+            done.append(jnp.where(pp_rank == pp - 1, y, 0.0))
+        # hand off to the next stage (wraps; stage 0's incoming is
+        # overwritten by the next injected microbatch)
+        x = jax.lax.ppermute(y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+
+    # final outputs, valid on the last stage; broadcast to all stages so
+    # every device computes logits identically
+    x_out = jnp.concatenate(done, axis=1)
+    x_out = jax.lax.psum(x_out, "pp")
+    return x_out, k_cache, v_cache
+
+
+def _logits_of(cfg, params, x_out):
+    """Final norm + sharded wcls + tp all-gather -> full logits, f32."""
+    x_out = rms_norm(x_out, params.final_norm, cfg.norm_epsilon)
+    logits_local = linear(
+        x_out, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations
+    )  # vocab/tp slice
+    logits = jax.lax.all_gather(logits_local, "tp", axis=-1, tiled=True)
+    return logits.astype(jnp.float32)
 
 
 def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbatches):
+    pp = mesh.shape["pp"]
+    rope_spec = RopeTables(cos=P(), sin=P())
+    logits_spec = P("dp", None) if logits_mode == "last" else P("dp", None, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_spec, rope_spec, cache_spec, P("dp", None), P()),
+        out_specs=(logits_spec, cache_spec),
+        check_vma=False,
+    )
+    def run(params, rope_t, cache, tokens, pos_start):
+        k_cache, v_cache = cache.k, cache.v  # [L_local, b_local, local_seq, kvh_local, hd]
+        sp_ctx, ep_axis = _mesh_ctx(mesh, k_cache)
+        x_all = params.embedding[tokens].astype(jnp.float32)  # [b_local, t, dim]
+        x_out, k_cache, v_cache = _stage_rounds(
+            cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start,
+            max(microbatches, 1), sp_ctx, ep_axis,
+        )
+        if logits_mode == "last":
+            x_out = x_out[:, -1, :]
+        return _logits_of(cfg, params, x_out), KVCache(k=k_cache, v=v_cache)
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def pipeline_decode_chunk(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: ModelParams,
+    rope: RopeTables,
+    cache: KVCache,
+    token: jnp.ndarray,  # [b] int32 — the token to feed first
+    pos_start,  # scalar int32
+    key: jnp.ndarray,
+    n_steps: int = 16,
+    temperature: float = 0.0,
+    topp: float = 0.9,
+):
+    """On-device chunked decode for pipeline meshes: the same
+    K-forwards-per-host-call loop as runtime/decode.py decode_chunk, but with
+    each forward crossing the pp stages via ppermute inside the scan — no
+    per-token host round trip on PP/SP/EP meshes.
+
+    Returns (tokens [b, n_steps], cache).
+    """
+    fn = _cached_pipeline_fn(
+        cfg, mesh, params, cache, ("decode", n_steps, temperature, topp),
+        lambda ps, cs: _build_pipeline_decode_fn(
+            cfg, mesh, ps, cs, n_steps, temperature, topp
+        ),
+    )
+    return fn(
+        params, rope, cache, jnp.asarray(token),
+        jnp.asarray(pos_start, jnp.int32), key,
+    )
+
+
+def _build_pipeline_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp):
+    from ..ops.sampling import sample_logits
+
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(params_spec, rope_spec, cache_spec, P(None, None), P()),
-        out_specs=(P(), cache_spec),
+        in_specs=(params_spec, rope_spec, cache_spec, P("dp"), P(), P()),
+        out_specs=(P("dp", None), cache_spec),
         check_vma=False,
     )
-    def run(params, rope_t, cache, tokens, pos_start):
-        pp_rank = jax.lax.axis_index("pp")
-        b, t = tokens.shape
-        n_micro = max(microbatches, 1)
-        mt = t // n_micro
+    def run(params, rope_t, cache, token, pos_start, key):
+        sp_ctx, ep_axis = _mesh_ctx(mesh, cache.k)
+        # independent sampling randomness per dp shard (the key arrives
+        # replicated; without the fold every shard would draw the same coins
+        # for its local batch rows)
+        if mesh.shape["dp"] > 1:
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
 
-        k_cache, v_cache = cache.k, cache.v  # [L_local, b, local_seq, kvh_local, hd]
-        # sequence parallelism: the cache's seq axis is sharded over `sp`;
-        # attention combines partial softmax stats across the axis
-        sp = mesh.shape["sp"]
-        sp_ctx = None
-        if sp > 1:
-            local_seq = k_cache.shape[2]
-            sp_ctx = ("sp", jax.lax.axis_index("sp") * local_seq)
-        ep_axis = "ep" if mesh.shape.get("ep", 1) > 1 else None
-
-        emb = params.embedding
-        x_all = emb[tokens].astype(jnp.float32)  # [b, t, dim]
-
-        # microbatch m enters stage 0 in round m; stage s processes it in
-        # round m+s; total rounds = n_micro + pp - 1 (GPipe schedule).
-        # Each device carries one in-flight activation slot `x`.
-        x = jnp.zeros((b, mt, cfg.dim), jnp.float32)
-        done = []
-        for r in range(n_micro + pp - 1):
-            # inject microbatch r into stage 0's slot
-            if r < n_micro:
-                x_in = jax.lax.dynamic_slice_in_dim(x_all, r * mt, mt, axis=1)
-                x = jnp.where(pp_rank == 0, x_in, x)
-            mb_idx = r - pp_rank  # which microbatch this stage holds this round
-            pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
-            positions = pos0 + jnp.arange(mt, dtype=jnp.int32)[None, :]
-            positions = jnp.broadcast_to(positions, (b, mt))
-
-            y, k_upd, v_upd = _local_stage(
-                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
-                sp_ctx, ep_axis=ep_axis,
+        def step(carry, _):
+            token, pos, k_cache, v_cache, key = carry
+            x = params.embedding[token[:, None]].astype(jnp.float32)
+            x_out, k_cache, v_cache = _stage_rounds(
+                cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx, ep_axis
             )
-            # commit cache only when this stage held a real microbatch
-            active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
-            k_cache = jnp.where(active, k_upd, k_cache)
-            v_cache = jnp.where(active, v_upd, v_cache)
-            # last stage's output for microbatch (r - pp + 1) is final
-            if r >= pp - 1:
-                done.append(jnp.where(pp_rank == pp - 1, y, 0.0))
-            # hand off to the next stage (wraps; stage 0's incoming is
-            # overwritten by the next injected microbatch)
-            x = jax.lax.ppermute(y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            logits = _logits_of(cfg, params, x_out[:, -1, :])
+            key, sub = jax.random.split(key)
+            nxt = sample_logits(logits, sub, temperature, topp)
+            return (nxt, pos + 1, k_cache, v_cache, key), nxt
 
-        # final outputs: [b, t, dim], valid on the last stage; broadcast to
-        # all stages so every device computes logits identically
-        x_out = jnp.concatenate(done, axis=1)
-        x_out = jax.lax.psum(x_out, "pp")
-
-        x_out = rms_norm(x_out, params.final_norm, cfg.norm_epsilon)
-        if logits_mode == "last":
-            x_out = x_out[:, -1, :]
-        logits_local = linear(
-            x_out, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations
-        )  # vocab/tp slice
-        logits = jax.lax.all_gather(logits_local, "tp", axis=-1, tiled=True)
-        return logits.astype(jnp.float32), KVCache(k=k_cache, v=v_cache)
+        (_, _, k_cache, v_cache, _), toks = jax.lax.scan(
+            step,
+            (token, jnp.asarray(pos_start, jnp.int32), cache.k, cache.v, key),
+            None,
+            length=n_steps,
+        )
+        return jnp.transpose(toks, (1, 0)), KVCache(k=k_cache, v=v_cache)
 
     return jax.jit(run, donate_argnums=(2,))
 

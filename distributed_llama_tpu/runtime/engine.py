@@ -97,6 +97,7 @@ class InferenceEngine:
         decode_chunk_size: int = 32,
         verbose: bool = False,
         q80_activations: bool = False,
+        execution: str = "auto",
     ):
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
         self.header = self.reader.header
@@ -108,14 +109,35 @@ class InferenceEngine:
         self.mesh = mesh
         shardings = None
         self._cache_sharding = None
-        # pipeline execution (shard_map PPxTP[xSPxEP]) when the mesh has pp,
-        # sp, or ep extent: layer/seq/expert axes shard only under the
-        # explicit path. TP-only (or dp) meshes run GSPMD.
-        self.use_pipeline = mesh is not None and (
+        # execution path for meshes: "pipeline" = explicit shard_map
+        # (ppermute stage handoff, psum TP reduce; Pallas kernels see local
+        # shards and stay enabled), "gspmd" = sharded jit with XLA-inserted
+        # collectives (pp/sp/ep extents unsupported, and the Pallas fused
+        # kernel is disabled — GSPMD cannot partition an opaque pallas_call).
+        # "auto" picks pipeline for ANY model-parallel axis — including
+        # tp-only meshes, precisely to keep the fused Q40 kernel in the
+        # flagship TP configs — and gspmd only for dp-only meshes.
+        needs_pipeline = mesh is not None and (
             mesh.shape["pp"] > 1
             or mesh.shape["sp"] > 1
             or mesh.shape.get("ep", 1) > 1
         )
+        if execution not in ("auto", "gspmd", "pipeline"):
+            raise ValueError(f"unknown execution mode {execution!r}")
+        if execution == "gspmd" and needs_pipeline:
+            raise ValueError("pp/sp/ep mesh axes require the pipeline path")
+        if execution == "pipeline" and mesh is None:
+            raise ValueError("execution='pipeline' requires a mesh")
+        self.use_pipeline = mesh is not None and (
+            needs_pipeline
+            or execution == "pipeline"
+            or (execution == "auto" and mesh.shape["tp"] > 1)
+        )
+        if mesh is not None and batch % mesh.shape["dp"] != 0:
+            raise ValueError(
+                f"batch ({batch}) must divide over the dp mesh axis "
+                f"({mesh.shape['dp']})"
+            )
         if self.use_pipeline:
             from ..parallel.pipeline import pp_cache_sharding, pp_param_shardings
 
@@ -155,9 +177,16 @@ class InferenceEngine:
         if self.use_pipeline:
             from ..parallel.pipeline import pipeline_forward
 
+            # GPipe microbatching: prefill chunks split into pp microbatches
+            # so all stages stay busy (the reference's prefill chunking,
+            # src/app.cpp:156-184); decode (t=1) necessarily runs 1
+            pp = self.mesh.shape["pp"]
+            t = tokens_arr.shape[-1]
+            micro = pp if t % pp == 0 else 1
             return pipeline_forward(
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 tokens_arr, pos_start, logits_mode=logits_mode,
+                microbatches=micro,
             )
         return forward(
             self.cfg, self.params, self.rope, self.cache, tokens_arr,
@@ -271,7 +300,7 @@ class InferenceEngine:
         pos = pos_start + len(prompt_tokens) - 1
         token = prompt_tokens[-1]
         max_pos = min(self.cfg.seq_len, steps)
-        if self.device_decode and not self.use_pipeline:
+        if self.device_decode:
             self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         else:
             self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
@@ -327,10 +356,20 @@ class InferenceEngine:
                 n //= 2
             n = max(n, 1)
             key[0], sub = jax.random.split(key[0])
-            toks, self.cache = decode_chunk(
-                self.cfg, self.params, self.rope, self.cache, tok_arr,
-                jnp.int32(at_pos), sub, n_steps=n, temperature=temperature, topp=topp,
-            )
+            if self.use_pipeline:
+                from ..parallel.pipeline import pipeline_decode_chunk
+
+                toks, self.cache = pipeline_decode_chunk(
+                    self.cfg, self.mesh, self.params, self.rope, self.cache,
+                    tok_arr, jnp.int32(at_pos), sub, n_steps=n,
+                    temperature=temperature, topp=topp,
+                )
+            else:
+                toks, self.cache = decode_chunk(
+                    self.cfg, self.params, self.rope, self.cache, tok_arr,
+                    jnp.int32(at_pos), sub, n_steps=n, temperature=temperature,
+                    topp=topp,
+                )
             return toks, n
 
         if pos >= max_pos:

