@@ -103,15 +103,22 @@ def run_inference(args) -> int:
 
     res = engine.generate(ids, args.steps, sampler=sampler, on_token=on_token)
 
+    # one line per measured step (a chunk on the device-decode path, a token
+    # on the host-loop path); no Sync column — under XLA compute and
+    # collectives are one fused device program, a split is not observable
     for s in res.eval_steps:
-        print(f"🔷️ Eval{s.eval_us // 1000:5d} ms Sync{s.sync_us // 1000:5d} ms | ({s.n_tokens} tokens)")
-    for s, piece in zip(res.pred_steps, pieces):
-        print(f"🔶 Pred{s.eval_us // 1000:5d} ms Sync{s.sync_us // 1000:5d} ms | {piece or '~'}")
+        print(f"🔷️ Eval{s.eval_us // 1000:5d} ms | ({s.n_tokens} tokens)")
+    pi = 0
+    for s in res.pred_steps:
+        text = "".join(pieces[pi : pi + s.n_tokens]) or "~"
+        label = f"({s.n_tokens} tokens) " if s.n_tokens > 1 else ""
+        print(f"🔶 Pred{s.eval_us // 1000:5d} ms | {label}{text}")
+        pi += s.n_tokens
 
     n_eval = res.n_prompt_tokens - 1
     n_pred = res.n_pred_tokens
-    eval_ms = sum(s.eval_us + s.sync_us for s in res.eval_steps) / 1000.0
-    pred_ms = sum(s.eval_us + s.sync_us for s in res.pred_steps) / 1000.0
+    eval_ms = sum(s.eval_us for s in res.eval_steps) / 1000.0
+    pred_ms = sum(s.eval_us for s in res.pred_steps) / 1000.0
     print()
     print("Evaluation")
     print(f"   nBatches: {engine.max_chunk}")

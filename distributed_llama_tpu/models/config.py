@@ -47,6 +47,11 @@ class ModelConfig:
     # everything else stay f32). Off in production: activations already live
     # on-chip, quantizing them buys no bandwidth.
     q80_activations: bool = False
+    # pallas_interpret: run Pallas kernels in interpret mode (CPU testing of
+    # the kernel code paths). Captured into the config — a static jit
+    # argument — at construction (from DLT_PALLAS_INTERPRET) so a program
+    # traced in one mode can never be replayed in the other.
+    pallas_interpret: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -65,6 +70,15 @@ class ModelConfig:
         return self.n_experts > 0
 
     @property
+    def pallas_arg(self):
+        """The `pallas` argument for quant_matmul/linear: use_pallas, or the
+        "interpret" sentinel (force-enabled interpret-mode kernels) when
+        pallas_interpret is set."""
+        if self.pallas_interpret:
+            return "interpret"
+        return self.use_pallas
+
+    @property
     def dtype(self):
         return jnp.dtype(self.compute_dtype)
 
@@ -79,9 +93,12 @@ class ModelConfig:
 def config_from_header(
     h: ModelHeader, compute_dtype: str = "bfloat16", cache_dtype: str | None = None
 ) -> ModelConfig:
+    import os
+
     if cache_dtype is None:
         cache_dtype = "float32" if compute_dtype == "float32" else "bfloat16"
     return ModelConfig(
+        pallas_interpret=bool(os.environ.get("DLT_PALLAS_INTERPRET")),
         arch_type=h.arch_type,
         dim=h.dim,
         hidden_dim=h.ff_dim,

@@ -82,8 +82,8 @@ def _sel_layer(w: Any, i) -> Any:
 
 def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:
     q80 = cfg.q80_activations
-    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.use_pallas, q80, layer)) * linear(y, lp.w3, cfg.dtype, cfg.use_pallas, q80, layer)
-    return linear(h, lp.w2, cfg.dtype, cfg.use_pallas, q80, layer)
+    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.pallas_arg, q80, layer)) * linear(y, lp.w3, cfg.dtype, cfg.pallas_arg, q80, layer)
+    return linear(h, lp.w2, cfg.dtype, cfg.pallas_arg, q80, layer)
 
 
 def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
@@ -110,6 +110,35 @@ def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndar
         eq, x.astype(dtype), wd, preferred_element_type=jnp.float32, precision=precision
     )
     return y.astype(x.dtype)
+
+
+def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
+    """Pick the attention implementation for this (static) shape:
+
+    * prefill-sized q on a bf16 cache with the Pallas path enabled -> blocked
+      flash kernel (ops/pallas_attention.py) — no O(t*S) score tensor;
+    * otherwise (decode t=1, f32 parity path, unaligned shapes) -> the XLA
+      whole-cache einsum (ops/attention.py), whose reads the engine already
+      bounds with the kv_len position bucket.
+    """
+    from ..ops.pallas_attention import flash_attention, flash_attention_aligned
+    from ..ops.quant import _use_pallas
+
+    t = q.shape[1]
+    pallas = cfg.use_pallas if cfg.use_pallas is not None else _use_pallas()
+    # interpret mode rides in the (static, hashable) config, so the jit
+    # cache can never replay a program traced in the other mode
+    if cfg.pallas_interpret:
+        pallas = True
+    if (
+        pallas
+        and k_view.dtype == jnp.bfloat16
+        and flash_attention_aligned(q, k_view, t)
+    ):
+        return flash_attention(
+            q, k_view, v_view, pos_start, interpret=cfg.pallas_interpret
+        )
+    return gqa_attention(q, k_view, v_view, positions)
 
 
 def _n_local_experts(w: Any) -> int:
@@ -203,6 +232,11 @@ def _layer(
     # big matmuls select the layer inside the Pallas kernel (no weight-slice
     # copy — see quant_matmul) and the small per-layer tensors are sliced
     # here. None = `lp` is already a single layer's weights.
+    kv_len=None,  # static int: attention reads only cache[:, :kv_len] (a
+    # static slice that fuses into the attention ops). The engine picks the
+    # power-of-two bucket covering pos_start + t, so decode reads scale with
+    # the position, not the allocated cache (full-cache reads made 32k-seq
+    # decode pay for the whole cache every token). None = full cache.
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
@@ -214,9 +248,9 @@ def _layer(
     # head counts come from the weight shapes, not cfg: under shard_map the
     # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
     # src/nn/nn-core.cpp:280-287)
-    q = linear(y, lp.q, cfg.dtype, cfg.use_pallas, q80, layer_idx)
-    k = linear(y, lp.k, cfg.dtype, cfg.use_pallas, q80, layer_idx)
-    v = linear(y, lp.v, cfg.dtype, cfg.use_pallas, q80, layer_idx)
+    q = linear(y, lp.q, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+    k = linear(y, lp.k, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+    v = linear(y, lp.v, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
     q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
     k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
     v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
@@ -235,7 +269,12 @@ def _layer(
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos_start, axis=1
         )
-        a = gqa_attention(q, k_cache, v_cache, positions)
+        if kv_len is not None and kv_len < k_cache.shape[1]:
+            k_view = jax.lax.slice_in_dim(k_cache, 0, kv_len, axis=1)
+            v_view = jax.lax.slice_in_dim(v_cache, 0, kv_len, axis=1)
+        else:
+            k_view, v_view = k_cache, v_cache
+        a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
     else:
         from ..ops.attention import gqa_attention_sp, scatter_cache_update_sp
 
@@ -244,7 +283,7 @@ def _layer(
         v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
         a = gqa_attention_sp(q, k_cache, v_cache, positions, shard_offset, axis_name)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
-    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.use_pallas, q80, layer_idx)
+    att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
     x = x + reduce_fn(att_out).astype(x.dtype)
 
     # --- ffn block ---
@@ -266,6 +305,7 @@ def forward_uncompiled(
     tokens: jnp.ndarray,  # [b, t] int32
     pos_start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
     logits_mode: str = "last",  # "last" | "all"
+    kv_len: int | None = None,  # static KV read bound (see _layer)
 ) -> tuple[jnp.ndarray, KVCache]:
     """One forward step (prefill chunk or decode token).
 
@@ -289,7 +329,7 @@ def forward_uncompiled(
         li, k_c, v_c = per_layer
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
-            layer_idx=li,
+            layer_idx=li, kv_len=kv_len,
         )
         return x, (k_c, v_c)
 
@@ -299,12 +339,12 @@ def forward_uncompiled(
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":
         x = x[:, -1, :]
-    logits = linear(x, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations)
+    logits = linear(x, params.wcls, cfg.dtype, cfg.pallas_arg, cfg.q80_activations)
     return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
 
 
 # The jit entry point: cache is donated (updated in place in HBM); one
-# compiled program per (cfg, token-shape, logits_mode).
-forward = partial(jax.jit, static_argnames=("cfg", "logits_mode"), donate_argnames=("cache",))(
-    forward_uncompiled
-)
+# compiled program per (cfg, token-shape, logits_mode, kv_len bucket).
+forward = partial(
+    jax.jit, static_argnames=("cfg", "logits_mode", "kv_len"), donate_argnames=("cache",)
+)(forward_uncompiled)

@@ -1,0 +1,178 @@
+"""Blocked (flash) causal GQA attention over the KV cache, in Pallas.
+
+The XLA path (ops/attention.py gqa_attention) materializes the full
+[q_len, cache_len] score matrix — O(t*S) activation memory, prohibitive for
+long-context prefill (t=512 against a 32k cache is a 2 GB f32 score tensor
+per layer at 32 query heads). This kernel never materializes scores: it
+tiles the cache into KV blocks and keeps running online-softmax statistics
+(row max m, exp-sum l, weighted-V accumulator) in VMEM scratch, the
+standard flash decomposition. Fully-masked KV blocks (block start beyond
+the last query's position) skip their compute.
+
+The reference has no analogue — it caps context instead (SURVEY.md §5
+"Long-context: absent"); this is the framework's beyond-reference axis.
+
+Layout: one grid row per (batch, kv_head); the kv_mul query heads of a KV
+head fold into the score-matrix row axis, so GQA costs nothing extra:
+
+    q   [b*kv, t, g, hd]   block [1, BT, g, hd] -> rows BT*g
+    k/v [b*kv, S,  hd]     block [1, BS, hd]
+    out = softmax(q k^T / sqrt(hd) + causal) v, accumulated over S/BS steps
+
+Grid (b*kv, t/BT, S/BS), KV innermost; the causal structure comes from the
+absolute positions: query row r (token index ti*BT + r//g) at position
+pos_start + token_index sees cache slot s iff s <= position.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+DEFAULT_BLOCK_T = 128
+DEFAULT_BLOCK_S = 256
+
+
+def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, g, n_s):
+    si = pl.program_id(2)
+    ti = pl.program_id(1)
+    pos_start = ps_ref[0]
+
+    _, bt, _, hd = q_ref.shape
+    bs = k_ref.shape[1]
+    rows = bt * g
+
+    @pl.when(si == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # this KV block is visible to this q block iff its first slot is <= the
+    # last query's position
+    last_pos = pos_start + ti * bt + (bt - 1)
+    block_visible = si * bs <= last_pos
+
+    @pl.when(block_visible)
+    def _():
+        q = q_ref[0].reshape(rows, hd)
+        k = k_ref[0]  # [bs, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [rows, bs]
+
+        row_pos = pos_start + ti * bt + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, bs), 0
+        ) // g
+        col_pos = si * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        s = jnp.where(col_pos <= row_pos, s, NEG_INF)
+
+        m_prev = m_ref[...][:, :1]  # [rows, 1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        # clamp so a fully-masked ROW (padded tail) stays finite
+        m_safe = jnp.maximum(m_cur, NEG_INF / 2)
+        corr = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(col_pos <= row_pos, p, 0.0)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, hd]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_safe, m_ref.shape)
+
+    @pl.when(si == n_s - 1)
+    def _():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).reshape(bt, g, hd).astype(o_ref.dtype)
+
+
+def flash_attention_aligned(q, k_cache, t: int) -> bool:
+    """Kernel preconditions: prefill-sized q block, lane-aligned cache
+    length, uniform head grouping."""
+    b, _, n_heads, head_dim = q.shape
+    cache_len = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    return (
+        t >= 8
+        and n_heads % n_kv == 0
+        and head_dim % 8 == 0
+        and cache_len % 128 == 0
+    )
+
+
+@partial(jax.jit, static_argnames=("scale", "block_t", "block_s", "interpret"))
+def flash_attention(
+    q: jnp.ndarray,  # [b, t, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [b, S, n_kv, head_dim]
+    v_cache: jnp.ndarray,
+    pos_start: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
+    scale: float | None = None,
+    block_t: int = DEFAULT_BLOCK_T,
+    block_s: int = DEFAULT_BLOCK_S,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Blocked causal GQA attention; same contract as gqa_attention with
+    positions = pos_start + arange(t). Returns [b, t, n_heads, head_dim]."""
+    b, t, n_heads, hd = q.shape
+    S = k_cache.shape[1]
+    n_kv = k_cache.shape[2]
+    g = n_heads // n_kv
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    bt = min(block_t, t)
+    while t % bt:
+        bt //= 2
+    bs = min(block_s, S)
+    while S % bs:
+        bs //= 2
+    n_s = S // bs
+
+    # [b, t, kv, g, hd] -> [b*kv, t, g, hd]; cache [b, S, kv, hd] -> [b*kv, S, hd]
+    cdt = k_cache.dtype if k_cache.dtype == jnp.bfloat16 else q.dtype
+    q4 = (
+        q.reshape(b, t, n_kv, g, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * n_kv, t, g, hd)
+        .astype(cdt)
+    )
+    k3 = k_cache.transpose(0, 2, 1, 3).reshape(b * n_kv, S, hd)
+    v3 = v_cache.transpose(0, 2, 1, 3).reshape(b * n_kv, S, hd)
+
+    grid = (b * n_kv, t // bt, n_s)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
+            pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
+            pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bt * g, 128), jnp.float32),  # running row max
+            pltpu.VMEM((bt * g, 128), jnp.float32),  # running exp-sum
+            pltpu.VMEM((bt * g, hd), jnp.float32),  # weighted-V accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        partial(_kernel, scale=scale, g=g, n_s=n_s),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n_kv, t, g, hd), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(pos_start, jnp.int32).reshape(1), q4, k3, v3)
+    # [b*kv, t, g, hd] -> [b, t, kv*g, hd]
+    return (
+        out.reshape(b, n_kv, t, g, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, t, n_heads, hd)
+        .astype(q.dtype)
+    )

@@ -157,11 +157,15 @@ def quant_matmul(
         q40_matmul_pallas_stacked,
     )
 
+    # "interpret" (cfg.pallas_arg): force-enabled kernels in interpret mode —
+    # lets CPU tests drive the exact Pallas code path without TPU hardware.
+    # The mode rides in the pallas argument (and thus the jit cache key via
+    # cfg) rather than being read from the environment at trace time.
+    interpret = pallas == "interpret"
+    if interpret:
+        pallas = True
     if pallas is None:
         pallas = _use_pallas()
-    # interpret mode: lets CPU tests drive the exact Pallas kernel code path
-    # (pallas=True forced) without TPU hardware
-    interpret = bool(os.environ.get("DLT_PALLAS_INTERPRET"))
     if layer is not None and w.q.ndim == 4:
         if pallas and w.out_features % 128 == 0 and x.shape[-1] == w.in_features:
             out = q40_matmul_pallas_stacked(

@@ -85,7 +85,10 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
 
 
-def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx, ep_axis=None):
+def _local_stage(
+    cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx,
+    ep_axis=None, kv_len=None,
+):
     """Run this device's resident layers over x (a scan, like the global
     forward but over the local slice)."""
     reduce_fn = lambda z: jax.lax.psum(z, "tp")
@@ -95,7 +98,7 @@ def _local_stage(cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, s
         lp, k_c, v_c = per_layer
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, lp, k_c, v_c,
-            reduce_fn=reduce_fn, sp_ctx=sp_ctx, ep_axis=ep_axis,
+            reduce_fn=reduce_fn, sp_ctx=sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
         )
         return x, (k_c, v_c)
 
@@ -116,6 +119,8 @@ def pipeline_forward(
     pos_start,  # scalar int32
     logits_mode: str = "last",
     microbatches: int = 1,
+    kv_len: int | None = None,  # static KV read bound (models.transformer
+    # _layer); ignored when the cache's seq axis is sp-sharded
 ):
     """PPxTP forward step. Same contract as models.transformer.forward.
 
@@ -132,9 +137,13 @@ def pipeline_forward(
             f"microbatches ({microbatches}) must divide the token length "
             f"({jnp.shape(tokens)[-1]})"
         )
+    if mesh.shape["sp"] > 1:
+        kv_len = None
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches),
-        lambda ps, cs: _build_pipeline_fn(cfg, mesh, ps, cs, logits_mode, microbatches),
+        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches, kv_len),
+        lambda ps, cs: _build_pipeline_fn(
+            cfg, mesh, ps, cs, logits_mode, microbatches, kv_len
+        ),
     )
     return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
 
@@ -144,9 +153,9 @@ def _cached_pipeline_fn(cfg, mesh, params, cache, extra_key, builder):
 
     Partition specs must be read off the *concrete* input arrays (inside jit
     they are tracers without NamedShardings), so the program is built once
-    per (cfg, mesh, variant, specs) and cached. The Pallas interpret-mode
-    env toggle participates in the key — a program traced in one mode must
-    not be replayed in the other.
+    per (cfg, mesh, variant, specs) and cached. Pallas interpret mode rides
+    in cfg (cfg.pallas_interpret), so it participates in the key — a program
+    traced in one mode is never replayed in the other.
     """
     params_leaves, params_def = jax.tree.flatten(params)
     cache_leaves, cache_def = jax.tree.flatten(cache)
@@ -154,7 +163,6 @@ def _cached_pipeline_fn(cfg, mesh, params, cache, extra_key, builder):
         cfg,
         mesh,
         extra_key,
-        bool(os.environ.get("DLT_PALLAS_INTERPRET")),
         tuple(_spec_of(a) for a in params_leaves),
         tuple(_spec_of(a) for a in cache_leaves),
     )
@@ -178,7 +186,8 @@ def _mesh_ctx(mesh, k_cache):
 
 
 def _stage_rounds(
-    cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start, n_micro, sp_ctx, ep_axis
+    cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start, n_micro,
+    sp_ctx, ep_axis, kv_len=None,
 ):
     """Push x_all [b, t, dim] through the GPipe schedule; returns
     (x_out [b, t, dim] — valid on every stage, k_cache, v_cache).
@@ -205,12 +214,27 @@ def _stage_rounds(
 
         y, k_upd, v_upd = _local_stage(
             cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
-            sp_ctx, ep_axis=ep_axis,
+            sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
         )
-        # commit cache only when this stage held a real microbatch
+        # commit cache only when this stage held a real microbatch. Without
+        # sp, only rows [pos0, pos0+mt) can differ — select just that window
+        # (a full-cache jnp.where would read+write the whole allocation per
+        # round, per token, on decode)
         active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
-        k_cache = jnp.where(active, k_upd, k_cache)
-        v_cache = jnp.where(active, v_upd, v_cache)
+        if sp_ctx is None:
+
+            def commit(full, upd):
+                new_win = jax.lax.dynamic_slice_in_dim(upd, pos0, mt, axis=2)
+                old_win = jax.lax.dynamic_slice_in_dim(full, pos0, mt, axis=2)
+                win = jnp.where(active, new_win, old_win)
+                return jax.lax.dynamic_update_slice_in_dim(full, win, pos0, axis=2)
+
+            k_cache = commit(k_cache, k_upd)
+            v_cache = commit(v_cache, v_upd)
+        else:
+            # sp scatters rows anywhere in the local shard — no window bound
+            k_cache = jnp.where(active, k_upd, k_cache)
+            v_cache = jnp.where(active, v_upd, v_cache)
         # last stage's output for microbatch (r - pp + 1) is final
         if r >= pp - 1:
             done.append(jnp.where(pp_rank == pp - 1, y, 0.0))
@@ -229,13 +253,15 @@ def _logits_of(cfg, params, x_out):
     """Final norm + sharded wcls + tp all-gather -> full logits, f32."""
     x_out = rms_norm(x_out, params.final_norm, cfg.norm_epsilon)
     logits_local = linear(
-        x_out, params.wcls, cfg.dtype, cfg.use_pallas, cfg.q80_activations
+        x_out, params.wcls, cfg.dtype, cfg.pallas_arg, cfg.q80_activations
     )  # vocab/tp slice
     logits = jax.lax.all_gather(logits_local, "tp", axis=-1, tiled=True)
     return logits.astype(jnp.float32)
 
 
-def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbatches):
+def _build_pipeline_fn(
+    cfg, mesh, params_spec, cache_spec, logits_mode, microbatches, kv_len=None
+):
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
     logits_spec = P("dp", None) if logits_mode == "last" else P("dp", None, None)
@@ -253,7 +279,7 @@ def _build_pipeline_fn(cfg, mesh, params_spec, cache_spec, logits_mode, microbat
         x_all = params.embedding[tokens].astype(jnp.float32)  # [b_local, t, dim]
         x_out, k_cache, v_cache = _stage_rounds(
             cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start,
-            max(microbatches, 1), sp_ctx, ep_axis,
+            max(microbatches, 1), sp_ctx, ep_axis, kv_len=kv_len,
         )
         if logits_mode == "last":
             x_out = x_out[:, -1, :]
@@ -274,6 +300,8 @@ def pipeline_decode_chunk(
     n_steps: int = 16,
     temperature: float = 0.0,
     topp: float = 0.9,
+    kv_len: int | None = None,  # static KV read bound covering
+    # pos_start + n_steps; ignored when the cache's seq axis is sp-sharded
 ):
     """On-device chunked decode for pipeline meshes: the same
     K-forwards-per-host-call loop as runtime/decode.py decode_chunk, but with
@@ -282,10 +310,12 @@ def pipeline_decode_chunk(
 
     Returns (tokens [b, n_steps], cache).
     """
+    if mesh.shape["sp"] > 1:
+        kv_len = None
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("decode", n_steps, temperature, topp),
+        cfg, mesh, params, cache, ("decode", n_steps, temperature, topp, kv_len),
         lambda ps, cs: _build_pipeline_decode_fn(
-            cfg, mesh, ps, cs, n_steps, temperature, topp
+            cfg, mesh, ps, cs, n_steps, temperature, topp, kv_len
         ),
     )
     return fn(
@@ -294,7 +324,9 @@ def pipeline_decode_chunk(
     )
 
 
-def _build_pipeline_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp):
+def _build_pipeline_decode_fn(
+    cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp, kv_len=None
+):
     from ..ops.sampling import sample_logits
 
     pp = mesh.shape["pp"]
@@ -319,7 +351,8 @@ def _build_pipeline_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps, tempe
             token, pos, k_cache, v_cache, key = carry
             x = params.embedding[token[:, None]].astype(jnp.float32)
             x_out, k_cache, v_cache = _stage_rounds(
-                cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx, ep_axis
+                cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx,
+                ep_axis, kv_len=kv_len,
             )
             logits = _logits_of(cfg, params, x_out[:, -1, :])
             key, sub = jax.random.split(key)

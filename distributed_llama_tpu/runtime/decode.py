@@ -30,7 +30,7 @@ from ..ops.sampling import sample_logits
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "temperature", "topp"),
+    static_argnames=("cfg", "n_steps", "temperature", "topp", "kv_len"),
     donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -44,6 +44,9 @@ def decode_chunk(
     n_steps: int = 16,
     temperature: float = 0.0,
     topp: float = 0.9,
+    kv_len: int | None = None,  # static KV read bound covering
+    # pos_start + n_steps (the engine's position bucket): attention reads
+    # scale with the position, not the allocated cache
 ):
     """Run n_steps feed-forward+sample iterations on device.
 
@@ -53,7 +56,8 @@ def decode_chunk(
     def step(carry, _):
         token, pos, cache, key = carry
         logits, cache = forward_uncompiled(
-            cfg, params, rope, cache, token[:, None], pos, logits_mode="last"
+            cfg, params, rope, cache, token[:, None], pos, logits_mode="last",
+            kv_len=kv_len,
         )
         key, sub = jax.random.split(key)
         nxt = sample_logits(logits, sub, temperature, topp)

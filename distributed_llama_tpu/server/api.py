@@ -153,9 +153,6 @@ class ApiState:
         max_tokens = params.get("max_tokens", -1)
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
 
-        for m in delta_prompt:
-            self.naive_cache.push(prompt_end, m["role"], m["content"])
-
         buffer = []
         if prompt.public_prompt:
             emit(prompt.public_prompt)
@@ -192,10 +189,21 @@ class ApiState:
             if eos_type == EOS_FOUND:
                 state["stop"] = True
 
-        res = engine.generate(
-            ids, max_pred, sampler=self.sampler, pos_start=start_pos,
-            on_token=on_token, stop_fn=lambda t: state["stop"],
-        )
+        try:
+            res = engine.generate(
+                ids, max_pred, sampler=self.sampler, pos_start=start_pos,
+                on_token=on_token, stop_fn=lambda t: state["stop"],
+            )
+        except Exception:
+            # a failed generation leaves the KV cache holding a prefix that
+            # was never fully written — drop both caches so the next request
+            # starts clean instead of silently resuming from a corrupt prefix
+            self.recover()
+            raise
+        # cache entries record only successfully-prefilled KV (pushing them
+        # before generate would let a mid-stream failure poison later turns)
+        for m in delta_prompt:
+            self.naive_cache.push(prompt_end, m["role"], m["content"])
         pos = prompt_end + res.n_pred_tokens
 
         text = "".join(buffer)
@@ -204,6 +212,16 @@ class ApiState:
         else:
             self.naive_cache.push(pos, "assistant", text)
         return text, len(ids), res.n_pred_tokens
+
+    def recover(self):
+        """Reset engine + prefix cache after a failed generation (the
+        reference instead restarts the whole server loop,
+        dllama-api.cpp:624-636; one engine reset is the cheaper analogue)."""
+        self.naive_cache.clear()
+        try:
+            self.engine.reset()
+        except Exception:
+            pass
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -273,6 +291,16 @@ class Handler(BaseHTTPRequestHandler):
                         self._json(400, json.dumps({"error": str(e)}).encode())
                         return
                     raise
+                except Exception as e:
+                    # engine failure before any SSE chunk went out: return a
+                    # clean 500 like the non-stream path; mid-stream the only
+                    # honest signal left is EOF
+                    if not started[0]:
+                        self._json(
+                            500, json.dumps({"error": f"engine error: {e}"}).encode()
+                        )
+                        return
+                    raise
                 start_stream()
                 data = json.dumps(chunk_json(None, True))
                 self.wfile.write(f"data: {data}\r\n\r\n".encode())
@@ -283,6 +311,10 @@ class Handler(BaseHTTPRequestHandler):
                     text, n_prompt, n_completion = st.complete(params, lambda d: None)
                 except PromptTooLong as e:
                     self._json(400, json.dumps({"error": str(e)}).encode())
+                    return
+                except Exception as e:  # engine failure: recovered by
+                    # complete(); report it instead of dropping the socket
+                    self._json(500, json.dumps({"error": f"engine error: {e}"}).encode())
                     return
                 body = json.dumps(
                     {
@@ -330,17 +362,48 @@ def serve(args) -> HTTPServer:
 
 
 def main(argv=None) -> int:
+    import time
+
     from ..cli import build_arg_parser
 
     p = build_arg_parser()
     p.add_argument("--port", type=int, default=9990)
+    p.add_argument(
+        "--restart-delay", type=float, default=3.0,
+        help="seconds between automatic server restarts after a crash; "
+        "<0 disables the restart loop",
+    )
     # mode positional comes from the shared parser; default it away
     argv = ["inference"] + (argv if argv is not None else __import__("sys").argv[1:])
     args = p.parse_args(argv)
-    httpd = serve(args)
-    print(f"🚧 Listening on port {args.port}...")
-    httpd.serve_forever()
-    return 0
+    # auto-restart outer loop (reference: dllama-api.cpp:624-636 rebuilds the
+    # whole server every 3 s after a crash). Per-request engine failures are
+    # already absorbed by ApiState.recover() + a 500 response; this loop is
+    # the last-resort layer for accept-loop/socket-level crashes that escape
+    # serve_forever. Only restart once the server came up at least once — a
+    # config error at startup (bad model path, tokenizer without a chat
+    # template) is permanent and must fail loudly, not loop.
+    ever_started = False
+    while True:
+        httpd = None
+        try:
+            httpd = serve(args)
+            print(f"🚧 Listening on port {args.port}...")
+            ever_started = True
+            httpd.serve_forever()
+            return 0
+        except KeyboardInterrupt:
+            return 0
+        except Exception as e:
+            if args.restart_delay < 0 or not ever_started:
+                raise
+            print(f"💥 server crashed: {e!r}; restarting in {args.restart_delay}s")
+            time.sleep(args.restart_delay)
+        finally:
+            if httpd is not None:
+                # release the listening socket — rebinding over a live
+                # listener fails with EADDRINUSE even with SO_REUSEADDR
+                httpd.server_close()
 
 
 if __name__ == "__main__":

@@ -40,44 +40,75 @@ class GatewayConfig:
     max_inflight_per_backend: int = 4
     health_retry_ms: int = 3000
     connect_timeout_s: float = 5.0
+    # bounded wait queue: when every backend is saturated, up to queue_size
+    # requests wait (max queue_timeout_s) for capacity before 429 — the
+    # reference queues to a cap first too (dllama-gateway.cpp:332-373)
+    queue_size: int = 16
+    queue_timeout_s: float = 30.0
 
 
 class Balancer:
     def __init__(self, config: GatewayConfig):
         self.config = config
         self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
+        self.waiting = 0
+
+    def _select_locked(self) -> int:
+        now = time.monotonic()
+        n = len(self.config.backends)
+        selected, min_inflight = -1, None
+        for i in range(n):
+            idx = (self.rr_cursor + i) % n
+            b = self.config.backends[idx]
+            if b.unhealthy_until > now:
+                continue
+            if b.inflight >= self.config.max_inflight_per_backend:
+                continue
+            if min_inflight is None or b.inflight < min_inflight:
+                min_inflight = b.inflight
+                selected = idx
+        if selected >= 0:
+            self.config.backends[selected].inflight += 1
+            self.rr_cursor = (selected + 1) % n
+        return selected
 
     def acquire(self) -> int:
-        """Returns backend index or -1 (all busy/unhealthy)."""
-        with self.lock:
-            now = time.monotonic()
-            n = len(self.config.backends)
-            selected, min_inflight = -1, None
-            for i in range(n):
-                idx = (self.rr_cursor + i) % n
-                b = self.config.backends[idx]
-                if b.unhealthy_until > now:
-                    continue
-                if b.inflight >= self.config.max_inflight_per_backend:
-                    continue
-                if min_inflight is None or b.inflight < min_inflight:
-                    min_inflight = b.inflight
-                    selected = idx
-            if selected >= 0:
-                self.config.backends[selected].inflight += 1
-                self.rr_cursor = (selected + 1) % n
-            return selected
+        """Returns backend index, or -1 when every backend is saturated AND
+        the wait queue is full (or the queued wait timed out)."""
+        with self.cond:
+            idx = self._select_locked()
+            if idx >= 0:
+                return idx
+            if self.waiting >= self.config.queue_size:
+                return -1  # queue full -> immediate 429
+            self.waiting += 1
+            try:
+                deadline = time.monotonic() + self.config.queue_timeout_s
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return -1
+                    # short wait slices so an unhealthy backend coming back
+                    # (a timed event no release() announces) is picked up
+                    self.cond.wait(min(remaining, 0.25))
+                    idx = self._select_locked()
+                    if idx >= 0:
+                        return idx
+            finally:
+                self.waiting -= 1
 
     def release(self, idx: int, mark_unhealthy: bool):
         if idx < 0:
             return
-        with self.lock:
+        with self.cond:
             b = self.config.backends[idx]
             if b.inflight > 0:
                 b.inflight -= 1
             if mark_unhealthy:
                 b.unhealthy_until = time.monotonic() + self.config.health_retry_ms / 1000.0
+            self.cond.notify()
 
 
 def _read_http_request(sock: socket.socket) -> bytes | None:
@@ -197,11 +228,15 @@ def main(argv=None) -> int:
     p.add_argument("--backend", action="append", required=True, help="host:port (repeatable)")
     p.add_argument("--max-inflight-per-backend", type=int, default=4)
     p.add_argument("--health-retry-ms", type=int, default=3000)
+    p.add_argument("--queue-size", type=int, default=16)
+    p.add_argument("--queue-timeout-s", type=float, default=30.0)
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
         max_inflight_per_backend=args.max_inflight_per_backend,
         health_retry_ms=args.health_retry_ms,
+        queue_size=args.queue_size,
+        queue_timeout_s=args.queue_timeout_s,
     )
     run(args.port, Balancer(config))
     return 0

@@ -56,7 +56,7 @@ def main():
     def mk_decode(use_pallas):
         c = cfg.with_(use_pallas=use_pallas)
         @jax.jit
-        def fn(cache_k, cache_v, tok):
+        def fn(params, cache_k, cache_v, tok):
             from distributed_llama_tpu.models.params import KVCache
             def body(carry, _):
                 tok, pos, ck, cv = carry
@@ -68,7 +68,7 @@ def main():
                 body, (tok, jnp.int32(100), cache_k, cache_v), None, length=N)
             return tok
         cache = engine._new_cache()
-        return fn, (cache.k, cache.v, jnp.zeros((1,), jnp.int32))
+        return fn, (params, cache.k, cache.v, jnp.zeros((1,), jnp.int32))
 
     full_p = dev_ms("decode step (pallas)", lambda: mk_decode(True), N)
     full_x = dev_ms("decode step (xla dequant)", lambda: mk_decode(False), N)
@@ -77,7 +77,7 @@ def main():
     def mk_matmuls(use_pallas):
         pallas = use_pallas
         @jax.jit
-        def fn(x):
+        def fn(params, x):
             def layer_body(x, lp):
                 y = quant_matmul(x, lp.q, pallas=pallas)
                 y = y + quant_matmul(x, lp.k, pallas=pallas, out_dtype=x.dtype).sum() * 1e-30
@@ -93,7 +93,7 @@ def main():
                 return x + lg[..., :1] * 1e-30, None
             x, _ = jax.lax.scan(body, x, None, length=N)
             return x
-        return fn, (jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
+        return fn, (params, jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
 
     mm_p = dev_ms("matmul chain (pallas)", lambda: mk_matmuls(True), N)
     mm_x = dev_ms("matmul chain (xla)", lambda: mk_matmuls(False), N)
@@ -146,15 +146,15 @@ def main():
         wd = w.d[0] if w.d.ndim == 3 else w.d
         from distributed_llama_tpu.ops.quant import QuantTensor
         ww = QuantTensor(q=wq, d=wd)
-        def mk():
+        def mk(ww=ww):
             @jax.jit
-            def fn(x):
+            def fn(ww, x):
                 def body(x, _):
                     y = quant_matmul(x, ww, pallas=True)
                     return x + y[..., :1] * 1e-30, None
                 x, _ = jax.lax.scan(body, x, None, length=N)
                 return x
-            return fn, (jnp.ones((1, ww.in_features), jnp.bfloat16),)
+            return fn, (ww, jnp.ones((1, ww.in_features), jnp.bfloat16),)
         ms = dev_ms(f"pallas {name}", mk, N)
         mb = ww.q.size / 1e6
         print(f"    -> {mb/ms:.0f} GB/s effective ({mb:.1f} MB)")

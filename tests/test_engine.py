@@ -35,6 +35,29 @@ def test_device_decode_matches_host_decode(model_files):
     assert ra.tokens == rb.tokens
 
 
+def test_prefill_padding_never_writes_past_seq_len(tmp_path):
+    """A padded tail chunk near seq_len must not clamp its cache write start
+    (dynamic_update_slice clamps silently, overwriting earlier KV). seq_len
+    70 with max_chunk 32 forces a 5-token tail that would pad to 8 and write
+    rows 64..71 unbounded."""
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=70,
+        vocab_size=288,
+    )
+    mp = str(tmp_path / "m.m")
+    write_tiny_model(mp, h, seed=5)
+    prompt = [(i % 250) + 1 for i in range(70)]
+
+    chunked = InferenceEngine(mp, compute_dtype="float32", max_chunk=32)
+    chunked.prefill(prompt)
+    stepwise = InferenceEngine(mp, compute_dtype="float32", max_chunk=1)
+    stepwise.prefill(prompt)
+    np.testing.assert_allclose(
+        np.asarray(chunked.cache.k), np.asarray(stepwise.cache.k),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_greedy_generation_matches_numpy_golden(model_files):
     mp, _ = model_files
     prompt = [3, 17, 99]

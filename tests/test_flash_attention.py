@@ -1,0 +1,66 @@
+"""Blocked (flash) Pallas attention vs the XLA whole-cache einsum.
+
+The kernel must be numerically equivalent (online softmax is an exact
+decomposition) on every shape class it accepts: mid-prefill chunks,
+history + chunk, multi-batch, GQA grouping, padded tails.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.ops.attention import gqa_attention
+from distributed_llama_tpu.ops.pallas_attention import flash_attention
+
+
+def _case(b, t, S, n_heads, n_kv, hd, pos_start, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, t, n_heads, hd)).astype(np.float32), dtype)
+    k = jnp.asarray(rng.normal(size=(b, S, n_kv, hd)).astype(np.float32), dtype)
+    v = jnp.asarray(rng.normal(size=(b, S, n_kv, hd)).astype(np.float32), dtype)
+    positions = pos_start + jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, t))
+    return q, k, v, positions
+
+
+@pytest.mark.parametrize(
+    "b,t,S,nh,nkv,hd,pos",
+    [
+        (1, 16, 128, 4, 2, 64, 0),      # fresh prefill from position 0
+        (1, 16, 256, 8, 2, 64, 100),    # chunk with history (partial block)
+        (2, 32, 256, 4, 4, 64, 13),     # MHA (g=1), batch 2, odd offset
+        (1, 8, 128, 8, 1, 128, 120),    # deep grouping, large head, near-end
+        (1, 64, 512, 4, 2, 64, 200),    # multi t-block, multi s-block
+    ],
+)
+def test_flash_matches_xla(b, t, S, nh, nkv, hd, pos):
+    q, k, v, positions = _case(b, t, S, nh, nkv, hd, pos)
+    want = gqa_attention(q, k, v, positions)
+    got = flash_attention(q, k, v, jnp.int32(pos), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_block_boundaries():
+    """Positions that land exactly on block boundaries (the causal skip's
+    edge) must not drop or double-count a block."""
+    for pos in (255, 256, 257, 511):
+        q, k, v, positions = _case(1, 32, 1024, 4, 2, 64, pos, seed=pos)
+        want = gqa_attention(q, k, v, positions)
+        got = flash_attention(q, k, v, jnp.int32(pos), interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            err_msg=f"pos={pos}",
+        )
+
+
+def test_flash_bf16_close_to_f32():
+    """bf16 inputs (the production path) stay within bf16 tolerance of the
+    f32 XLA result."""
+    q, k, v, positions = _case(1, 32, 256, 8, 2, 64, 40, dtype=jnp.bfloat16)
+    want = gqa_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32), positions
+    )
+    got = flash_attention(q, k, v, jnp.int32(40), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=3e-2, atol=3e-2
+    )

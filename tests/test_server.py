@@ -133,11 +133,43 @@ def test_bad_request(api_server):
         assert e.code == 400
 
 
+def test_engine_failure_returns_500_and_recovers(api_server):
+    """A generation failure returns a clean 500, drops the (possibly
+    corrupt) prefix cache, and the server keeps serving (the engine-level
+    analogue of the reference's auto-restart loop, dllama-api.cpp:624-636)."""
+    st = api_mod.Handler.state
+    orig = st.engine.generate
+    calls = {"n": 0}
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("injected engine failure")
+
+    st.engine.generate = boom
+    try:
+        try:
+            _post(api_server, {"messages": [{"role": "user", "content": "x"}], "max_tokens": 4})
+            assert False, "should have raised"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert b"engine error" in e.read()
+    finally:
+        st.engine.generate = orig
+    assert calls["n"] == 1
+    assert st.naive_cache.items == []  # corrupt prefix dropped
+    # and the server still serves the next request
+    with _post(api_server, {"messages": [{"role": "user", "content": "again"}], "max_tokens": 4}) as r:
+        data = json.loads(r.read())
+    assert data["usage"]["completion_tokens"] > 0
+
+
 class TestBalancer:
-    def cfg(self, n=3, cap=2):
+    def cfg(self, n=3, cap=2, queue_size=0, queue_timeout_s=0.0):
         return GatewayConfig(
             backends=[Backend("127.0.0.1", 10000 + i) for i in range(n)],
             max_inflight_per_backend=cap,
+            queue_size=queue_size,
+            queue_timeout_s=queue_timeout_s,
         )
 
     def test_least_inflight_with_rr(self):
@@ -153,7 +185,41 @@ class TestBalancer:
         b = Balancer(self.cfg(n=1, cap=2))
         assert b.acquire() == 0
         assert b.acquire() == 0
-        assert b.acquire() == -1  # saturated -> caller returns 429
+        assert b.acquire() == -1  # saturated, queue disabled -> 429
+
+    def test_queued_request_drains_on_release(self):
+        """A saturated balancer holds the request in the bounded queue and
+        hands it the freed slot (reference: dllama-gateway.cpp:332-373)."""
+        import time
+
+        b = Balancer(self.cfg(n=1, cap=1, queue_size=2, queue_timeout_s=10.0))
+        assert b.acquire() == 0
+        got = []
+        t = threading.Thread(target=lambda: got.append(b.acquire()))
+        t.start()
+        time.sleep(0.15)
+        assert got == []  # still queued
+        b.release(0, mark_unhealthy=False)
+        t.join(timeout=5)
+        assert got == [0]
+        b.release(0, mark_unhealthy=False)
+
+    def test_queue_full_is_immediate_429(self):
+        b = Balancer(self.cfg(n=1, cap=1, queue_size=1, queue_timeout_s=10.0))
+        assert b.acquire() == 0
+        t = threading.Thread(target=b.acquire)  # fills the one queue slot
+        t.start()
+        import time
+
+        time.sleep(0.15)
+        assert b.acquire() == -1  # queue full -> immediate reject
+        b.release(0, mark_unhealthy=False)
+        t.join(timeout=5)
+
+    def test_queue_times_out(self):
+        b = Balancer(self.cfg(n=1, cap=1, queue_size=4, queue_timeout_s=0.2))
+        assert b.acquire() == 0
+        assert b.acquire() == -1  # waited 0.2s, nothing freed -> 429
 
     def test_unhealthy_cooldown(self):
         b = Balancer(self.cfg(n=2))
