@@ -149,6 +149,165 @@ def q40_matmul_pallas_stacked(
     return out2.reshape(*lead, out)
 
 
+def _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
+    """int8xint8 MXU path (single activation row): the weight's int8 values
+    hit the MXU directly — no per-element VPU dequant, the structural
+    bottleneck of the bf16 kernel at square shapes (measured 17x there).
+
+    Per-block partial dots come from ONE 2D int8 matmul: the lhs is the
+    block-diagonal expansion of the activation row (row b = the row masked
+    to block b's 32 columns), so row b of the product is exactly
+    x8_block_b . q_block_b. The per-block scales (activation q80 scale x
+    weight Q40 scale) then combine on the VPU at O(knb*tn) — 1/32nd of the
+    dequant's element count. Activation quantization is the reference's
+    default `--buffer-float-type q80` numerics (src/llm.cpp:221-255).
+    """
+    k = pl.program_id(1)
+    knb, tn = dt_ref.shape
+    x8 = x8_ref[...]  # [1, knb*32] int8
+    # select, not multiply: muli on i8 vectors doesn't legalize in Mosaic
+    blockdiag = jnp.where(
+        mask_ref[...] != 0, jnp.broadcast_to(x8, mask_ref.shape), jnp.int8(0)
+    )  # [knb, knb*32]
+    qt2 = qt_ref[...].reshape(knb * Q_BLOCK, tn)
+    partials = jax.lax.dot_general(
+        blockdiag, qt2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [knb, tn]; row b = block b's exact integer dot
+    scale = xs_ref[...][:, :1] * dt_ref[...]  # [knb, tn] f32
+    acc = jnp.sum(partials.astype(jnp.float32) * scale, axis=0)[None, :]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def _kernel_stacked_i8(l_ref, x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
+    # identical math to _kernel_i8; the layer offset was folded into the
+    # weight block index by the scalar-prefetch index_map
+    _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref)
+
+
+def _quantize_row_q80(x2: jnp.ndarray, nb: int):
+    """[1, in] f32-able row -> (x8 [1, in] int8, xs [nb, 128] f32 scales).
+    Per-32-block symmetric int8 with the Q80 codec's numerics (same contract
+    as ops/quant.py quantize_q80_activations and the reference's
+    quantizeF32toQ80): int8 values are computed against the unrounded f32
+    scale, dequantization uses the f16-ROUNDED scale stored in the block."""
+    xb = x2.reshape(nb, Q_BLOCK).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
+    scale16 = scale.astype(jnp.float16).astype(jnp.float32)
+    xs = jnp.broadcast_to(scale16, (nb, 128)).astype(jnp.float32)
+    return x8.reshape(1, nb * Q_BLOCK), xs
+
+
+def _blockdiag_mask(tile_knb: int) -> jnp.ndarray:
+    """[tile_knb, tile_knb*32] int8: row b is 1 on block b's columns."""
+    import numpy as np
+
+    m = np.zeros((tile_knb, tile_knb * Q_BLOCK), np.int8)
+    for b in range(tile_knb):
+        m[b, b * Q_BLOCK : (b + 1) * Q_BLOCK] = 1
+    return jnp.asarray(m)
+
+
+def _i8_tiles(nb: int, out: int) -> tuple[int, int]:
+    """Tile shapes for the int8 kernel, from a measured sweep on v5e
+    (scripts at /tmp were transient; numbers recorded in PERF.md):
+    ffn-sized outs want wide n tiles (1024 -> 528 GB/s vs 418 at 256),
+    vocab-sized outs regress past 512, and deep contractions (nb >= 256,
+    e.g. w2's 8192 in-features) want k tiles of 128 (589 GB/s)."""
+    if out >= 16384:
+        tile_n = 512
+    elif out >= 4096:
+        tile_n = 1024
+    else:
+        tile_n = DEFAULT_TILE_N
+    tile_n = min(tile_n, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = 128 if nb >= 256 else DEFAULT_TILE_KNB
+    tile_knb = min(tile_knb, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+    return tile_n, tile_knb
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
+    """Single-row x @ w via the int8-MXU kernel. x: [..., in] with exactly
+    one row; returns [..., out] f32."""
+    nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    lead = x.shape[:-1]
+    x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    tile_n, tile_knb = _i8_tiles(nb, out)
+    mask = _blockdiag_mask(tile_knb)
+    grid = (out // tile_n, nb // tile_knb)
+    out2 = pl.pallas_call(
+        _kernel_i8,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
+        interpret=interpret,
+    )(x8, xs, mask, qt, dt)
+    return out2.reshape(*lead, out)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_pallas_stacked_i8(
+    x, qt, dt, layer, interpret: bool = False
+) -> jnp.ndarray:
+    """Single-row x @ w[layer] for a stacked Q40 weight via the int8-MXU
+    kernel; the layer index scalar-prefetches into the DMA offsets exactly
+    like q40_matmul_pallas_stacked."""
+    L, nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    lead = x.shape[:-1]
+    x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    tile_n, tile_knb = _i8_tiles(nb, out)
+    mask = _blockdiag_mask(tile_knb)
+    k_steps = nb // tile_knb
+    qt3 = qt.reshape(L * nb, Q_BLOCK, out)
+    dt3 = dt.reshape(L * nb, out)
+    grid = (out // tile_n, k_steps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
+            pl.BlockSpec((tile_knb, 128), lambda j, k, l: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k, l: (0, 0)),
+            pl.BlockSpec(
+                (tile_knb, Q_BLOCK, tile_n), lambda j, k, l: (l[0] * k_steps + k, 0, j)
+            ),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_n), lambda j, k, l: (0, j)),
+    )
+    out2 = pl.pallas_call(
+        _kernel_stacked_i8,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x8, xs, mask, qt3, dt3)
+    return out2.reshape(*lead, out)
+
+
 @partial(jax.jit, static_argnames=("dtype", "interpret"))
 def q40_matmul_pallas(
     x: jnp.ndarray,  # [..., in_features]

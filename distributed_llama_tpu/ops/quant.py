@@ -154,7 +154,9 @@ def quant_matmul(
     from .pallas_q40 import (
         q40_matmul_aligned,
         q40_matmul_pallas,
+        q40_matmul_pallas_i8,
         q40_matmul_pallas_stacked,
+        q40_matmul_pallas_stacked_i8,
     )
 
     # "interpret" (cfg.pallas_arg): force-enabled kernels in interpret mode —
@@ -166,11 +168,25 @@ def quant_matmul(
         pallas = True
     if pallas is None:
         pallas = _use_pallas()
+    # single-row (decode) on the approximate bf16 path: the int8-MXU kernel —
+    # weights hit the MXU as int8 with per-block scale combine, removing the
+    # per-element VPU dequant (measured 17x on square shapes). Activation
+    # numerics = the reference's default `--buffer-float-type q80`; the
+    # f32 parity paths never take this branch.
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
+    use_i8 = pallas and rows == 1 and dtype == jnp.bfloat16
     if layer is not None and w.q.ndim == 4:
         if pallas and w.out_features % 128 == 0 and x.shape[-1] == w.in_features:
-            out = q40_matmul_pallas_stacked(
-                x, w.q, w.d, layer, dtype=dtype, interpret=interpret
-            )
+            if use_i8:
+                out = q40_matmul_pallas_stacked_i8(
+                    x, w.q, w.d, layer, interpret=interpret
+                )
+            else:
+                out = q40_matmul_pallas_stacked(
+                    x, w.q, w.d, layer, dtype=dtype, interpret=interpret
+                )
         else:
             q = jax.lax.dynamic_index_in_dim(w.q, layer, 0, keepdims=False)
             d = jax.lax.dynamic_index_in_dim(w.d, layer, 0, keepdims=False)
@@ -178,7 +194,10 @@ def quant_matmul(
         return out.astype(out_dtype if out_dtype is not None else x.dtype)
     assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
     if pallas and q40_matmul_aligned(x, w):
-        out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype, interpret=interpret)
+        if use_i8:
+            out = q40_matmul_pallas_i8(x, w.q, w.d, interpret=interpret)
+        else:
+            out = q40_matmul_pallas(x, w.q, w.d, dtype=dtype, interpret=interpret)
     else:
         out = _quant_matmul_xla(x, w.q, w.d, dtype)
     return out.astype(out_dtype if out_dtype is not None else x.dtype)

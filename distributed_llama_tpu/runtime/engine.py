@@ -267,6 +267,11 @@ class InferenceEngine:
             # dynamic_update_slice would CLAMP the start and silently
             # overwrite earlier positions' KV (real corruption, not junk)
             size = min(size, self.cfg.seq_len - (pos_start + i))
+            if size <= 0:
+                raise ValueError(
+                    f"prefill would write past seq_len ({self.cfg.seq_len}): "
+                    f"{n} tokens starting at position {pos_start}"
+                )
             chunk = tokens[i : i + size]
             n_real = len(chunk)
             chunk = chunk + [0] * (size - n_real)

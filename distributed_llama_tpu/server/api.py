@@ -194,8 +194,12 @@ class ApiState:
                 ids, max_pred, sampler=self.sampler, pos_start=start_pos,
                 on_token=on_token, stop_fn=lambda t: state["stop"],
             )
+        except (BrokenPipeError, ConnectionError):
+            # the CLIENT dropped mid-stream (emit raised) — the engine and
+            # the cached prefix are fine; this turn simply was never pushed
+            raise
         except Exception:
-            # a failed generation leaves the KV cache holding a prefix that
+            # an ENGINE failure leaves the KV cache holding a prefix that
             # was never fully written — drop both caches so the next request
             # starts clean instead of silently resuming from a corrupt prefix
             self.recover()

@@ -1,0 +1,209 @@
+"""Microbenchmark lab for Q40 matmul kernel variants on the real chip.
+
+Compares, at the bench model's shapes (decode b=1):
+  A. current bf16-dequant Pallas kernel (ops/pallas_q40.py)
+  B. int8xint8 MXU variant: activations quantized per 32-block to int8
+     in-kernel, weights hit the MXU as int8, per-block scales combine after
+     (the reference's Q80xQ40 structure mapped onto the MXU int8 path)
+  C. XLA dequant fallback
+Each runs N iterations chained inside one jit scan; one tiny sync at the end.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.quant import QuantTensor, quant_matmul
+
+N = 64
+
+
+def dev_ms(label, make_fn, args, trials=3):
+    """make_fn(n) -> jitted chain of n iterations. Times are differenced
+    between two iteration counts so the ~90 ms host dispatch+fetch round
+    trip cancels out."""
+    n1, n2 = 64, 320
+    f1, f2 = make_fn(n1), make_fn(n2)
+    best = {n1: float("inf"), n2: float("inf")}
+    for f, n in ((f1, n1), (f2, n2)):
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]  # compile
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = f(*args)
+            _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+            best[n] = min(best[n], time.perf_counter() - t0)
+    ms = (best[n2] - best[n1]) / (n2 - n1) * 1e3
+    print(f"{label}: {ms:.4f} ms/iter (diffed; t64={best[n1]*1e3:.1f}ms t320={best[n2]*1e3:.1f}ms)")
+    return ms
+
+
+# ---- variant B kernel ----
+
+def _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
+    """Per-block int8 partial sums via ONE 2D int8 MXU matmul: lhs is the
+    block-diagonal expansion of the activation row (mask * broadcast), so
+    row b of the product is exactly block b's int dot — per-block scales
+    then combine on the VPU at O(knb*tn) instead of O(knb*32*tn) dequant."""
+    k = pl.program_id(1)
+    knb, tn = dt_ref.shape
+    x8 = x8_ref[...]  # [1, knb*32] int8
+    # int8 select (muli on i8 vectors doesn't legalize in Mosaic)
+    blockdiag = jnp.where(
+        mask_ref[...] != 0, jnp.broadcast_to(x8, mask_ref.shape), jnp.int8(0)
+    )  # [knb, knb*32] int8
+    qt2 = qt_ref[...].reshape(knb * Q_BLOCK, tn)
+    partials = jax.lax.dot_general(
+        blockdiag, qt2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [knb, tn] — row b = x8_block_b . q_block_b
+    scale = xs_ref[...][:, :1] * dt_ref[...]  # [knb, tn] f32
+    acc = jnp.sum(partials.astype(jnp.float32) * scale, axis=0)[None, :]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def _blockdiag_mask(tile_knb: int) -> np.ndarray:
+    """[tile_knb, tile_knb*32] int8: row b is 1 on block b's columns."""
+    m = np.zeros((tile_knb, tile_knb * Q_BLOCK), np.int8)
+    for b in range(tile_knb):
+        m[b, b * Q_BLOCK : (b + 1) * Q_BLOCK] = 1
+    return m
+
+
+@partial(jax.jit, static_argnames=())
+def q40_matmul_i8(x, qt, dt):
+    nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    x2 = x.reshape(1, in_features).astype(jnp.float32)
+    # quantize activations per 32-block (q80 numerics) OUTSIDE the kernel —
+    # once per matmul, O(in) work
+    xb = x2.reshape(nb, Q_BLOCK)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
+    xs = jnp.broadcast_to(scale, (nb, 128)).astype(jnp.float32)
+
+    tile_n = min(256, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = min(64, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+
+    mask = jnp.asarray(_blockdiag_mask(tile_knb))
+    grid = (out // tile_n, nb // tile_knb)
+    out2 = pl.pallas_call(
+        _kernel_i8,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, 128), lambda j, k: (k, 0)),
+            pl.BlockSpec(
+                (tile_knb, tile_knb * Q_BLOCK), lambda j, k: (0, 0)
+            ),
+            pl.BlockSpec((tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
+    )(x8.reshape(1, in_features), xs, mask, qt, dt)
+    return out2
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shapes = [
+        ("qkvo 2048->2048", 2048, 2048),
+        ("ffn 2048->8192", 2048, 8192),
+        ("wcls 2048->32768", 2048, 32768),
+    ]
+    for label, infe, out in shapes:
+        nb = infe // Q_BLOCK
+        qt = jnp.asarray(rng.integers(-8, 8, size=(nb, Q_BLOCK, out), dtype=np.int8))
+        dt = jnp.asarray(rng.normal(size=(nb, out)).astype(np.float32) * 0.01)
+        w = QuantTensor(q=qt, d=dt)
+        x = jnp.asarray(rng.normal(size=(1, infe)).astype(np.float32), jnp.bfloat16)
+        mb = qt.size / 1e6
+
+        def chainA(n):
+            @jax.jit
+            def f(x, qt, dt):
+                def body(c, _):
+                    y = quant_matmul(c, QuantTensor(q=qt, d=dt), pallas=True)
+                    return c + (y.sum() * 1e-30).astype(c.dtype), None
+
+                c, _ = jax.lax.scan(body, x, None, length=n)
+                return c
+            return f
+
+        def chainB(n):
+            @jax.jit
+            def f(x, qt, dt):
+                def body(c, _):
+                    y = q40_matmul_i8(c, qt, dt)
+                    return c + (y.sum() * 1e-30).astype(c.dtype), None
+
+                c, _ = jax.lax.scan(body, x, None, length=n)
+                return c
+            return f
+
+        def chainC(n):
+            @jax.jit
+            def f(x, qt, dt):
+                def body(c, _):
+                    y = quant_matmul(c, QuantTensor(q=qt, d=dt), pallas=False)
+                    return c + (y.sum() * 1e-30).astype(c.dtype), None
+
+                c, _ = jax.lax.scan(body, x, None, length=n)
+                return c
+            return f
+
+        try:
+            a = dev_ms(f"A bf16-dequant {label}", chainA, (x, qt, dt))
+            print(f"    A -> {mb / a:.0f} GB/s")
+        except Exception as e:
+            print(f"A {label} failed: {e}")
+        try:
+            b = dev_ms(f"B int8-mxu    {label}", chainB, (x, qt, dt))
+            print(f"    B -> {mb / b:.0f} GB/s")
+        except Exception as e:
+            print(f"B {label} failed: {type(e).__name__} {str(e)[:200]}")
+        try:
+            c = dev_ms(f"C xla-dequant {label}", chainC, (x, qt, dt))
+            print(f"    C -> {mb / c:.0f} GB/s")
+        except Exception as e:
+            print(f"C {label} failed: {e}")
+
+    # numeric sanity: B vs exact f32 reference
+    infe, out = 2048, 2048
+    nb = infe // Q_BLOCK
+    qt = jnp.asarray(rng.integers(-8, 8, size=(nb, Q_BLOCK, out), dtype=np.int8))
+    dt = jnp.asarray(rng.normal(size=(nb, out)).astype(np.float32) * 0.01)
+    x = jnp.asarray(rng.normal(size=(1, infe)).astype(np.float32))
+    wdense = (np.asarray(qt, np.float32) * np.asarray(dt)[:, None, :]).reshape(infe, out)
+    want = np.asarray(x, np.float32) @ wdense
+    got = np.asarray(q40_matmul_i8(x, qt, dt))
+    err = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    print(f"B relative max err vs f32: {err:.4f}")
+
+
+if __name__ == "__main__":
+    main()
