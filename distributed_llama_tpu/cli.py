@@ -49,6 +49,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-dtype", choices=["bfloat16", "float32"], default=None)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel mesh size")
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel mesh size (long context)")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel mesh size (MoE)")
+    p.add_argument("--dp", type=int, default=1, help="data-parallel mesh size (batch)")
+    p.add_argument(
+        "--host-decode", action="store_true",
+        help="per-token host decode loop (bit-parity RNG with the reference; "
+        "slower than the chunked on-device decode)",
+    )
     # accepted-for-compat knobs from the reference CLI (no-ops or remapped):
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
@@ -62,10 +70,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def make_engine(args) -> InferenceEngine:
     max_chunk = args.prefill_chunk_size if args.prefill_chunk_size > 0 else args.max_chunk
     mesh = None
-    if args.tp > 1 or args.pp > 1:
+    sp = getattr(args, "sp", 1)
+    ep = getattr(args, "ep", 1)
+    dp = getattr(args, "dp", 1)
+    if args.tp > 1 or args.pp > 1 or sp > 1 or ep > 1 or dp > 1:
         from .parallel import make_mesh
 
-        mesh = make_mesh(tp=args.tp, pp=args.pp)
+        mesh = make_mesh(tp=args.tp, pp=args.pp, sp=sp, ep=ep, dp=dp)
     return InferenceEngine(
         args.model,
         compute_dtype=args.compute_dtype,
@@ -73,6 +84,8 @@ def make_engine(args) -> InferenceEngine:
         max_seq_len=args.max_seq_len,
         max_chunk=max_chunk,
         mesh=mesh,
+        batch=max(dp, 1),
+        device_decode=not getattr(args, "host_decode", False),
         verbose=True,
     )
 
@@ -204,7 +217,11 @@ def run_chat(args) -> int:
     gen = ChatTemplateGenerator(template_type, tok.chat_template, stops[0] if stops else "")
     max_stop = max((len(s) for s in stops), default=0)
 
-    sys_prompt = input("💻 System prompt (optional): ")
+    try:
+        sys_prompt = input("💻 System prompt (optional): ")
+    except (EOFError, KeyboardInterrupt):
+        print()
+        return 0
     delta_items: list[ChatItem] = []
     if sys_prompt:
         delta_items.append(ChatItem("system", sys_prompt))
@@ -213,34 +230,45 @@ def run_chat(args) -> int:
     seq_len = engine.cfg.seq_len
     while pos < seq_len:
         user = ""
-        while not user:
-            user = input("\n👱 User\n> ")
+        try:
+            while not user:
+                user = input("\n👱 User\n> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
         delta_items.append(ChatItem("user", user))
         prompt = gen.generate(delta_items, True)
         ids = tok.encode(prompt.content, is_start=(pos == 0))
-        end = min(seq_len, pos + len(ids) - 1)
-        engine.prefill(ids[: end - pos], pos)
-        token = ids[-1]
-        pos = end
+        if pos + len(ids) - 1 >= seq_len:
+            break
 
         tok.reset_decoder()
         detector = EosDetector(tok.eos_token_ids, stops, max_stop, max_stop)
         print("\n🤖 Assistant")
         if prompt.public_prompt:
             print(prompt.public_prompt, end="")
-        while pos < seq_len:
-            logits = engine.decode_one(token, pos)
-            token = sampler.sample(logits[0].copy())
-            piece = tok.decode(token)
-            eos_type = detector.append(token, piece)
+
+        # chunked on-device decode with host-side stop scanning: the engine
+        # never appends tokens past the stop (overrun cache writes are
+        # overwritten by the next turn's prefill — engine.generate contract)
+        state = {"stop": False}
+
+        def on_token(t):
+            piece = tok.decode(t)
+            eos_type = detector.append(t, piece)
             if eos_type != EOS_MAYBE:
                 delta = detector.get_delta()
                 if delta:
                     print(delta, end="", flush=True)
                 detector.reset()
-            pos += 1
             if eos_type == EOS_FOUND:
-                break
+                state["stop"] = True
+
+        res = engine.generate(
+            ids, seq_len, sampler=sampler, pos_start=pos,
+            on_token=on_token, stop_fn=lambda t: state["stop"],
+        )
+        pos = pos + len(ids) - 1 + res.n_pred_tokens
         delta_items.clear()
     print("(end of context)")
     return 0

@@ -28,24 +28,31 @@ _SRC = os.path.join(
 _SO = os.path.join(os.path.dirname(_SRC), "libq40codec.so")
 
 
-def _build() -> str | None:
-    if not os.path.exists(_SRC):
+def _build_and_load(src: str, so: str, extra_flags: tuple = ()):
+    """Compile `src` to `so` if stale and dlopen it; None on any failure.
+    Shared by every native library in this package — the build/caching and
+    concurrency subtleties live in exactly one place."""
+    if os.environ.get("DLT_NO_NATIVE"):
         return None
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    # pid-suffixed temp: concurrent builders (server + CLI, pytest-xdist)
-    # must not interleave writes into one temp file and install a corrupt .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
-    except (OSError, subprocess.SubprocessError):
+    if not os.path.exists(src):
+        return None
+    if not (os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src)):
+        # pid-suffixed temp: concurrent builders (server + CLI, pytest-xdist)
+        # must not interleave writes into one temp and install a corrupt .so
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", *extra_flags, src, "-o", tmp]
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
         return None
 
 
@@ -55,14 +62,8 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("DLT_NO_NATIVE"):
-            return None
-        so = _build()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError:
+        lib = _build_and_load(_SRC, _SO, extra_flags=("-pthread",))
+        if lib is None:
             return None
         lib.q40_unpack_t.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -107,3 +108,74 @@ def q40_dequant_native(raw, n_elements: int) -> np.ndarray | None:
     out = np.empty(n_elements, dtype=np.float32)
     lib.q40_dequant(buf.ctypes.data, n_blocks, out.ctypes.data)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Native BPE merge engine (native/bpe_encoder.cpp) — same loader contract:
+# build-on-first-use, every caller tolerates unavailability and falls back to
+# the Python merge loop in tokenizer.py (the semantic reference).
+# ---------------------------------------------------------------------------
+
+_BPE_SRC = os.path.join(os.path.dirname(_SRC), "bpe_encoder.cpp")
+_BPE_SO = os.path.join(os.path.dirname(_SRC), "libbpeencoder.so")
+_bpe_lib = None
+_bpe_tried = False
+
+
+def _load_bpe():
+    global _bpe_lib, _bpe_tried
+    with _lock:
+        if _bpe_tried:
+            return _bpe_lib
+        _bpe_tried = True
+        lib = _build_and_load(_BPE_SRC, _BPE_SO)
+        if lib is None:
+            return None
+        lib.bpe_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_free.restype = None
+        lib.bpe_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.bpe_merge.restype = ctypes.c_int64
+        _bpe_lib = lib
+        return _bpe_lib
+
+
+class NativeBpe:
+    """Handle over the C++ merge engine for one vocabulary. `create` returns
+    None when the native path is unavailable."""
+
+    @staticmethod
+    def create(vocab: list, scores, n_regular: int) -> "NativeBpe | None":
+        lib = _load_bpe()
+        if lib is None:
+            return None
+        blob = b"".join(vocab)
+        offsets = np.zeros(len(vocab) + 1, dtype=np.int64)
+        np.cumsum([len(v) for v in vocab], out=offsets[1:])
+        scores_arr = np.ascontiguousarray(scores, dtype=np.float32)
+        buf = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(1, np.uint8)
+        handle = lib.bpe_create(
+            buf.ctypes.data, offsets.ctypes.data, scores_arr.ctypes.data,
+            len(vocab), n_regular,
+        )
+        if not handle:
+            return None
+        obj = NativeBpe()
+        obj._lib = lib
+        obj._handle = handle
+        return obj
+
+    def merge(self, tokens: list) -> list:
+        arr = np.asarray(tokens, dtype=np.int32)
+        new_n = self._lib.bpe_merge(self._handle, arr.ctypes.data, len(arr))
+        return arr[:new_n].tolist()
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        handle = getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.bpe_free(handle)

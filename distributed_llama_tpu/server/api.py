@@ -44,6 +44,13 @@ class PromptTooLong(ValueError):
     pass
 
 
+class ClientDisconnected(Exception):
+    """The HTTP client dropped mid-stream (raised from the emit path). The
+    engine state is fine — distinguished by TYPE from engine failures so
+    recovery logic can't confuse the two (an engine error travelling as a
+    ConnectionError through the device tunnel must still trigger recovery)."""
+
+
 @dataclass
 class CacheItem:
     end_pos: int
@@ -194,7 +201,7 @@ class ApiState:
                 ids, max_pred, sampler=self.sampler, pos_start=start_pos,
                 on_token=on_token, stop_fn=lambda t: state["stop"],
             )
-        except (BrokenPipeError, ConnectionError):
+        except ClientDisconnected:
             # the CLIENT dropped mid-stream (emit raised) — the engine and
             # the cached prefix are fine; this turn simply was never pushed
             raise
@@ -283,10 +290,15 @@ class Handler(BaseHTTPRequestHandler):
                         started[0] = True
 
                 def emit(delta):
-                    start_stream()
-                    data = json.dumps(chunk_json(delta, False))
-                    self.wfile.write(f"data: {data}\r\n\r\n".encode())
-                    self.wfile.flush()
+                    try:
+                        start_stream()
+                        data = json.dumps(chunk_json(delta, False))
+                        self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionError) as e:
+                        # tag socket failures at the emit site so complete()
+                        # can tell a client drop from an engine failure
+                        raise ClientDisconnected(str(e)) from e
 
                 try:
                     text, n_prompt, n_completion = st.complete(params, emit)
@@ -295,6 +307,8 @@ class Handler(BaseHTTPRequestHandler):
                         self._json(400, json.dumps({"error": str(e)}).encode())
                         return
                     raise
+                except ClientDisconnected:
+                    return  # nothing to send — the socket is gone
                 except Exception as e:
                     # engine failure before any SSE chunk went out: return a
                     # clean 500 like the non-stream path; mid-stream the only

@@ -51,6 +51,13 @@ class Tokenizer:
             (self.vocab[i], i) for i in range(self.regular_vocab_size, self.vocab_size)
         ]
         self._decode_buf = b""
+        # native C++ merge engine (native/bpe_encoder.cpp); None -> the
+        # Python merge loop below, which is the semantic reference
+        from .formats.native import NativeBpe
+
+        self._native_bpe = NativeBpe.create(
+            self.vocab, self.scores, self.regular_vocab_size
+        )
 
     # -- encode ------------------------------------------------------------
 
@@ -87,6 +94,15 @@ class Tokenizer:
         if pending:
             raise ValueError(f"cannot tokenize bytes {pending!r} (not in vocab)")
 
+        # identical candidate rules in both paths (pair lookups hit only the
+        # regular index, so bos/special ids pass through them unmerged unless
+        # a regular piece genuinely equals the concatenation — same as the
+        # Python loop)
+        if self._native_bpe is not None:
+            return self._native_bpe.merge(tokens)
+        return self._merge_py(tokens)
+
+    def _merge_py(self, tokens: list[int]) -> list[int]:
         # Merge the best-scoring adjacent pair until no pair merges. Same
         # leftmost-max policy as the reference, but with cached per-pair merge
         # candidates so each iteration only re-evaluates the two pairs touched

@@ -1,0 +1,100 @@
+// Native BPE merge loop for the tokenizer's encode hot path.
+//
+// C++ analogue of the reference's bpeEncode merge loop (reference:
+// src/tokenizer.cpp:212-258): repeatedly merge the adjacent token pair whose
+// concatenation exists in the vocab with the best score (leftmost wins
+// ties), until no pair merges. The Python implementation
+// (distributed_llama_tpu/tokenizer.py Tokenizer.encode) carries the exact
+// same policy and stays the semantic reference + fallback; this library is a
+// drop-in accelerator for long prompts, loaded via ctypes
+// (formats/native.py) like the Q40 codec.
+//
+// Semantics pinned to the Python implementation:
+//   * pair lookup over the REGULAR vocab only, duplicates resolve to the
+//     LOWEST token id (Python builds its dict iterating ids descending);
+//   * strict > comparison while scanning candidates left to right, so the
+//     leftmost maximum wins;
+//   * after a merge only the two adjacent pairs are re-evaluated.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Bpe {
+    std::vector<std::string> vocab;     // regular + special pieces
+    std::vector<float> scores;
+    std::unordered_map<std::string, int32_t> index;  // regular pieces only
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(const uint8_t* bytes, const int64_t* offsets,
+                 const float* scores, int32_t n_vocab, int32_t n_regular) {
+    auto* b = new Bpe();
+    b->vocab.reserve(n_vocab);
+    for (int32_t i = 0; i < n_vocab; ++i) {
+        b->vocab.emplace_back(
+            reinterpret_cast<const char*>(bytes) + offsets[i],
+            static_cast<size_t>(offsets[i + 1] - offsets[i]));
+    }
+    b->scores.assign(scores, scores + n_vocab);
+    b->index.reserve(n_regular * 2);
+    for (int32_t i = 0; i < n_regular; ++i) {
+        b->index.emplace(b->vocab[i], i);  // emplace keeps the FIRST (lowest) id
+    }
+    return b;
+}
+
+void bpe_free(void* h) { delete static_cast<Bpe*>(h); }
+
+// In-place merge; returns the new token count.
+int64_t bpe_merge(void* h, int32_t* tokens, int64_t n) {
+    auto* b = static_cast<Bpe*>(h);
+    if (n < 2) return n;
+
+    std::vector<int32_t> toks(tokens, tokens + n);
+    struct Cand {
+        float score;
+        int32_t tid;  // -1 = no merge for this pair
+    };
+    auto candidate = [&](int32_t a, int32_t c) -> Cand {
+        std::string key = b->vocab[a] + b->vocab[c];
+        auto it = b->index.find(key);
+        if (it == b->index.end()) return {0.0f, -1};
+        return {b->scores[it->second], it->second};
+    };
+
+    std::vector<Cand> cand(toks.size() - 1);
+    for (size_t j = 0; j + 1 < toks.size(); ++j)
+        cand[j] = candidate(toks[j], toks[j + 1]);
+
+    while (true) {
+        float best_score = -1e10f;
+        int64_t best = -1;
+        for (size_t j = 0; j < cand.size(); ++j) {
+            if (cand[j].tid >= 0 && cand[j].score > best_score) {
+                best_score = cand[j].score;
+                best = static_cast<int64_t>(j);
+            }
+        }
+        if (best < 0) break;
+        toks[best] = cand[best].tid;
+        toks.erase(toks.begin() + best + 1);
+        cand.erase(cand.begin() + best);
+        if (static_cast<size_t>(best) < cand.size())
+            cand[best] = candidate(toks[best], toks[best + 1]);
+        if (best > 0)
+            cand[best - 1] = candidate(toks[best - 1], toks[best]);
+    }
+
+    std::memcpy(tokens, toks.data(), toks.size() * sizeof(int32_t));
+    return static_cast<int64_t>(toks.size());
+}
+
+}  // extern "C"

@@ -48,84 +48,9 @@ def dev_ms(label, make_fn, args, trials=3):
     return ms
 
 
-# ---- variant B kernel ----
+# ---- variant B = the productionized kernel (ops/pallas_q40.py) ----
 
-def _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
-    """Per-block int8 partial sums via ONE 2D int8 MXU matmul: lhs is the
-    block-diagonal expansion of the activation row (mask * broadcast), so
-    row b of the product is exactly block b's int dot — per-block scales
-    then combine on the VPU at O(knb*tn) instead of O(knb*32*tn) dequant."""
-    k = pl.program_id(1)
-    knb, tn = dt_ref.shape
-    x8 = x8_ref[...]  # [1, knb*32] int8
-    # int8 select (muli on i8 vectors doesn't legalize in Mosaic)
-    blockdiag = jnp.where(
-        mask_ref[...] != 0, jnp.broadcast_to(x8, mask_ref.shape), jnp.int8(0)
-    )  # [knb, knb*32] int8
-    qt2 = qt_ref[...].reshape(knb * Q_BLOCK, tn)
-    partials = jax.lax.dot_general(
-        blockdiag, qt2, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    )  # [knb, tn] — row b = x8_block_b . q_block_b
-    scale = xs_ref[...][:, :1] * dt_ref[...]  # [knb, tn] f32
-    acc = jnp.sum(partials.astype(jnp.float32) * scale, axis=0)[None, :]
-
-    @pl.when(k == 0)
-    def _():
-        out_ref[...] = acc
-
-    @pl.when(k != 0)
-    def _():
-        out_ref[...] += acc
-
-
-def _blockdiag_mask(tile_knb: int) -> np.ndarray:
-    """[tile_knb, tile_knb*32] int8: row b is 1 on block b's columns."""
-    m = np.zeros((tile_knb, tile_knb * Q_BLOCK), np.int8)
-    for b in range(tile_knb):
-        m[b, b * Q_BLOCK : (b + 1) * Q_BLOCK] = 1
-    return m
-
-
-@partial(jax.jit, static_argnames=())
-def q40_matmul_i8(x, qt, dt):
-    nb, _, out = qt.shape
-    in_features = nb * Q_BLOCK
-    x2 = x.reshape(1, in_features).astype(jnp.float32)
-    # quantize activations per 32-block (q80 numerics) OUTSIDE the kernel —
-    # once per matmul, O(in) work
-    xb = x2.reshape(nb, Q_BLOCK)
-    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
-    scale = amax / 127.0
-    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
-    x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
-    xs = jnp.broadcast_to(scale, (nb, 128)).astype(jnp.float32)
-
-    tile_n = min(256, out)
-    while out % tile_n:
-        tile_n //= 2
-    tile_knb = min(64, nb)
-    while nb % tile_knb:
-        tile_knb //= 2
-
-    mask = jnp.asarray(_blockdiag_mask(tile_knb))
-    grid = (out // tile_n, nb // tile_knb)
-    out2 = pl.pallas_call(
-        _kernel_i8,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
-            pl.BlockSpec((tile_knb, 128), lambda j, k: (k, 0)),
-            pl.BlockSpec(
-                (tile_knb, tile_knb * Q_BLOCK), lambda j, k: (0, 0)
-            ),
-            pl.BlockSpec((tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j)),
-            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
-        ],
-        out_specs=pl.BlockSpec((1, tile_n), lambda j, k: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
-    )(x8.reshape(1, in_features), xs, mask, qt, dt)
-    return out2
+from distributed_llama_tpu.ops.pallas_q40 import q40_matmul_pallas_i8 as q40_matmul_i8
 
 
 def main():

@@ -119,6 +119,33 @@ def test_cli_inference_smoke(model_files, capsys):
     assert "Prediction" in out and "tokens/s:" in out and "ttftMs:" in out
 
 
+def test_cli_chat_smoke(model_files, capsys, monkeypatch):
+    """One chat turn through the chunked device-decode path, then EOF."""
+    mp, tp = model_files
+    inputs = iter(["", "hello there"])
+
+    def fake_input(prompt_str=""):
+        try:
+            return next(inputs)
+        except StopIteration:
+            raise EOFError
+
+    monkeypatch.setattr("builtins.input", fake_input)
+    rc = cli.main(
+        [
+            "chat",
+            "--model", mp,
+            "--tokenizer", tp,
+            "--temperature", "0",
+            "--compute-dtype", "float32",
+            "--chat-template", "chatml",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "🤖 Assistant" in out
+
+
 def test_cli_perplexity_smoke(model_files, capsys):
     mp, tp = model_files
     rc = cli.main(

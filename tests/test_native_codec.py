@@ -96,3 +96,51 @@ def test_native_codec_speedup_large(codec_available):
     t_nat = time.perf_counter() - t0
     # don't flake on loaded machines; just require it's not slower
     assert t_nat < t_np * 1.5, (t_nat, t_np)
+
+
+# ---------------------------------------------------------------------------
+# Native BPE merge engine vs the Python reference loop
+# ---------------------------------------------------------------------------
+
+def test_native_bpe_matches_python_merge():
+    from distributed_llama_tpu.formats.native import NativeBpe
+    from distributed_llama_tpu.testing import byte_vocab_tokenizer
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    tok = Tokenizer(byte_vocab_tokenizer())
+    if tok._native_bpe is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+
+    import random
+
+    rnd = random.Random(7)
+    samples = [
+        b"hello world",
+        b"",
+        b"a",
+        "unicode éè你好 emoji".encode(),
+        bytes(range(256)),
+    ] + [bytes(rnd.randrange(256) for _ in range(rnd.randrange(1, 200))) for _ in range(30)]
+    for s in samples:
+        want = Tokenizer(byte_vocab_tokenizer())
+        want._native_bpe = None  # force the Python loop
+        a = want.encode(s)
+        b = tok.encode(s)
+        assert a == b, f"divergence on {s!r}: {a} != {b}"
+        # round trip: both decode back to the original bytes
+        assert b"".join(tok.piece(t) for t in b if t != tok.bos_id) == s
+
+
+def test_native_bpe_long_prompt_speed_sanity():
+    """The native path must handle a long prompt and agree with Python."""
+    from distributed_llama_tpu.testing import byte_vocab_tokenizer
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    tok = Tokenizer(byte_vocab_tokenizer())
+    text = (b"the quick brown fox jumps over the lazy dog. " * 200)
+    got = tok.encode(text)
+    py = Tokenizer(byte_vocab_tokenizer())
+    py._native_bpe = None
+    assert got == py.encode(text)
