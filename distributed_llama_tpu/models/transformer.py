@@ -87,7 +87,12 @@ def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) ->
 
 
 def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
-    """Select per-token expert weights: w [E, out, in] + idx [b, t, k]."""
+    """Select per-token expert weights: w [E, ...] + idx [b, t, k].
+
+    Callers pass a `_sel_layer`-sliced stack. Measured on-chip: XLA fuses
+    that slice into this gather, while a single combined (layer, idx)
+    advanced-index lowers to a generalized gather that ran 4x SLOWER at
+    decode — keep the two-step form."""
     if isinstance(w, QuantTensor):
         return QuantTensor(q=w.q[idx], d=w.d[idx])
     return w[idx]
@@ -141,9 +146,11 @@ def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
     return gqa_attention(q, k_view, v_view, positions)
 
 
-def _n_local_experts(w: Any) -> int:
-    """Expert count of a (layer-selected) stacked expert weight."""
-    return w.q.shape[0] if isinstance(w, QuantTensor) else w.shape[0]
+def _n_local_experts(w: Any, stacked: bool = False) -> int:
+    """Expert count of an expert weight — `stacked`: w carries a leading
+    all-layers axis ([L, E, ...] rather than [E, ...])."""
+    axis = 1 if stacked else 0
+    return w.q.shape[axis] if isinstance(w, QuantTensor) else w.shape[axis]
 
 
 def _moe_ffn(
@@ -171,34 +178,34 @@ def _moe_ffn(
     results combine with one psum.
     """
     idx, wts = moe_router(y, _sel_layer(lp.moe_gate, layer), cfg.n_active_experts)  # [b,t,k]
-    w1 = _sel_layer(lp.w1, layer)
-    w3 = _sel_layer(lp.w3, layer)
-    w2 = _sel_layer(lp.w2, layer)
     q80 = cfg.q80_activations
 
     rows = y.shape[0] * y.shape[1] * cfg.n_active_experts
     if rows >= cfg.n_experts:
         from ..ops.moe import moe_ffn_ragged
 
+        # the ragged path streams every expert anyway, so slicing the layer
+        # out of the stack first costs nothing extra
         return moe_ffn_ragged(
-            y, idx, wts, w1, w3, w2, partial(_activation, cfg), cfg.dtype,
-            q80=q80, ep_axis=ep_axis,
+            y, idx, wts,
+            _sel_layer(lp.w1, layer), _sel_layer(lp.w3, layer), _sel_layer(lp.w2, layer),
+            partial(_activation, cfg), cfg.dtype, q80=q80, ep_axis=ep_axis,
         )
 
     if ep_axis is not None:
         # small-chunk under EP: gather against the LOCAL expert slice — slots
         # routed to another shard's experts are clamped and zero-weighted,
         # and the shards' partials psum-combine
-        n_local = _n_local_experts(w1)
+        n_local = _n_local_experts(lp.w1, stacked=layer is not None)
         e0 = jax.lax.axis_index(ep_axis) * n_local
         idx_local = idx - e0
         valid = (idx_local >= 0) & (idx_local < n_local)
         idx = jnp.clip(idx_local, 0, n_local - 1)
         wts = wts * valid.astype(wts.dtype)
 
-    w1 = _gather_expert(w1, idx)
-    w3 = _gather_expert(w3, idx)
-    w2 = _gather_expert(w2, idx)
+    w1 = _gather_expert(_sel_layer(lp.w1, layer), idx)
+    w3 = _gather_expert(_sel_layer(lp.w3, layer), idx)
+    w2 = _gather_expert(_sel_layer(lp.w2, layer), idx)
     xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
     h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
     out = _expert_matmul(h, w2, cfg.dtype, q80)  # [b,t,k,dim]
