@@ -117,6 +117,17 @@ def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndar
     return y.astype(x.dtype)
 
 
+def _pallas_enabled(cfg) -> bool:
+    """Single owner of the pallas-enable resolution for trace-time path
+    choices: cfg.use_pallas, auto-resolved by backend when None, with
+    interpret mode forcing on (it exists to exercise the kernel paths)."""
+    from ..ops.quant import _use_pallas
+
+    if cfg.pallas_interpret:
+        return True
+    return cfg.use_pallas if cfg.use_pallas is not None else _use_pallas()
+
+
 def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
     """Pick the attention implementation for this (static) shape:
 
@@ -127,16 +138,12 @@ def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
       bounds with the kv_len position bucket.
     """
     from ..ops.pallas_attention import flash_attention, flash_attention_aligned
-    from ..ops.quant import _use_pallas
 
     t = q.shape[1]
-    pallas = cfg.use_pallas if cfg.use_pallas is not None else _use_pallas()
     # interpret mode rides in the (static, hashable) config, so the jit
     # cache can never replay a program traced in the other mode
-    if cfg.pallas_interpret:
-        pallas = True
     if (
-        pallas
+        _pallas_enabled(cfg)
         and k_view.dtype == jnp.bfloat16
         and flash_attention_aligned(q, k_view, t)
     ):
@@ -203,16 +210,73 @@ def _moe_ffn(
         idx = jnp.clip(idx_local, 0, n_local - 1)
         wts = wts * valid.astype(wts.dtype)
 
-    w1 = _gather_expert(_sel_layer(lp.w1, layer), idx)
-    w3 = _gather_expert(_sel_layer(lp.w3, layer), idx)
-    w2 = _gather_expert(_sel_layer(lp.w2, layer), idx)
-    xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
-    h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
-    out = _expert_matmul(h, w2, cfg.dtype, q80)  # [b,t,k,dim]
-    out = jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts)
+    if _moe_decode_i8_eligible(cfg, y, lp):
+        out = _moe_decode_i8(cfg, y, lp, layer, idx, wts)
+    else:
+        w1 = _gather_expert(_sel_layer(lp.w1, layer), idx)
+        w3 = _gather_expert(_sel_layer(lp.w3, layer), idx)
+        w2 = _gather_expert(_sel_layer(lp.w2, layer), idx)
+        xk = jnp.broadcast_to(y[:, :, None, :], (*y.shape[:2], cfg.n_active_experts, y.shape[-1]))
+        h = _activation(cfg, _expert_matmul(xk, w1, cfg.dtype, q80)) * _expert_matmul(xk, w3, cfg.dtype, q80)
+        out = _expert_matmul(h, w2, cfg.dtype, q80)  # [b,t,k,dim]
+        out = jnp.einsum("btko,btk->bto", out.astype(jnp.float32), wts)
     if ep_axis is not None:
         out = jax.lax.psum(out, ep_axis)
     return out.astype(y.dtype)
+
+
+def _moe_decode_i8_eligible(cfg, y, lp) -> bool:
+    """Single-token decode on the bf16 Pallas path with aligned Q40 expert
+    stacks -> per-slot int8-MXU kernel calls (reads ONLY the k active
+    experts' int8 weights; the gather path materializes dequantized copies)."""
+    return (
+        _pallas_enabled(cfg)
+        and cfg.dtype == jnp.bfloat16
+        and y.shape[0] * y.shape[1] == 1
+        and all(isinstance(w, QuantTensor) for w in (lp.w1, lp.w2, lp.w3))
+        and lp.w1.out_features % 128 == 0
+        and lp.w2.out_features % 128 == 0
+    )
+
+
+def _moe_decode_i8(cfg, y, lp, layer, idx, wts):
+    """One token's top-k expert SwiGLU via the scalar-prefetched stacked
+    int8-MXU kernel (ops/pallas_q40.py): each (slot, role) matmul indexes the
+    [L*E]-flattened expert stack directly, so HBM traffic is exactly the k
+    active experts' int8 weights — the decode-optimal read set, at the same
+    effective bandwidth as the dense decode path."""
+    from ..ops.pallas_q40 import q40_matmul_pallas_stacked_i8
+
+    def flat(w):
+        # [L, E, nb, 32, out] -> [L*E, nb, 32, out] (free reshape); a
+        # layer-sliced [E, ...] stack (pipeline path) passes through as-is
+        if w.q.ndim == 5:
+            return (
+                w.q.reshape(-1, *w.q.shape[2:]),
+                w.d.reshape(-1, *w.d.shape[2:]),
+            )
+        return w.q, w.d
+
+    w1q, w1d = flat(lp.w1)
+    w3q, w3d = flat(lp.w3)
+    w2q, w2d = flat(lp.w2)
+    n_e = _n_local_experts(lp.w1, stacked=lp.w1.q.ndim == 5)
+    base = (layer * n_e) if layer is not None else 0
+    interp = cfg.pallas_interpret
+
+    x = y.reshape(1, y.shape[-1])
+    k = idx.shape[-1]
+    out = jnp.zeros((1, cfg.dim), jnp.float32)
+    for slot in range(k):
+        fi = base + idx.reshape(k)[slot]
+        h = _activation(
+            cfg, q40_matmul_pallas_stacked_i8(x, w1q, w1d, fi, interpret=interp)
+        ) * q40_matmul_pallas_stacked_i8(x, w3q, w3d, fi, interpret=interp)
+        o = q40_matmul_pallas_stacked_i8(
+            h.astype(y.dtype), w2q, w2d, fi, interpret=interp
+        )
+        out = out + wts.reshape(k)[slot] * o
+    return out.reshape(*y.shape[:2], cfg.dim)
 
 
 def _layer(

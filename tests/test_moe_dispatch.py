@@ -135,3 +135,49 @@ def test_engine_ep_pp_mesh_matches(tmp_path):
     eng = InferenceEngine(path, compute_dtype="float32", mesh=make_mesh(ep=2, pp=2))
     got = eng.generate([3, 17, 99, 4], 12, sampler=None).tokens
     assert got == want
+
+
+def test_moe_decode_i8_kernel_close_to_gather(tmp_path, monkeypatch):
+    """The per-slot int8-MXU decode path (interpret mode) stays within q80
+    quantization tolerance of the bf16 gather path and picks the same
+    greedy token."""
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    # 128-aligned dims — the i8 path's eligibility gate requires
+    # out_features % 128 == 0 for w1 (ff) and w2 (dim)
+    h = tiny_header(
+        arch=ArchType.QWEN3_MOE, rope_type=RopeType.FALCON,
+        dim=128, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        n_experts=4, n_active_experts=2, moe_hidden_dim=128, seq_len=64,
+    )
+    path = str(tmp_path / "moe128.m")
+    write_tiny_model(path, h, seed=13)
+    reader = MFileReader(path)
+
+    from distributed_llama_tpu.models.transformer import _moe_decode_i8_eligible
+
+    cfg_probe = config_from_header(reader.header, compute_dtype="bfloat16")
+    cfg_probe = cfg_probe.with_(use_pallas=True, pallas_interpret=True)
+    params_probe = load_params(reader, cfg_probe)
+    assert _moe_decode_i8_eligible(
+        cfg_probe, jnp.zeros((1, 1, 128)), params_probe.layers
+    ), "fixture must actually take the i8 decode path"
+
+    def logits_with(use_pallas):
+        cfg = config_from_header(reader.header, compute_dtype="bfloat16")
+        cfg = cfg.with_(use_pallas=use_pallas, pallas_interpret=use_pallas)
+        params = load_params(reader, cfg)
+        rope = build_rope_tables(reader.header)
+        cache = init_kv_cache(cfg, batch=1)
+        out = []
+        for p, t in enumerate([5, 42, 7]):
+            lg, cache = forward(
+                cfg, params, rope, cache, jnp.asarray([[t]], jnp.int32), jnp.int32(p)
+            )
+            out.append(np.asarray(lg[0], np.float32))
+        return out
+
+    fast = logits_with(True)
+    ref = logits_with(False)
+    for a, b in zip(fast, ref):
+        assert int(a.argmax()) == int(b.argmax())
+        np.testing.assert_allclose(a, b, rtol=8e-2, atol=8e-2)
