@@ -229,13 +229,15 @@ def _moe_decode_i8_eligible(cfg, y, lp) -> bool:
     """Single-token decode on the bf16 Pallas path with aligned Q40 expert
     stacks -> per-slot int8-MXU kernel calls (reads ONLY the k active
     experts' int8 weights; the gather path materializes dequantized copies)."""
+    from ..ops.pallas_q40 import q40_stacked_aligned
+
     return (
         _pallas_enabled(cfg)
         and cfg.dtype == jnp.bfloat16
         and y.shape[0] * y.shape[1] == 1
         and all(isinstance(w, QuantTensor) for w in (lp.w1, lp.w2, lp.w3))
-        and lp.w1.out_features % 128 == 0
-        and lp.w2.out_features % 128 == 0
+        and q40_stacked_aligned(lp.w1.in_features, lp.w1.out_features)
+        and q40_stacked_aligned(lp.w2.in_features, lp.w2.out_features)
     )
 
 

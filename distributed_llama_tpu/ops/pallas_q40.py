@@ -43,6 +43,19 @@ def q40_matmul_aligned(x, w) -> bool:
     )
 
 
+def q40_stacked_aligned(in_features: int, out_features: int) -> bool:
+    """THE alignment contract of the stacked (scalar-prefetch) kernels, for
+    every gate that selects them: lane-aligned out_features AND nb % 8 == 0.
+    The stacked kernels flatten [N, nb, ...] -> [N*nb, ...], so the scale
+    block's leading tile can no longer be 'equal to the whole array dim' and
+    must be 8-sublane divisible — REAL Mosaic lowering enforces this;
+    interpret mode does NOT, so only this predicate protects real TPUs."""
+    return (
+        out_features % LANE == 0
+        and (in_features // Q_BLOCK) % 8 == 0
+    )
+
+
 def _kernel(x_ref, qt_ref, dt_ref, out_ref):
     k = pl.program_id(1)
     # dequant: f32 multiply keeps full f16-scale precision, then cast once

@@ -157,6 +157,7 @@ def quant_matmul(
         q40_matmul_pallas_i8,
         q40_matmul_pallas_stacked,
         q40_matmul_pallas_stacked_i8,
+        q40_stacked_aligned,
     )
 
     # "interpret" (cfg.pallas_arg): force-enabled kernels in interpret mode —
@@ -178,7 +179,11 @@ def quant_matmul(
         rows *= s
     use_i8 = pallas and rows == 1 and dtype == jnp.bfloat16
     if layer is not None and w.q.ndim == 4:
-        if pallas and w.out_features % 128 == 0 and x.shape[-1] == w.in_features:
+        stack_aligned = (
+            x.shape[-1] == w.in_features
+            and q40_stacked_aligned(w.in_features, w.out_features)
+        )
+        if pallas and stack_aligned:
             if use_i8:
                 out = q40_matmul_pallas_stacked_i8(
                     x, w.q, w.d, layer, interpret=interpret

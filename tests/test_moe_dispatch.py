@@ -142,12 +142,13 @@ def test_moe_decode_i8_kernel_close_to_gather(tmp_path, monkeypatch):
     quantization tolerance of the bf16 gather path and picks the same
     greedy token."""
     monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
-    # 128-aligned dims — the i8 path's eligibility gate requires
-    # out_features % 128 == 0 for w1 (ff) and w2 (dim)
+    # aligned dims — the i8 path's eligibility gate requires
+    # out_features % 128 == 0 AND in_features % 256 == 0 (nb % 8, the
+    # stacked kernel's sublane constraint) for w1 (ff) and w2 (dim)
     h = tiny_header(
         arch=ArchType.QWEN3_MOE, rope_type=RopeType.FALCON,
-        dim=128, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
-        n_experts=4, n_active_experts=2, moe_hidden_dim=128, seq_len=64,
+        dim=256, hidden_dim=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        n_experts=4, n_active_experts=2, moe_hidden_dim=256, seq_len=64,
     )
     path = str(tmp_path / "moe128.m")
     write_tiny_model(path, h, seed=13)
@@ -159,7 +160,7 @@ def test_moe_decode_i8_kernel_close_to_gather(tmp_path, monkeypatch):
     cfg_probe = cfg_probe.with_(use_pallas=True, pallas_interpret=True)
     params_probe = load_params(reader, cfg_probe)
     assert _moe_decode_i8_eligible(
-        cfg_probe, jnp.zeros((1, 1, 128)), params_probe.layers
+        cfg_probe, jnp.zeros((1, 1, 256)), params_probe.layers
     ), "fixture must actually take the i8 decode path"
 
     def logits_with(use_pallas):

@@ -131,3 +131,37 @@ def test_i8_path_selected_for_single_row_bf16():
     # bf16 input quantized to q80: compare against the reference math of the
     # same quantized input
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_stacked_gate_rejects_unaligned_nb(monkeypatch):
+    """Stacked kernels need nb % 8 == 0 (the flattened [L*nb, out] scale
+    block's sublane constraint — REAL Mosaic enforces it, interpret mode
+    doesn't). An unaligned stack must take the XLA fallback PATH (asserted
+    by poisoning the kernels — numerics alone can't prove path selection in
+    interpret mode) and stay correct."""
+    from distributed_llama_tpu.ops import pallas_q40 as pq
+    from distributed_llama_tpu.ops import quant as quant_mod
+
+    assert not pq.q40_stacked_aligned(128, 256)  # nb=4
+    assert pq.q40_stacked_aligned(256, 256)  # nb=8
+
+    def boom(*a, **kw):
+        raise AssertionError("stacked kernel selected for unaligned nb")
+
+    # quant_matmul does `from .pallas_q40 import ...` at call time, so the
+    # kernel must be poisoned on the pallas_q40 module itself
+    monkeypatch.setattr(pq, "q40_matmul_pallas_stacked", boom)
+    monkeypatch.setattr(pq, "q40_matmul_pallas_stacked_i8", boom)
+    rng = np.random.default_rng(4)
+    layers = [make_weight(rng, 256, 128) for _ in range(2)]  # nb = 4
+    stacked = QuantTensor(
+        q=jnp.stack([w.q for w in layers]), d=jnp.stack([w.d for w in layers])
+    )
+    x = jnp.asarray(rng.standard_normal((1, 128)), jnp.float32)
+    got = np.asarray(
+        quant_mod.quant_matmul(
+            x, stacked, dtype=jnp.float32, pallas="interpret", layer=jnp.int32(1)
+        )
+    )
+    want = np.asarray(x) @ np.asarray(dequantize(layers[1])).T
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
