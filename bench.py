@@ -92,6 +92,11 @@ def leg_longcontext():
     eng = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
 
     def decode_at(pos: int) -> float:
+        """TIMING-ONLY leg: only the last 512 cache positions are prefilled,
+        so decode at 30k attends mostly zero K/V rows — the read volume (and
+        thus the timing) is identical to a fully-written cache, but the
+        generated tokens are numerically meaningless. Numerics at depth are
+        covered by the parity/perplexity legs."""
         eng.reset()
         prompt = [(i % 999) + 1 for i in range(512)]
         # place the prompt so decode runs at `pos`

@@ -84,14 +84,16 @@ def q40_unpack_t_native(
     raw, out_f: int, in_f: int, n_threads: int = 0
 ) -> tuple[np.ndarray, np.ndarray] | None:
     """Q40 file bytes -> (qt [in_f//32, 32, out_f] int8, dt [in_f//32, out_f]
-    f32) — the device T layout, in one pass. None if the codec is missing."""
+    f16) — the device T layout, in one pass. The scale plane carries the
+    file's f16 bits verbatim (bit-exact, half the f32 plane's traffic). None
+    if the codec is missing."""
     lib = _load()
     if lib is None:
         return None
     bpr = in_f // 32
     buf = np.frombuffer(raw, dtype=np.uint8, count=out_f * bpr * 18)
     qt = np.empty((bpr, 32, out_f), dtype=np.int8)
-    dt = np.empty((bpr, out_f), dtype=np.float32)
+    dt = np.empty((bpr, out_f), dtype=np.float16)
     lib.q40_unpack_t(
         buf.ctypes.data, out_f, bpr,
         qt.ctypes.data, dt.ctypes.data, n_threads,

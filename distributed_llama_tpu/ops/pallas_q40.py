@@ -12,7 +12,17 @@ Tiling:
   f32 accumulation in place);
   qt block [TILE_KNB, 32, TILE_N] int8 — the 32-sublane dim is exactly
   int8's min tile, TILE_N sits on the 128-lane dim;
-  dt block [TILE_KNB, TILE_N] f32 broadcasts over the sublane axis.
+  dt block [TILE_KNB, TILE_N] broadcasts over the sublane axis.
+
+Scale plane: the .m file's per-block scales are f16; the T layout carries
+them verbatim (2 bytes/block — half the round-2 f32 plane's HBM traffic and
+footprint, and bit-exact). Mosaic cannot load float16 on this platform
+(remote-compile 500 at every tile shape — scripts/probe_f16_scales.py), so
+the wrappers bitcast the plane to int16 and the kernels convert bits -> f32
+on the VPU (`_scale_f32`): shifts + masks + one bitcast, subnormal-aware,
+measured exact. Scales are 1/32nd of the elements, so the conversion cost is
+noise next to the dequant work it replaces. f32 planes (hand-built test
+tensors) still work everywhere.
 """
 
 from __future__ import annotations
@@ -56,10 +66,40 @@ def q40_stacked_aligned(in_features: int, out_features: int) -> bool:
     )
 
 
+def _scale_f32(dt: jnp.ndarray) -> jnp.ndarray:
+    """Per-block scale block -> f32, inside a kernel.
+
+    int16 = raw f16 bits (the 2-byte scale plane; see module docstring):
+    manual f16->f32 with integer ops + bitcast. Normal/zero/subnormal are
+    exact; inf/NaN don't occur in scale planes. f32 passes through."""
+    if dt.dtype != jnp.int16:
+        return dt.astype(jnp.float32)
+    h = dt.astype(jnp.int32) & 0xFFFF
+    sign = jnp.left_shift(jnp.bitwise_and(h, 0x8000), 16)
+    exp = jnp.bitwise_and(jnp.right_shift(h, 10), 0x1F)
+    mant = jnp.bitwise_and(h, 0x3FF)
+    normal = jax.lax.bitcast_convert_type(
+        sign | jnp.left_shift(exp + 112, 23) | jnp.left_shift(mant, 13),
+        jnp.float32,
+    )
+    signf = jnp.where(sign != 0, -1.0, 1.0).astype(jnp.float32)
+    subnormal = mant.astype(jnp.float32) * jnp.float32(2.0**-24) * signf
+    return jnp.where(exp == 0, subnormal, normal)
+
+
+def _dt_operand(dt: jnp.ndarray) -> jnp.ndarray:
+    """Scale plane -> what the kernel can load: f16 bitcasts to int16 at the
+    pallas_call boundary (an XLA no-op); f32 passes through. Interpret mode
+    takes the same bitcast path, so CPU tests exercise `_scale_f32`."""
+    if dt.dtype == jnp.float16:
+        return jax.lax.bitcast_convert_type(dt, jnp.int16)
+    return dt
+
+
 def _kernel(x_ref, qt_ref, dt_ref, out_ref):
     k = pl.program_id(1)
     # dequant: f32 multiply keeps full f16-scale precision, then cast once
-    w = (qt_ref[...].astype(jnp.float32) * dt_ref[...][:, None, :]).astype(
+    w = (qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]).astype(
         x_ref.dtype
     )
     w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
@@ -82,9 +122,13 @@ def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
     if x_ref.dtype == jnp.bfloat16:
         # dequant in bf16: the weight lands in bf16 either way (x's dtype);
         # multiplying in bf16 vs f32-then-cast differs only by one rounding
-        w = qt_ref[...].astype(jnp.bfloat16) * dt_ref[...][:, None, :].astype(jnp.bfloat16)
+        w = qt_ref[...].astype(jnp.bfloat16) * _scale_f32(dt_ref[...])[
+            :, None, :
+        ].astype(jnp.bfloat16)
     else:
-        w = (qt_ref[...].astype(jnp.float32) * dt_ref[...][:, None, :]).astype(x_ref.dtype)
+        w = (
+            qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]
+        ).astype(x_ref.dtype)
     w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
     acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
 
@@ -125,6 +169,7 @@ def q40_matmul_pallas_stacked(
     for s in lead:
         b *= s
     x2 = x.reshape(b, in_features).astype(dtype)
+    dt = _dt_operand(dt)
 
     tile_n = min(DEFAULT_TILE_N, out)
     while out % tile_n:
@@ -132,6 +177,9 @@ def q40_matmul_pallas_stacked(
     tile_knb = min(DEFAULT_TILE_KNB, nb)
     while nb % tile_knb:
         tile_knb //= 2
+    # callers gate on q40_stacked_aligned (nb % 8 == 0), which guarantees the
+    # chain above never lands below 8 — the sublane rule Mosaic enforces on
+    # real TPUs for blocks that don't span the whole (flattened) leading dim
 
     # flatten the layer axis into the block-row axis (a free bitcast — the
     # memory is contiguous) so the kernel sees the same 3D blocks as the
@@ -187,7 +235,7 @@ def _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
         blockdiag, qt2, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # [knb, tn]; row b = block b's exact integer dot
-    scale = xs_ref[...][:, :1] * dt_ref[...]  # [knb, tn] f32
+    scale = xs_ref[...][:, :1] * _scale_f32(dt_ref[...])  # [knb, tn] f32
     acc = jnp.sum(partials.astype(jnp.float32) * scale, axis=0)[None, :]
 
     @pl.when(k == 0)
@@ -232,24 +280,49 @@ def _blockdiag_mask(tile_knb: int) -> jnp.ndarray:
 
 
 def _i8_tiles(nb: int, out: int) -> tuple[int, int]:
-    """Tile shapes for the int8 kernel, from a measured sweep on v5e
-    (scripts at /tmp were transient; numbers recorded in PERF.md):
-    ffn-sized outs want wide n tiles (1024 -> 528 GB/s vs 418 at 256),
-    vocab-sized outs regress past 512, and deep contractions (nb >= 256,
-    e.g. w2's 8192 in-features) want k tiles of 128 (589 GB/s)."""
+    """Tile shapes for the int8 kernel, from the round-3 measured sweeps on
+    v5e with the f16 scale plane at both the 1B and 8B model shapes
+    (scripts/sweep_i8_tiles.py; µs per decode matmul, best of the grid):
+      qkvo-like  (out<4096, nb<256):  tn=512  knb=64  (2048->2048:  7.3 µs)
+      deep-k w2  (nb>=256, out<4096): tn=2048 knb=16  (8192->2048: 24.8 µs,
+                 719 GB/s — wide lanes beat deep k-tiles for w2 shapes)
+      ffn-wide   (4096<=out<16384):   nb>=128: tn=2048 knb=16
+                 (4096->14336: 82 µs, 14336->4096: 86 µs); smaller
+                 contractions: tn=512 knb=32 (2048->8192: 25.6 µs)
+      vocab-wide (out>=16384): tn=2048 (chains down for ragged vocabs, e.g.
+                 128256 -> 256), knb=128 when nb allows (4096->128256:
+                 799 µs, 698 GB/s) else 32 (2048->32768: 97 µs)
+    """
     if out >= 16384:
-        tile_n = 512
+        tile_n = 2048
+        tile_knb = 128 if nb >= 128 else 32
     elif out >= 4096:
-        tile_n = 1024
+        tile_n = 2048 if nb >= 128 else 512
+        tile_knb = 16 if nb >= 128 else 32
+    elif nb >= 256:
+        tile_n = 2048
+        tile_knb = 16
     else:
-        tile_n = DEFAULT_TILE_N
+        tile_n = 512
+        tile_knb = DEFAULT_TILE_KNB
     tile_n = min(tile_n, out)
     while out % tile_n:
         tile_n //= 2
-    tile_knb = 128 if nb >= 256 else DEFAULT_TILE_KNB
     tile_knb = min(tile_knb, nb)
     while nb % tile_knb:
         tile_knb //= 2
+    # VMEM cap: the int8 weight block (tile_knb*32*tile_n bytes) is
+    # double-buffered; >4 MB blocks failed remote compile in the sweep
+    while tile_n * tile_knb * Q_BLOCK > 4 * 1024 * 1024 and tile_knb > 8:
+        tile_knb //= 2
+    # Mosaic's sublane rule for the multi-k-step case: a [tile_knb, tile_n]
+    # scale block must have tile_knb % 8 == 0 UNLESS it spans the whole
+    # leading dim. The divisor chain can land below 8 for ragged nb (e.g.
+    # nb=68 -> 4); fall back to one whole-dim k step — always legal, and
+    # ragged-nb weights are small enough for a single block. Interpret mode
+    # doesn't enforce this; only this guard protects real TPUs.
+    if tile_knb != nb and tile_knb % 8:
+        tile_knb = nb
     return tile_n, tile_knb
 
 
@@ -261,6 +334,7 @@ def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    dt = _dt_operand(dt)
     tile_n, tile_knb = _i8_tiles(nb, out)
     mask = _blockdiag_mask(tile_knb)
     grid = (out // tile_n, nb // tile_knb)
@@ -292,6 +366,7 @@ def q40_matmul_pallas_stacked_i8(
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    dt = _dt_operand(dt)
     tile_n, tile_knb = _i8_tiles(nb, out)
     mask = _blockdiag_mask(tile_knb)
     k_steps = nb // tile_knb
@@ -337,6 +412,7 @@ def q40_matmul_pallas(
     for s in lead:
         b *= s
     x2 = x.reshape(b, in_features).astype(dtype)
+    dt = _dt_operand(dt)
 
     tile_n = min(DEFAULT_TILE_N, out)
     while out % tile_n:
@@ -344,6 +420,11 @@ def q40_matmul_pallas(
     tile_knb = min(DEFAULT_TILE_KNB, nb)
     while nb % tile_knb:
         tile_knb //= 2
+    # ragged nb (e.g. 68) can chain below 8: a multi-step block violating
+    # Mosaic's sublane rule on real TPUs (interpret mode doesn't enforce it).
+    # One whole-dim k step is always legal and such weights are small.
+    if tile_knb != nb and tile_knb % 8:
+        tile_knb = nb
 
     grid = (out // tile_n, nb // tile_knb)
     out2 = pl.pallas_call(

@@ -15,7 +15,8 @@ Device layout (the "T" layout, chosen for TPU tiling): a logical
 block-major*:
 
     q: [in_features // 32, 32, out_features]  int8  (values in [-8, 7])
-    d: [in_features // 32, out_features]      f32   (per-block scales)
+    d: [in_features // 32, out_features]      f16   (per-block scales — the
+                                                     file's f16 bits verbatim)
 
 so that the innermost axis (out_features, the matmul's N) sits on the
 128-lane dimension, the 32 elements of a quantization block sit exactly on
@@ -47,7 +48,8 @@ from ..formats.quants import Q_BLOCK
 class QuantTensor:
     """A Q40 weight on device in the T layout (see module docstring).
 
-    q: [..., in//32, 32, out] int8;  d: [..., in//32, out] f32.
+    q: [..., in//32, 32, out] int8;  d: [..., in//32, out] f16 (the file's
+    scale bits verbatim; f32 also accepted for hand-built test tensors).
     Logical value[o, i] = q[i//32, i%32, o] * d[i//32, o].
     """
 
@@ -79,9 +81,10 @@ def q40_to_t_layout(q: np.ndarray, d: np.ndarray) -> tuple[np.ndarray, np.ndarra
     """Host-side transform from the file layout ([out, in//32, 32] values +
     [out, in//32] scales, `unpack_q40`) to the device T layout. The single
     source of truth for the layout contract — used by both the param loader
-    and `quant_tensor_from_q40`."""
+    and `quant_tensor_from_q40`. The scale plane keeps the file's f16 dtype
+    (bit-exact, and half the HBM traffic/footprint of an f32 plane)."""
     qt = np.ascontiguousarray(np.transpose(q, (1, 2, 0)))
-    dt = np.ascontiguousarray(np.transpose(d, (1, 0))).astype(np.float32)
+    dt = np.ascontiguousarray(np.transpose(d, (1, 0))).astype(np.float16)
     return qt, dt
 
 
@@ -97,7 +100,7 @@ def dequantize_t(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
     (the T layout's natural orientation). Single owner of the dequant
     formula: value = q * d broadcast over the 32-sublane axis, scale multiply
     in f32, one cast at the end."""
-    x = (w.q.astype(jnp.float32) * w.d[..., None, :]).astype(dtype)
+    x = (w.q.astype(jnp.float32) * w.d[..., None, :].astype(jnp.float32)).astype(dtype)
     return x.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
 
 
@@ -115,8 +118,8 @@ def _use_pallas() -> bool:
 @partial(jax.jit, static_argnames=("dtype",))
 def _quant_matmul_xla(x, q, d, dtype):
     # w [in, out] dequantized on the fly; dequant multiply in f32 (scale
-    # precision), operands cast to `dtype` for the MXU
-    w = (q.astype(jnp.float32) * d[:, None, :]).astype(dtype)
+    # precision — f16 scales upcast exactly), operands cast to `dtype`
+    w = (q.astype(jnp.float32) * d[:, None, :].astype(jnp.float32)).astype(dtype)
     w = w.reshape(q.shape[-3] * Q_BLOCK, q.shape[-1])
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     return jax.lax.dot_general(

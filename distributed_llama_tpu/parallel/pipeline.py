@@ -26,7 +26,6 @@ for exactly this reason).
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax

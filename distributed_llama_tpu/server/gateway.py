@@ -53,7 +53,12 @@ class Balancer:
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
-        self.waiting = 0
+        # FIFO wait queue of tickets: freed slots go to the head waiter, and
+        # new arrivals queue behind existing waiters instead of stealing
+        # capacity from them (without this, sustained load can starve queued
+        # requests into 429 timeouts while latecomers sail through)
+        self._queue: list[int] = []
+        self._next_ticket = 0
 
     def _select_locked(self) -> int:
         now = time.monotonic()
@@ -78,26 +83,36 @@ class Balancer:
         """Returns backend index, or -1 when every backend is saturated AND
         the wait queue is full (or the queued wait timed out)."""
         with self.cond:
-            idx = self._select_locked()
-            if idx >= 0:
-                return idx
-            if self.waiting >= self.config.queue_size:
+            # fast path only when nobody is already waiting — otherwise this
+            # caller must take its place at the back of the line
+            if not self._queue:
+                idx = self._select_locked()
+                if idx >= 0:
+                    return idx
+            if len(self._queue) >= self.config.queue_size:
                 return -1  # queue full -> immediate 429
-            self.waiting += 1
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._queue.append(ticket)
             try:
                 deadline = time.monotonic() + self.config.queue_timeout_s
                 while True:
+                    # only the head of the line may claim capacity
+                    if self._queue[0] == ticket:
+                        idx = self._select_locked()
+                        if idx >= 0:
+                            return idx
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return -1
                     # short wait slices so an unhealthy backend coming back
                     # (a timed event no release() announces) is picked up
                     self.cond.wait(min(remaining, 0.25))
-                    idx = self._select_locked()
-                    if idx >= 0:
-                        return idx
             finally:
-                self.waiting -= 1
+                self._queue.remove(ticket)
+                # the next waiter may have become head — wake everyone (the
+                # queue is small, bounded by queue_size)
+                self.cond.notify_all()
 
     def release(self, idx: int, mark_unhealthy: bool):
         if idx < 0:
@@ -108,7 +123,7 @@ class Balancer:
                 b.inflight -= 1
             if mark_unhealthy:
                 b.unhealthy_until = time.monotonic() + self.config.health_retry_ms / 1000.0
-            self.cond.notify()
+            self.cond.notify_all()
 
 
 def _read_http_request(sock: socket.socket) -> bytes | None:

@@ -15,7 +15,10 @@
 //           nibble and elem j+16 in the high nibble —
 //           reference: src/nn/nn-quants.hpp:64-67)
 //   output: qt[bpr][32][out_f] int8 (values in [-8, 7])
-//           dt[bpr][out_f] float32
+//           dt[bpr][out_f] float16 (the block's raw f16 scale bits, copied
+//           verbatim — the round-3 2-byte scale plane: halves the scale
+//           traffic/footprint and stays bit-exact with the file; the Pallas
+//           kernels convert f16 bits -> f32 in-kernel, ops/pallas_q40.py)
 
 #include <cstdint>
 #include <cstring>
@@ -64,7 +67,7 @@ float f16_to_f32(uint16_t h) {
 constexpr int64_t TILE = 128;
 
 void unpack_block_cols(const uint8_t* raw, int64_t out_f, int64_t bpr,
-                       int8_t* qt, float* dt, int64_t b_start, int64_t b_end) {
+                       int8_t* qt, uint16_t* dt, int64_t b_start, int64_t b_end) {
     int8_t tile[Q40_BLOCK][TILE];
     for (int64_t b = b_start; b < b_end; b++) {
         for (int64_t o0 = 0; o0 < out_f; o0 += TILE) {
@@ -74,7 +77,7 @@ void unpack_block_cols(const uint8_t* raw, int64_t out_f, int64_t bpr,
                     raw + ((o0 + i) * bpr + b) * Q40_BLOCK_BYTES;
                 uint16_t h;
                 std::memcpy(&h, blk, 2);
-                dt[b * out_f + o0 + i] = f16_to_f32(h);
+                dt[b * out_f + o0 + i] = h;
                 const uint8_t* packed = blk + 2;
                 for (int j = 0; j < 16; j++) {
                     uint8_t byte = packed[j];
@@ -94,9 +97,10 @@ void unpack_block_cols(const uint8_t* raw, int64_t out_f, int64_t bpr,
 extern "C" {
 
 // raw: out_f*bpr Q40 blocks (18B each, row-major); qt: [bpr,32,out_f] int8;
-// dt: [bpr,out_f] f32. n_threads <= 0 means hardware_concurrency.
+// dt: [bpr,out_f] f16 (raw scale bits). n_threads <= 0 means
+// hardware_concurrency.
 void q40_unpack_t(const uint8_t* raw, int64_t out_f, int64_t bpr,
-                  int8_t* qt, float* dt, int32_t n_threads) {
+                  int8_t* qt, uint16_t* dt, int32_t n_threads) {
     int64_t nt = n_threads > 0 ? n_threads : (int64_t)std::thread::hardware_concurrency();
     nt = std::max<int64_t>(1, std::min<int64_t>(nt, bpr));
     if (nt == 1) {

@@ -221,6 +221,46 @@ class TestBalancer:
         assert b.acquire() == 0
         assert b.acquire() == -1  # waited 0.2s, nothing freed -> 429
 
+    def test_queue_is_fifo_under_contention(self):
+        """Freed slots go to the longest waiter; latecomers can't steal
+        capacity from queued requests (starvation -> spurious 429s)."""
+        import time
+
+        b = Balancer(self.cfg(n=1, cap=1, queue_size=8, queue_timeout_s=10.0))
+        assert b.acquire() == 0
+        order = []
+        lock = threading.Lock()
+
+        def waiter(tag):
+            idx = b.acquire()
+            with lock:
+                order.append((tag, idx))
+
+        threads = []
+        for tag in range(3):
+            t = threading.Thread(target=waiter, args=(tag,))
+            t.start()
+            threads.append(t)
+            # wait until this waiter actually enqueued (sleep-based ordering
+            # races thread scheduling on loaded machines)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with b.lock:
+                    if len(b._queue) == tag + 1:
+                        break
+                time.sleep(0.005)
+        # a latecomer arriving exactly as a slot frees must queue behind all
+        # three; release one slot at a time and check arrival order
+        for i in range(3):
+            b.release(0, mark_unhealthy=False)
+            deadline = time.monotonic() + 5
+            while len(order) < i + 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=5)
+        assert [tag for tag, _ in order] == [0, 1, 2]
+        assert all(idx == 0 for _, idx in order)
+
     def test_unhealthy_cooldown(self):
         b = Balancer(self.cfg(n=2))
         idx = b.acquire()
