@@ -79,6 +79,33 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     return decode_tok_s, prefill_tok_s, res.ttft_us / 1e3, eng
 
 
+def leg_8b():
+    """The north-star class made a measured number: a Llama-3.1-8B-shaped
+    synthetic Q40 model (dim 4096, 32L, 32/8 heads, ffn 14336, vocab 128256)
+    on ONE chip. Weight reads per decoded token: 7.50e9 weights (32 layers x
+    218M + wcls 525M) ~= 7.5 GB int8 + 0.47 GB f16 scales ~= 7.97 GB; the
+    roofline % is reported against ~819 GB/s HBM."""
+    path = build_model(
+        "llama8b_q40_v1",
+        dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+        head_dim=128, vocab_size=128256, seq_len=2048,
+    )
+    decode, prefill, ttft, eng = measure(path, 512, 128)
+    # bytes per decoded token: all layer weights + wcls, int8 + f16 scales
+    n_w = 32 * (4096 * (4096 + 1024 + 1024 + 4096) + 3 * 4096 * 14336) + 4096 * 128256
+    bytes_tok = n_w * (1 + 2 / 32)
+    gbs = bytes_tok * decode / 1e9
+    del eng
+    return {
+        "config": "llama-8B-class q40 1chip",
+        "decode_tok_s": round(decode, 2),
+        "prefill_tok_s": round(prefill, 1),
+        "ttft_ms": round(ttft, 1),
+        "decode_eff_gb_s": round(gbs, 1),
+        "hbm_roofline_pct": round(100 * gbs / 819, 1),
+    }
+
+
 def leg_longcontext():
     """32k-context model: decode cost must track the position bucket, not the
     allocated cache (flash attention + kv_len bucketing)."""
@@ -232,6 +259,13 @@ def main():
         print(f"# longctx: {lc}", file=sys.stderr)
     except Exception as e:
         print(f"# longcontext leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        l8 = leg_8b()
+        configs.append(l8)
+        print(f"# 8B-class: {l8}", file=sys.stderr)
+    except Exception as e:
+        print(f"# 8B leg failed: {e!r}", file=sys.stderr)
 
     try:
         pp = leg_perplexity_proxy(

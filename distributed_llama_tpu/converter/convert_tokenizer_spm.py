@@ -1,0 +1,210 @@
+"""Sentencepiece / original-distribution tokenizers -> `.t` files.
+
+Capability port of the reference's two non-HF tokenizer converters:
+
+* ``convert_tokenizer_spm`` — reference converter/convert-tokenizer-llama2.py:
+  enumerate a sentencepiece ``tokenizer.model``'s (piece, score) pairs,
+  replace the sentencepiece whitespace marker ``\u2581`` with a space, carry
+  bos/eos from the model's trainer spec, and embed the llama2 chat template.
+  The reference drives the ``sentencepiece`` library for this; that package
+  is not available here, so `parse_spm_model` walks the protobuf wire format
+  of the .model file directly (the fields used are stable public contract:
+  sentencepiece_model.proto — pieces field 1 {piece=1, score=2}, trainer_spec
+  field 2 {unk_id=40, bos_id=41, eos_id=42}).
+* ``convert_tokenizer_llama3`` — reference converter/convert-tokenizer-llama3.py:
+  the original-distribution Llama-3 tiktoken-format file (base64 token +
+  rank per line), scores = -rank, plus the fixed 256 special tokens and the
+  llama3 chat template.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+
+from ..formats.tfile import TokenizerData, write_tfile
+
+# chat template strings are format data shipped inside the .t — they must
+# byte-match what the reference embeds (reference:
+# converter/convert-tokenizer-llama2.py:6, convert-tokenizer-llama3.py:31)
+LLAMA2_CHAT_TEMPLATE = (
+    "{% if messages[0]['role'] == 'system' %}{% set loop_messages = messages[1:] %}"
+    "{% set system_message = messages[0]['content'] %}{% else %}"
+    "{% set loop_messages = messages %}{% set system_message = false %}{% endif %}"
+    "{% for message in loop_messages %}"
+    "{% if (message['role'] == 'user') != (loop.index0 % 2 == 0) %}"
+    "{{ raise_exception('Conversation roles must alternate user/assistant/user/assistant/...') }}"
+    "{% endif %}{% if loop.index0 == 0 and system_message != false %}"
+    "{% set content = '<<SYS>>\\n' + system_message + '\\n<</SYS>>\\n\\n' + message['content'] %}"
+    "{% else %}{% set content = message['content'] %}{% endif %}"
+    "{% if message['role'] == 'user' %}{{ bos_token + '[INST] ' + content.strip() + ' [/INST]' }}"
+    "{% elif message['role'] == 'assistant' %}{{ ' '  + content.strip() + ' ' + eos_token }}"
+    "{% endif %}{% endfor %}"
+)
+
+LLAMA3_CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}"
+    "{% if loop.index0 == 0 %}{% set content = bos_token + content %}{% endif %}"
+    "{{ content }}{% endfor %}{% if add_generation_prompt %}"
+    "{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}"
+)
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format reader (no deps). Wire types: 0 varint,
+# 1 fixed64, 2 length-delimited, 5 fixed32.
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _fields(data: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Length-delimited values come out as bytes; varints as int; fixed32/64 as
+    raw 4/8 bytes."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        tag, pos = _read_varint(data, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(data, pos)
+        elif wt == 1:
+            v, pos = data[pos : pos + 8], pos + 8
+        elif wt == 2:
+            ln, pos = _read_varint(data, pos)
+            v, pos = data[pos : pos + ln], pos + ln
+        elif wt == 5:
+            v, pos = data[pos : pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield field, wt, v
+
+
+def parse_spm_model(path: str):
+    """sentencepiece .model -> (pieces: list[(str piece, float score)],
+    bos_id, eos_id). Equivalent of the reference's SentencePieceProcessor
+    enumeration (id_to_piece/get_score/bos_id/eos_id)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    pieces: list[tuple[str, float]] = []
+    bos_id, eos_id = 1, 2  # sentencepiece trainer defaults
+    for field, wt, v in _fields(blob):
+        if field == 1 and wt == 2:  # repeated SentencePiece
+            piece, score = "", 0.0
+            for f2, wt2, v2 in _fields(v):
+                if f2 == 1 and wt2 == 2:
+                    piece = v2.decode("utf-8")
+                elif f2 == 2 and wt2 == 5:
+                    (score,) = struct.unpack("<f", v2)
+            pieces.append((piece, score))
+        elif field == 2 and wt == 2:  # TrainerSpec
+            for f2, wt2, v2 in _fields(v):
+                if f2 == 41 and wt2 == 0:
+                    bos_id = v2
+                elif f2 == 42 and wt2 == 0:
+                    eos_id = v2
+    if not pieces:
+        raise ValueError(f"{path}: no sentencepiece pieces found")
+    return pieces, bos_id, eos_id
+
+
+def convert_tokenizer_spm(
+    model_path: str,
+    out_path: str,
+    chat_template: str | None = LLAMA2_CHAT_TEMPLATE,
+) -> TokenizerData:
+    """Sentencepiece tokenizer.model -> .t (reference
+    convert-tokenizer-llama2.py semantics: '\u2581' -> ' ', scores carried
+    verbatim, bos/eos from the model)."""
+    pieces, bos_id, eos_id = parse_spm_model(model_path)
+    vocab = [p.replace("\u2581", " ").encode("utf-8") for p, _ in pieces]
+    scores = [s for _, s in pieces]
+    t = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id,
+        eos_token_ids=[eos_id],
+        add_bos=True,
+        chat_template=chat_template,
+        max_token_length=max(len(v) for v in vocab),
+    )
+    write_tfile(out_path, t)
+    return t
+
+
+N_LLAMA3_SPECIAL = 256
+LLAMA3_SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|reserved_special_token_2|>",
+    "<|reserved_special_token_3|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|reserved_special_token_4|>",
+    "<|eot_id|>",
+] + [f"<|reserved_special_token_{i}|>" for i in range(5, N_LLAMA3_SPECIAL - 5)]
+
+
+def convert_tokenizer_llama3(model_path: str, out_path: str) -> TokenizerData:
+    """Original-distribution Llama-3 tokenizer.model (tiktoken text format:
+    'base64token rank' per line) -> .t (reference
+    convert-tokenizer-llama3.py semantics)."""
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    with open(model_path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            b64, rank = line.split(" ")
+            vocab.append(base64.b64decode(b64))
+            scores.append(-float(rank))
+    vocab += [s.encode("utf-8") for s in LLAMA3_SPECIAL_TOKENS]
+    scores += [0.0] * N_LLAMA3_SPECIAL
+    t = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=len(vocab) - N_LLAMA3_SPECIAL,  # 128000 for the real model
+        eos_token_ids=[len(vocab) - N_LLAMA3_SPECIAL + 1, len(vocab) - N_LLAMA3_SPECIAL + 9],
+        add_bos=True,
+        chat_template=LLAMA3_CHAT_TEMPLATE,
+        max_token_length=max(len(v) for v in vocab),
+    )
+    write_tfile(out_path, t)
+    return t
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="convert-tokenizer-spm")
+    p.add_argument("kind", choices=["spm", "llama2", "llama3"],
+                   help="spm/llama2: sentencepiece .model; llama3: tiktoken text format")
+    p.add_argument("model", help="path to tokenizer.model")
+    p.add_argument("-o", "--output", default="tokenizer.t")
+    args = p.parse_args(argv)
+    if args.kind == "llama3":
+        t = convert_tokenizer_llama3(args.model, args.output)
+    else:
+        t = convert_tokenizer_spm(args.model, args.output)
+    print(f"✅ Created {args.output} ({t.vocab_size} tokens, bos={t.bos_id})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

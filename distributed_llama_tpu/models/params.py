@@ -44,25 +44,38 @@ def _register(cls, fields):
 
 @dataclass
 class LayerParams:
-    """Per-layer weights, each stacked with a leading [n_layers] axis."""
+    """Per-layer weights, each stacked with a leading [n_layers] axis.
 
-    q: Weight  # [L, q_dim, dim]
-    k: Weight  # [L, kv_dim, dim]
-    v: Weight  # [L, kv_dim, dim]
+    Decode makes one kernel dispatch per matmul, so the loader FUSES the
+    row-split projections that share an input: q/k/v -> `wqkv` (always) and
+    dense w1/w3 -> `w13` — 7 weight matmuls per layer become 4, with larger
+    (better-streaming) shapes. The fused out axis is per-TP-shard
+    interleaved (see _fuse_rows) so a plain out-axis sharding gives every
+    shard exactly its own q|k|v (or w1|w3) slices. When fused, the separate
+    fields are None; MoE expert stacks stay separate (the dispatch
+    formulations index experts individually).
+    """
+
+    q: Optional[Weight]  # [L, q_dim, dim] — None when fused into wqkv
+    k: Optional[Weight]  # [L, kv_dim, dim]
+    v: Optional[Weight]  # [L, kv_dim, dim]
     wo: Weight  # [L, dim, q_dim]
-    w1: Weight  # [L, ff, dim] dense | [L, E, ff, dim] moe
+    w1: Optional[Weight]  # [L, ff, dim] dense (None when fused) | [L, E, ff, dim] moe
     w2: Weight  # [L, dim, ff] dense | [L, E, dim, ff] moe
-    w3: Weight  # [L, ff, dim] dense | [L, E, ff, dim] moe
+    w3: Optional[Weight]  # [L, ff, dim] dense (None when fused) | [L, E, ff, dim] moe
     norm0: jnp.ndarray  # [L, dim]
     norm1: jnp.ndarray  # [L, dim]
     q_norm: Optional[jnp.ndarray] = None  # [L, head_dim] (qwen3)
     k_norm: Optional[jnp.ndarray] = None  # [L, head_dim] (qwen3)
     moe_gate: Optional[jnp.ndarray] = None  # [L, E, dim] f32 (moe)
+    wqkv: Optional[Weight] = None  # [L, q_dim+2*kv_dim, dim] fused projection
+    w13: Optional[Weight] = None  # [L, 2*ff, dim] fused dense ffn in-proj
 
 
 _register(
     LayerParams,
-    ["q", "k", "v", "wo", "w1", "w2", "w3", "norm0", "norm1", "q_norm", "k_norm", "moe_gate"],
+    ["q", "k", "v", "wo", "w1", "w2", "w3", "norm0", "norm1", "q_norm", "k_norm",
+     "moe_gate", "wqkv", "w13"],
 )
 
 
@@ -149,6 +162,33 @@ def _stack(parts: list) -> Any:
     return np.stack(parts)
 
 
+def _interleave(arrs: list, tp: int, axis: int) -> np.ndarray:
+    """Concat host arrays along `axis`, permuted so TP shard s's slice of
+    the result is the concat of shard s's slices of each input — a plain
+    out-axis NamedSharding then gives every shard its own parts, at any tp."""
+    if tp == 1:
+        return np.concatenate(arrs, axis=axis)
+    chunks = []
+    for s in range(tp):
+        for a in arrs:
+            n = a.shape[axis]
+            assert n % tp == 0, f"fused out dim {n} not divisible by tp={tp}"
+            chunks.append(np.take(a, range(s * (n // tp), (s + 1) * (n // tp)), axis=axis))
+    return np.concatenate(chunks, axis=axis)
+
+
+def _fuse_rows(parts: list, tp: int) -> Any:
+    """Fuse same-input row-split weights (one layer's host values) along the
+    out axis: T-layout quant pairs (qt [nb,32,out], dt [nb,out]) concat on
+    the last axis; dense [out, in] on axis 0."""
+    if isinstance(parts[0], tuple):
+        return (
+            _interleave([p[0] for p in parts], tp, axis=-1),
+            _interleave([p[1] for p in parts], tp, axis=-1),
+        )
+    return _interleave(parts, tp, axis=0)
+
+
 def _put(x: Any, sharding=None) -> Weight:
     """Host tensor (or quant pair) -> device array(s), optionally sharded.
 
@@ -170,6 +210,7 @@ def load_params(
     reader: MFileReader,
     cfg: ModelConfig,
     shardings: Optional[dict] = None,
+    tp: int = 1,
 ) -> ModelParams:
     """Read all weights, stack per-layer, move to device.
 
@@ -177,6 +218,9 @@ def load_params(
     `NamedSharding` (dense weights) or a pair of shardings (QuantTensor's q/d
     components) — provided by parallel/sharding.py; None loads replicated on
     the default device.
+
+    `tp` is the TP degree the fused projections (LayerParams.wqkv / .w13)
+    are interleaved for — it must match the mesh the shardings come from.
     """
     dense = np.dtype(cfg.compute_dtype)
     sh = shardings or {}
@@ -209,7 +253,23 @@ def load_params(
             else:
                 per_role[r].append(_load_one(reader, reader.by_name[f"{r}.l{l}"], role_dtype))
 
-    layer_kw = {r: put(r, _stack(per_role[r])) for r in roles}
+    # fuse same-input row-split projections (see LayerParams docstring):
+    # q/k/v always; dense w1/w3 (MoE expert stacks stay separate)
+    per_role["wqkv"] = [
+        _fuse_rows([per_role["q"][l], per_role["k"][l], per_role["v"][l]], tp)
+        for l in range(cfg.n_layers)
+    ]
+    del per_role["q"], per_role["k"], per_role["v"]
+    if not cfg.is_moe:
+        per_role["w13"] = [
+            _fuse_rows([per_role["w1"][l], per_role["w3"][l]], tp)
+            for l in range(cfg.n_layers)
+        ]
+        del per_role["w1"], per_role["w3"]
+
+    layer_kw = {r: put(r, _stack(parts)) for r, parts in per_role.items()}
+    for r in ("q", "k", "v", "w1", "w3"):  # consumed by the fused forms
+        layer_kw.setdefault(r, None)
     layers = LayerParams(**layer_kw)
 
     embedding = put("embedding", _load_one(reader, reader.by_name["embedding"], np.float32))

@@ -82,7 +82,15 @@ def _sel_layer(w: Any, i) -> Any:
 
 def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:
     q80 = cfg.q80_activations
-    h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.pallas_arg, q80, layer)) * linear(y, lp.w3, cfg.dtype, cfg.pallas_arg, q80, layer)
+    if lp.w13 is not None:
+        # fused in-projection: one kernel reads w1|w3 (per-shard interleaved
+        # halves, models/params.py) — identical math to two matmuls, half
+        # the dispatches, one activation quantize
+        h13 = linear(y, lp.w13, cfg.dtype, cfg.pallas_arg, q80, layer)
+        ff = h13.shape[-1] // 2
+        h = _activation(cfg, h13[..., :ff]) * h13[..., ff:]
+    else:
+        h = _activation(cfg, linear(y, lp.w1, cfg.dtype, cfg.pallas_arg, q80, layer)) * linear(y, lp.w3, cfg.dtype, cfg.pallas_arg, q80, layer)
     return linear(h, lp.w2, cfg.dtype, cfg.pallas_arg, q80, layer)
 
 
@@ -321,9 +329,23 @@ def _layer(
     # head counts come from the weight shapes, not cfg: under shard_map the
     # local shard holds n_heads/tp heads (the reference's sliceMultiHeadAtt,
     # src/nn/nn-core.cpp:280-287)
-    q = linear(y, lp.q, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
-    k = linear(y, lp.k, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
-    v = linear(y, lp.v, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+    if lp.wqkv is not None:
+        # fused projection: one kernel reads q|k|v. Local split sizes follow
+        # from the global q:k:v ratio — every part shrinks by the same tp
+        # factor under the interleaved row sharding (models/params.py)
+        qkv = linear(y, lp.wqkv, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+        fused_out = qkv.shape[-1]
+        g_q = cfg.n_heads * cfg.head_dim
+        g_kv = cfg.n_kv_heads * cfg.head_dim
+        local_q = fused_out * g_q // (g_q + 2 * g_kv)
+        local_kv = fused_out * g_kv // (g_q + 2 * g_kv)
+        q = qkv[..., :local_q]
+        k = qkv[..., local_q : local_q + local_kv]
+        v = qkv[..., local_q + local_kv :]
+    else:
+        q = linear(y, lp.q, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+        k = linear(y, lp.k, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
+        v = linear(y, lp.v, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
     q = q.reshape(b, t, q.shape[-1] // cfg.head_dim, cfg.head_dim)
     k = k.reshape(b, t, k.shape[-1] // cfg.head_dim, cfg.head_dim)
     v = v.reshape(b, t, v.shape[-1] // cfg.head_dim, cfg.head_dim)
@@ -349,12 +371,45 @@ def _layer(
             k_view, v_view = k_cache, v_cache
         a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
     else:
-        from ..ops.attention import gqa_attention_sp, scatter_cache_update_sp
+        from ..ops.attention import (
+            flash_attention_sp,
+            gqa_attention_sp,
+            scatter_cache_update_sp,
+        )
+        from ..ops.pallas_attention import flash_attention_aligned
 
         axis_name, shard_offset = sp_ctx
         k_cache = scatter_cache_update_sp(k_cache, k, positions, shard_offset)
         v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
-        a = gqa_attention_sp(q, k_cache, v_cache, positions, shard_offset, axis_name)
+        # per-shard KV read bound: kv_len is the GLOBAL position bucket; a
+        # static local bound of min(kv_len, local_seq) is EXACT for every
+        # shard — rows past it are either beyond the bucket (shard 0) or at
+        # global positions >= kv_len (later shards), i.e. future and fully
+        # masked either way. SPMD forbids per-shard static shapes, so this
+        # uniform bound is the tightest static slice available; it caps the
+        # worst case at sp * min(kv_len, local_seq) reads instead of the
+        # full allocation every token (the round-2 behavior).
+        local_seq = k_cache.shape[1]
+        local_kv = min(kv_len, local_seq) if kv_len is not None else local_seq
+        if local_kv < local_seq:
+            k_view = jax.lax.slice_in_dim(k_cache, 0, local_kv, axis=1)
+            v_view = jax.lax.slice_in_dim(v_cache, 0, local_kv, axis=1)
+        else:
+            k_view, v_view = k_cache, v_cache
+        if (
+            _pallas_enabled(cfg)
+            and k_view.dtype == jnp.bfloat16
+            and flash_attention_aligned(q, k_view, t)
+        ):
+            # prefill-sized chunks: blocked flash over the local shard with
+            # cross-shard online-softmax combine — the long-context sp path
+            # finally runs the same kernel as the single-chip path
+            a = flash_attention_sp(
+                q, k_view, v_view, pos_start, shard_offset, axis_name,
+                interpret=cfg.pallas_interpret,
+            )
+        else:
+            a = gqa_attention_sp(q, k_view, v_view, positions, shard_offset, axis_name)
     n_local_heads = q.shape[2]  # == cfg.n_heads unless sharded under shard_map
     att_out = linear(a.reshape(b, t, n_local_heads * cfg.head_dim), lp.wo, cfg.dtype, cfg.pallas_arg, q80, layer_idx)
     x = x + reduce_fn(att_out).astype(x.dtype)

@@ -39,10 +39,15 @@ DEFAULT_BLOCK_T = 128
 DEFAULT_BLOCK_S = 256
 
 
-def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, g, n_s):
+def _attend_block(ps_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *, scale, g):
+    """One KV block's online-softmax update (shared by the normalizing and
+    the partial-stats kernels). ps_ref carries [pos_start, col_offset]:
+    col_offset is the GLOBAL position of the cache's local row 0 — nonzero
+    when the cache operand is one shard of a sequence-parallel cache."""
     si = pl.program_id(2)
     ti = pl.program_id(1)
     pos_start = ps_ref[0]
+    col_offset = ps_ref[1]
 
     _, bt, _, hd = q_ref.shape
     bs = k_ref.shape[1]
@@ -54,10 +59,10 @@ def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # this KV block is visible to this q block iff its first slot is <= the
-    # last query's position
+    # this KV block is visible to this q block iff its first slot's global
+    # position is <= the last query's position
     last_pos = pos_start + ti * bt + (bt - 1)
-    block_visible = si * bs <= last_pos
+    block_visible = col_offset + si * bs <= last_pos
 
     @pl.when(block_visible)
     def _():
@@ -70,7 +75,9 @@ def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         row_pos = pos_start + ti * bt + jax.lax.broadcasted_iota(
             jnp.int32, (rows, bs), 0
         ) // g
-        col_pos = si * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        col_pos = col_offset + si * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, bs), 1
+        )
         s = jnp.where(col_pos <= row_pos, s, NEG_INF)
 
         m_prev = m_ref[...][:, :1]  # [rows, 1]
@@ -88,10 +95,34 @@ def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         acc_ref[...] = acc_ref[...] * corr + pv
         m_ref[...] = jnp.broadcast_to(m_safe, m_ref.shape)
 
+
+def _kernel(ps_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, g, n_s):
+    _attend_block(ps_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, scale=scale, g=g)
+    si = pl.program_id(2)
+    _, bt, _, hd = q_ref.shape
+
     @pl.when(si == n_s - 1)
     def _():
         l = jnp.maximum(l_ref[...][:, :1], 1e-30)
         o_ref[0] = (acc_ref[...] / l).reshape(bt, g, hd).astype(o_ref.dtype)
+
+
+def _kernel_partial(
+    ps_ref, q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref, m_ref, l_ref, acc_ref,
+    *, scale, g, n_s,
+):
+    """Like _kernel but emits the UNNORMALIZED accumulator plus the row
+    stats (m, l) — the shard-local triple of the sequence-parallel
+    online-softmax combine (ops/attention.py flash_attention_sp)."""
+    _attend_block(ps_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, scale=scale, g=g)
+    si = pl.program_id(2)
+    _, bt, _, hd = q_ref.shape
+
+    @pl.when(si == n_s - 1)
+    def _():
+        o_ref[0] = acc_ref[...].reshape(bt, g, hd)
+        m_out_ref[0] = m_ref[...][:, :1].reshape(bt, g)
+        l_out_ref[0] = l_ref[...][:, :1].reshape(bt, g)
 
 
 def flash_attention_aligned(q, k_cache, t: int) -> bool:
@@ -108,25 +139,13 @@ def flash_attention_aligned(q, k_cache, t: int) -> bool:
     )
 
 
-@partial(jax.jit, static_argnames=("scale", "block_t", "block_s", "interpret"))
-def flash_attention(
-    q: jnp.ndarray,  # [b, t, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [b, S, n_kv, head_dim]
-    v_cache: jnp.ndarray,
-    pos_start: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
-    scale: float | None = None,
-    block_t: int = DEFAULT_BLOCK_T,
-    block_s: int = DEFAULT_BLOCK_S,
-    interpret: bool = False,
-) -> jnp.ndarray:
-    """Blocked causal GQA attention; same contract as gqa_attention with
-    positions = pos_start + arange(t). Returns [b, t, n_heads, head_dim]."""
+def _flash_operands(q, k_cache, v_cache, block_t, block_s):
+    """Shared shape plumbing: fold kv heads into the batch grid axis and pick
+    block sizes. Returns (q4, k3, v3, dims)."""
     b, t, n_heads, hd = q.shape
     S = k_cache.shape[1]
     n_kv = k_cache.shape[2]
     g = n_heads // n_kv
-    if scale is None:
-        scale = 1.0 / (hd ** 0.5)
 
     bt = min(block_t, t)
     while t % bt:
@@ -146,29 +165,57 @@ def flash_attention(
     )
     k3 = k_cache.transpose(0, 2, 1, 3).reshape(b * n_kv, S, hd)
     v3 = v_cache.transpose(0, 2, 1, 3).reshape(b * n_kv, S, hd)
+    return q4, k3, v3, (b, t, n_heads, hd, n_kv, g, bt, bs, n_s)
 
-    grid = (b * n_kv, t // bt, n_s)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
+
+def _flash_grid_spec(dims, n_extra_outs=0):
+    b, t, n_heads, hd, n_kv, g, bt, bs, n_s = dims
+    out_specs = [pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0))]
+    out_specs += [
+        pl.BlockSpec((1, bt, g), lambda bk, ti, si, ps: (bk, ti, 0))
+    ] * n_extra_outs
+    return pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=grid,
+        grid=(b * n_kv, t // bt, n_s),
         in_specs=[
             pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
             pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
             pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
+        out_specs=out_specs if n_extra_outs else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((bt * g, 128), jnp.float32),  # running row max
             pltpu.VMEM((bt * g, 128), jnp.float32),  # running exp-sum
             pltpu.VMEM((bt * g, hd), jnp.float32),  # weighted-V accumulator
         ],
     )
+
+
+@partial(jax.jit, static_argnames=("scale", "block_t", "block_s", "interpret"))
+def flash_attention(
+    q: jnp.ndarray,  # [b, t, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [b, S, n_kv, head_dim]
+    v_cache: jnp.ndarray,
+    pos_start: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
+    scale: float | None = None,
+    block_t: int = DEFAULT_BLOCK_T,
+    block_s: int = DEFAULT_BLOCK_S,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Blocked causal GQA attention; same contract as gqa_attention with
+    positions = pos_start + arange(t). Returns [b, t, n_heads, head_dim]."""
+    b, t, n_heads, hd = q.shape
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    q4, k3, v3, dims = _flash_operands(q, k_cache, v_cache, block_t, block_s)
+    _, _, _, _, n_kv, g, bt, bs, n_s = dims
+    ps = jnp.stack([jnp.asarray(pos_start, jnp.int32), jnp.int32(0)])
     out = pl.pallas_call(
         partial(_kernel, scale=scale, g=g, n_s=n_s),
-        grid_spec=grid_spec,
+        grid_spec=_flash_grid_spec(dims),
         out_shape=jax.ShapeDtypeStruct((b * n_kv, t, g, hd), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(pos_start, jnp.int32).reshape(1), q4, k3, v3)
+    )(ps, q4, k3, v3)
     # [b*kv, t, g, hd] -> [b, t, kv*g, hd]
     return (
         out.reshape(b, n_kv, t, g, hd)
@@ -176,3 +223,47 @@ def flash_attention(
         .reshape(b, t, n_heads, hd)
         .astype(q.dtype)
     )
+
+
+@partial(jax.jit, static_argnames=("scale", "block_t", "block_s", "interpret"))
+def flash_attention_partial(
+    q: jnp.ndarray,  # [b, t, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [b, S_local, n_kv, head_dim] — ONE shard's slice
+    v_cache: jnp.ndarray,
+    pos_start: jnp.ndarray,  # scalar int32: absolute position of q[:, 0]
+    col_offset: jnp.ndarray,  # scalar int32: global position of cache row 0
+    scale: float | None = None,
+    block_t: int = DEFAULT_BLOCK_T,
+    block_s: int = DEFAULT_BLOCK_S,
+    interpret: bool = False,
+):
+    """Shard-local flash attention returning the UNNORMALIZED online-softmax
+    triple (o [b,t,h,hd] f32, m [b,t,h] f32, l [b,t,h] f32) over this shard's
+    cache rows; exact cross-shard combine happens in
+    ops/attention.flash_attention_sp. A fully-masked shard returns
+    (0, NEG_INF/2, 0) rows, contributing nothing to the combine."""
+    b, t, n_heads, hd = q.shape
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    q4, k3, v3, dims = _flash_operands(q, k_cache, v_cache, block_t, block_s)
+    _, _, _, _, n_kv, g, bt, bs, n_s = dims
+    ps = jnp.stack(
+        [jnp.asarray(pos_start, jnp.int32), jnp.asarray(col_offset, jnp.int32)]
+    )
+    o, m, l = pl.pallas_call(
+        partial(_kernel_partial, scale=scale, g=g, n_s=n_s),
+        grid_spec=_flash_grid_spec(dims, n_extra_outs=2),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n_kv, t, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, t, g), jnp.float32),
+            jax.ShapeDtypeStruct((b * n_kv, t, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ps, q4, k3, v3)
+
+    def unfold(x):  # [b*kv, t, g, ...] -> [b, t, kv*g, ...]
+        lead = (b, n_kv, t, g) + x.shape[3:]
+        perm = (0, 2, 1, 3) + tuple(range(4, x.ndim + 1))
+        return x.reshape(lead).transpose(perm).reshape((b, t, n_heads) + x.shape[3:])
+
+    return unfold(o), unfold(m), unfold(l)

@@ -65,6 +65,10 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "q": row,
         "k": row,
         "v": row,
+        # fused projections: row-split; fused out axis is per-shard
+        # interleaved at load (models/params.py _fuse_rows)
+        "wqkv": row,
+        "w13": row,
         "wo": col,
         "w1": erow if moe else row,
         "w3": erow if moe else row,
@@ -118,8 +122,9 @@ def pipeline_forward(
     pos_start,  # scalar int32
     logits_mode: str = "last",
     microbatches: int = 1,
-    kv_len: int | None = None,  # static KV read bound (models.transformer
-    # _layer); ignored when the cache's seq axis is sp-sharded
+    kv_len: int | None = None,  # static GLOBAL KV read bound
+    # (models.transformer._layer); under sp each shard clamps it to its
+    # local slice — min(kv_len, local_seq) — which is exact (see _layer)
 ):
     """PPxTP forward step. Same contract as models.transformer.forward.
 
@@ -136,8 +141,6 @@ def pipeline_forward(
             f"microbatches ({microbatches}) must divide the token length "
             f"({jnp.shape(tokens)[-1]})"
         )
-    if mesh.shape["sp"] > 1:
-        kv_len = None
     fn = _cached_pipeline_fn(
         cfg, mesh, params, cache, ("fwd", logits_mode, microbatches, kv_len),
         lambda ps, cs: _build_pipeline_fn(
@@ -299,8 +302,8 @@ def pipeline_decode_chunk(
     n_steps: int = 16,
     temperature: float = 0.0,
     topp: float = 0.9,
-    kv_len: int | None = None,  # static KV read bound covering
-    # pos_start + n_steps; ignored when the cache's seq axis is sp-sharded
+    kv_len: int | None = None,  # static GLOBAL KV read bound covering
+    # pos_start + n_steps; under sp each shard clamps to its local slice
 ):
     """On-device chunked decode for pipeline meshes: the same
     K-forwards-per-host-call loop as runtime/decode.py decode_chunk, but with
@@ -309,8 +312,6 @@ def pipeline_decode_chunk(
 
     Returns (tokens [b, n_steps], cache).
     """
-    if mesh.shape["sp"] > 1:
-        kv_len = None
     fn = _cached_pipeline_fn(
         cfg, mesh, params, cache, ("decode", n_steps, temperature, topp, kv_len),
         lambda ps, cs: _build_pipeline_decode_fn(

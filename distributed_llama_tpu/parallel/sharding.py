@@ -66,6 +66,10 @@ def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "q": row,
         "k": row,
         "v": row,
+        # fused projections (models/params.py _fuse_rows): plain row-split —
+        # the fused out axis is per-shard interleaved at load time
+        "wqkv": row,
+        "w13": row,
         "wo": col,
         "w1": erow if moe else row,
         "w3": erow if moe else row,

@@ -159,7 +159,15 @@ class InferenceEngine:
             self.cfg = self.cfg.with_(use_pallas=False)
             shardings = param_shardings(mesh, moe=self.cfg.is_moe)
             self._cache_sharding = cache_shardings(mesh)
-        self.params = load_params(self.reader, self.cfg, shardings=shardings)
+        # fused-projection interleaving (load_params tp=) is a SHARD_MAP
+        # concept: each shard must see its own q|k|v slices locally. Under
+        # GSPMD the forward computes global math over the global arrays —
+        # the fused axis must stay in plain concat order (tp=1) and XLA
+        # partitions the matmul + split itself.
+        self.params = load_params(
+            self.reader, self.cfg, shardings=shardings,
+            tp=mesh.shape["tp"] if self.use_pipeline else 1,
+        )
         self.rope = build_rope_tables(self.header)
         self.batch = batch
         self.max_chunk = max(1, min(max_chunk, self.cfg.seq_len))

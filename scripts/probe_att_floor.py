@@ -1,0 +1,124 @@
+"""Isolate the ~90 us/layer decode-attention floor.
+
+Every attention variant (einsum, flash, grouped-DMA) floors at ~90 us per
+layer at S<=2048 while the i8 matmul kernels run 7-25 us calls in the same
+scan pattern. Measure, at S=1024 (2 MB K+V):
+  1. pure-read kernel: same grid/blocks as grouped attention, body = sum
+  2. grouped attention kernel, L=1 per outer iteration
+  3. grouped attention with NO softmax (dot + accumulate only)
+  4. i8-matmul-sized control: read the same 2 MB as a [nb,32,out] matmul
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from probe_grouped_decode_att import decode_attention
+
+
+def dev_ms(label, fn, args, n=64, trials=3):
+    f = jax.jit(fn)
+    r = f(*args)
+    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    ms = best / n * 1e3
+    print(f"{label}: {ms:.4f} ms/iter")
+    return ms
+
+
+def main():
+    b, heads, kv, hd, S = 1, 32, 8, 64, 1024
+    rng = np.random.default_rng(0)
+    kc = jnp.asarray(rng.standard_normal((b, kv, S, hd)), jnp.bfloat16)
+    q = jnp.ones((b, heads, hd), jnp.bfloat16)
+    mb_kv = 2 * kc.size * 2 / 1e6  # K+V per call
+
+    # 1. pure read: same blocks, body sums the block into scratch
+    def _read_kernel(k_ref, v_ref, o_ref, acc_ref):
+        si = pl.program_id(1)
+        n_s = pl.num_programs(1)
+
+        @pl.when(si == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.sum(
+            k_ref[0].astype(jnp.float32), axis=(0, 1)
+        ) + jnp.sum(v_ref[0].astype(jnp.float32), axis=(0, 1))
+
+        @pl.when(si == n_s - 1)
+        def _():
+            o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+    def pure_read(kc, bs=512):
+        n_s = S // bs
+        return pl.pallas_call(
+            _read_kernel,
+            grid=(b, n_s),
+            in_specs=[
+                pl.BlockSpec((1, kv, bs, hd), lambda bi, si: (bi, 0, si, 0)),
+                pl.BlockSpec((1, kv, bs, hd), lambda bi, si: (bi, 0, si, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, hd), lambda bi, si: (bi, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, 1, hd), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, hd), jnp.float32)],
+        )(kc, kc)
+
+    def chain_pure(kc):
+        def body(c, _):
+            r = pure_read(kc)
+            return c + r[0, 0, :1] * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.zeros((1,), jnp.float32), None, length=64)
+        return c
+
+    ms = dev_ms("1. pure-read kernel (2 MB)", chain_pure, (kc,))
+    print(f"    -> {mb_kv/ms:.0f} GB/s")
+
+    # 2. grouped attention, one call per iteration
+    def chain_att(q, kc, ps):
+        def body(q, _):
+            a = decode_attention(q, kc, kc, ps, block_s=512)
+            return q + a * jnp.bfloat16(1e-8), None
+        q, _ = jax.lax.scan(body, q, None, length=64)
+        return q
+
+    ms = dev_ms("2. grouped attention L=1", chain_att, (q, kc, jnp.int32(S - 10)))
+    print(f"    -> {mb_kv/ms:.0f} GB/s")
+
+    # 4. control: same bytes through the i8 matmul kernel
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul_pallas_i8
+
+    nb = 2048 // 32
+    out_f = 512  # 64*32*512 = 1 MB int8 ~ comparable read
+    qt = jnp.asarray(rng.integers(-8, 8, (nb, 32, out_f)), jnp.int8)
+    dt = jnp.asarray((rng.standard_normal((nb, out_f)) * 0.01), jnp.float16)
+    x = jnp.ones((1, 2048), jnp.bfloat16)
+
+    def chain_mm(x, qt, dt):
+        def body(c, _):
+            y = q40_matmul_pallas_i8(c, qt, dt)
+            return c + (y[..., :1].sum() * 1e-30).astype(c.dtype), None
+        c, _ = jax.lax.scan(body, x, None, length=64)
+        return c
+
+    ms = dev_ms("4. i8 matmul control (1 MB)", chain_mm, (x, qt, dt))
+    print(f"    -> {qt.size/ms/1e6:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Decode-attention (t=1) variants, timed on the real chip.
+
+profile_decode.py showed 16-layer full-cache decode attention at ~1.5 ms —
+~8x its HBM read cost (134 MB of bf16 K/V at ~700 GB/s ~= 0.19 ms). The
+einsum path forces Precision.HIGHEST even over a bf16 cache, and t=1 shapes
+may tile poorly. Candidates:
+  A. current gqa_attention (einsum, HIGHEST)
+  B. einsum with default precision for the bf16 cache
+  C. flash kernel with the t>=8 gate lifted (bt=1)
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.ops.attention import gqa_attention, NEG_INF
+from distributed_llama_tpu.ops.pallas_attention import flash_attention
+
+
+def gqa_attention_fast(q, k_cache, v_cache, positions, scale=None):
+    """Variant B: default-precision einsums (bf16 MXU passes) with f32
+    accumulation via preferred_element_type."""
+    b, q_len, n_heads, head_dim = q.shape
+    cache_len = k_cache.shape[1]
+    n_kv_heads = k_cache.shape[2]
+    kv_mul = n_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
+    qg = q.reshape(b, q_len, n_kv_heads, kv_mul, head_dim).astype(k_cache.dtype)
+    scores = jnp.einsum(
+        "bqhgd,bthd->bhgqt", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    t_idx = jnp.arange(cache_len, dtype=jnp.int32)
+    mask = t_idx[None, None, :] <= positions[:, :, None]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqt,bthd->bqhgd", probs.astype(k_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, q_len, n_heads, head_dim).astype(q.dtype)
+
+
+def dev_ms(label, fn, args, n=64, trials=3):
+    f = jax.jit(fn)
+    r = f(*args)
+    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    ms = best / n * 1e3
+    print(f"{label}: {ms:.4f} ms/iter")
+    return ms
+
+
+def main():
+    L, b, heads, kv, hd = 16, 1, 32, 8, 64
+    for S in (1024, 2048):
+        kc = jnp.asarray(
+            np.random.default_rng(0).standard_normal((b, S, kv, hd)), jnp.bfloat16
+        )
+        q = jnp.ones((b, 1, heads, hd), jnp.bfloat16)
+        pos = jnp.full((b, 1), S - 10, jnp.int32)
+        mb = 2 * L * kc.size * 2 / 1e6
+
+        def chain(att_fn):
+            def f(q, kc, pos):
+                def body(q, _):
+                    def layer(q, _):
+                        a = att_fn(q, kc, kc, pos)
+                        return q + a * jnp.bfloat16(1e-8), None
+                    q, _ = jax.lax.scan(layer, q, None, length=L)
+                    return q, None
+                q, _ = jax.lax.scan(body, q, None, length=64)
+                return q
+            return f
+
+        def chain_flash():
+            ps = jnp.int32(S - 10)
+            def f(q, kc, ps):
+                def body(q, _):
+                    def layer(q, _):
+                        a = flash_attention(q, kc, kc, ps)
+                        return q + a * jnp.bfloat16(1e-8), None
+                    q, _ = jax.lax.scan(layer, q, None, length=L)
+                    return q, None
+                q, _ = jax.lax.scan(body, q, None, length=64)
+                return q
+            return f, ps
+
+        print(f"-- S={S} ({mb:.0f} MB K+V reads x{L} layers/iter) --")
+        a = dev_ms("A einsum HIGHEST x16", chain(gqa_attention), (q, kc, pos))
+        print(f"    -> {mb/a:.0f} GB/s")
+        bms = dev_ms("B einsum default  x16", chain(gqa_attention_fast), (q, kc, pos))
+        print(f"    -> {mb/bms:.0f} GB/s")
+        try:
+            ff, ps = chain_flash()
+            c = dev_ms("C flash bt=1      x16", ff, (q, kc, ps))
+            print(f"    -> {mb/c:.0f} GB/s")
+        except Exception as e:
+            print(f"C failed: {str(e).splitlines()[0][:140]}")
+
+
+if __name__ == "__main__":
+    main()

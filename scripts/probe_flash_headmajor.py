@@ -1,0 +1,123 @@
+"""Flash kernel fed by a head-major [b, kv, S, hd] cache at decode (t=1).
+
+probe_kv_layout.py: head-major einsum hits 329 GB/s at 32k but has a
+~0.1 ms/layer fixed floor (tiny per-head matmuls). probe_decode_attention.py:
+the flash path was throttled by its per-call [b,S,kv,hd]->[b*kv,S,hd]
+transpose COPY. Head-major makes that reshape free — this measures the
+combination, plus block_s sensitivity.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_llama_tpu.ops.pallas_attention import _kernel
+
+
+def flash_headmajor(q, k_hm, v_hm, pos_start, block_s=256, interpret=False):
+    """q [b,t,h,hd]; k/v [b, kv, S, hd] head-major -> [b,t,h,hd]."""
+    b, t, n_heads, hd = q.shape
+    n_kv, S = k_hm.shape[1], k_hm.shape[2]
+    g = n_heads // n_kv
+    scale = 1.0 / (hd ** 0.5)
+    bt = t
+    bs = min(block_s, S)
+    while S % bs:
+        bs //= 2
+    n_s = S // bs
+    q4 = (
+        q.reshape(b, t, n_kv, g, hd).transpose(0, 2, 1, 3, 4).reshape(b * n_kv, t, g, hd)
+        .astype(k_hm.dtype)
+    )
+    k3 = k_hm.reshape(b * n_kv, S, hd)  # FREE — no copy
+    v3 = v_hm.reshape(b * n_kv, S, hd)
+    ps = jnp.stack([jnp.asarray(pos_start, jnp.int32), jnp.int32(0)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * n_kv, t // bt, n_s),
+        in_specs=[
+            pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
+            pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
+            pl.BlockSpec((1, bs, hd), lambda bk, ti, si, ps: (bk, si, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, ps: (bk, ti, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bt * g, 128), jnp.float32),
+            pltpu.VMEM((bt * g, 128), jnp.float32),
+            pltpu.VMEM((bt * g, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        partial(_kernel, scale=scale, g=g, n_s=n_s),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n_kv, t, g, hd), q.dtype),
+        interpret=interpret,
+    )(ps, q4, k3, v3)
+    return (
+        out.reshape(b, n_kv, t, g, hd).transpose(0, 2, 1, 3, 4).reshape(b, t, n_heads, hd)
+    )
+
+
+def dev_ms(label, fn, args, n=64, trials=3):
+    f = jax.jit(fn)
+    r = f(*args)
+    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    ms = best / n * 1e3
+    print(f"{label}: {ms:.4f} ms/iter")
+    return ms
+
+
+def main():
+    L, b, heads, kv, hd = 16, 1, 32, 8, 64
+    # correctness vs einsum reference first (S small, CPU-friendly shapes)
+    from distributed_llama_tpu.ops.attention import gqa_attention
+
+    rng = np.random.default_rng(0)
+    S0 = 256
+    kc0 = jnp.asarray(rng.standard_normal((b, S0, kv, hd)), jnp.bfloat16)
+    q0 = jnp.asarray(rng.standard_normal((b, 1, heads, hd)), jnp.bfloat16)
+    pos0 = jnp.full((b, 1), 100, jnp.int32)
+    want = gqa_attention(q0, kc0, kc0, pos0)
+    got = flash_headmajor(q0, jnp.transpose(kc0, (0, 2, 1, 3)), jnp.transpose(kc0, (0, 2, 1, 3)), jnp.int32(100))
+    err = float(jnp.max(jnp.abs(want.astype(jnp.float32) - got.astype(jnp.float32))))
+    print(f"correctness vs einsum: max abs err {err:.5f}")
+
+    for S in (1024, 2048, 32768):
+        kc = jnp.asarray(rng.standard_normal((b, kv, S, hd)), jnp.bfloat16)
+        q = jnp.ones((b, 1, heads, hd), jnp.bfloat16)
+        mb = 2 * L * kc.size * 2 / 1e6
+        for bs in (256, 512, 1024):
+            if bs > S:
+                continue
+
+            def f(q, kc, ps):
+                def body(q, _):
+                    def layer(q, _):
+                        a = flash_headmajor(q, kc, kc, ps, block_s=bs)
+                        return q + a * jnp.bfloat16(1e-8), None
+                    q, _ = jax.lax.scan(layer, q, None, length=L)
+                    return q, None
+                q, _ = jax.lax.scan(body, q, None, length=64)
+                return q
+
+            ms = dev_ms(f"flash-hm x{L} S={S} bs={bs}", f, (q, kc, jnp.int32(S - 10)))
+            print(f"    -> {mb/ms:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

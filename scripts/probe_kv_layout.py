@@ -1,0 +1,84 @@
+"""Is decode attention bandwidth-bound by the KV cache LAYOUT?
+
+Current cache layout [b, S, kv, hd]: one head's K rows are strided by
+kv*hd*2 bytes — the score einsum reads 128-byte pieces at 1 KB stride, and
+the flash path pays a materialized transpose per call. Candidate layout
+[b, kv, S, hd] makes each head's rows contiguous.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def att_headmajor(q, k_cache, v_cache, positions, scale=None):
+    """q [b,1,h,hd]; k/v [b, kv, S, hd] head-major."""
+    b, t, n_heads, hd = q.shape
+    n_kv, S = k_cache.shape[1], k_cache.shape[2]
+    g = n_heads // n_kv
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    qg = q.reshape(b, t, n_kv, g, hd).astype(k_cache.dtype)
+    scores = jnp.einsum(
+        "bqhgd,bhtd->bhgqt", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    t_idx = jnp.arange(S, dtype=jnp.int32)
+    mask = t_idx[None, None, :] <= positions[:, :, None]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqt,bhtd->bqhgd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, n_heads, hd).astype(q.dtype)
+
+
+def dev_ms(label, fn, args, n=64, trials=3):
+    f = jax.jit(fn)
+    r = f(*args)
+    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    ms = best / n * 1e3
+    print(f"{label}: {ms:.4f} ms/iter")
+    return ms
+
+
+def main():
+    L, b, heads, kv, hd = 16, 1, 32, 8, 64
+    for S in (1024, 2048, 32768):
+        kc = jnp.asarray(
+            np.random.default_rng(0).standard_normal((b, kv, S, hd)), jnp.bfloat16
+        )
+        q = jnp.ones((b, 1, heads, hd), jnp.bfloat16)
+        pos = jnp.full((b, 1), S - 10, jnp.int32)
+        mb = 2 * L * kc.size * 2 / 1e6
+
+        def f(q, kc, pos):
+            def body(q, _):
+                def layer(q, _):
+                    a = att_headmajor(q, kc, kc, pos)
+                    return q + a * jnp.bfloat16(1e-8), None
+                q, _ = jax.lax.scan(layer, q, None, length=L)
+                return q, None
+            q, _ = jax.lax.scan(body, q, None, length=64)
+            return q
+
+        ms = dev_ms(f"head-major einsum x{L} S={S}", f, (q, kc, pos))
+        print(f"    -> {mb/ms:.0f} GB/s ({mb:.0f} MB/iter)")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,19 +20,27 @@ import numpy as np
 
 
 def dev_ms(label, make_fn, n=64, trials=3):
-    """make_fn() -> (jitted_fn, args). jitted_fn must contain its own
-    n-iteration device loop. Returns device ms per iteration."""
-    fn, args = make_fn()
-    r = fn(*args)
-    _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]  # compile + sync
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
+    """make_fn(n) -> (jitted_fn, args); jitted_fn contains an n-iteration
+    device loop. Times are DIFFERENCED between two iteration counts so the
+    ~70-90 ms (and jittery) tunnel dispatch round trip cancels — dividing a
+    single run by n silently reports dispatch/n as if it were compute (that
+    bug cost round 3 an afternoon of phantom 'attention floor' hunting)."""
+    n1, n2 = n, n * 5
+    best = {}
+    for ni in (n1, n2):
+        fn, args = make_fn(ni)
         r = fn(*args)
-        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
-        best = min(best, (time.perf_counter() - t0))
-    ms = best / n * 1e3
-    print(f"{label}: {ms:.4f} ms/iter  ({best*1e3:.1f} ms / {n} iters)")
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]  # compile + sync
+        b = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = fn(*args)
+            _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+            b = min(b, (time.perf_counter() - t0))
+        best[ni] = b
+    ms = (best[n2] - best[n1]) / (n2 - n1) * 1e3
+    print(f"{label}: {ms:.4f} ms/iter  (diffed {best[n1]*1e3:.1f} @ {n1} / "
+          f"{best[n2]*1e3:.1f} @ {n2})")
     return ms
 
 
@@ -53,53 +61,58 @@ def main():
     N = 64
 
     # ---- full decode step (forward t=1 + argmax), chained ----
-    def mk_decode(use_pallas):
-        c = cfg.with_(use_pallas=use_pallas)
-        @jax.jit
-        def fn(params, cache_k, cache_v, tok):
-            from distributed_llama_tpu.models.params import KVCache
-            def body(carry, _):
-                tok, pos, ck, cv = carry
-                logits, cache = forward_uncompiled(
-                    c, params, rope, KVCache(k=ck, v=cv), tok[:, None], pos)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (nxt, pos + 1, cache.k, cache.v), None
-            (tok, _, ck, cv), _ = jax.lax.scan(
-                body, (tok, jnp.int32(100), cache_k, cache_v), None, length=N)
-            return tok
-        cache = engine._new_cache()
-        return fn, (params, cache.k, cache.v, jnp.zeros((1,), jnp.int32))
+    def mk_decode(use_pallas, kv_len=None):
+        def make(n):
+            c = cfg.with_(use_pallas=use_pallas)
+            @jax.jit
+            def fn(params, cache_k, cache_v, tok):
+                from distributed_llama_tpu.models.params import KVCache
+                def body(carry, _):
+                    tok, pos, ck, cv = carry
+                    logits, cache = forward_uncompiled(
+                        c, params, rope, KVCache(k=ck, v=cv), tok[:, None], pos,
+                        kv_len=kv_len)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pos + 1, cache.k, cache.v), None
+                (tok, _, ck, cv), _ = jax.lax.scan(
+                    body, (tok, jnp.int32(100), cache_k, cache_v), None, length=n)
+                return tok
+            cache = engine._new_cache()
+            return fn, (params, cache.k, cache.v, jnp.zeros((1,), jnp.int32))
+        return make
 
-    full_p = dev_ms("decode step (pallas)", lambda: mk_decode(True), N)
-    full_x = dev_ms("decode step (xla dequant)", lambda: mk_decode(False), N)
+    full_p = dev_ms("decode step (pallas)", mk_decode(True), N)
+    full_b = dev_ms("decode step (pallas, kv bucket 1024)", mk_decode(True, 1024), N)
+    full_x = dev_ms("decode step (xla dequant)", mk_decode(False), N)
 
     # ---- matmuls only: the 16-layer x 7-matmul chain + wcls ----
     def mk_matmuls(use_pallas):
+      def make(n):
         pallas = use_pallas
         @jax.jit
         def fn(params, x):
             def layer_body(x, lp):
-                y = quant_matmul(x, lp.q, pallas=pallas)
-                y = y + quant_matmul(x, lp.k, pallas=pallas, out_dtype=x.dtype).sum() * 1e-30
-                y = y + quant_matmul(x, lp.v, pallas=pallas, out_dtype=x.dtype).sum() * 1e-30
-                x = quant_matmul(y, lp.wo, pallas=pallas)
-                h1 = quant_matmul(x, lp.w1, pallas=pallas)
-                h3 = quant_matmul(x, lp.w3, pallas=pallas)
-                x = quant_matmul(h1 * h3, lp.w2, pallas=pallas)
+                qkv = quant_matmul(x, lp.wqkv, pallas=pallas)
+                x = quant_matmul(qkv[..., : cfg.dim], lp.wo, pallas=pallas)
+                h13 = quant_matmul(x, lp.w13, pallas=pallas)
+                ff = h13.shape[-1] // 2
+                x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=pallas)
                 return x, None
             def body(x, _):
                 x, _ = jax.lax.scan(layer_body, x, params.layers)
                 lg = quant_matmul(x, params.wcls, pallas=pallas)
                 return x + lg[..., :1] * 1e-30, None
-            x, _ = jax.lax.scan(body, x, None, length=N)
+            x, _ = jax.lax.scan(body, x, None, length=n)
             return x
         return fn, (params, jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
+      return make
 
-    mm_p = dev_ms("matmul chain (pallas)", lambda: mk_matmuls(True), N)
-    mm_x = dev_ms("matmul chain (xla)", lambda: mk_matmuls(False), N)
+    mm_p = dev_ms("matmul chain (pallas)", mk_matmuls(True), N)
+    mm_x = dev_ms("matmul chain (xla)", mk_matmuls(False), N)
 
     # ---- attention only, 16 layers over the full cache ----
     def mk_att():
+      def make(n):
         @jax.jit
         def fn(q, kc, vc, pos):
             def body(q, _):
@@ -108,18 +121,21 @@ def main():
                     return q + a * 1e-30, None
                 q, _ = jax.lax.scan(layer, q, None, length=cfg.n_layers)
                 return q, None
-            q, _ = jax.lax.scan(body, q, None, length=N)
+            q, _ = jax.lax.scan(body, q, None, length=n)
             return q
         q = jnp.ones((1, 1, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
         kc = jnp.ones((1, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
         pos = jnp.full((1, 1), 100, jnp.int32)
         return fn, (q, kc, kc, pos)
+      return make
 
-    att = dev_ms("attention x16 (full cache)", mk_att, N)
+    att = dev_ms("attention x16 (full cache)", mk_att(), N)
 
     # ---- cache scan-update only (the per-step KV copy) ----
     def mk_cache():
-        @partial(jax.jit, donate_argnums=(0, 1))
+      def make(n):
+        # NO donation: dev_ms re-calls fn with the same buffers
+        @jax.jit
         def fn(ck, cv, newk):
             def body(carry, _):
                 ck, cv, newk = carry
@@ -131,35 +147,95 @@ def main():
                 _, (ck, cv) = jax.lax.scan(layer, 0, (ck, cv))
                 newk = newk + ck[0, :1, 100:101] * 1e-30
                 return (ck, cv, newk), None
-            (ck, cv, _), _ = jax.lax.scan(body, (ck, cv, newk), None, length=N)
+            (ck, cv, _), _ = jax.lax.scan(body, (ck, cv, newk), None, length=n)
             return ck
         cache = engine._new_cache()
         newk = jnp.ones((1, 1, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
         return fn, (cache.k, cache.v, newk)
+      return make
 
-    cache_ms = dev_ms("cache scan-update x16", mk_cache, N)
+    cache_ms = dev_ms("cache scan-update x16", mk_cache(), N)
+
+    # ---- per-layer glue: norms + rope + head reshapes, no matmuls ----
+    def mk_glue():
+      def make(n):
+        from distributed_llama_tpu.ops import rms_norm
+        from distributed_llama_tpu.ops.rope import apply_rope
+
+        norm_w = jnp.ones((cfg.dim,), jnp.float32)
+        rope_t = engine.rope
+
+        @jax.jit
+        def fn(x, pos):
+            def body(x, _):
+                def layer(x, _):
+                    y = rms_norm(x, norm_w, cfg.norm_epsilon)
+                    q = y[..., : cfg.n_heads * cfg.head_dim].reshape(
+                        1, 1, cfg.n_heads, cfg.head_dim
+                    )
+                    k = y[..., : cfg.n_kv_heads * cfg.head_dim].reshape(
+                        1, 1, cfg.n_kv_heads, cfg.head_dim
+                    )
+                    q = apply_rope(q, rope_t, pos, cfg.rope_type)
+                    k = apply_rope(k, rope_t, pos, cfg.rope_type)
+                    y2 = rms_norm(x, norm_w, cfg.norm_epsilon)
+                    x = x + q.reshape(1, 1, -1).astype(x.dtype)[..., : cfg.dim] * 0.5 \
+                        + y2 * jnp.bfloat16(1e-3) + k.sum() * jnp.bfloat16(1e-8)
+                    return x, None
+                x, _ = jax.lax.scan(layer, x, None, length=cfg.n_layers)
+                return x, None
+            x, _ = jax.lax.scan(body, x, None, length=n)
+            return x
+        pos = jnp.full((1, 1), 100, jnp.int32)
+        return fn, (jnp.ones((1, 1, cfg.dim), jnp.bfloat16), pos)
+      return make
+
+    glue_ms = dev_ms("glue x16 (norms+rope+reshape)", mk_glue(), N)
+
+    # ---- sampling + embedding row (once per token) ----
+    def mk_sample():
+      def make(n):
+        @jax.jit
+        def fn(emb, logits, tok):
+            def body(carry, _):
+                logits_c, tok = carry
+                nxt = jnp.argmax(logits_c, axis=-1).astype(jnp.int32)
+                x = emb[nxt]
+                logits_c = logits_c + x[..., :1] * 1e-30 + tok * 0
+                return (logits_c, nxt), None
+            (logits, tok), _ = jax.lax.scan(body, (logits, tok), None, length=n)
+            return tok
+        emb = jnp.ones((cfg.vocab_size, cfg.dim), jnp.float32)
+        return fn, (emb, jnp.ones((1, cfg.vocab_size), jnp.float32),
+                    jnp.zeros((1,), jnp.int32))
+      return make
+
+    sample_ms = dev_ms("argmax+embedding row", mk_sample(), N)
 
     # ---- single pallas matmul bandwidth at each shape ----
-    for name, w in [("qkvo 2048x2048", params.layers.q), ("ffn 8192x2048", params.layers.w1),
+    for name, w in [("qkv 2048x3072", params.layers.wqkv), ("ffn13 2048x16384", params.layers.w13),
                     ("wcls 32768x2048", params.wcls)]:
         wq = w.q[0] if w.q.ndim == 4 else w.q
         wd = w.d[0] if w.d.ndim == 3 else w.d
         from distributed_llama_tpu.ops.quant import QuantTensor
         ww = QuantTensor(q=wq, d=wd)
         def mk(ww=ww):
+          def make(n):
             @jax.jit
             def fn(ww, x):
                 def body(x, _):
                     y = quant_matmul(x, ww, pallas=True)
                     return x + y[..., :1] * 1e-30, None
-                x, _ = jax.lax.scan(body, x, None, length=N)
+                x, _ = jax.lax.scan(body, x, None, length=n)
                 return x
             return fn, (ww, jnp.ones((1, ww.in_features), jnp.bfloat16),)
-        ms = dev_ms(f"pallas {name}", mk, N)
+          return make
+        ms = dev_ms(f"pallas {name}", mk(), N)
         mb = ww.q.size / 1e6
         print(f"    -> {mb/ms:.0f} GB/s effective ({mb:.1f} MB)")
 
-    print(f"\nsummary ms/token: full={full_p:.3f} matmuls={mm_p:.3f} att={att:.3f} "
+    print(f"\nsummary ms/token: full={full_p:.3f} full@bucket1024={full_b:.3f} "
+          f"matmuls={mm_p:.3f} att={att:.3f} "
           f"cacheupd={cache_ms:.3f} other={full_p-mm_p-att-cache_ms:.3f}")
     print(f"xla-dequant full={full_x:.3f} matmuls={mm_x:.3f}")
 

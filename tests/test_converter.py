@@ -165,3 +165,115 @@ def test_tokenizer_converter_round_trip(tmp_path):
     assert tok.vocab_size == 29
     ids = tok.encode("ab", add_special_tokens=False)
     assert ids[-1] == 26  # merged pair wins (scores follow id order)
+
+
+# ---------------------------------------------------------------------------
+# Sentencepiece / llama3-original tokenizer converters
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _pb_field(field: int, wire: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | wire) + payload
+
+
+def _pb_str(field: int, s: bytes) -> bytes:
+    return _pb_field(field, 2, _varint(len(s)) + s)
+
+
+def _make_spm_model(pieces, bos_id, eos_id) -> bytes:
+    """Serialize a minimal sentencepiece ModelProto (the exact wire format
+    parse_spm_model reads): field 1 = pieces {1: piece, 2: f32 score},
+    field 2 = trainer_spec {41: bos_id, 42: eos_id}."""
+    import struct as _struct
+
+    blob = b""
+    for piece, score in pieces:
+        msg = _pb_str(1, piece.encode("utf-8")) + _pb_field(
+            2, 5, _struct.pack("<f", score)
+        )
+        blob += _pb_str(1, msg)
+    trainer = _pb_field(41, 0, _varint(bos_id)) + _pb_field(42, 0, _varint(eos_id))
+    blob += _pb_str(2, trainer)
+    return blob
+
+
+def test_spm_tokenizer_converter_roundtrip(tmp_path):
+    """Synthesized sentencepiece .model -> .t -> Tokenizer encodes a known
+    string to the expected ids (the reference's convert-tokenizer-llama2.py
+    capability, minus the sentencepiece runtime dependency)."""
+    from distributed_llama_tpu.converter.convert_tokenizer_spm import (
+        convert_tokenizer_spm, parse_spm_model,
+    )
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    # regular pieces first, bos/eos at the end (the .t format's assumption
+    # that bos_id splits regular from special vocab — reference
+    # tokenizer.cpp:139-143 carries the same constraint)
+    pieces = [
+        ("h", -2.0), ("e", -3.0), ("l", -4.0), ("o", -5.0), ("▁", -1.0),
+        ("he", 5.0), ("ll", 4.0), ("hell", 8.0), ("hello", 10.0),
+        ("▁hello", 12.0),
+        ("<s>", 0.0), ("</s>", 0.0),
+    ]
+    mp = tmp_path / "tokenizer.model"
+    mp.write_bytes(_make_spm_model(pieces, bos_id=10, eos_id=11))
+
+    got_pieces, bos, eos = parse_spm_model(str(mp))
+    assert [p for p, _ in got_pieces] == [p for p, _ in pieces]
+    assert [s for _, s in got_pieces] == [s for _, s in pieces]
+    assert (bos, eos) == (10, 11)
+
+    out = str(tmp_path / "spm.t")
+    data = convert_tokenizer_spm(str(mp), out)
+    assert data.vocab[4] == b" "          # sentencepiece marker -> space
+    assert data.vocab[9] == b" hello"
+    assert data.bos_id == 10 and data.eos_token_ids == [11]
+    assert data.chat_template and "[INST]" in data.chat_template
+
+    tok = Tokenizer(out)
+    # " hello" must merge up to the single best-scoring piece, after bos
+    ids = tok.encode(" hello")
+    assert ids == [10, 9]
+    assert tok.vocab[9] == b" hello"
+
+
+def test_llama3_original_tokenizer_converter(tmp_path):
+    """tiktoken-format (base64 rank) file -> .t with the 256 llama3 special
+    tokens appended (reference convert-tokenizer-llama3.py capability)."""
+    import base64 as b64
+
+    from distributed_llama_tpu.converter.convert_tokenizer_spm import (
+        N_LLAMA3_SPECIAL, convert_tokenizer_llama3,
+    )
+    from distributed_llama_tpu.tokenizer import Tokenizer
+
+    words = [bytes([c]) for c in range(97, 123)] + [b"ab", b" ", b"abab"]
+    lines = [f"{b64.b64encode(w).decode()} {i}" for i, w in enumerate(words)]
+    mp = tmp_path / "tokenizer.model"
+    mp.write_text("\n".join(lines) + "\n")
+
+    out = str(tmp_path / "l3.t")
+    data = convert_tokenizer_llama3(str(mp), out)
+    assert data.vocab_size == len(words) + N_LLAMA3_SPECIAL
+    assert data.bos_id == len(words)
+    assert data.vocab[data.bos_id] == b"<|begin_of_text|>"
+    # two eos ids: end_of_text and eot_id, positioned like the real model
+    assert data.eos_token_ids == [len(words) + 1, len(words) + 9]
+    assert data.scores[:3] == [0.0, -1.0, -2.0]  # -rank ordering
+
+    tok = Tokenizer(out)
+    ids = tok.encode("abab", add_special_tokens=False)
+    # rank-based scores: smaller rank = higher score; "abab" (rank 28) still
+    # beats per-letter pieces via pair merging
+    assert ids[-1] == 28

@@ -76,8 +76,8 @@ def test_load_path_uses_native(tmp_path, codec_available):
         del os.environ["DLT_NO_NATIVE"]
         native._tried, native._lib = False, None
 
-    np.testing.assert_array_equal(np.asarray(a.layers.q.q), np.asarray(b.layers.q.q))
-    np.testing.assert_array_equal(np.asarray(a.layers.q.d), np.asarray(b.layers.q.d))
+    np.testing.assert_array_equal(np.asarray(a.layers.wqkv.q), np.asarray(b.layers.wqkv.q))
+    np.testing.assert_array_equal(np.asarray(a.layers.wqkv.d), np.asarray(b.layers.wqkv.d))
 
 
 def test_native_codec_speedup_large(codec_available):

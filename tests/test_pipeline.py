@@ -28,7 +28,10 @@ def _build(tmp_path, mesh=None, **kw):
     reader = MFileReader(path)
     cfg = config_from_header(reader.header, compute_dtype="float32")
     sh = pp_param_shardings(mesh, moe=cfg.is_moe) if mesh is not None else None
-    params = load_params(reader, cfg, shardings=sh)
+    params = load_params(
+        reader, cfg, shardings=sh,
+        tp=mesh.shape["tp"] if mesh is not None else 1,
+    )
     rope = build_rope_tables(reader.header)
     return cfg, params, rope
 

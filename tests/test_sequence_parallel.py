@@ -88,6 +88,69 @@ def test_sp_scatter_update_straddles_shards():
     np.testing.assert_array_equal(got, want)
 
 
+def test_sp_flash_partial_combine_matches_full():
+    """flash_attention_sp (shard-local flash kernel partials + psum combine,
+    interpret mode) == unsharded attention, prefill-sized q chunks."""
+    from distributed_llama_tpu.ops.attention import flash_attention_sp
+
+    rng = np.random.default_rng(7)
+    b, t, n_heads, n_kv, hd, seq, sp = 1, 8, 4, 2, 8, 512, 4
+    mesh = make_mesh(sp=sp)
+    q = jnp.asarray(rng.standard_normal((b, t, n_heads, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, seq, n_kv, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, seq, n_kv, hd)), jnp.bfloat16)
+    for pos0 in [0, 100, 250, 500]:  # chunk lands in shard 0 / 1 / boundary / 3
+        positions = pos0 + jnp.arange(t, dtype=jnp.int32)[None, :]
+        want = gqa_attention(q, k, v, positions)
+
+        @jax.jit
+        @lambda f: shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), P(None, "sp", None, None), P(None, "sp", None, None), P()),
+            out_specs=P(), check_vma=False,
+        )
+        def run(q, k_l, v_l, ps):
+            offset = jax.lax.axis_index("sp") * (seq // sp)
+            return flash_attention_sp(q, k_l, v_l, ps, offset, interpret=True)
+
+        got = run(q, k, v, jnp.int32(pos0))
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2, err_msg=f"pos0={pos0}",
+        )
+
+
+@pytest.mark.parametrize("kv_len", [8, 16, 32])
+def test_sp_bounded_kv_matches_full(tmp_path, kv_len):
+    """Under sp, a global kv_len bucket clamps each shard's cache reads to
+    min(kv_len, local_seq) — results must equal the unsharded forward with
+    the same bucket (the bound is exact, not approximate)."""
+    tokens = [3, 99, 41, 7]
+    cfg, params, rope = _build(tmp_path, None, **KW)
+    cache = init_kv_cache(cfg, batch=1)
+
+    mesh = make_mesh(sp=4)  # local_seq = 16
+    cfg2, params2, rope2 = _build(tmp_path, mesh, **KW)
+    cache2 = jax.device_put(init_kv_cache(cfg2, batch=1), pp_cache_sharding(mesh))
+
+    arr = jnp.asarray([tokens], jnp.int32)
+    want, cache = forward(cfg, params, rope, cache, arr, jnp.int32(0), kv_len=kv_len)
+    got, cache2 = pipeline_forward(
+        cfg2, mesh, params2, rope2, cache2, arr, jnp.int32(0), kv_len=kv_len
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+    # decode inside the bucket
+    want, cache = forward(
+        cfg, params, rope, cache, jnp.asarray([[5]], jnp.int32), jnp.int32(4),
+        kv_len=kv_len,
+    )
+    got, cache2 = pipeline_forward(
+        cfg2, mesh, params2, rope2, cache2, jnp.asarray([[5]], jnp.int32),
+        jnp.int32(4), kv_len=kv_len,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
 def _build(tmp_path, mesh=None, **kw):
     h = tiny_header(**kw)
     path = str(tmp_path / "m.m")
@@ -95,7 +158,10 @@ def _build(tmp_path, mesh=None, **kw):
     reader = MFileReader(path)
     cfg = config_from_header(reader.header, compute_dtype="float32")
     sh = pp_param_shardings(mesh, moe=cfg.is_moe) if mesh is not None else None
-    params = load_params(reader, cfg, shardings=sh)
+    params = load_params(
+        reader, cfg, shardings=sh,
+        tp=mesh.shape["tp"] if mesh is not None else 1,
+    )
     rope = build_rope_tables(reader.header)
     return cfg, params, rope
 
