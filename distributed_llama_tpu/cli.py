@@ -53,6 +53,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=1, help="expert-parallel mesh size (MoE)")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh size (batch)")
     p.add_argument(
+        "--batch", type=int, default=1,
+        help="engine batch rows (independent per-row sequences; the API "
+        "server batches concurrent requests into them)",
+    )
+    p.add_argument(
         "--host-decode", action="store_true",
         help="per-token host decode loop (bit-parity RNG with the reference; "
         "slower than the chunked on-device decode)",
@@ -84,7 +89,7 @@ def make_engine(args) -> InferenceEngine:
         max_seq_len=args.max_seq_len,
         max_chunk=max_chunk,
         mesh=mesh,
-        batch=max(dp, 1),
+        batch=max(dp, getattr(args, "batch", 1)),
         device_decode=not getattr(args, "host_decode", False),
         verbose=True,
     )

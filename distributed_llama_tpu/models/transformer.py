@@ -149,9 +149,13 @@ def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
 
     t = q.shape[1]
     # interpret mode rides in the (static, hashable) config, so the jit
-    # cache can never replay a program traced in the other mode
+    # cache can never replay a program traced in the other mode. Per-row
+    # pos_start (vector) only occurs at decode t=1, which takes the einsum
+    # path anyway — the flash kernel's causal math assumes one scalar chunk
+    # start, so it is gated to scalar pos_start.
     if (
         _pallas_enabled(cfg)
+        and jnp.ndim(pos_start) == 0
         and k_view.dtype == jnp.bfloat16
         and flash_attention_aligned(q, k_view, t)
     ):
@@ -205,6 +209,7 @@ def _moe_ffn(
             y, idx, wts,
             _sel_layer(lp.w1, layer), _sel_layer(lp.w3, layer), _sel_layer(lp.w2, layer),
             partial(_activation, cfg), cfg.dtype, q80=q80, ep_axis=ep_axis,
+            pallas=cfg.pallas_arg,
         )
 
     if ep_axis is not None:
@@ -358,12 +363,22 @@ def _layer(
     k = apply_rope(k, rope, positions, cfg.rope_type)
 
     if sp_ctx is None:
-        k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), pos_start, axis=1
-        )
-        v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), pos_start, axis=1
-        )
+        if jnp.ndim(pos_start) == 0:
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k.astype(k_cache.dtype), pos_start, axis=1
+            )
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v.astype(v_cache.dtype), pos_start, axis=1
+            )
+        else:
+            # per-row sequences (independent prompts per batch row): each
+            # row writes at its own position — a vmapped update-slice over
+            # the batch axis (cheap at decode t=1)
+            def row_update(c, u, p):
+                return jax.lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
+
+            k_cache = jax.vmap(row_update)(k_cache, k.astype(k_cache.dtype), pos_start)
+            v_cache = jax.vmap(row_update)(v_cache, v.astype(v_cache.dtype), pos_start)
         if kv_len is not None and kv_len < k_cache.shape[1]:
             k_view = jax.lax.slice_in_dim(k_cache, 0, kv_len, axis=1)
             v_view = jax.lax.slice_in_dim(v_cache, 0, kv_len, axis=1)
@@ -431,7 +446,9 @@ def forward_uncompiled(
     rope: RopeTables,
     cache: KVCache,
     tokens: jnp.ndarray,  # [b, t] int32
-    pos_start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    pos_start: jnp.ndarray,  # int32 absolute position of tokens[:, 0] —
+    # scalar (all rows aligned) or [b] (independent per-row sequences;
+    # batch decode / DP serving)
     logits_mode: str = "last",  # "last" | "all"
     kv_len: int | None = None,  # static KV read bound (see _layer)
 ) -> tuple[jnp.ndarray, KVCache]:
@@ -442,7 +459,8 @@ def forward_uncompiled(
     The cache is donated: under jit the update is in-place in HBM.
     """
     b, t = tokens.shape
-    positions = pos_start + jnp.arange(t, dtype=jnp.int32)[None, :]
+    ps = jnp.asarray(pos_start, jnp.int32)
+    positions = ps[..., None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (b, t))
 
     x = params.embedding[tokens].astype(jnp.float32)

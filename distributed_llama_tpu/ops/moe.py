@@ -69,6 +69,62 @@ def expert_stack_matrix(w, dtype) -> jnp.ndarray:
     return jnp.swapaxes(w, -1, -2).astype(dtype)
 
 
+def _grouped_layout(group_sizes: jnp.ndarray, rows: int, n_groups: int, block_r: int):
+    """Row layout for the grouped Pallas kernel: each group padded to a
+    block_r multiple so every row block belongs to exactly one expert.
+
+    Returns (padded_idx [rows] — where sorted row r lands in the padded
+    buffer, block_expert [n_blocks] — which group each row block computes,
+    R_pad — static padded row count = rows + n_groups*block_r worst case).
+    Pad rows are zeros; their outputs are garbage-free (0 @ w = 0) and are
+    never gathered back.
+    """
+    R_pad = rows + n_groups * block_r
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes.astype(jnp.int32))[:-1]]
+    )
+    padded_sizes = ((group_sizes + block_r - 1) // block_r) * block_r
+    padded_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded_sizes.astype(jnp.int32))[:-1]]
+    )
+    r = jnp.arange(rows, dtype=jnp.int32)
+    g_of_r = jnp.searchsorted(starts, r, side="right").astype(jnp.int32) - 1
+    padded_idx = padded_starts[g_of_r] + (r - starts[g_of_r])
+    blocks = jnp.arange(R_pad // block_r, dtype=jnp.int32) * block_r
+    block_expert = jnp.clip(
+        jnp.searchsorted(padded_starts, blocks, side="right").astype(jnp.int32) - 1,
+        0,
+        n_groups - 1,
+    )
+    return padded_idx, block_expert, R_pad
+
+
+def _grouped_quant_eligible(w1, w3, w2, dtype, q80: bool, pallas) -> bool:
+    """The grouped Pallas kernel serves the production path: bf16 compute,
+    Q40 expert stacks, Pallas on, tile-aligned shapes. The f32/q80 parity
+    paths keep the exact dequant+ragged_dot formulation."""
+    import jax.numpy as jnp
+
+    from .quant import _use_pallas
+
+    if pallas is None:
+        pallas = _use_pallas()
+    interpret = pallas == "interpret"
+    if not (pallas or interpret) or q80 or dtype != jnp.bfloat16:
+        return False
+    from .pallas_q40 import q40_stacked_aligned
+
+    for w in (w1, w3, w2):
+        if not isinstance(w, QuantTensor):
+            return False
+        # same alignment contract as every other stacked kernel: the
+        # flattened [E*nb, out] scale plane needs lane-aligned out AND
+        # nb % 8 (Mosaic's sublane rule — invisible to interpret mode)
+        if not q40_stacked_aligned(w.in_features, w.out_features):
+            return False
+    return True
+
+
 def moe_ffn_ragged(
     y: jnp.ndarray,  # [b, t, dim] normed activations
     idx: jnp.ndarray,  # [b, t, k] int32 expert ids (GLOBAL, from moe_router)
@@ -80,6 +136,7 @@ def moe_ffn_ragged(
     dtype,  # MXU operand dtype
     q80: bool = False,  # reference-parity Q80 activation round-trip
     ep_axis: str | None = None,  # shard_map axis name when experts are sharded
+    pallas=None,  # None=auto | False | True | "interpret" (ops/quant.py)
 ) -> jnp.ndarray:
     """Exact top-k expert SwiGLU via sort + grouped (ragged) matmuls.
 
@@ -99,10 +156,12 @@ def moe_ffn_ragged(
     tok = order // k
     xs = y.reshape(n_tok, dim)[tok]  # [rows, dim] expert-sorted inputs
 
-    w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
-    w3m = expert_stack_matrix(w3, dtype)
-    w2m = expert_stack_matrix(w2, dtype)  # [E_local, ff, dim]
-    n_local = w1m.shape[0]
+    use_grouped = _grouped_quant_eligible(w1, w3, w2, dtype, q80, pallas)
+    n_local = w1.q.shape[0] if isinstance(w1, QuantTensor) else w1.shape[0]
+    if not use_grouped:
+        w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
+        w3m = expert_stack_matrix(w3, dtype)
+        w2m = expert_stack_matrix(w2, dtype)  # [E_local, ff, dim]
 
     if ep_axis is None:
         group_sizes = jnp.bincount(e_flat, length=n_local).astype(jnp.int32)
@@ -123,24 +182,68 @@ def moe_ffn_ragged(
             [before[None], local, after[None]]
         ).astype(jnp.int32)
 
-        def pad(w):
-            z = jnp.zeros((1,) + w.shape[1:], w.dtype)
-            return jnp.concatenate([z, w, z], axis=0)
+        if not use_grouped:
+            def pad(w):
+                z = jnp.zeros((1,) + w.shape[1:], w.dtype)
+                return jnp.concatenate([z, w, z], axis=0)
 
-        w1m, w3m, w2m = pad(w1m), pad(w3m), pad(w2m)
+            w1m, w3m, w2m = pad(w1m), pad(w3m), pad(w2m)
 
-    precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+    if use_grouped:
+        # production path: the grouped Pallas kernel streams the int8
+        # expert stacks directly (ops/pallas_q40.py q40_matmul_pallas_grouped)
+        # — no dequantized [E, dim, ff] transient exists at ANY expert count
+        from .pallas_q40 import q40_matmul_pallas_grouped
 
-    def rdot(x_, w_):
-        return jax.lax.ragged_dot(
-            x_.astype(dtype), w_, group_sizes,
-            precision=precision, preferred_element_type=jnp.float32,
+        interpret = pallas == "interpret"
+        w1q, w3q, w2q = w1, w3, w2
+        if ep_axis is not None:
+            # boundary groups 0 and E_local+1 (other shards' rows) index
+            # zero experts padded onto both ends of the stack — their rows
+            # produce exact zeros, matching the materialized path's pad()
+            def padq2(w):
+                zq = jnp.zeros((1,) + w.q.shape[1:], w.q.dtype)
+                zd = jnp.zeros((1,) + w.d.shape[1:], w.d.dtype)
+                return QuantTensor(
+                    q=jnp.concatenate([zq, w.q, zq], axis=0),
+                    d=jnp.concatenate([zd, w.d, zd], axis=0),
+                )
+            w1q, w3q, w2q = padq2(w1), padq2(w3), padq2(w2)
+
+        n_groups = int(group_sizes.shape[0])
+        # block_r trades tail-padding waste (small blocks) against expert
+        # weight re-reads across row blocks (large groups split into many
+        # blocks re-stream the same expert): target ~rows/n_groups, clamped
+        avg = max(1, rows // max(n_groups, 1))
+        block_r = 8
+        while block_r * 2 <= min(avg, 64):
+            block_r *= 2
+        padded_idx, block_expert, R_pad = _grouped_layout(
+            group_sizes, rows, n_groups, block_r
         )
+        xp = jnp.zeros((R_pad, dim), y.dtype).at[padded_idx].set(xs.astype(y.dtype))
 
-    xq = quantize_q80_activations(xs) if q80 else xs
-    h = (act_fn(rdot(xq, w1m)) * rdot(xq, w3m)).astype(y.dtype)
-    hq = quantize_q80_activations(h) if q80 else h
-    out_rows = rdot(hq, w2m)  # [rows, dim] f32
+        def gdot(x_, w_):
+            return q40_matmul_pallas_grouped(
+                x_, w_.q, w_.d, block_expert, block_r, dtype=dtype,
+                interpret=interpret,
+            )
+
+        h = (act_fn(gdot(xp, w1q)) * gdot(xp, w3q)).astype(y.dtype)
+        out_rows = gdot(h, w2q)[padded_idx]  # [rows, dim] f32
+    else:
+        precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+
+        def rdot(x_, w_):
+            return jax.lax.ragged_dot(
+                x_.astype(dtype), w_, group_sizes,
+                precision=precision, preferred_element_type=jnp.float32,
+            )
+
+        xq = quantize_q80_activations(xs) if q80 else xs
+        h = (act_fn(rdot(xq, w1m)) * rdot(xq, w3m)).astype(y.dtype)
+        hq = quantize_q80_activations(h) if q80 else h
+        out_rows = rdot(hq, w2m)  # [rows, dim] f32
 
     w_flat = wts.reshape(rows)[order].astype(jnp.float32)
     out = jnp.zeros((n_tok, dim), jnp.float32).at[tok].add(

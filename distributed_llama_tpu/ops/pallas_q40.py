@@ -211,32 +211,61 @@ def q40_matmul_pallas_stacked(
 
 
 def _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref):
-    """int8xint8 MXU path (single activation row): the weight's int8 values
-    hit the MXU directly — no per-element VPU dequant, the structural
+    """int8xint8 MXU path (decode-sized activation rows): the weight's int8
+    values hit the MXU directly — no per-element VPU dequant, the structural
     bottleneck of the bf16 kernel at square shapes (measured 17x there).
 
-    Per-block partial dots come from ONE 2D int8 matmul: the lhs is the
-    block-diagonal expansion of the activation row (row b = the row masked
-    to block b's 32 columns), so row b of the product is exactly
-    x8_block_b . q_block_b. The per-block scales (activation q80 scale x
-    weight Q40 scale) then combine on the VPU at O(knb*tn) — 1/32nd of the
-    dequant's element count. Activation quantization is the reference's
-    default `--buffer-float-type q80` numerics (src/llm.cpp:221-255).
+    Per-block partial dots come from ONE 2D int8 matmul: the lhs stacks, for
+    every activation row r, the block-diagonal expansion of that row (lhs
+    row r*knb + b = row r masked to block b's 32 columns), so product row
+    r*knb + b is exactly x8[r]_block_b . q_block_b. The per-block scales
+    (activation q80 scale x weight Q40 scale) then combine on the VPU at
+    O(R*knb*tn) — 1/32nd of the dequant's element count. Activation
+    numerics are the reference's default `--buffer-float-type q80`
+    (src/llm.cpp:221-255). R is small (<= 8, gated in quant_matmul) — the
+    lhs expansion is R*knb rows; larger batches amortize dequant over rows
+    and use the bf16 kernel instead.
     """
     k = pl.program_id(1)
     knb, tn = dt_ref.shape
-    x8 = x8_ref[...]  # [1, knb*32] int8
-    # select, not multiply: muli on i8 vectors doesn't legalize in Mosaic
-    blockdiag = jnp.where(
-        mask_ref[...] != 0, jnp.broadcast_to(x8, mask_ref.shape), jnp.int8(0)
-    )  # [knb, knb*32]
+    R = x8_ref.shape[0]
+    x8 = x8_ref[...]  # [R, knb*32] int8
+    # select, not multiply: muli on i8 vectors doesn't legalize in Mosaic.
+    # Multi-row stays strictly 2D: per-row broadcast-select then concat on
+    # the sublane axis — 3D int8 broadcasts/reshapes ([R,1,knb*32] etc.)
+    # fail Mosaic's shape-cast lowering on this platform (found by
+    # scripts/compile_check_tpu.py; interpret mode accepted them).
+    mask = mask_ref[...]  # [knb, knb*32]
+    if R == 1:
+        blockdiag = jnp.where(
+            mask != 0, jnp.broadcast_to(x8, mask.shape), jnp.int8(0)
+        )  # [knb, knb*32]
+    else:
+        blockdiag = jnp.concatenate(
+            [
+                jnp.where(
+                    mask != 0,
+                    jnp.broadcast_to(x8[r : r + 1], mask.shape),
+                    jnp.int8(0),
+                )
+                for r in range(R)
+            ],
+            axis=0,
+        )  # [R*knb, knb*32]
     qt2 = qt_ref[...].reshape(knb * Q_BLOCK, tn)
     partials = jax.lax.dot_general(
         blockdiag, qt2, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
-    )  # [knb, tn]; row b = block b's exact integer dot
-    scale = xs_ref[...][:, :1] * _scale_f32(dt_ref[...])  # [knb, tn] f32
-    acc = jnp.sum(partials.astype(jnp.float32) * scale, axis=0)[None, :]
+    )  # [R*knb, tn]; row r*knb+b = row r's block-b integer dot
+    dtf = _scale_f32(dt_ref[...])  # [knb, tn]
+    # per-row scale combine, unrolled over the (small, static) R; row r's
+    # activation scales sit at xs column r*128 (see _quantize_rows_q80)
+    rows = []
+    for r in range(R):
+        pr = partials[r * knb : (r + 1) * knb]  # [knb, tn]
+        scale = xs_ref[...][:, r * 128 : r * 128 + 1] * dtf  # [knb, tn]
+        rows.append(jnp.sum(pr.astype(jnp.float32) * scale, axis=0)[None, :])
+    acc = rows[0] if R == 1 else jnp.concatenate(rows, axis=0)  # [R, tn]
 
     @pl.when(k == 0)
     def _():
@@ -253,20 +282,30 @@ def _kernel_stacked_i8(l_ref, x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref)
     _kernel_i8(x8_ref, xs_ref, mask_ref, qt_ref, dt_ref, out_ref)
 
 
-def _quantize_row_q80(x2: jnp.ndarray, nb: int):
-    """[1, in] f32-able row -> (x8 [1, in] int8, xs [nb, 128] f32 scales).
+def _quantize_rows_q80(x2: jnp.ndarray, nb: int):
+    """[R, in] f32-able rows -> (x8 [R, in] int8, xs [nb, R*128] f32).
     Per-32-block symmetric int8 with the Q80 codec's numerics (same contract
     as ops/quant.py quantize_q80_activations and the reference's
     quantizeF32toQ80): int8 values are computed against the unrounded f32
-    scale, dequantization uses the f16-ROUNDED scale stored in the block."""
-    xb = x2.reshape(nb, Q_BLOCK).astype(jnp.float32)
+    scale, dequantization uses the f16-ROUNDED scale stored in the block.
+    Row r's per-block scales live at xs columns [r*128, (r+1)*128) — a
+    lane-aligned layout the kernel slices per row."""
+    R = x2.shape[0]
+    xb = x2.reshape(R, nb, Q_BLOCK).astype(jnp.float32)
     amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
     scale = amax / 127.0
     inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
     x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
-    scale16 = scale.astype(jnp.float16).astype(jnp.float32)
-    xs = jnp.broadcast_to(scale16, (nb, 128)).astype(jnp.float32)
-    return x8.reshape(1, nb * Q_BLOCK), xs
+    scale16 = scale.astype(jnp.float16).astype(jnp.float32)  # [R, nb, 1]
+    xs = jnp.broadcast_to(
+        jnp.transpose(scale16, (1, 0, 2)), (nb, R, 128)
+    ).reshape(nb, R * 128)
+    return x8.reshape(R, nb * Q_BLOCK), xs
+
+
+# backwards-compatible single-row name (scripts/sweeps import it)
+def _quantize_row_q80(x2: jnp.ndarray, nb: int):
+    return _quantize_rows_q80(x2, nb)
 
 
 def _blockdiag_mask(tile_knb: int) -> jnp.ndarray:
@@ -279,7 +318,7 @@ def _blockdiag_mask(tile_knb: int) -> jnp.ndarray:
     return jnp.asarray(m)
 
 
-def _i8_tiles(nb: int, out: int) -> tuple[int, int]:
+def _i8_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
     """Tile shapes for the int8 kernel, from the round-3 measured sweeps on
     v5e with the f16 scale plane at both the 1B and 8B model shapes
     (scripts/sweep_i8_tiles.py; µs per decode matmul, best of the grid):
@@ -312,8 +351,12 @@ def _i8_tiles(nb: int, out: int) -> tuple[int, int]:
     while nb % tile_knb:
         tile_knb //= 2
     # VMEM cap: the int8 weight block (tile_knb*32*tile_n bytes) is
-    # double-buffered; >4 MB blocks failed remote compile in the sweep
+    # double-buffered; >4 MB blocks failed remote compile in the sweep.
+    # Multi-row calls also materialize the [rows*knb, knb*32] block-diagonal
+    # lhs in VMEM — cap it too.
     while tile_n * tile_knb * Q_BLOCK > 4 * 1024 * 1024 and tile_knb > 8:
+        tile_knb //= 2
+    while rows * tile_knb * tile_knb * Q_BLOCK > 4 * 1024 * 1024 and tile_knb > 8:
         tile_knb //= 2
     # Mosaic's sublane rule for the multi-k-step case: a [tile_knb, tile_n]
     # scale block must have tile_knb % 8 == 0 UNLESS it spans the whole
@@ -328,28 +371,32 @@ def _i8_tiles(nb: int, out: int) -> tuple[int, int]:
 
 @partial(jax.jit, static_argnames=("interpret",))
 def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
-    """Single-row x @ w via the int8-MXU kernel. x: [..., in] with exactly
-    one row; returns [..., out] f32."""
+    """x @ w via the int8-MXU kernel for decode-sized batches. x: [..., in]
+    with a small row count (quant_matmul gates rows <= 8); returns
+    [..., out] f32."""
     nb, _, out = qt.shape
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
-    x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    R = 1
+    for s in lead:
+        R *= s
+    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
     dt = _dt_operand(dt)
-    tile_n, tile_knb = _i8_tiles(nb, out)
+    tile_n, tile_knb = _i8_tiles(nb, out, rows=R)
     mask = _blockdiag_mask(tile_knb)
     grid = (out // tile_n, nb // tile_knb)
     out2 = pl.pallas_call(
         _kernel_i8,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
-            pl.BlockSpec((tile_knb, 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((R, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
             pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k: (0, 0)),
             pl.BlockSpec((tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j)),
             pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
         ],
-        out_specs=pl.BlockSpec((1, tile_n), lambda j, k: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
     )(x8, xs, mask, qt, dt)
     return out2.reshape(*lead, out)
@@ -359,15 +406,18 @@ def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
 def q40_matmul_pallas_stacked_i8(
     x, qt, dt, layer, interpret: bool = False
 ) -> jnp.ndarray:
-    """Single-row x @ w[layer] for a stacked Q40 weight via the int8-MXU
-    kernel; the layer index scalar-prefetches into the DMA offsets exactly
-    like q40_matmul_pallas_stacked."""
+    """x @ w[layer] for a stacked Q40 weight via the int8-MXU kernel at
+    decode-sized batches; the layer index scalar-prefetches into the DMA
+    offsets exactly like q40_matmul_pallas_stacked."""
     L, nb, _, out = qt.shape
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
-    x8, xs = _quantize_row_q80(x.reshape(1, in_features), nb)
+    R = 1
+    for s in lead:
+        R *= s
+    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
     dt = _dt_operand(dt)
-    tile_n, tile_knb = _i8_tiles(nb, out)
+    tile_n, tile_knb = _i8_tiles(nb, out, rows=R)
     mask = _blockdiag_mask(tile_knb)
     k_steps = nb // tile_knb
     qt3 = qt.reshape(L * nb, Q_BLOCK, out)
@@ -377,23 +427,109 @@ def q40_matmul_pallas_stacked_i8(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
-            pl.BlockSpec((tile_knb, 128), lambda j, k, l: (k, 0)),
+            pl.BlockSpec((R, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k, l: (k, 0)),
             pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k, l: (0, 0)),
             pl.BlockSpec(
                 (tile_knb, Q_BLOCK, tile_n), lambda j, k, l: (l[0] * k_steps + k, 0, j)
             ),
             pl.BlockSpec((tile_knb, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)),
         ],
-        out_specs=pl.BlockSpec((1, tile_n), lambda j, k, l: (0, j)),
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k, l: (0, j)),
     )
     out2 = pl.pallas_call(
         _kernel_stacked_i8,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((1, out), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1), x8, xs, mask, qt3, dt3)
     return out2.reshape(*lead, out)
+
+
+def _kernel_grouped(be_ref, x_ref, qt_ref, dt_ref, out_ref):
+    # same dequant-matmul math as _kernel_stacked; the expert index comes
+    # from the scalar-prefetched per-row-block map instead of a layer scalar
+    k = pl.program_id(2)
+    if x_ref.dtype == jnp.bfloat16:
+        w = qt_ref[...].astype(jnp.bfloat16) * _scale_f32(dt_ref[...])[
+            :, None, :
+        ].astype(jnp.bfloat16)
+    else:
+        w = (
+            qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]
+        ).astype(x_ref.dtype)
+    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
+    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("block_r", "dtype", "interpret"))
+def q40_matmul_pallas_grouped(
+    xp: jnp.ndarray,  # [R_pad, in] — rows grouped by expert, groups padded
+    # to block_r multiples (ops/moe.py _grouped_layout)
+    qt: jnp.ndarray,  # [E, nb, 32, out] int8 expert stack
+    dt: jnp.ndarray,  # [E, nb, out] scale plane
+    block_expert: jnp.ndarray,  # [R_pad // block_r] int32 — expert of each row block
+    block_r: int,
+    dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Grouped (ragged) quantized matmul: row block i is multiplied by
+    expert block_expert[i]'s weight, streamed from HBM as int8 — the MoE
+    prefill path's replacement for dequantize-the-whole-expert-stack +
+    `lax.ragged_dot` (which writes and re-reads a bf16 copy of every expert,
+    and at 30B-A3B scale materializes GB-sized transients). The expert index
+    rides the scalar-prefetch channel into the BlockSpec index maps exactly
+    like the stacked kernels' layer index. Upgrades the formulation of the
+    reference's per-expert indexed matmul (src/nn/nn-cpu-ops.cpp:1166-1192).
+    """
+    E, nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    R_pad = xp.shape[0]
+    xp = xp.astype(dtype)
+    dt = _dt_operand(dt)
+
+    tile_n = min(DEFAULT_TILE_N, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = min(DEFAULT_TILE_KNB, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+    if tile_knb != nb and tile_knb % 8:
+        tile_knb = nb
+    k_steps = nb // tile_knb
+
+    qt3 = qt.reshape(E * nb, Q_BLOCK, out)
+    dt3 = dt.reshape(E * nb, out)
+    grid = (R_pad // block_r, out // tile_n, k_steps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_r, tile_knb * Q_BLOCK), lambda i, j, k, be: (i, k)),
+            pl.BlockSpec(
+                (tile_knb, Q_BLOCK, tile_n),
+                lambda i, j, k, be, ks=k_steps: (be[i] * ks + k, 0, j),
+            ),
+            pl.BlockSpec(
+                (tile_knb, tile_n), lambda i, j, k, be, ks=k_steps: (be[i] * ks + k, j)
+            ),
+        ],
+        out_specs=pl.BlockSpec((block_r, tile_n), lambda i, j, k, be: (i, j)),
+    )
+    return pl.pallas_call(
+        _kernel_grouped,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R_pad, out), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(block_expert, jnp.int32), xp, qt3, dt3)
 
 
 @partial(jax.jit, static_argnames=("dtype", "interpret"))

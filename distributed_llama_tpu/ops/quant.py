@@ -172,15 +172,18 @@ def quant_matmul(
         pallas = True
     if pallas is None:
         pallas = _use_pallas()
-    # single-row (decode) on the approximate bf16 path: the int8-MXU kernel —
-    # weights hit the MXU as int8 with per-block scale combine, removing the
-    # per-element VPU dequant (measured 17x on square shapes). Activation
+    # decode-sized batches on the approximate bf16 path: the int8-MXU
+    # kernel — weights hit the MXU as int8 with per-block scale combine,
+    # removing the per-element VPU dequant (measured 17x on square shapes).
+    # The kernel's block-diagonal lhs stacks rows on the sublane axis, so
+    # any rows <= 8 qualify (beyond that, the bf16-dequant kernel's
+    # per-element dequant amortizes over rows and wins). Activation
     # numerics = the reference's default `--buffer-float-type q80`; the
     # f32 parity paths never take this branch.
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
-    use_i8 = pallas and rows == 1 and dtype == jnp.bfloat16
+    use_i8 = pallas and rows <= 8 and dtype == jnp.bfloat16
     if layer is not None and w.q.ndim == 4:
         stack_aligned = (
             x.shape[-1] == w.in_features

@@ -18,6 +18,7 @@ reference's accept loop; horizontal scale comes from the gateway
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 from dataclasses import dataclass
@@ -107,6 +108,129 @@ def chunk_json(delta: str | None, stop: bool) -> dict:
     }
 
 
+class _BatchReq:
+    """One request's slot in a batched generation round."""
+
+    def __init__(self, ids, max_new, temperature, topp, seed, on_token):
+        self.ids = ids
+        self.max_new = max_new
+        self.temperature = temperature
+        self.topp = topp
+        self.seed = seed
+        self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        self.stopped = False
+        self.n = 0
+        self.error = None
+        self.done = threading.Event()
+
+
+class Batcher:
+    """Groups concurrent requests into one engine.generate_batch call.
+
+    The reference serializes requests entirely (one sequential accept loop,
+    dllama-api.cpp:571-576); the gateway's replica DP is its only
+    concurrency. With per-row sequences the engine decodes independent
+    prompts in one batch, so the API server batches instead: handler
+    threads submit requests, a worker collects up to engine.batch of them
+    within a short window and runs them together. Unfilled rows are padded
+    with 1-token dummy prompts that stop immediately. The naive prefix
+    cache does not apply in batch mode (rows are independent fresh
+    sequences).
+    """
+
+    def __init__(self, state: "ApiState", window_s: float = 0.05):
+        import queue
+
+        self.state = state
+        self.window_s = window_s
+        self.q: "queue.Queue[_BatchReq]" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, req: _BatchReq):
+        self.q.put(req)
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+
+    def _loop(self):
+        import queue
+        import time as _time
+
+        held = None  # sampling-incompatible request deferred to the next round
+        while True:
+            first = held if held is not None else self.q.get()
+            held = None
+            batch = [first]
+            deadline = _time.monotonic() + self.window_s
+            while len(batch) < self.state.engine.batch:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self.q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                # rows share one sampler, so only requests with identical
+                # sampling settings may share a round; an incompatible
+                # request seeds the next round instead
+                if (nxt.temperature, nxt.topp) != (first.temperature, first.topp):
+                    held = nxt
+                    break
+                batch.append(nxt)
+            self._run(batch)
+
+    def _run(self, batch):
+        engine = self.state.engine
+        try:
+            engine.reset()
+            prompts = [r.ids for r in batch]
+            while len(prompts) < engine.batch:
+                prompts.append([1])  # dummy row; stops after one token
+            # one shared step budget: the largest request's, clamped so the
+            # longest prompt still fits the context window
+            budget = max(r.max_new for r in batch)
+            budget = max(1, min(budget, engine.cfg.seq_len - max(len(p) for p in prompts)))
+            sampler = self.state.sampler
+            sampler.set_temp(batch[0].temperature)
+            sampler.topp = batch[0].topp
+            if batch[0].seed is not None:
+                sampler.set_seed(batch[0].seed)
+
+            def on_token(row, t):
+                if row >= len(batch):
+                    return
+                r = batch[row]
+                if r.stopped:
+                    return
+                r.n += 1
+                try:
+                    r.on_token(t)
+                except Exception as e:
+                    # a per-ROW failure (typically the client dropping its
+                    # socket mid-stream) stops that row only — co-batched
+                    # requests and the engine are unaffected
+                    r.error = e
+                    r.stopped = True
+                if r.n >= r.max_new:
+                    r.stopped = True
+
+            def stop_fn(row, t):
+                return row >= len(batch) or batch[row].stopped
+
+            engine.generate_batch(
+                prompts, budget, sampler=sampler, on_token=on_token,
+                stop_fn=stop_fn,
+            )
+        except Exception as e:
+            self.state.recover()
+            for r in batch:
+                r.error = e
+        finally:
+            for r in batch:
+                r.done.set()
+
+
 class ApiState:
     """Engine + tokenizer + cache shared by all requests (serialized)."""
 
@@ -134,6 +258,70 @@ class ApiState:
         self.template = ChatTemplateGenerator(
             template_type, tokenizer.chat_template, self.stops[0] if self.stops else ""
         )
+        # batch serving: engines with batch > 1 (and per-row positions, i.e.
+        # the non-pipeline path) get a Batcher that groups concurrent
+        # requests into one generate_batch call; batch == 1 keeps the
+        # serialized path with the naive prefix cache
+        self.batcher = (
+            Batcher(self) if engine.batch > 1 and not engine.use_pipeline else None
+        )
+
+    def complete_batched(self, params: dict, emit):
+        """One request's slice of a batched generation: encode, submit to the
+        Batcher, stream deltas from this row's tokens as they arrive.
+        Returns (full_text, n_prompt_tokens, n_completion_tokens)."""
+        tok = self.tokenizer
+        items = [ChatItem(m["role"], m["content"]) for m in params["messages"]]
+        prompt = self.template.generate(items, True)
+        ids = tok.encode(prompt.content, is_start=True)
+        seq_len = self.engine.cfg.seq_len
+        # batch mode needs at least one decode slot past the prompt (the
+        # serialized path's boundary case of a seq_len-exact prompt would
+        # otherwise surface as a batch-wide engine error)
+        if len(ids) >= seq_len:
+            raise PromptTooLong(
+                f"prompt ({len(ids)} tokens) exceeds the context window ({seq_len})"
+            )
+        max_tokens = params.get("max_tokens", -1)
+        budget = max_tokens if max_tokens and max_tokens > 0 else seq_len
+        budget = max(1, min(budget, seq_len - len(ids)))
+
+        buffer = []
+        if prompt.public_prompt:
+            emit(prompt.public_prompt)
+            buffer.append(prompt.public_prompt)
+
+        dec = tok.stream_decoder()  # per-row UTF-8 carry state
+        detector = EosDetector(
+            tok.eos_token_ids,
+            self.stops,
+            max((len(s) for s in self.stops), default=0),
+            max((len(s) for s in self.stops), default=0),
+        )
+        req_box = []
+
+        def on_token(t):
+            piece = dec.decode(t)
+            eos_type = detector.append(t, piece)
+            if eos_type != EOS_MAYBE:
+                delta = detector.get_delta()
+                if delta:
+                    emit(delta)
+                    buffer.append(delta)
+                detector.reset()
+            if eos_type == EOS_FOUND:
+                req_box[0].stopped = True
+
+        req = _BatchReq(
+            ids, budget,
+            params.get("temperature", self.args.temperature),
+            params.get("top_p", self.args.topp),
+            params.get("seed"),
+            on_token,
+        )
+        req_box.append(req)
+        self.batcher.submit(req)
+        return "".join(buffer), len(ids), req.n
 
     def complete(self, params: dict, emit):
         """Run one completion; calls emit(delta_text) per safe-to-send chunk.
@@ -274,7 +462,16 @@ class Handler(BaseHTTPRequestHandler):
 
         stream = bool(params.get("stream", False))
         st = self.state
-        with st.lock:
+        # batch mode: the Batcher serializes engine access and groups
+        # concurrent requests into one generation — no global lock, so
+        # handler threads can actually arrive concurrently
+        if st.batcher is not None:
+            complete_fn = st.complete_batched
+            lock_ctx = contextlib.nullcontext()
+        else:
+            complete_fn = st.complete
+            lock_ctx = st.lock
+        with lock_ctx:
             if stream:
                 # headers go out lazily on the first emitted chunk, so a
                 # validation failure (e.g. prompt too long) can still return
@@ -301,7 +498,7 @@ class Handler(BaseHTTPRequestHandler):
                         raise ClientDisconnected(str(e)) from e
 
                 try:
-                    text, n_prompt, n_completion = st.complete(params, emit)
+                    text, n_prompt, n_completion = complete_fn(params, emit)
                 except PromptTooLong as e:
                     if not started[0]:
                         self._json(400, json.dumps({"error": str(e)}).encode())
@@ -326,7 +523,7 @@ class Handler(BaseHTTPRequestHandler):
                 self.close_connection = True
             else:
                 try:
-                    text, n_prompt, n_completion = st.complete(params, lambda d: None)
+                    text, n_prompt, n_completion = complete_fn(params, lambda d: None)
                 except PromptTooLong as e:
                     self._json(400, json.dumps({"error": str(e)}).encode())
                     return
@@ -370,13 +567,20 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(args) -> HTTPServer:
-    """Build state and return a configured (unstarted) HTTPServer."""
+    """Build state and return a configured (unstarted) HTTPServer.
+
+    batch == 1: single-threaded server, serialized requests + prefix cache
+    (the reference's model). batch > 1: threaded server so concurrent
+    handlers can reach the Batcher together."""
+    from http.server import ThreadingHTTPServer
+
     from ..cli import make_engine
 
     engine = make_engine(args)
     tokenizer = Tokenizer(args.tokenizer)
     Handler.state = ApiState(engine, tokenizer, args)
-    return HTTPServer(("0.0.0.0", args.port), Handler)
+    cls = ThreadingHTTPServer if Handler.state.batcher is not None else HTTPServer
+    return cls(("0.0.0.0", args.port), Handler)
 
 
 def main(argv=None) -> int:

@@ -133,41 +133,64 @@ class Tokenizer:
     def reset_decoder(self):
         self._decode_buf = b""
 
+    def stream_decoder(self) -> "StreamDecoder":
+        """An INDEPENDENT streaming-decode state over this tokenizer's vocab
+        — batch serving gives each concurrent row its own UTF-8 carry
+        buffer instead of sharing the tokenizer's."""
+        return StreamDecoder(self)
+
     def decode(self, token: int) -> str | None:
         """Streaming decode: returns printable text or None if the token only
-        extended an incomplete UTF-8 sequence (or was bos/eos)."""
-        if token == self.bos_id:
-            return None
-        if self.is_eos(token):
-            if self._decode_buf:
-                out = self._decode_buf.decode("utf-8", errors="replace")
-                self._decode_buf = b""
-                return out
-            return None
-        self._decode_buf += self.vocab[token]
-        # find the longest prefix that is complete UTF-8
-        buf = self._decode_buf
-        cut = len(buf)
-        # walk back over at most 3 trailing continuation-or-lead bytes
-        for back in range(1, min(4, len(buf)) + 1):
-            b = buf[-back]
-            if b < 0x80:
-                break  # ascii: everything is complete
-            if b >= 0xC0:  # lead byte: is the sequence complete?
-                need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
-                if back < need:
-                    cut = len(buf) - back  # incomplete, hold back
-                break
-        if cut == 0:
-            return None
-        out, self._decode_buf = buf[:cut], buf[cut:]
-        return out.decode("utf-8", errors="replace") or None
+        extended an incomplete UTF-8 sequence (or was bos/eos). Uses the
+        tokenizer's own carry buffer (single-sequence use); see
+        `stream_decoder` for independent per-row state."""
+        out, self._decode_buf = _decode_step(self, self._decode_buf, token)
+        return out
 
     def is_eos(self, token: int) -> bool:
         return token in self.eos_token_ids
 
     def piece(self, token: int) -> bytes:
         return self.vocab[token]
+
+
+def _decode_step(tok: "Tokenizer", buf: bytes, token: int):
+    """One streaming-decode step: (text|None, new_buf)."""
+    if token == tok.bos_id:
+        return None, buf
+    if token in tok.eos_token_ids:
+        if buf:
+            return buf.decode("utf-8", errors="replace"), b""
+        return None, buf
+    buf = buf + tok.vocab[token]
+    # find the longest prefix that is complete UTF-8
+    cut = len(buf)
+    # walk back over at most 3 trailing continuation-or-lead bytes
+    for back in range(1, min(4, len(buf)) + 1):
+        b = buf[-back]
+        if b < 0x80:
+            break  # ascii: everything is complete
+        if b >= 0xC0:  # lead byte: is the sequence complete?
+            need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
+            if back < need:
+                cut = len(buf) - back  # incomplete, hold back
+            break
+    if cut == 0:
+        return None, buf
+    out, buf = buf[:cut], buf[cut:]
+    return (out.decode("utf-8", errors="replace") or None), buf
+
+
+class StreamDecoder:
+    """Per-row streaming decoder sharing a Tokenizer's vocab."""
+
+    def __init__(self, tok: Tokenizer):
+        self._tok = tok
+        self._buf = b""
+
+    def decode(self, token: int) -> str | None:
+        out, self._buf = _decode_step(self._tok, self._buf, token)
+        return out
 
 
 # ---------------------------------------------------------------------------

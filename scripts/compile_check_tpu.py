@@ -1,0 +1,185 @@
+"""Compile-and-smoke every Pallas kernel variant on the REAL chip.
+
+Interpret mode does not enforce Mosaic's lowering rules (round 2's late
+catch: the stacked kernels' nb%8 sublane constraint was invisible to the
+whole CPU suite), so this script builds each kernel at the bench-model
+shapes on hardware and checks numerics loosely against the XLA reference.
+Run before recording any BENCH_r* result. Exit code != 0 on any failure.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+failures = []
+
+
+def check(label, fn):
+    try:
+        fn()
+        print(f"PASS {label}")
+    except Exception as e:
+        failures.append(label)
+        print(f"FAIL {label}: {str(e).splitlines()[0][:140]}")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.formats.quants import Q_BLOCK
+    from distributed_llama_tpu.ops.pallas_q40 import (
+        q40_matmul_pallas,
+        q40_matmul_pallas_grouped,
+        q40_matmul_pallas_i8,
+        q40_matmul_pallas_stacked,
+        q40_matmul_pallas_stacked_i8,
+    )
+    from distributed_llama_tpu.ops.pallas_attention import (
+        flash_attention,
+        flash_attention_partial,
+    )
+    from distributed_llama_tpu.ops.quant import QuantTensor, _quant_matmul_xla
+
+    assert jax.default_backend() == "tpu", "run on the real chip"
+    rng = np.random.default_rng(0)
+
+    def mkw(out, inf, L=None, E=None):
+        nb = inf // Q_BLOCK
+        lead = ()
+        if L is not None:
+            lead += (L,)
+        if E is not None:
+            lead += (E,)
+        q = rng.integers(-8, 8, lead + (nb, Q_BLOCK, out)).astype(np.int8)
+        d = (rng.standard_normal(lead + (nb, out)) * 0.01).astype(np.float16)
+        return QuantTensor(q=jnp.asarray(q), d=jnp.asarray(d))
+
+    # weight-shape matrix: (label, in, out) for the 1B, qwen3 and 8B bench
+    # models (fused wqkv/w13 shapes included)
+    shapes = [
+        ("1B wqkv", 2048, 3072), ("1B wo", 2048, 2048), ("1B w13", 2048, 16384),
+        ("1B w2", 8192, 2048), ("1B wcls", 2048, 32768),
+        ("qwen3 wqkv", 1024, 4096), ("qwen3 w13", 1024, 6144),
+        ("8B wqkv", 4096, 6144), ("8B w13", 4096, 28672),
+        ("8B w2", 14336, 4096), ("8B wcls", 4096, 128256),
+    ]
+    for label, inf, out in shapes:
+        w = mkw(out, inf)
+        xref = jnp.asarray(rng.standard_normal((1, inf)) * 0.1, jnp.bfloat16)
+        want = np.asarray(_quant_matmul_xla(xref, w.q, w.d, jnp.float32))
+
+        def run_i8(w=w, x=xref, want=want):
+            got = np.asarray(q40_matmul_pallas_i8(x, w.q, w.d))
+            np.testing.assert_allclose(got, want, rtol=0.1, atol=0.5)
+
+        check(f"i8 1-row {label} {inf}->{out}", run_i8)
+        for R in (2, 4, 8):
+            xa = jnp.asarray(rng.standard_normal((R, inf)) * 0.1, jnp.bfloat16)
+
+            def run_multi(w=w, x=xa):
+                got = np.asarray(q40_matmul_pallas_i8(x, w.q, w.d))
+                ref = np.asarray(_quant_matmul_xla(x, w.q, w.d, jnp.float32))
+                np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.5)
+
+            check(f"i8 {R}-row {label}", run_multi)
+
+        # multi-row bf16-dequant (prefill) kernel
+        xp = jnp.asarray(rng.standard_normal((64, inf)) * 0.1, jnp.bfloat16)
+
+        def run_bf16(w=w, x=xp):
+            got = np.asarray(q40_matmul_pallas(x, w.q, w.d))
+            ref = np.asarray(_quant_matmul_xla(x, w.q, w.d, jnp.bfloat16))
+            np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.5)
+
+        check(f"bf16-dequant 64-row {label}", run_bf16)
+
+    # stacked (layer-indexed) kernels at the 1B shapes
+    for label, inf, out in [("1B wqkv", 2048, 3072), ("1B w13", 2048, 16384)]:
+        ws = mkw(out, inf, L=4)
+        x1 = jnp.asarray(rng.standard_normal((1, inf)) * 0.1, jnp.bfloat16)
+        xp = jnp.asarray(rng.standard_normal((64, inf)) * 0.1, jnp.bfloat16)
+
+        def run_st(ws=ws, x=xp):
+            got = np.asarray(q40_matmul_pallas_stacked(x, ws.q, ws.d, jnp.int32(2)))
+            ref = np.asarray(
+                _quant_matmul_xla(x, ws.q[2], ws.d[2], jnp.bfloat16)
+            )
+            np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.5)
+
+        def run_sti(ws=ws, x=x1):
+            got = np.asarray(
+                q40_matmul_pallas_stacked_i8(x, ws.q, ws.d, jnp.int32(1))
+            )
+            ref = np.asarray(_quant_matmul_xla(x, ws.q[1], ws.d[1], jnp.float32))
+            np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.5)
+
+        check(f"stacked bf16 {label}", run_st)
+        check(f"stacked i8 {label}", run_sti)
+
+    # MoE: stacked i8 over [L*E]-flattened expert stacks + the grouped kernel
+    we = mkw(512, 1024, L=12 * 32)  # qwen3-moe decode slot indexing
+    x1 = jnp.asarray(rng.standard_normal((1, 1024)) * 0.1, jnp.bfloat16)
+
+    def run_moe_slot(we=we, x=x1):
+        got = np.asarray(q40_matmul_pallas_stacked_i8(x, we.q, we.d, jnp.int32(37)))
+        ref = np.asarray(_quant_matmul_xla(x, we.q[37], we.d[37], jnp.float32))
+        np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.5)
+
+    check("moe stacked-i8 slot (L*E flat)", run_moe_slot)
+
+    for E, block_r in [(32, 32), (128, 8)]:
+        wg = mkw(512, 1024, E=E)
+        n_blocks = 16
+        xp = jnp.asarray(
+            rng.standard_normal((n_blocks * block_r, 1024)) * 0.1, jnp.bfloat16
+        )
+        be = jnp.asarray(rng.integers(0, E, n_blocks), jnp.int32)
+
+        def run_grouped(wg=wg, xp=xp, be=be, block_r=block_r):
+            got = np.asarray(
+                q40_matmul_pallas_grouped(xp, wg.q, wg.d, be, block_r)
+            )
+            for i in (0, n_blocks - 1):
+                e = int(be[i])
+                ref = np.asarray(
+                    _quant_matmul_xla(
+                        xp[i * block_r : (i + 1) * block_r], wg.q[e], wg.d[e],
+                        jnp.bfloat16,
+                    )
+                )
+                np.testing.assert_allclose(
+                    got[i * block_r : (i + 1) * block_r], ref, rtol=0.1, atol=0.5
+                )
+
+        check(f"grouped moe E={E} block_r={block_r}", run_grouped)
+
+    # flash attention (new default blocks) + the sp partial variant
+    for label, (h, kv, hd) in [("llama", (32, 8, 64)), ("qwen3", (16, 8, 128))]:
+        q = jnp.asarray(rng.standard_normal((1, 512, h, hd)), jnp.bfloat16)
+        kc = jnp.asarray(rng.standard_normal((1, 2048, kv, hd)), jnp.bfloat16)
+
+        def run_flash(q=q, kc=kc):
+            out = np.asarray(flash_attention(q, kc, kc, jnp.int32(1000)))
+            assert np.isfinite(out).all()
+
+        def run_partial(q=q, kc=kc):
+            o, m, l = flash_attention_partial(
+                q, kc, kc, jnp.int32(1000), jnp.int32(0)
+            )
+            out = np.asarray(o) / np.maximum(np.asarray(l), 1e-30)[..., None]
+            full = np.asarray(flash_attention(q, kc, kc, jnp.int32(1000)), np.float32)
+            np.testing.assert_allclose(out, full, rtol=0.05, atol=0.05)
+
+        check(f"flash {label} t=512", run_flash)
+        check(f"flash-partial {label} t=512", run_partial)
+
+    print(f"\n{len(failures)} failures" if failures else "\nall kernels compile on TPU")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

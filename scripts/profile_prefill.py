@@ -1,0 +1,130 @@
+"""Prefill-chunk compute profile on the real chip (differenced timing).
+
+The bench's prefill tok/s at a 512-token prompt is dominated by the ~70-90 ms
+tunnel dispatch (one chunk = one dispatch); this isolates the COMPUTE:
+  * full 512-token forward chunk (the real prefill unit)
+  * matmul-only chain at t=512 (bf16-dequant kernel, multi-row)
+  * flash attention at t=512 over the kv bucket
+  * per-shape multi-row matmul bandwidth/MFU
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from profile_decode import dev_ms  # differenced timing
+
+
+def main():
+    from bench import ensure_model
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.models.transformer import forward_uncompiled
+    from distributed_llama_tpu.models.params import KVCache
+    from distributed_llama_tpu.ops.quant import quant_matmul
+    from distributed_llama_tpu.ops.pallas_attention import flash_attention
+
+    path = ensure_model()
+    engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
+    cfg, params, rope = engine.cfg, engine.params, engine.rope
+    T = 512
+    N = 8
+
+    # full prefill chunk, chained (cache threads through)
+    def mk_full(n):
+        @jax.jit
+        def fn(params, ck, cv, toks):
+            def body(carry, _):
+                toks, ck, cv = carry
+                logits, cache = forward_uncompiled(
+                    cfg, params, rope, KVCache(k=ck, v=cv), toks, jnp.int32(0),
+                    kv_len=1024,
+                )
+                toks = toks + (logits[..., :1].sum() * 1e-30).astype(jnp.int32)
+                return (toks, cache.k, cache.v), None
+            (toks, ck, cv), _ = jax.lax.scan(body, (toks, ck, cv), None, length=n)
+            return toks
+        cache = engine._new_cache()
+        toks = jnp.ones((1, T), jnp.int32)
+        return fn, (params, cache.k, cache.v, toks)
+
+    full = dev_ms(f"prefill chunk t={T}", mk_full, N)
+    print(f"    -> {T/full*1000:.0f} tok/s compute-only")
+
+    # matmul chain at t=512 (stacked layer-indexed, production formulation)
+    def mk_mm(n):
+        @jax.jit
+        def fn(params, x):
+            lp = params.layers
+            def layer_body(x, li):
+                qkv = quant_matmul(x, lp.wqkv, pallas=True, layer=li)
+                x = quant_matmul(qkv[..., : cfg.dim], lp.wo, pallas=True, layer=li)
+                h13 = quant_matmul(x, lp.w13, pallas=True, layer=li)
+                ff = h13.shape[-1] // 2
+                x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=True, layer=li)
+                return x.astype(jnp.bfloat16), None
+            def body(x, _):
+                x, _ = jax.lax.scan(layer_body, x, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+                lg = quant_matmul(x[:, -1:], params.wcls, pallas=True)
+                return x + (lg[..., :1].sum() * 1e-30).astype(x.dtype), None
+            x, _ = jax.lax.scan(body, x, None, length=n)
+            return x
+        return fn, (params, jnp.ones((1, T, cfg.dim), jnp.bfloat16))
+
+    mm = dev_ms(f"matmul chain t={T}", mk_mm, N)
+    flops = T * (cfg.n_layers * (
+        cfg.dim * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim
+        + cfg.dim * cfg.n_heads * cfg.head_dim
+        + 3 * cfg.dim * cfg.hidden_dim
+    ) * 2)
+    print(f"    -> {flops/mm/1e9:.1f} TFLOP/s ({100*flops/mm/1e9/197:.1f}% MFU)")
+
+    # flash attention at t=512 over 1024-bucket cache
+    def mk_flash(n):
+        @jax.jit
+        def fn(q, kc):
+            def body(q, _):
+                def layer(q, _):
+                    a = flash_attention(q, kc, kc, jnp.int32(400))
+                    return q + a * jnp.bfloat16(1e-8), None
+                q, _ = jax.lax.scan(layer, q, None, length=cfg.n_layers)
+                return q, None
+            q, _ = jax.lax.scan(body, q, None, length=n)
+            return q
+        q = jnp.ones((1, T, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
+        kc = jnp.ones((1, 1024, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
+        return fn, (q, kc)
+
+    fl = dev_ms(f"flash attention x{cfg.n_layers} t={T}", mk_flash, N)
+
+    # single multi-row matmuls at the fused shapes
+    from distributed_llama_tpu.ops.quant import QuantTensor
+
+    for name, w in [("wqkv", params.layers.wqkv), ("w13", params.layers.w13),
+                    ("w2", params.layers.w2), ("wcls", params.wcls)]:
+        wq = w.q[0] if w.q.ndim == 4 else w.q
+        wd = w.d[0] if w.d.ndim == 3 else w.d
+        ww = QuantTensor(q=wq, d=wd)
+        def mk(n, ww=ww):
+            @jax.jit
+            def fn(ww, x):
+                def body(x, _):
+                    y = quant_matmul(x, ww, pallas=True)
+                    return x + (y[..., :1] * 1e-30).astype(x.dtype), None
+                x, _ = jax.lax.scan(body, x, None, length=n)
+                return x
+            return fn, (ww, jnp.ones((T, ww.in_features), jnp.bfloat16))
+        ms = dev_ms(f"matmul {name} {ww.in_features}x{ww.out_features} t={T}", mk, N)
+        fl2 = 2 * T * ww.in_features * ww.out_features
+        print(f"    -> {fl2/ms/1e9:.1f} TFLOP/s, {ww.q.size/ms/1e6:.0f} GB/s weights")
+
+    print(f"\nprefill t={T}: full={full:.1f} ms  matmuls={mm:.1f}  flash={fl:.1f}  "
+          f"other={full-mm-fl:.1f}")
+
+
+if __name__ == "__main__":
+    main()

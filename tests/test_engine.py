@@ -175,3 +175,49 @@ def test_graft_entry_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_generate_batch_independent_prompts(tmp_path):
+    """Two DIFFERENT prompts of different lengths in one batch must each
+    match their solo (batch=1) greedy generations — the per-row-positions
+    serving axis the reference lacks (its batch dim is prefill positions)."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    h = tiny_header(dim=64, n_layers=2, vocab_size=128, seq_len=128)
+    mp = str(tmp_path / "m.m")
+    write_tiny_model(mp, h, seed=21)
+
+    prompts = [[5, 9, 17, 3, 44, 2, 60], [7, 1]]
+    solo = []
+    for p in prompts:
+        eng1 = InferenceEngine(mp, compute_dtype="bfloat16", max_chunk=8)
+        # generate's `steps` is a position budget; slice to 12 new tokens
+        res = eng1.generate(p, len(p) + 13, sampler=None)
+        solo.append(res.tokens[len(p):][:12])
+
+    eng = InferenceEngine(mp, compute_dtype="bfloat16", batch=2, max_chunk=8)
+    got = eng.generate_batch(prompts, 12, sampler=None)
+    assert got[0] == solo[0]
+    assert got[1] == solo[1]
+
+
+def test_generate_batch_per_row_stop(tmp_path):
+    """Per-row stop: one row hits the stop token early, the other keeps
+    generating to its budget."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    h = tiny_header(dim=64, n_layers=2, vocab_size=128, seq_len=128)
+    mp = str(tmp_path / "m.m")
+    write_tiny_model(mp, h, seed=22)
+
+    eng = InferenceEngine(mp, compute_dtype="bfloat16", batch=2, max_chunk=8)
+    ref = eng.generate_batch([[5, 9, 17], [7, 1, 2, 9]], 10, sampler=None)
+    stop_tok = ref[0][2]  # row 0's third token
+    eng.reset()
+    got = eng.generate_batch(
+        [[5, 9, 17], [7, 1, 2, 9]], 10, sampler=None,
+        stop_fn=lambda r, t: t == stop_tok,
+    )
+    assert got[0] == ref[0][:3]          # row 0 stopped at its stop token
+    assert len(got[1]) >= len(got[0])    # row 1 unaffected by row 0's stop
+    assert got[1][: len(got[1])] == ref[1][: len(got[1])]

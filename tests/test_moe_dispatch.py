@@ -182,3 +182,51 @@ def test_moe_decode_i8_kernel_close_to_gather(tmp_path, monkeypatch):
     for a, b in zip(fast, ref):
         assert int(a.argmax()) == int(b.argmax())
         np.testing.assert_allclose(a, b, rtol=8e-2, atol=8e-2)
+
+
+def test_grouped_quant_kernel_matches_materialized():
+    """The grouped Pallas kernel (int8 expert stacks streamed directly,
+    interpret mode) == the dequantize+ragged_dot path, including at E=128
+    where the materialized path's [E, dim, ff] transient is what the kernel
+    exists to eliminate."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40
+    from distributed_llama_tpu.ops.activations import silu
+    from distributed_llama_tpu.ops.moe import moe_ffn_ragged, moe_router
+    from distributed_llama_tpu.ops.quant import QuantTensor, q40_to_t_layout
+
+    rng = np.random.default_rng(3)
+
+    def qstack(E, out, inf):
+        qs, ds = [], []
+        for _ in range(E):
+            w = rng.standard_normal((out, inf)).astype(np.float32) * 0.05
+            raw = quantize_q40(w)
+            q, d = unpack_q40(raw, w.size)
+            qt, dt = q40_to_t_layout(
+                q.reshape(out, inf // 32, 32), d.reshape(out, inf // 32)
+            )
+            qs.append(qt)
+            ds.append(dt)
+        return QuantTensor(q=jnp.asarray(np.stack(qs)), d=jnp.asarray(np.stack(ds)))
+
+    for E, t, k in [(8, 16, 2), (128, 8, 4)]:
+        dim, ff = 64, 128
+        w1, w3 = qstack(E, ff, dim), qstack(E, ff, dim)
+        w2 = qstack(E, dim, ff)
+        gate = jnp.asarray(rng.standard_normal((E, dim)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((1, t, dim)) * 0.1, jnp.bfloat16)
+        idx, wts = moe_router(y, gate, k)
+
+        want = moe_ffn_ragged(
+            y, idx, wts, w1, w3, w2, silu, jnp.bfloat16, pallas=False
+        )
+        got = moe_ffn_ragged(
+            y, idx, wts, w1, w3, w2, silu, jnp.bfloat16, pallas="interpret"
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=5e-2, atol=5e-2, err_msg=f"E={E}",
+        )
