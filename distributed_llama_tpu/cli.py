@@ -74,6 +74,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def make_engine(args) -> InferenceEngine:
     max_chunk = args.prefill_chunk_size if args.prefill_chunk_size > 0 else args.max_chunk
+    batch = getattr(args, "batch", 1) or 1
+    dp_axis = getattr(args, "dp", 1)
+    # an explicit batch must be compatible with the dp mesh, not silently
+    # overridden: every dp shard holds batch/dp rows
+    if batch % dp_axis != 0 and batch != 1:
+        raise ValueError(
+            f"--batch {batch} must be a multiple of --dp {dp_axis} "
+            f"(each dp shard holds batch/dp rows)"
+        )
+    batch = max(batch, dp_axis)
     mesh = None
     sp = getattr(args, "sp", 1)
     ep = getattr(args, "ep", 1)
@@ -89,7 +99,7 @@ def make_engine(args) -> InferenceEngine:
         max_seq_len=args.max_seq_len,
         max_chunk=max_chunk,
         mesh=mesh,
-        batch=max(dp, getattr(args, "batch", 1)),
+        batch=batch,
         device_decode=not getattr(args, "host_decode", False),
         verbose=True,
     )

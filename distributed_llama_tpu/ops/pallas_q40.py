@@ -96,29 +96,13 @@ def _dt_operand(dt: jnp.ndarray) -> jnp.ndarray:
     return dt
 
 
-def _kernel(x_ref, qt_ref, dt_ref, out_ref):
-    k = pl.program_id(1)
-    # dequant: f32 multiply keeps full f16-scale precision, then cast once
-    w = (qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]).astype(
-        x_ref.dtype
-    )
-    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
-    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
-
-    @pl.when(k == 0)
-    def _():
-        out_ref[...] = acc
-
-    @pl.when(k != 0)
-    def _():
-        out_ref[...] += acc
-
-
-def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
-    # identical math to _kernel — the layer offset was folded into the block
-    # index by the scalar-prefetch index_map (the stacked array arrives
-    # flattened to 3D so the blocks match the unstacked kernel exactly)
-    k = pl.program_id(1)
+def _dequant_dot_accum(k, x_ref, qt_ref, dt_ref, out_ref):
+    """Shared body of the bf16-dequant kernels: dequantize this k-step's
+    weight tile, matmul against the x tile, accumulate into out over the k
+    grid axis. Single owner of the dequant rounding choice — the unstacked,
+    stacked, and grouped kernels differ only in how their BlockSpec
+    index_maps pick the tile (plain / scalar-prefetched layer / per-row-block
+    expert), never in the math."""
     if x_ref.dtype == jnp.bfloat16:
         # dequant in bf16: the weight lands in bf16 either way (x's dtype);
         # multiplying in bf16 vs f32-then-cast differs only by one rounding
@@ -126,6 +110,7 @@ def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
             :, None, :
         ].astype(jnp.bfloat16)
     else:
+        # f32 multiply keeps full f16-scale precision, then cast once
         w = (
             qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]
         ).astype(x_ref.dtype)
@@ -139,6 +124,17 @@ def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
     @pl.when(k != 0)
     def _():
         out_ref[...] += acc
+
+
+def _kernel(x_ref, qt_ref, dt_ref, out_ref):
+    _dequant_dot_accum(pl.program_id(1), x_ref, qt_ref, dt_ref, out_ref)
+
+
+def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
+    # identical math to _kernel — the layer offset was folded into the block
+    # index by the scalar-prefetch index_map (the stacked array arrives
+    # flattened to 3D so the blocks match the unstacked kernel exactly)
+    _dequant_dot_accum(pl.program_id(1), x_ref, qt_ref, dt_ref, out_ref)
 
 
 @partial(jax.jit, static_argnames=("dtype", "interpret"))
@@ -449,25 +445,7 @@ def q40_matmul_pallas_stacked_i8(
 def _kernel_grouped(be_ref, x_ref, qt_ref, dt_ref, out_ref):
     # same dequant-matmul math as _kernel_stacked; the expert index comes
     # from the scalar-prefetched per-row-block map instead of a layer scalar
-    k = pl.program_id(2)
-    if x_ref.dtype == jnp.bfloat16:
-        w = qt_ref[...].astype(jnp.bfloat16) * _scale_f32(dt_ref[...])[
-            :, None, :
-        ].astype(jnp.bfloat16)
-    else:
-        w = (
-            qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]
-        ).astype(x_ref.dtype)
-    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
-    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
-
-    @pl.when(k == 0)
-    def _():
-        out_ref[...] = acc
-
-    @pl.when(k != 0)
-    def _():
-        out_ref[...] += acc
+    _dequant_dot_accum(pl.program_id(2), x_ref, qt_ref, dt_ref, out_ref)
 
 
 @partial(jax.jit, static_argnames=("block_r", "dtype", "interpret"))

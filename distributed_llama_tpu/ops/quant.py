@@ -171,7 +171,15 @@ def quant_matmul(
     if interpret:
         pallas = True
     if pallas is None:
-        pallas = _use_pallas()
+        # Auto mode never hands an f32 matmul to the Pallas kernels: their
+        # in-kernel dots run at the MXU's default precision (~bf16 one-pass),
+        # which silently degrades the f32 *parity* path to bf16-grade on real
+        # TPUs (measured: 5e-3 abs error on a 256x384 matmul vs 2e-7 for the
+        # XLA path with Precision.HIGHEST). The XLA fallback is exact and the
+        # parity path is not performance-critical. Explicit pallas=True /
+        # "interpret" still force the kernels (interpret mode executes them
+        # exactly, so CPU kernel tests keep their f32 references).
+        pallas = _use_pallas() and dtype != jnp.float32
     # decode-sized batches on the approximate bf16 path: the int8-MXU
     # kernel — weights hit the MXU as int8 with per-block scale combine,
     # removing the per-element VPU dequant (measured 17x on square shapes).

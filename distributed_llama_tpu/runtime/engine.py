@@ -78,6 +78,23 @@ class GenerationResult:
         return n * 1e6 / us
 
 
+def _sampler_prng_key(sampler) -> jax.Array:
+    """Device PRNG key derived from the host sampler's xorshift* state.
+
+    The state is an unsigned 64-bit value (seed 0 maps to the golden-ratio
+    constant 0x9E3779B97F4A7C15 > 2^63-1, tokenizer.py Sampler.set_seed), so
+    it must be split into 32-bit halves — `PRNGKey(int(state))` overflows
+    int64 for half the state space."""
+    state = getattr(sampler, "_state", None)
+    if state is None:
+        return jax.random.PRNGKey(0)
+    s = int(state)
+    return jax.random.wrap_key_data(
+        jnp.asarray([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], dtype=jnp.uint32),
+        impl="threefry2x32",
+    )
+
+
 def _chunk_buckets(max_chunk: int) -> list[int]:
     out = [1]
     while out[-1] < max_chunk:
@@ -351,7 +368,7 @@ class InferenceEngine:
     def generate_batch(
         self,
         prompts: list,
-        max_new_tokens: int,
+        max_new_tokens,  # int (shared) or list[int] (per row)
         sampler: Sampler | None = None,
         on_token=None,  # on_token(row, token) as tokens arrive
         stop_fn=None,  # stop_fn(row, token) -> bool, per row
@@ -368,6 +385,12 @@ class InferenceEngine:
         generated-token lists (stop token included, as `generate` does).
         Requires len(prompts) == self.batch and the non-pipeline path
         (per-row positions on pp/sp meshes are future work).
+
+        `max_new_tokens` may be per-row: each row's budget is bounded by ITS
+        OWN prompt length against seq_len, so a short prompt co-batched with
+        a long one keeps its full budget (rows that finish keep riding the
+        chunk loop; their cache writes land past their budget and their
+        tokens are discarded host-side).
         """
         if self.use_pipeline:
             raise ValueError("generate_batch requires a non-pipeline engine")
@@ -376,8 +399,18 @@ class InferenceEngine:
         if any(len(p) == 0 for p in prompts):
             raise ValueError("empty prompt")
         lens = [len(p) for p in prompts]
-        if max(lens) + max_new_tokens > self.cfg.seq_len:
-            raise ValueError("prompt + budget exceeds the sequence length")
+        if isinstance(max_new_tokens, int):
+            budgets = [max_new_tokens] * self.batch
+        else:
+            budgets = list(max_new_tokens)
+            if len(budgets) != self.batch:
+                raise ValueError("per-row budgets must match the batch size")
+        for r in range(self.batch):
+            if lens[r] + budgets[r] > self.cfg.seq_len:
+                raise ValueError(
+                    f"row {r}: prompt ({lens[r]}) + budget ({budgets[r]}) "
+                    f"exceeds the sequence length ({self.cfg.seq_len})"
+                )
 
         from .decode import decode_chunk
 
@@ -400,21 +433,37 @@ class InferenceEngine:
 
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9
-        seed = getattr(sampler, "_state", None)
-        key = jax.random.PRNGKey(int(seed) if seed is not None else 0)
+        key = _sampler_prng_key(sampler)
 
         pos = jnp.asarray([l - 1 for l in lens], jnp.int32)  # [b]
         token = jnp.asarray([p[-1] for p in prompts], jnp.int32)
         done = [False] * self.batch
         out: list[list[int]] = [[] for _ in range(self.batch)]
         produced = 0
-        while produced < max_new_tokens and not all(done):
-            n = self.decode_chunk_size
-            while n > max_new_tokens - produced:
+
+        def remaining() -> int:
+            return max(
+                (budgets[r] - len(out[r]) for r in range(self.batch) if not done[r]),
+                default=0,
+            )
+
+        while remaining() > 0:
+            # same TTFT ramp as _decode_device: a small first chunk gets the
+            # first tokens of every row to the host (and its SSE clients)
+            # after ~8 steps instead of a full decode_chunk_size
+            n = min(8, self.decode_chunk_size) if produced == 0 else self.decode_chunk_size
+            while n > remaining():
                 n //= 2
             n = max(n, 1)
             key, sub = jax.random.split(key)
-            max_end = max(lens) + produced + n
+            # kv bucket covers the furthest position any ACTIVE row reaches
+            # this chunk (finished rows still step, but their output is
+            # discarded and their trailing cache writes are never read)
+            max_end = min(
+                max(lens[r] + len(out[r]) for r in range(self.batch) if not done[r])
+                + n,
+                self.cfg.seq_len,
+            )
             toks, self.cache = decode_chunk(
                 self.cfg, self.params, self.rope, self.cache, token,
                 pos, sub, n_steps=n, temperature=temperature, topp=topp,
@@ -424,13 +473,16 @@ class InferenceEngine:
                 host = np.asarray(toks)  # [b, n]
             for j in range(n):
                 for r in range(self.batch):
-                    if done[r]:
+                    if done[r] or len(out[r]) >= budgets[r]:
+                        done[r] = True
                         continue
                     tkn = int(host[r, j])
                     out[r].append(tkn)
                     if on_token is not None:
                         on_token(r, tkn)
                     if stop_fn is not None and stop_fn(r, tkn):
+                        done[r] = True
+                    elif len(out[r]) >= budgets[r]:
                         done[r] = True
             token = toks[:, -1]
             pos = pos + n
@@ -474,8 +526,7 @@ class InferenceEngine:
 
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9
-        seed = getattr(sampler, "_state", None)
-        key = [jax.random.PRNGKey(int(seed) if seed is not None else 0)]
+        key = [_sampler_prng_key(sampler)]
 
         def dispatch(at_pos, tok_arr, chunk=None):
             """Queue one device chunk (async); returns (tokens_device, n)."""

@@ -162,22 +162,38 @@ class Batcher:
             first = held if held is not None else self.q.get()
             held = None
             batch = [first]
-            deadline = _time.monotonic() + self.window_s
-            while len(batch) < self.state.engine.batch:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    break
+            # An explicitly seeded request always runs alone: its sampled
+            # stream depends on its batch row and on co-batched rows' chunk
+            # schedule, so sharing a round would silently break seed
+            # reproducibility even between requests with EQUAL seeds.
+            deadline = None
+            while first.seed is None and len(batch) < self.state.engine.batch:
                 try:
-                    nxt = self.q.get(timeout=remaining)
+                    if deadline is None:
+                        # no idle-window penalty: a lone request starts its
+                        # round immediately; the window opens only once a
+                        # second request proves there IS concurrency (and
+                        # requests arriving mid-round batch naturally into
+                        # the next one)
+                        nxt = self.q.get_nowait()
+                    else:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            break
+                        nxt = self.q.get(timeout=remaining)
                 except queue.Empty:
                     break
-                # rows share one sampler, so only requests with identical
-                # sampling settings may share a round; an incompatible
-                # request seeds the next round instead
-                if (nxt.temperature, nxt.topp) != (first.temperature, first.topp):
+                # rows share one sampler, so only unseeded requests with
+                # identical sampling settings may share a round; anything
+                # else seeds the next round instead
+                if nxt.seed is not None or (nxt.temperature, nxt.topp) != (
+                    first.temperature, first.topp
+                ):
                     held = nxt
                     break
                 batch.append(nxt)
+                if deadline is None:
+                    deadline = _time.monotonic() + self.window_s
             self._run(batch)
 
     def _run(self, batch):
@@ -187,10 +203,13 @@ class Batcher:
             prompts = [r.ids for r in batch]
             while len(prompts) < engine.batch:
                 prompts.append([1])  # dummy row; stops after one token
-            # one shared step budget: the largest request's, clamped so the
-            # longest prompt still fits the context window
-            budget = max(r.max_new for r in batch)
-            budget = max(1, min(budget, engine.cfg.seq_len - max(len(p) for p in prompts)))
+            # per-row budgets: each request's max_new clamped by ITS OWN
+            # prompt against the context window, so a short prompt co-batched
+            # with a long one keeps its full budget; dummy rows get 1
+            budget = [
+                max(1, min(r.max_new, engine.cfg.seq_len - len(r.ids)))
+                for r in batch
+            ] + [1] * (engine.batch - len(batch))
             sampler = self.state.sampler
             sampler.set_temp(batch[0].temperature)
             sampler.topp = batch[0].topp
@@ -265,6 +284,14 @@ class ApiState:
         self.batcher = (
             Batcher(self) if engine.batch > 1 and not engine.use_pipeline else None
         )
+        if self.batcher is not None and getattr(args, "host_decode", False):
+            # generate_batch only has the device decode path; silently
+            # dropping the requested bit-parity host sampler would be worse
+            # than refusing to start
+            raise ValueError(
+                "--host-decode is incompatible with --batch > 1 "
+                "(batched serving samples on-device); drop one of the flags"
+            )
 
     def complete_batched(self, params: dict, emit):
         """One request's slice of a batched generation: encode, submit to the

@@ -221,3 +221,42 @@ def test_generate_batch_per_row_stop(tmp_path):
     assert got[0] == ref[0][:3]          # row 0 stopped at its stop token
     assert len(got[1]) >= len(got[0])    # row 1 unaffected by row 0's stop
     assert got[1][: len(got[1])] == ref[1][: len(got[1])]
+
+
+def test_generate_batch_per_row_budgets(tmp_path):
+    """A short prompt co-batched with a long one keeps its OWN budget:
+    each row's limit is bounded by its own prompt length against seq_len,
+    not by the longest prompt in the batch."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    h = tiny_header(dim=64, n_layers=2, vocab_size=128, seq_len=64)
+    mp = str(tmp_path / "m.m")
+    write_tiny_model(mp, h, seed=23)
+
+    long_p = list(range(2, 50))  # 48 tokens: only 16 headroom for THIS row
+    short_p = [5, 9]             # 2 tokens: 62 headroom
+    eng = InferenceEngine(mp, compute_dtype="bfloat16", batch=2, max_chunk=16)
+    got = eng.generate_batch([short_p, long_p], [40, 16], sampler=None)
+    assert len(got[0]) == 40, "short row truncated to the long row's headroom"
+    assert len(got[1]) == 16
+
+    # the short row's tokens must match its solo run (the long row riding
+    # past its own budget must not corrupt the short row's stream)
+    eng1 = InferenceEngine(mp, compute_dtype="bfloat16", max_chunk=16)
+    solo = eng1.generate(short_p, len(short_p) + 41, sampler=None)
+    assert got[0] == solo.tokens[len(short_p):][:40]
+
+
+def test_generate_batch_seed_zero(tmp_path):
+    """Sampler seed 0 maps to a 64-bit state above int63 — the PRNG key
+    derivation must not overflow (regression: OverflowError in PRNGKey)."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.tokenizer import Sampler
+
+    h = tiny_header(dim=64, n_layers=2, vocab_size=128, seq_len=64)
+    mp = str(tmp_path / "m.m")
+    write_tiny_model(mp, h, seed=24)
+    eng = InferenceEngine(mp, compute_dtype="bfloat16", batch=2, max_chunk=8)
+    sampler = Sampler(128, 0.8, 0.9, 0)
+    got = eng.generate_batch([[5, 9], [7, 1]], 8, sampler=sampler)
+    assert len(got[0]) == 8 and len(got[1]) == 8

@@ -213,9 +213,17 @@ def test_grouped_quant_kernel_matches_materialized():
         return QuantTensor(q=jnp.asarray(np.stack(qs)), d=jnp.asarray(np.stack(ds)))
 
     for E, t, k in [(8, 16, 2), (128, 8, 4)]:
-        dim, ff = 64, 128
+        # dim/ff must satisfy the stacked-kernel alignment gate (nb % 8,
+        # out % 128) or _grouped_quant_eligible silently falls back to the
+        # materialized path and this test compares that path to itself
+        dim, ff = 256, 256
         w1, w3 = qstack(E, ff, dim), qstack(E, ff, dim)
         w2 = qstack(E, dim, ff)
+        from distributed_llama_tpu.ops.moe import _grouped_quant_eligible
+
+        assert _grouped_quant_eligible(
+            w1, w3, w2, jnp.bfloat16, False, "interpret"
+        ), "test shapes no longer reach the grouped kernel"
         gate = jnp.asarray(rng.standard_normal((E, dim)), jnp.float32)
         y = jnp.asarray(rng.standard_normal((1, t, dim)) * 0.1, jnp.bfloat16)
         idx, wts = moe_router(y, gate, k)
