@@ -90,7 +90,7 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     if long_n > prefill_tokens:
         def prefill_wall(n):
             best = float("inf")
-            for _ in range(2):
+            for _ in range(3):
                 eng.reset()
                 t0 = time.perf_counter()
                 eng.prefill([(i % 1000) + 1 for i in range(n)])
@@ -99,7 +99,9 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
         prefill_wall(long_n)  # compile the extra chunk shapes
         t_long = prefill_wall(long_n)
         t_short = prefill_wall(prefill_tokens)
-        if t_long > t_short:
+        # the difference must clear the tunnel's dispatch jitter or the
+        # quotient is noise (observed: a 2.4k tok/s config reporting 4M)
+        if t_long - t_short > 0.02:
             marginal = (long_n - prefill_tokens) / (t_long - t_short)
     return decode_tok_s, prefill_tok_s, res.ttft_us / 1e3, marginal, eng
 
