@@ -84,26 +84,38 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     decode_tok_s = 1e6 / per_tok_us
     prefill_tok_s = res.eval_tok_per_s
 
+    # TTFT as a streaming client sees it: on_token enables the engine's
+    # first-chunk ramp (chunk of 8), which non-streaming runs skip to keep
+    # full decode chunks. Run twice: first compiles the ramp chunk shape.
+    sink = lambda t: None  # noqa: E731
+    for _ in range(2):
+        eng.reset()
+        res_stream = eng.generate(prompt, prefill_tokens + 16, sampler=None, on_token=sink)
+    ttft_ms = res_stream.ttft_us / 1e3
+
     # marginal prefill rate: difference long vs short prompt walls
     long_n = min(3 * prefill_tokens, eng.cfg.seq_len - 64)
     marginal = None
     if long_n > prefill_tokens:
         def prefill_wall(n):
-            best = float("inf")
+            walls = []
             for _ in range(3):
                 eng.reset()
                 t0 = time.perf_counter()
                 eng.prefill([(i % 1000) + 1 for i in range(n)])
-                best = min(best, time.perf_counter() - t0)
-            return best
+                walls.append(time.perf_counter() - t0)
+            return min(walls), max(walls) - min(walls)
         prefill_wall(long_n)  # compile the extra chunk shapes
-        t_long = prefill_wall(long_n)
-        t_short = prefill_wall(prefill_tokens)
-        # the difference must clear the tunnel's dispatch jitter or the
-        # quotient is noise (observed: a 2.4k tok/s config reporting 4M)
-        if t_long - t_short > 0.02:
+        t_long, spread_long = prefill_wall(long_n)
+        t_short, spread_short = prefill_wall(prefill_tokens)
+        # the difference must clear the observed run-to-run jitter or the
+        # quotient is noise (observed: a 2.4k tok/s config reporting 4M
+        # through the tunnel's ~10-30 ms dispatch variance); the floor is
+        # jitter-RELATIVE so fast direct-attached hardware, where the
+        # measurement is clean and small, still reports
+        if t_long - t_short > max(0.002, spread_long + spread_short):
             marginal = (long_n - prefill_tokens) / (t_long - t_short)
-    return decode_tok_s, prefill_tok_s, res.ttft_us / 1e3, marginal, eng
+    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, eng
 
 
 def leg_8b():
@@ -117,7 +129,18 @@ def leg_8b():
         dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
         head_dim=128, vocab_size=128256, seq_len=2048,
     )
-    decode, prefill, ttft, marginal, eng = measure(path, 512, 128)
+    # the 8B prefill graph's first remote compile has been observed anywhere
+    # from ~60 s to >600 s depending on the tunnel's day — don't let the
+    # stall watchdog's default hard timeout kill an otherwise-healthy leg
+    prev = os.environ.get("DLT_STALL_TIMEOUT_MS")
+    os.environ.setdefault("DLT_STALL_TIMEOUT_MS", "1800000")
+    try:
+        decode, prefill, ttft, marginal, eng = measure(path, 512, 128)
+    finally:
+        if prev is None:
+            os.environ.pop("DLT_STALL_TIMEOUT_MS", None)
+        else:
+            os.environ["DLT_STALL_TIMEOUT_MS"] = prev
     # bytes per decoded token: all layer weights + wcls, int8 + f16 scales
     n_w = 32 * (4096 * (4096 + 1024 + 1024 + 4096) + 3 * 4096 * 14336) + 4096 * 128256
     bytes_tok = n_w * (1 + 2 / 32)

@@ -293,9 +293,17 @@ def _quantize_rows_q80(x2: jnp.ndarray, nb: int):
     inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
     x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
     scale16 = scale.astype(jnp.float16).astype(jnp.float32)  # [R, nb, 1]
-    xs = jnp.broadcast_to(
-        jnp.transpose(scale16, (1, 0, 2)), (nb, R, 128)
-    ).reshape(nb, R * 128)
+    if R == 1:
+        # hot decode path: a [nb, 1] -> [nb, 128] broadcast. The general
+        # formulation below goes through a 3D transpose that XLA lowers to a
+        # relayout copy costing ~16 us PER MATMUL CALL on v5e — 3x the whole
+        # kernel at the square decode shapes (caught by a 410 -> 177 tok/s
+        # regression in the round-3 bench; scripts/kernel_lab.py reproduces)
+        xs = jnp.broadcast_to(scale16.reshape(nb, 1), (nb, 128))
+    else:
+        xs = jnp.broadcast_to(
+            jnp.transpose(scale16, (1, 0, 2)), (nb, R, 128)
+        ).reshape(nb, R * 128)
     return x8.reshape(R, nb * Q_BLOCK), xs
 
 

@@ -448,10 +448,11 @@ class InferenceEngine:
             )
 
         while remaining() > 0:
-            # same TTFT ramp as _decode_device: a small first chunk gets the
-            # first tokens of every row to the host (and its SSE clients)
-            # after ~8 steps instead of a full decode_chunk_size
-            n = min(8, self.decode_chunk_size) if produced == 0 else self.decode_chunk_size
+            # same TTFT ramp as _decode_device (and same caveat: only when a
+            # streaming consumer exists — the small first chunk fragments a
+            # fixed budget's chunk ladder and each chunk pays a dispatch)
+            ramp = produced == 0 and on_token is not None
+            n = min(8, self.decode_chunk_size) if ramp else self.decode_chunk_size
             while n > remaining():
                 n //= 2
             n = max(n, 1)
@@ -562,11 +563,16 @@ class InferenceEngine:
         # ~tens-of-ms device->host transfer overlaps the next chunk's compute
         first = True
         t_prev = time.perf_counter()
-        # TTFT ramp: the first chunk is small (8) so the first tokens reach
-        # the host after ~8 decode steps instead of a full chunk; steady
-        # state continues at decode_chunk_size (the lookahead hides the
-        # extra dispatch). Worth ~100 ms of TTFT on the 1B, ~800 ms on 8B.
-        first_chunk = min(8, self.decode_chunk_size)
+        # TTFT ramp — only when a consumer is streaming (on_token): the first
+        # chunk is small (8) so the first tokens reach the host after ~8
+        # decode steps instead of a full chunk (~100 ms of TTFT on the 1B,
+        # ~800 ms on 8B). The ramp is NOT free: it de-aligns the remaining
+        # budget from the power-of-two chunk ladder, so a fixed budget decays
+        # into a fragmented tail (8+64+32+16+8 instead of 64+64) and every
+        # extra chunk pays a ~70-90 ms tunnel dispatch — a 2x throughput hit
+        # on short fixed-budget runs (caught by the round-3 bench). Without a
+        # streaming consumer, TTFT is unobservable; keep full chunks.
+        first_chunk = min(8, self.decode_chunk_size) if on_token is not None else None
         pending = dispatch(
             pos, jnp.full((self.batch,), token, dtype=jnp.int32), chunk=first_chunk
         )
