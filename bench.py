@@ -35,6 +35,13 @@ import time
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 BASELINE_TOK_S = 26.4  # reference PP=4 best (see module docstring)
 
+# persistent XLA compile cache: first compiles of the big prefill graphs
+# cost 30 s - many minutes through the tunnel; cache them across bench runs
+os.environ.setdefault(
+    "DLT_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 
 def build_model(name: str, **kw) -> str:
     os.makedirs(CACHE_DIR, exist_ok=True)
