@@ -238,3 +238,68 @@ def test_grouped_quant_kernel_matches_materialized():
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=5e-2, atol=5e-2, err_msg=f"E={E}",
         )
+
+
+def test_grouped_quant_kernel_under_ep():
+    """The grouped kernel composed with expert parallelism: an ep=2
+    shard_map (each shard holds E/2 experts + the zero boundary groups,
+    interpret-mode kernels inside) must match the unsharded materialized
+    path. This is the production MoE prefill configuration on a real mesh —
+    the engine-level ep tests run f32 parity mode and never reach the
+    kernel."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40
+    from distributed_llama_tpu.ops.activations import silu
+    from distributed_llama_tpu.ops.moe import _grouped_quant_eligible
+    from distributed_llama_tpu.ops.quant import QuantTensor, q40_to_t_layout
+
+    rng = np.random.default_rng(5)
+    E, t, k, dim, ff = 8, 16, 2, 256, 256
+
+    def qstack(E, out, inf):
+        qs, ds = [], []
+        for _ in range(E):
+            w = rng.standard_normal((out, inf)).astype(np.float32) * 0.05
+            raw = quantize_q40(w)
+            q, d = unpack_q40(raw, w.size)
+            qt, dt = q40_to_t_layout(
+                q.reshape(out, inf // 32, 32), d.reshape(out, inf // 32)
+            )
+            qs.append(qt)
+            ds.append(dt)
+        return QuantTensor(q=jnp.asarray(np.stack(qs)), d=jnp.asarray(np.stack(ds)))
+
+    w1, w3 = qstack(E, ff, dim), qstack(E, ff, dim)
+    w2 = qstack(E, dim, ff)
+    assert _grouped_quant_eligible(w1, w3, w2, jnp.bfloat16, False, "interpret")
+    gate = jnp.asarray(rng.standard_normal((E, dim)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((1, t, dim)) * 0.1, jnp.bfloat16)
+    idx, wts = moe_router(y, gate, k)
+
+    want = moe_ffn_ragged(y, idx, wts, w1, w3, w2, silu, jnp.bfloat16, pallas=False)
+
+    mesh = make_mesh(ep=2)
+    espec = QuantTensor(q=P("ep"), d=P("ep"))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), espec, espec, espec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def sharded(y_, idx_, wts_, w1_, w3_, w2_):
+        return moe_ffn_ragged(
+            y_, idx_, wts_, w1_, w3_, w2_, silu, jnp.bfloat16,
+            ep_axis="ep", pallas="interpret",
+        )
+
+    got = sharded(y, idx, wts, w1, w3, w2)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
