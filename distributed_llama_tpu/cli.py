@@ -57,6 +57,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="engine batch rows (independent per-row sequences; the API "
         "server batches concurrent requests into them)",
     )
+    # multi-host (pod) launch — the reference's `--workers host:port ...`
+    # analogue. Every host runs the SAME command (multi-controller SPMD);
+    # these wire jax.distributed.initialize, after which the mesh axes
+    # below span ALL hosts' chips. On TPU pod slices with the platform's
+    # metadata available, a bare --distributed suffices (docs/DISTRIBUTED.md).
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="initialize the multi-controller runtime (TPU pod metadata "
+        "supplies coordinator/process ids; otherwise pass the flags below)",
+    )
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordinator address (process 0's reachable address)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument(
         "--host-decode", action="store_true",
         help="per-token host decode loop (bit-parity RNG with the reference; "
@@ -88,7 +102,22 @@ def make_engine(args) -> InferenceEngine:
     sp = getattr(args, "sp", 1)
     ep = getattr(args, "ep", 1)
     dp = getattr(args, "dp", 1)
-    if args.tp > 1 or args.pp > 1 or sp > 1 or ep > 1 or dp > 1:
+    distributed = getattr(args, "distributed", False) or getattr(args, "coordinator", None)
+    if distributed:
+        # must run before anything initializes the local backend; after it,
+        # jax.devices() is the GLOBAL device set and the mesh spans hosts
+        from .parallel.multihost import initialize_distributed, make_multihost_mesh
+
+        initialize_distributed(
+            coordinator_address=getattr(args, "coordinator", None),
+            num_processes=getattr(args, "num_processes", None),
+            process_id=getattr(args, "process_id", None),
+        )
+        # bare --distributed with no axis flags = TP over every chip in the
+        # pod (tp=0 means "all remaining devices" to make_multihost_mesh)
+        tp = 0 if (args.tp == 1 and args.pp == 1 and sp == ep == dp == 1) else args.tp
+        mesh = make_multihost_mesh(tp=tp, pp=args.pp, sp=sp, ep=ep, dp=dp)
+    elif args.tp > 1 or args.pp > 1 or sp > 1 or ep > 1 or dp > 1:
         from .parallel import make_mesh
 
         mesh = make_mesh(tp=args.tp, pp=args.pp, sp=sp, ep=ep, dp=dp)

@@ -154,3 +154,32 @@ def test_engine_tp_pipeline_runs_fused_kernel(tmp_path, monkeypatch):
     got = eng.generate([3, 17, 99, 4], 10, sampler=None).tokens
     assert got == want
     assert calls["n"] > 0, "fused Pallas kernel was never selected"
+
+
+def test_cli_distributed_flags_build_multihost_mesh(tmp_path):
+    """--distributed wires parallel/multihost through make_engine: on a
+    single process it must no-op the runtime init, span the (virtual) device
+    set with tp=all-chips by default, and generate identically to the
+    explicit-mesh engine. (A real pod exercises the same code with
+    jax.distributed wired by the platform — untestable here.)"""
+    from distributed_llama_tpu.cli import build_arg_parser, make_engine
+
+    from distributed_llama_tpu.parallel.multihost import make_multihost_mesh
+
+    # bare --distributed defaults to TP over every chip
+    assert make_multihost_mesh().shape["tp"] == 8
+
+    path = _model(tmp_path)
+    p = build_arg_parser()
+    args = p.parse_args(
+        ["inference", "--model", path, "--tokenizer", "unused",
+         "--distributed", "--tp", "4", "--pp", "2", "--compute-dtype", "float32"]
+    )
+    eng = make_engine(args)
+    assert eng.mesh is not None and eng.mesh.devices.size == 8
+    assert eng.mesh.shape["tp"] == 4 and eng.mesh.shape["pp"] == 2
+
+    solo = InferenceEngine(path, compute_dtype="float32")
+    want = solo.generate([3, 17, 99, 4], 16, sampler=None).tokens
+    got = eng.generate([3, 17, 99, 4], 16, sampler=None).tokens
+    assert got == want
