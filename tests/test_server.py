@@ -368,3 +368,33 @@ def test_concurrent_requests_are_batched(batched_api_server):
         assert out[i]["usage"]["completion_tokens"] > 0
         assert out[i]["choices"][0]["message"]["content"] == \
             solo[i]["choices"][0]["message"]["content"], f"request {i}"
+
+
+def test_seeded_requests_stay_reproducible_under_concurrency(batched_api_server):
+    """Explicitly seeded sampling requests must return the same completion
+    whether sent alone or racing another request: the Batcher runs seeded
+    requests in their own rounds (a shared round would sample them from
+    row-dependent slices of one PRNG stream)."""
+    port = batched_api_server
+
+    def ask(body, out, i):
+        with _post(port, body) as r:
+            out[i] = json.loads(r.read())
+
+    body = lambda text: {
+        "messages": [{"role": "user", "content": text}],
+        "max_tokens": 6, "temperature": 0.9, "seed": 42,
+    }
+    solo = [None, None]
+    ask(body("alpha"), solo, 0)
+    ask(body("bravo two"), solo, 1)
+
+    out = [None, None]
+    t1 = threading.Thread(target=ask, args=(body("alpha"), out, 0))
+    t2 = threading.Thread(target=ask, args=(body("bravo two"), out, 1))
+    t1.start(); t2.start()
+    t1.join(timeout=120); t2.join(timeout=120)
+    assert out[0] is not None and out[1] is not None
+    for i in (0, 1):
+        assert out[i]["choices"][0]["message"]["content"] == \
+            solo[i]["choices"][0]["message"]["content"], f"request {i}"
