@@ -34,9 +34,9 @@ from .tokenizer import (
 
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="distributed_llama_tpu")
-    p.add_argument("mode", choices=["inference", "chat", "perplexity"])
-    p.add_argument("--model", required=True)
-    p.add_argument("--tokenizer", required=True)
+    p.add_argument("mode", choices=["inference", "chat", "perplexity", "worker"])
+    p.add_argument("--model", required=False, default=None)
+    p.add_argument("--tokenizer", required=False, default=None)
     p.add_argument("--prompt", default=None)
     p.add_argument("--steps", type=int, default=0)
     p.add_argument("--max-seq-len", type=int, default=0)
@@ -319,7 +319,29 @@ def run_chat(args) -> int:
 
 
 def main(argv=None) -> int:
-    args = build_arg_parser().parse_args(argv)
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    if raw[:1] == ["worker"]:
+        # the reference's cluster model (root + `dllama worker --port N`
+        # processes, src/app.cpp:425-489) has no analogue here:
+        # multi-controller SPMD runs the SAME command on every host. Greet
+        # migrating scripts with the mapping instead of an argparse error
+        # (short-circuited before parsing so the reference's worker flags
+        # don't get in the way).
+        print(
+            "this framework has no worker processes: multi-chip/multi-host "
+            "execution runs the SAME command on every host.\n"
+            "  reference:  dllama inference --workers h1:port h2:port ...\n"
+            "  here:       <same inference command> --tp N      (one host)\n"
+            "              <same inference command> --distributed "
+            "--coordinator h0:port --num-processes P --process-id i  (pod)\n"
+            "see docs/DISTRIBUTED.md",
+            file=sys.stderr,
+        )
+        return 2
+    args = build_arg_parser().parse_args(raw)
+    if args.model is None or args.tokenizer is None:
+        print("--model and --tokenizer are required", file=sys.stderr)
+        return 2
     if args.mode == "inference":
         return run_inference(args)
     if args.mode == "perplexity":

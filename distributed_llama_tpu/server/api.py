@@ -625,6 +625,8 @@ def main(argv=None) -> int:
     # mode positional comes from the shared parser; default it away
     argv = ["inference"] + (argv if argv is not None else __import__("sys").argv[1:])
     args = p.parse_args(argv)
+    if args.model is None or args.tokenizer is None:
+        p.error("--model and --tokenizer are required")
     # auto-restart outer loop (reference: dllama-api.cpp:624-636 rebuilds the
     # whole server every 3 s after a crash). Per-request engine failures are
     # already absorbed by ApiState.recover() + a 500 response; this loop is
