@@ -372,13 +372,22 @@ def _layer(
             )
         else:
             # per-row sequences (independent prompts per batch row): each
-            # row writes at its own position — a vmapped update-slice over
-            # the batch axis (cheap at decode t=1)
-            def row_update(c, u, p):
-                return jax.lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
-
-            k_cache = jax.vmap(row_update)(k_cache, k.astype(k_cache.dtype), pos_start)
-            v_cache = jax.vmap(row_update)(v_cache, v.astype(v_cache.dtype), pos_start)
+            # row writes at its own positions — a scatter with OOB-DROP
+            # semantics, not a clamping dynamic_update_slice. The drop is
+            # load-bearing: a row whose positions reach seq_len writes
+            # NOTHING, so finished rows can keep riding decode chunks
+            # (generate_batch) and rolling admission can "park" a row at
+            # pos_start = seq_len, both without disturbing the row's live
+            # cache tail. Indices are pos_start + arange per row — strictly
+            # increasing, hence unique; all are >= 0 so none wrap before the
+            # drop applies.
+            b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+            k_cache = k_cache.at[b_idx, positions].set(
+                k.astype(k_cache.dtype), mode="drop", unique_indices=True
+            )
+            v_cache = v_cache.at[b_idx, positions].set(
+                v.astype(v_cache.dtype), mode="drop", unique_indices=True
+            )
         if kv_len is not None and kv_len < k_cache.shape[1]:
             k_view = jax.lax.slice_in_dim(k_cache, 0, kv_len, axis=1)
             v_view = jax.lax.slice_in_dim(v_cache, 0, kv_len, axis=1)
@@ -413,6 +422,9 @@ def _layer(
             k_view, v_view = k_cache, v_cache
         if (
             _pallas_enabled(cfg)
+            and jnp.ndim(pos_start) == 0  # flash's causal math assumes one
+            # scalar chunk start (same gate as _attention_auto); per-row
+            # prefill chunks take the masked einsum below
             and k_view.dtype == jnp.bfloat16
             and flash_attention_aligned(q, k_view, t)
         ):

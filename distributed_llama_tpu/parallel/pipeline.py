@@ -119,7 +119,7 @@ def pipeline_forward(
     rope: RopeTables,
     cache: KVCache,
     tokens: jnp.ndarray,  # [b, t]
-    pos_start,  # scalar int32
+    pos_start,  # scalar int32, or [b] for independent per-row sequences
     logits_mode: str = "last",
     microbatches: int = 1,
     kv_len: int | None = None,  # static GLOBAL KV read bound
@@ -141,10 +141,11 @@ def pipeline_forward(
             f"microbatches ({microbatches}) must divide the token length "
             f"({jnp.shape(tokens)[-1]})"
         )
+    per_row = jnp.ndim(pos_start) > 0
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches, kv_len),
+        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches, kv_len, per_row),
         lambda ps, cs: _build_pipeline_fn(
-            cfg, mesh, ps, cs, logits_mode, microbatches, kv_len
+            cfg, mesh, ps, cs, logits_mode, microbatches, kv_len, per_row=per_row
         ),
     )
     return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
@@ -197,10 +198,16 @@ def _stage_rounds(
     Microbatch m enters stage 0 in round m; stage s processes it in round
     m+s; total rounds = n_micro + pp - 1. Each device carries one in-flight
     activation slot `x`.
+
+    `pos_start` may be a scalar (all rows aligned — the single-sequence
+    path) or a [b] vector (independent per-row sequences — batched serving
+    on meshes). The vector path routes the cache writes through `_layer`'s
+    OOB-drop scatter, so a row parked at pos seq_len writes nothing.
     """
     pp_rank = jax.lax.axis_index("pp")
     b, t, _ = x_all.shape
     mt = t // n_micro
+    per_row = jnp.ndim(pos_start) > 0
 
     x = jnp.zeros((b, mt, cfg.dim), jnp.float32)
     done = []
@@ -211,7 +218,8 @@ def _stage_rounds(
             x = jnp.where(pp_rank == 0, x_in, x)
         mb_idx = r - pp_rank  # which microbatch this stage holds this round
         pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
-        positions = pos0 + jnp.arange(mt, dtype=jnp.int32)[None, :]
+        off = jnp.arange(mt, dtype=jnp.int32)
+        positions = (pos0[:, None] + off[None, :]) if per_row else (pos0 + off[None, :])
         positions = jnp.broadcast_to(positions, (b, mt))
 
         y, k_upd, v_upd = _local_stage(
@@ -224,12 +232,28 @@ def _stage_rounds(
         # round, per token, on decode)
         active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
         if sp_ctx is None:
+            if per_row:
+                # per-row windows: each row's [pos0_r, pos0_r+mt) slice may
+                # start anywhere, so vmap the window select over the batch
+                # axis (cache axis 1). A parked row's pos0 clamps into the
+                # tail here, but _layer's drop-scatter left upd == full for
+                # it, so the re-write is an identity.
+                def commit(full, upd):
+                    def row(fr, ur, p):  # [L, S, h, d]
+                        new_win = jax.lax.dynamic_slice_in_dim(ur, p, mt, axis=1)
+                        old_win = jax.lax.dynamic_slice_in_dim(fr, p, mt, axis=1)
+                        win = jnp.where(active, new_win, old_win)
+                        return jax.lax.dynamic_update_slice_in_dim(fr, win, p, axis=1)
 
-            def commit(full, upd):
-                new_win = jax.lax.dynamic_slice_in_dim(upd, pos0, mt, axis=2)
-                old_win = jax.lax.dynamic_slice_in_dim(full, pos0, mt, axis=2)
-                win = jnp.where(active, new_win, old_win)
-                return jax.lax.dynamic_update_slice_in_dim(full, win, pos0, axis=2)
+                    return jax.vmap(row, in_axes=(1, 1, 0), out_axes=1)(full, upd, pos0)
+
+            else:
+
+                def commit(full, upd):
+                    new_win = jax.lax.dynamic_slice_in_dim(upd, pos0, mt, axis=2)
+                    old_win = jax.lax.dynamic_slice_in_dim(full, pos0, mt, axis=2)
+                    win = jnp.where(active, new_win, old_win)
+                    return jax.lax.dynamic_update_slice_in_dim(full, win, pos0, axis=2)
 
             k_cache = commit(k_cache, k_upd)
             v_cache = commit(v_cache, v_upd)
@@ -262,7 +286,8 @@ def _logits_of(cfg, params, x_out):
 
 
 def _build_pipeline_fn(
-    cfg, mesh, params_spec, cache_spec, logits_mode, microbatches, kv_len=None
+    cfg, mesh, params_spec, cache_spec, logits_mode, microbatches, kv_len=None,
+    per_row=False,
 ):
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
@@ -271,7 +296,10 @@ def _build_pipeline_fn(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(params_spec, rope_spec, cache_spec, P("dp", None), P()),
+        in_specs=(
+            params_spec, rope_spec, cache_spec, P("dp", None),
+            P("dp") if per_row else P(),
+        ),
         out_specs=(logits_spec, cache_spec),
         check_vma=False,
     )
@@ -297,7 +325,7 @@ def pipeline_decode_chunk(
     rope: RopeTables,
     cache: KVCache,
     token: jnp.ndarray,  # [b] int32 — the token to feed first
-    pos_start,  # scalar int32
+    pos_start,  # scalar int32, or [b] for independent per-row sequences
     key: jnp.ndarray,
     n_steps: int = 16,
     temperature: float = 0.0,
@@ -312,10 +340,12 @@ def pipeline_decode_chunk(
 
     Returns (tokens [b, n_steps], cache).
     """
+    per_row = jnp.ndim(pos_start) > 0
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("decode", n_steps, temperature, topp, kv_len),
+        cfg, mesh, params, cache,
+        ("decode", n_steps, temperature, topp, kv_len, per_row),
         lambda ps, cs: _build_pipeline_decode_fn(
-            cfg, mesh, ps, cs, n_steps, temperature, topp, kv_len
+            cfg, mesh, ps, cs, n_steps, temperature, topp, kv_len, per_row=per_row
         ),
     )
     return fn(
@@ -325,7 +355,8 @@ def pipeline_decode_chunk(
 
 
 def _build_pipeline_decode_fn(
-    cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp, kv_len=None
+    cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp, kv_len=None,
+    per_row=False,
 ):
     from ..ops.sampling import sample_logits
 
@@ -335,7 +366,10 @@ def _build_pipeline_decode_fn(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(params_spec, rope_spec, cache_spec, P("dp"), P(), P()),
+        in_specs=(
+            params_spec, rope_spec, cache_spec, P("dp"),
+            P("dp") if per_row else P(), P(),
+        ),
         out_specs=(P("dp", None), cache_spec),
         check_vma=False,
     )

@@ -337,6 +337,27 @@ class InferenceEngine:
             if on_chunk is not None:
                 on_chunk(StepTiming(eval_us=dt, n_tokens=n_real))
 
+    def _decode_chunk_any(
+        self, token, pos, key, n_steps, temperature, topp, kv_len=None
+    ):
+        """One on-device decode chunk on whichever execution path this
+        engine uses. `pos` may be a scalar or a [b] per-row position vector
+        (independent sequences); both paths accept either."""
+        if self.use_pipeline:
+            from ..parallel.pipeline import pipeline_decode_chunk
+
+            return pipeline_decode_chunk(
+                self.cfg, self.mesh, self.params, self.rope, self.cache,
+                token, pos, key, n_steps=n_steps, temperature=temperature,
+                topp=topp, kv_len=kv_len,
+            )
+        from .decode import decode_chunk
+
+        return decode_chunk(
+            self.cfg, self.params, self.rope, self.cache, token, pos, key,
+            n_steps=n_steps, temperature=temperature, topp=topp, kv_len=kv_len,
+        )
+
     def decode_one(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns host logits [batch, vocab]."""
         arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
@@ -402,17 +423,18 @@ class InferenceEngine:
         it — the same invariant single-sequence padding relies on); decode
         then runs chunks with per-row positions. Returns a list of per-row
         generated-token lists (stop token included, as `generate` does).
-        Requires len(prompts) == self.batch and the non-pipeline path
-        (per-row positions on pp/sp meshes are future work).
+        Requires len(prompts) == self.batch. Works on both execution paths:
+        single-chip/GSPMD via runtime/decode.py and tp/pp/sp/ep meshes via
+        the shard_map pipeline (per-row positions thread through
+        parallel/pipeline.py's vector-pos path).
 
         `max_new_tokens` may be per-row: each row's budget is bounded by ITS
         OWN prompt length against seq_len, so a short prompt co-batched with
         a long one keeps its full budget (rows that finish keep riding the
-        chunk loop; their cache writes land past their budget and their
+        chunk loop; their cache writes past seq_len are DROPPED by the
+        per-row scatter — the live cache tail stays intact — and their
         tokens are discarded host-side).
         """
-        if self.use_pipeline:
-            raise ValueError("generate_batch requires a non-pipeline engine")
         if len(prompts) != self.batch:
             raise ValueError(f"need exactly {self.batch} prompts, got {len(prompts)}")
         if any(len(p) == 0 for p in prompts):
@@ -430,8 +452,6 @@ class InferenceEngine:
                     f"row {r}: prompt ({lens[r]}) + budget ({budgets[r]}) "
                     f"exceeds the sequence length ({self.cfg.seq_len})"
                 )
-
-        from .decode import decode_chunk
 
         # prefill all-but-last per row, rows right-padded to a common length
         pre_t = max(lens) - 1
@@ -484,10 +504,9 @@ class InferenceEngine:
                 + n,
                 self.cfg.seq_len,
             )
-            toks, self.cache = decode_chunk(
-                self.cfg, self.params, self.rope, self.cache, token,
-                pos, sub, n_steps=n, temperature=temperature, topp=topp,
-                kv_len=self._kv_bucket(max_end),
+            toks, self.cache = self._decode_chunk_any(
+                token, pos, sub, n_steps=n, temperature=temperature,
+                topp=topp, kv_len=self._kv_bucket(max_end),
             )
             with watchdog(f"decode_batch[{n}]"):
                 host = np.asarray(toks)  # [b, n]
@@ -542,8 +561,6 @@ class InferenceEngine:
         (runtime/decode.py), one token-array fetch per chunk."""
         import jax
 
-        from .decode import decode_chunk
-
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9
         key = [_sampler_prng_key(sampler)]
@@ -558,21 +575,11 @@ class InferenceEngine:
                 n //= 2
             n = max(n, 1)
             key[0], sub = jax.random.split(key[0])
-            if self.use_pipeline:
-                from ..parallel.pipeline import pipeline_decode_chunk
-
-                toks, self.cache = pipeline_decode_chunk(
-                    self.cfg, self.mesh, self.params, self.rope, self.cache,
-                    tok_arr, jnp.int32(at_pos), sub, n_steps=n,
-                    temperature=temperature, topp=topp,
-                    kv_len=self._kv_bucket(at_pos + n),
-                )
-            else:
-                toks, self.cache = decode_chunk(
-                    self.cfg, self.params, self.rope, self.cache, tok_arr,
-                    jnp.int32(at_pos), sub, n_steps=n, temperature=temperature,
-                    topp=topp, kv_len=self._kv_bucket(at_pos + n),
-                )
+            toks, self.cache = self._decode_chunk_any(
+                tok_arr, jnp.int32(at_pos), sub, n_steps=n,
+                temperature=temperature, topp=topp,
+                kv_len=self._kv_bucket(at_pos + n),
+            )
             return toks, n
 
         if pos >= max_pos:

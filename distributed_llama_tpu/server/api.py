@@ -277,20 +277,20 @@ class ApiState:
         self.template = ChatTemplateGenerator(
             template_type, tokenizer.chat_template, self.stops[0] if self.stops else ""
         )
-        # batch serving: engines with batch > 1 (and per-row positions, i.e.
-        # the non-pipeline path) get a Batcher that groups concurrent
-        # requests into one generate_batch call; batch == 1 keeps the
-        # serialized path with the naive prefix cache
-        self.batcher = (
-            Batcher(self) if engine.batch > 1 and not engine.use_pipeline else None
-        )
-        if self.batcher is not None and getattr(args, "host_decode", False):
-            # generate_batch only has the device decode path; silently
-            # dropping the requested bit-parity host sampler would be worse
-            # than refusing to start
-            raise ValueError(
-                "--host-decode is incompatible with --batch > 1 "
-                "(batched serving samples on-device); drop one of the flags"
+        # batch serving: engines with batch > 1 get a Batcher that groups
+        # concurrent requests into one generate_batch call — on every
+        # execution path, including tp/pp meshes (per-row positions thread
+        # through the shard_map pipeline); batch == 1 keeps the serialized
+        # path with the naive prefix cache. --host-decode requests the
+        # bit-parity host sampler, which only the serialized path has
+        # (generate_batch samples on-device) — honor it by serving
+        # serialized instead of silently dropping the parity guarantee.
+        host_decode = getattr(args, "host_decode", False)
+        self.batcher = Batcher(self) if engine.batch > 1 and not host_decode else None
+        if engine.batch > 1 and host_decode:
+            print(
+                "⚠️  --host-decode serves requests serialized (batched serving "
+                "samples on-device); concurrent requests will queue"
             )
 
     def complete_batched(self, params: dict, emit):

