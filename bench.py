@@ -67,6 +67,31 @@ def ensure_model() -> str:
     )
 
 
+def ensure_qwen3() -> str:
+    """The qwen3-class small dense model (bench leg + profiling target)."""
+    from distributed_llama_tpu.formats.mfile import ArchType, RopeType
+
+    return build_model(
+        "qwen3s_q40_v1",
+        arch=ArchType.QWEN3, rope_type=RopeType.FALCON,
+        dim=1024, hidden_dim=3072, n_layers=16, n_heads=16,
+        n_kv_heads=8, head_dim=128, vocab_size=32768, seq_len=2048,
+    )
+
+
+def ensure_moe() -> str:
+    """The qwen3-moe-class model (bench leg + profiling target)."""
+    from distributed_llama_tpu.formats.mfile import ArchType, RopeType
+
+    return build_model(
+        "qwen3moe_q40_v1",
+        arch=ArchType.QWEN3_MOE, rope_type=RopeType.FALCON,
+        dim=1024, hidden_dim=3072, n_layers=12, n_heads=16,
+        n_kv_heads=8, head_dim=128, n_experts=32, n_active_experts=4,
+        moe_hidden_dim=512, vocab_size=32768, seq_len=2048,
+    )
+
+
 def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw):
     """(decode_tok_s, prefill_tok_s, ttft_ms, marginal_prefill, eng).
 
@@ -269,34 +294,9 @@ def main():
     )
     del eng
 
-    from distributed_llama_tpu.formats.mfile import ArchType, RopeType
-
     extra_legs = [
-        (
-            "qwen3-class q40 1chip",
-            lambda: measure(
-                build_model(
-                    "qwen3s_q40_v1",
-                    arch=ArchType.QWEN3, rope_type=RopeType.FALCON,
-                    dim=1024, hidden_dim=3072, n_layers=16, n_heads=16,
-                    n_kv_heads=8, head_dim=128, vocab_size=32768, seq_len=2048,
-                ),
-                256, 128,
-            ),
-        ),
-        (
-            "qwen3-moe-class q40 1chip",
-            lambda: measure(
-                build_model(
-                    "qwen3moe_q40_v1",
-                    arch=ArchType.QWEN3_MOE, rope_type=RopeType.FALCON,
-                    dim=1024, hidden_dim=3072, n_layers=12, n_heads=16,
-                    n_kv_heads=8, head_dim=128, n_experts=32, n_active_experts=4,
-                    moe_hidden_dim=512, vocab_size=32768, seq_len=2048,
-                ),
-                256, 128,
-            ),
-        ),
+        ("qwen3-class q40 1chip", lambda: measure(ensure_qwen3(), 256, 128)),
+        ("qwen3-moe-class q40 1chip", lambda: measure(ensure_moe(), 256, 128)),
     ]
     for name, fn in extra_legs:
         try:

@@ -52,3 +52,69 @@ def _sample_topp(probs: jnp.ndarray, key: jnp.ndarray, topp: float) -> jnp.ndarr
     cdf = jnp.cumsum(kept, axis=-1)
     pick = jnp.sum(cdf < coin, axis=-1).clip(0, n - 1)
     return jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def split_row_keys(keys_data: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance a [b, 2] uint32 array of per-row threefry key states one
+    split: returns (new_states, subkeys_data). Each row's chain is
+    independent — a row's sampled stream depends only on its own seed and
+    its own step count, never on which rows it is co-batched with (the
+    property that lets SEEDED requests share a continuous-batching round)."""
+
+    def one(kd):
+        k = jax.random.wrap_key_data(kd, impl="threefry2x32")
+        nk, sub = jax.random.split(k)
+        return jax.random.key_data(nk), jax.random.key_data(sub)
+
+    return jax.vmap(one)(keys_data)
+
+
+def sample_logits_per_row(
+    logits: jnp.ndarray,  # [b, vocab] f32
+    subkeys_data: jnp.ndarray,  # [b, 2] uint32 per-row key states
+    temperature: jnp.ndarray,  # [b] f32; <= 0 means greedy for that row
+    topp: jnp.ndarray,  # [b] f32; outside (0, 1) means full-distribution
+) -> jnp.ndarray:
+    """Per-row sampling parameters as TRACED vectors: one compiled program
+    serves any mix of greedy/temperature/top-p rows (continuous batching
+    co-schedules requests with different sampling settings; the fixed-round
+    design had to serialize them). Each row mirrors `sample_logits`' branch
+    structure — greedy / full-distribution vocab-order CDF / top-p
+    sorted-order CDF — but the RNG structure necessarily differs (per-row
+    key chains vs one shared key), so streams only reproduce against other
+    per-row-keyed runs with the same per-row key."""
+    b, n = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp_safe = jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(logits / temp_safe, axis=-1)
+
+    def row_coin(kd):
+        return jax.random.uniform(jax.random.wrap_key_data(kd, impl="threefry2x32"), ())
+
+    coin = jax.vmap(row_coin)(subkeys_data)[:, None]  # [b, 1] in [0, 1)
+
+    # full-distribution branch (topp outside (0,1)): vocab-order CDF, the
+    # same structure as the scalar path's topp >= 1 branch
+    full_cdf = jnp.cumsum(probs, axis=-1)
+    full_pick = jnp.sum(full_cdf < coin, axis=-1).clip(0, n - 1).astype(jnp.int32)
+
+    # top-p branch: sorted-order CDF truncated at the first cumulative
+    # probability > topp (reference: sample_topp, tokenizer.cpp:426-447)
+    topp_safe = jnp.where((topp > 0.0) & (topp < 1.0), topp, 1.0)
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    order = jnp.argsort(-probs, axis=-1)
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    over = csum > topp_safe[:, None]
+    keep = jnp.logical_not(
+        jnp.concatenate([jnp.zeros((b, 1), bool), over[:, :-1]], axis=-1)
+    )
+    kept = jnp.where(keep, sorted_probs, 0.0)
+    kept_sum = jnp.sum(kept, axis=-1, keepdims=True)
+    cdf = jnp.cumsum(kept, axis=-1)
+    pick = jnp.sum(cdf < coin * kept_sum, axis=-1).clip(0, n - 1)
+    topp_pick = jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    in_topp = (topp > 0.0) & (topp < 1.0)
+    sampled = jnp.where(in_topp, topp_pick, full_pick)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -404,6 +404,73 @@ def _build_pipeline_decode_fn(
     return jax.jit(run, donate_argnums=(2,))
 
 
+def pipeline_batch_decode_chunk(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: ModelParams,
+    rope: RopeTables,
+    cache: KVCache,
+    token: jnp.ndarray,  # [b] int32
+    pos: jnp.ndarray,  # [b] int32 per-row positions (seq_len = parked)
+    keys: jnp.ndarray,  # [b, 2] uint32 per-row threefry key states
+    temperature: jnp.ndarray,  # [b] f32
+    topp: jnp.ndarray,  # [b] f32
+    n_steps: int = 16,
+    kv_len: int | None = None,
+):
+    """Mesh twin of runtime/batch_session.batch_decode_chunk: everything
+    per-row and traced (continuous batching on tp/pp/sp/ep meshes). Returns
+    (tokens [b, n_steps], cache, keys)."""
+    fn = _cached_pipeline_fn(
+        cfg, mesh, params, cache, ("batch_decode", n_steps, kv_len),
+        lambda ps, cs: _build_pipeline_batch_decode_fn(cfg, mesh, ps, cs, n_steps, kv_len),
+    )
+    return fn(
+        params, rope, cache, jnp.asarray(token), jnp.asarray(pos, jnp.int32),
+        jnp.asarray(keys), jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(topp, jnp.float32),
+    )
+
+
+def _build_pipeline_batch_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps, kv_len):
+    from ..ops.sampling import sample_logits_per_row, split_row_keys
+
+    pp = mesh.shape["pp"]
+    rope_spec = RopeTables(cos=P(), sin=P())
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            params_spec, rope_spec, cache_spec, P("dp"), P("dp"),
+            P("dp", None), P("dp"), P("dp"),
+        ),
+        out_specs=(P("dp", None), cache_spec, P("dp", None)),
+        check_vma=False,
+    )
+    def run(params, rope_t, cache, token, pos0, keys, temperature, topp):
+        sp_ctx, ep_axis = _mesh_ctx(mesh, cache.k)
+
+        def step(carry, _):
+            token, pos, k_cache, v_cache, keys = carry
+            x = params.embedding[token[:, None]].astype(jnp.float32)
+            x_out, k_cache, v_cache = _stage_rounds(
+                cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx,
+                ep_axis, kv_len=kv_len,
+            )
+            logits = _logits_of(cfg, params, x_out[:, -1, :])
+            keys, subs = split_row_keys(keys)
+            nxt = sample_logits_per_row(logits, subs, temperature, topp)
+            return (nxt, pos + 1, k_cache, v_cache, keys), nxt
+
+        (_, _, k_cache, v_cache, keys), toks = jax.lax.scan(
+            step, (token, pos0, cache.k, cache.v, keys), None, length=n_steps
+        )
+        return jnp.transpose(toks, (1, 0)), KVCache(k=k_cache, v=v_cache), keys
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
 def _spec_of(a) -> P:
     sh = getattr(a, "sharding", None)
     if isinstance(sh, NamedSharding):

@@ -1,0 +1,244 @@
+"""Continuous batching: rolling admission over parkable batch rows.
+
+The fixed-round Batcher design admits requests only at round boundaries: a
+request arriving mid-round waits for the whole in-flight round, and a short
+request co-batched with a long one holds its slot idle until the round
+drains. The reference has no analogue at all — its API serves strictly
+serially (reference: src/dllama-api.cpp:571-576) and its only concurrency is
+gateway replica-DP (src/dllama-gateway.cpp:266-301).
+
+This module is the engine-side machinery that makes admission a per-chunk
+decision instead:
+
+* every batch row is an independent SLOT with its own position, last token,
+  sampling settings, and RNG chain;
+* a free slot can be (re)filled between decode chunks: the newcomer's prompt
+  is prefilled into its row — on the single-chip path via a row-sliced
+  single-sequence forward (full speed: flash attention, scalar positions; the
+  other rows' cache is untouched), on mesh paths via the per-row-position
+  pipeline forward with every other row parked at pos seq_len (their cache
+  writes are dropped by the OOB scatter, models/transformer.py);
+* `step(n)` decodes n tokens for ALL slots in one on-device chunk with
+  per-row positions, per-row threefry key chains, and per-row
+  temperature/top-p vectors (ops/sampling.py sample_logits_per_row) — so
+  requests with different sampling settings, including explicitly seeded
+  ones, share a chunk; a row's sampled stream depends only on its own seed
+  and step count, never on its co-tenants;
+* a finished row is parked (pos = seq_len): it keeps riding the chunk for
+  shape stability, its writes drop, its tokens are discarded host-side.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.params import KVCache
+from ..models.transformer import forward_uncompiled
+from ..ops.sampling import sample_logits_per_row, split_row_keys
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "kv_len"),
+    donate_argnames=("cache",),
+)
+def batch_decode_chunk(
+    cfg,
+    params,
+    rope,
+    cache,
+    token: jnp.ndarray,  # [b] int32
+    pos: jnp.ndarray,  # [b] int32 per-row positions (seq_len = parked)
+    keys: jnp.ndarray,  # [b, 2] uint32 per-row threefry key states
+    temperature: jnp.ndarray,  # [b] f32 (<= 0 = greedy row)
+    topp: jnp.ndarray,  # [b] f32
+    n_steps: int = 16,
+    kv_len: int | None = None,
+):
+    """n_steps decode iterations with everything per-row and TRACED — one
+    compiled program per (batch, n_steps, kv_len) serves any mix of
+    greedy/sampled/seeded rows. Returns (tokens [b, n_steps], cache, keys)."""
+
+    def step(carry, _):
+        token, pos, cache, keys = carry
+        logits, cache = forward_uncompiled(
+            cfg, params, rope, cache, token[:, None], pos,
+            logits_mode="last", kv_len=kv_len,
+        )
+        keys, subs = split_row_keys(keys)
+        nxt = sample_logits_per_row(logits, subs, temperature, topp)
+        return (nxt, pos + 1, cache, keys), nxt
+
+    (_, _, cache, keys), toks = jax.lax.scan(
+        step, (token, pos, cache, keys), None, length=n_steps
+    )
+    return jnp.transpose(toks, (1, 0)), cache, keys
+
+
+@partial(jax.jit, static_argnames=("cfg", "kv_len"), donate_argnames=("cache",))
+def prefill_row(
+    cfg,
+    params,
+    rope,
+    cache,
+    tokens: jnp.ndarray,  # [1, t] int32 — one prompt chunk
+    pos_start,  # scalar int32
+    row,  # scalar int32 — which batch row receives the chunk
+    kv_len: int | None = None,
+):
+    """Prefill one row of a batched cache through the ordinary
+    single-sequence forward: slice the row's cache (batch axis 1 of the
+    [L, b, S, h, d] stack), run the b=1 forward at SCALAR positions (the
+    fast path — flash attention, bucketed reads), write the row back. The
+    slice+unslice moves one cache row (~tens of MB), negligible next to the
+    prefill itself; the alternative — pushing the whole batch through with
+    b-1 parked rows — multiplies the prefill matmul FLOPs by the batch."""
+    k_row = jax.lax.dynamic_slice_in_dim(cache.k, row, 1, axis=1)
+    v_row = jax.lax.dynamic_slice_in_dim(cache.v, row, 1, axis=1)
+    _, rc = forward_uncompiled(
+        cfg, params, rope, KVCache(k=k_row, v=v_row), tokens, pos_start,
+        logits_mode="last", kv_len=kv_len,
+    )
+    k = jax.lax.dynamic_update_slice_in_dim(cache.k, rc.k, row, axis=1)
+    v = jax.lax.dynamic_update_slice_in_dim(cache.v, rc.v, row, axis=1)
+    return KVCache(k=k, v=v)
+
+
+class BatchSession:
+    """Host-side slot state for one continuously-batched engine.
+
+    Not thread-safe — the server's Batcher worker owns it. All device work
+    happens in `admit` (prefill) and `step` (decode chunk); between calls
+    the device is idle and admission decisions are free.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        b = engine.batch
+        self.seq_len = engine.cfg.seq_len
+        self.pos = np.full((b,), self.seq_len, np.int32)  # parked
+        self.token = np.zeros((b,), np.int32)
+        self.active = np.zeros((b,), bool)
+        self.temp = np.zeros((b,), np.float32)
+        self.topp = np.full((b,), 0.9, np.float32)
+        self.keys = np.zeros((b, 2), np.uint32)
+        self._admits = 0  # distinguishes unseeded admissions' default keys
+        engine.reset()
+
+    def free_rows(self) -> list[int]:
+        return [r for r in range(len(self.active)) if not self.active[r]]
+
+    def active_rows(self) -> list[int]:
+        return [r for r in range(len(self.active)) if self.active[r]]
+
+    def admit(
+        self,
+        row: int,
+        prompt_tokens: list[int],
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        key_data=None,  # (hi, lo) uint32 pair; None derives from the row+pos
+    ) -> None:
+        """Prefill `prompt_tokens[:-1]` into `row` and arm the slot. The
+        row starts decoding on the next `step` call — admission latency is
+        one prefill plus at most one in-flight chunk boundary."""
+        eng = self.engine
+        n = len(prompt_tokens)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if n >= self.seq_len:
+            raise ValueError(
+                f"prompt ({n} tokens) exceeds the context window ({self.seq_len})"
+            )
+        if self.active[row]:
+            raise ValueError(f"row {row} is still active")
+
+        pre = prompt_tokens[:-1]
+        if pre:
+            from .engine import chunk_plan
+
+            for i, size, n_real in chunk_plan(len(pre), 0, eng.max_chunk, self.seq_len):
+                chunk = pre[i : i + n_real] + [0] * (size - n_real)
+                kv_len = eng._kv_bucket(i + size)
+                if eng.use_pipeline:
+                    # mesh path: whole-batch forward with every other row
+                    # parked at seq_len (writes dropped)
+                    from ..parallel.pipeline import pipeline_forward
+
+                    toks = np.zeros((eng.batch, size), np.int32)
+                    toks[row, :] = chunk
+                    pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
+                    pos_vec[row] = i
+                    _, eng.cache = pipeline_forward(
+                        eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
+                        jnp.asarray(toks), jnp.asarray(pos_vec),
+                        logits_mode="last", kv_len=kv_len,
+                    )
+                else:
+                    eng.cache = prefill_row(
+                        eng.cfg, eng.params, eng.rope, eng.cache,
+                        jnp.asarray([chunk], jnp.int32), jnp.int32(i),
+                        jnp.int32(row), kv_len=kv_len,
+                    )
+
+        self.pos[row] = n - 1
+        self.token[row] = prompt_tokens[-1]
+        self.temp[row] = temperature
+        self.topp[row] = topp
+        if key_data is None:
+            # unseeded: a fresh chain per admission (deterministic within a
+            # session, distinct across re-used rows). Seeded callers pass
+            # key_data derived from the seed alone, so the stream reproduces
+            # regardless of which row/chunks it lands in.
+            self._admits += 1
+            key_data = (
+                np.uint32(0x9E3779B9),
+                np.uint32((self._admits * 2654435761) & 0xFFFFFFFF),
+            )
+        self.keys[row] = np.asarray(key_data, np.uint32)
+        self.active[row] = True
+
+    def release(self, row: int) -> None:
+        """Park the row: its cache writes drop from the next chunk on, so
+        the slot can be re-admitted later without disturbing anyone."""
+        self.active[row] = False
+        self.pos[row] = self.seq_len
+        self.temp[row] = 0.0  # greedy is the cheap sampling path for junk
+
+    def step(self, n_steps: int) -> np.ndarray:
+        """One decode chunk for every slot; returns host tokens [b, n_steps]
+        (junk in parked rows). Advances every row's position by n_steps."""
+        eng = self.engine
+        ends = [int(self.pos[r]) + 1 + n_steps for r in self.active_rows()]
+        kv_len = eng._kv_bucket(min(max(ends, default=1), self.seq_len))
+        token = jnp.asarray(self.token)
+        pos = jnp.asarray(self.pos)
+        keys = jnp.asarray(self.keys)
+        temp = jnp.asarray(self.temp)
+        topp = jnp.asarray(self.topp)
+        if eng.use_pipeline:
+            from ..parallel.pipeline import pipeline_batch_decode_chunk
+
+            toks, eng.cache, keys = pipeline_batch_decode_chunk(
+                eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
+                token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+            )
+        else:
+            toks, eng.cache, keys = batch_decode_chunk(
+                eng.cfg, eng.params, eng.rope, eng.cache,
+                token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+            )
+        host = np.asarray(toks)
+        # np.array (copy): asarray of a device array is READ-ONLY, and admit
+        # writes rows into these between chunks
+        self.keys = np.array(keys)
+        self.pos += n_steps
+        # parked rows stay pinned at seq_len (a long-lived session must not
+        # creep their positions toward int32 range)
+        self.pos[~self.active] = self.seq_len
+        self.token = host[:, -1].copy()
+        return host

@@ -120,6 +120,31 @@ def _chunk_buckets(max_chunk: int) -> list[int]:
     return out
 
 
+def chunk_plan(n_tokens: int, pos_start: int, max_chunk: int, seq_len: int):
+    """The padded power-of-two prefill ladder — the ONE owner of the chunk
+    arithmetic shared by `prefill`, `generate_batch`, and
+    `BatchSession.admit`: yields (offset, size, n_real) triples covering
+    `n_tokens` tokens whose first absolute position is `pos_start`. `size`
+    is the padded bucket (keeps compiled programs O(log max_chunk)); the
+    last chunk's tail past `n_real` is padding. Raises when a chunk would
+    write past seq_len (dynamic_update_slice would CLAMP the start and
+    silently overwrite earlier positions' KV — real corruption, not junk)."""
+    buckets = _chunk_buckets(max_chunk)
+    i = 0
+    while i < n_tokens:
+        remaining = n_tokens - i
+        size = next(b for b in buckets if b >= min(remaining, max_chunk))
+        size = min(size, seq_len - (pos_start + i))
+        if size <= 0:
+            raise ValueError(
+                f"prefill would write past seq_len ({seq_len}): "
+                f"{n_tokens} tokens starting at position {pos_start}"
+            )
+        n_real = min(size, remaining)
+        yield i, size, n_real
+        i += n_real
+
+
 class InferenceEngine:
     """Owns params + cache + compiled steps for one model."""
 
@@ -296,36 +321,20 @@ class InferenceEngine:
         skips even that, letting decode dispatch chain straight on). Per-chunk
         timings are attributed proportionally from the synced total.
         """
-        buckets = _chunk_buckets(self.max_chunk)
-        i = 0
         n = len(tokens)
         if n == 0:
             return
         t0 = time.perf_counter()
         chunk_sizes: list[tuple[int, int]] = []  # (bucket, n_real)
         out = None
-        while i < n:
-            remaining = n - i
-            size = next(b for b in buckets if b >= min(remaining, self.max_chunk))
-            # padded tail rows must not write past seq_len —
-            # dynamic_update_slice would CLAMP the start and silently
-            # overwrite earlier positions' KV (real corruption, not junk)
-            size = min(size, self.cfg.seq_len - (pos_start + i))
-            if size <= 0:
-                raise ValueError(
-                    f"prefill would write past seq_len ({self.cfg.seq_len}): "
-                    f"{n} tokens starting at position {pos_start}"
-                )
-            chunk = tokens[i : i + size]
-            n_real = len(chunk)
-            chunk = chunk + [0] * (size - n_real)
+        for i, size, n_real in chunk_plan(n, pos_start, self.max_chunk, self.cfg.seq_len):
+            chunk = tokens[i : i + n_real] + [0] * (size - n_real)
             arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
             out, self.cache = self._forward(
                 arr, jnp.int32(pos_start + i),
                 kv_len=self._kv_bucket(pos_start + i + size),
             )
             chunk_sizes.append((size, n_real))
-            i += n_real
         if sync:
             with watchdog(f"prefill[{len(tokens)}]"):
                 # single scalar fetch = the only host round trip of the prefill
@@ -457,18 +466,13 @@ class InferenceEngine:
         pre_t = max(lens) - 1
         if pre_t > 0:
             padded = [list(p[:-1]) + [0] * (pre_t - (len(p) - 1)) for p in prompts]
-            buckets = _chunk_buckets(self.max_chunk)
-            i = 0
-            while i < pre_t:
-                size = next(b for b in buckets if b >= min(pre_t - i, self.max_chunk))
-                size = min(size, self.cfg.seq_len - i)
+            for i, size, _ in chunk_plan(pre_t, 0, self.max_chunk, self.cfg.seq_len):
                 rows = [row[i : i + size] for row in padded]
                 rows = [r + [0] * (size - len(r)) for r in rows]
                 _, self.cache = self._forward(
                     jnp.asarray(rows, dtype=jnp.int32), jnp.int32(i),
                     kv_len=self._kv_bucket(i + size),
                 )
-                i += size
 
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9

@@ -125,24 +125,39 @@ class _BatchReq:
 
 
 class Batcher:
-    """Groups concurrent requests into one engine.generate_batch call.
+    """Continuous batching: rolling admission into a BatchSession.
 
     The reference serializes requests entirely (one sequential accept loop,
     dllama-api.cpp:571-576); the gateway's replica DP is its only
-    concurrency. With per-row sequences the engine decodes independent
-    prompts in one batch, so the API server batches instead: handler
-    threads submit requests, a worker collects up to engine.batch of them
-    within a short window and runs them together. Unfilled rows are padded
-    with 1-token dummy prompts that stop immediately. The naive prefix
-    cache does not apply in batch mode (rows are independent fresh
-    sequences).
+    concurrency. Here a worker thread owns a `BatchSession`
+    (runtime/batch_session.py) whose rows are independent parkable slots:
+
+    * a request arriving at ANY time is admitted into a free slot at the
+      next decode-chunk boundary (at most one chunk of latency, not a whole
+      round) — its prompt prefills into its row without disturbing rows
+      mid-generation;
+    * rows finish independently: a short request's latency never depends on
+      a long co-tenant's budget, and its freed slot is immediately
+      re-admittable;
+    * sampling settings are PER ROW (traced vectors): mixed
+      temperature/top-p traffic — and explicitly seeded requests — co-batch
+      freely. A seeded request's stream depends only on its seed and step
+      count (per-row threefry chains), so it reproduces regardless of what
+      it shares chunks with.
+
+    The naive prefix cache does not apply in batch mode (rows are
+    independent fresh sequences).
     """
 
-    def __init__(self, state: "ApiState", window_s: float = 0.05):
+    def __init__(self, state: "ApiState", chunk_size: int | None = None):
         import queue
 
         self.state = state
-        self.window_s = window_s
+        engine = state.engine
+        # chunk = admission latency quantum. Smaller admits faster but pays
+        # more dispatch round trips per token; the engine default balances
+        # the two for throughput.
+        self.chunk = chunk_size or engine.decode_chunk_size
         self.q: "queue.Queue[_BatchReq]" = queue.Queue()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -153,101 +168,110 @@ class Batcher:
         if req.error is not None:
             raise req.error
 
+    @staticmethod
+    def _key_for_seed(seed: int):
+        """[2] uint32 threefry state from a request seed, via the same
+        xorshift* state derivation as the host Sampler (so a given seed
+        names one stream everywhere)."""
+        from ..runtime.engine import _sampler_prng_key
+        from ..tokenizer import Sampler
+
+        import jax
+
+        s = Sampler(1, 1.0, 0.9, seed)
+        return np.asarray(jax.random.key_data(_sampler_prng_key(s)))
+
+    def _finish(self, req: _BatchReq, session, slots, row):
+        session.release(row)
+        slots[row] = None
+        req.done.set()
+
     def _loop(self):
         import queue
-        import time as _time
 
-        held = None  # sampling-incompatible request deferred to the next round
+        from ..runtime.batch_session import BatchSession
+
+        import collections
+
+        engine = self.state.engine
+        session = BatchSession(engine)
+        slots: list[_BatchReq | None] = [None] * engine.batch
+        backlog: "collections.deque[_BatchReq]" = collections.deque()
+
         while True:
-            first = held if held is not None else self.q.get()
-            held = None
-            batch = [first]
-            # An explicitly seeded request always runs alone: its sampled
-            # stream depends on its batch row and on co-batched rows' chunk
-            # schedule, so sharing a round would silently break seed
-            # reproducibility even between requests with EQUAL seeds.
-            deadline = None
-            while first.seed is None and len(batch) < self.state.engine.batch:
+            # drain the queue into the FIFO backlog; block only when fully
+            # idle (no active slots and nothing waiting)
+            idle = all(s is None for s in slots)
+            if idle and not backlog:
+                backlog.append(self.q.get())
+            while True:
                 try:
-                    if deadline is None:
-                        # no idle-window penalty: a lone request starts its
-                        # round immediately; the window opens only once a
-                        # second request proves there IS concurrency (and
-                        # requests arriving mid-round batch naturally into
-                        # the next one)
-                        nxt = self.q.get_nowait()
-                    else:
-                        remaining = deadline - _time.monotonic()
-                        if remaining <= 0:
-                            break
-                        nxt = self.q.get(timeout=remaining)
+                    backlog.append(self.q.get_nowait())
                 except queue.Empty:
                     break
-                # rows share one sampler, so only unseeded requests with
-                # identical sampling settings may share a round; anything
-                # else seeds the next round instead
-                if nxt.seed is not None or (nxt.temperature, nxt.topp) != (
-                    first.temperature, first.topp
-                ):
-                    held = nxt
-                    break
-                batch.append(nxt)
-                if deadline is None:
-                    deadline = _time.monotonic() + self.window_s
-            self._run(batch)
-
-    def _run(self, batch):
-        engine = self.state.engine
-        try:
-            engine.reset()
-            prompts = [r.ids for r in batch]
-            while len(prompts) < engine.batch:
-                prompts.append([1])  # dummy row; stops after one token
-            # per-row budgets: each request's max_new clamped by ITS OWN
-            # prompt against the context window, so a short prompt co-batched
-            # with a long one keeps its full budget; dummy rows get 1
-            budget = [
-                max(1, min(r.max_new, engine.cfg.seq_len - len(r.ids)))
-                for r in batch
-            ] + [1] * (engine.batch - len(batch))
-            sampler = self.state.sampler
-            sampler.set_temp(batch[0].temperature)
-            sampler.topp = batch[0].topp
-            if batch[0].seed is not None:
-                sampler.set_seed(batch[0].seed)
-
-            def on_token(row, t):
-                if row >= len(batch):
-                    return
-                r = batch[row]
-                if r.stopped:
-                    return
-                r.n += 1
+            # admit in arrival order into free slots at this chunk boundary
+            admitted = False
+            for row in range(engine.batch):
+                if slots[row] is not None or not backlog:
+                    continue
+                req = backlog.popleft()
                 try:
-                    r.on_token(t)
+                    key = self._key_for_seed(req.seed) if req.seed is not None else None
+                    session.admit(
+                        row, req.ids, temperature=req.temperature,
+                        topp=req.topp, key_data=key,
+                    )
+                    slots[row] = req
+                    admitted = True
                 except Exception as e:
-                    # a per-ROW failure (typically the client dropping its
-                    # socket mid-stream) stops that row only — co-batched
-                    # requests and the engine are unaffected
-                    r.error = e
-                    r.stopped = True
-                if r.n >= r.max_new:
-                    r.stopped = True
+                    req.error = e
+                    req.done.set()
 
-            def stop_fn(row, t):
-                return row >= len(batch) or batch[row].stopped
-
-            engine.generate_batch(
-                prompts, budget, sampler=sampler, on_token=on_token,
-                stop_fn=stop_fn,
+            if all(s is None for s in slots):
+                continue
+            # chunk size: ramp to 8 right after an admission (a fresh
+            # request's first tokens — and a tiny request's only tokens —
+            # reach the client after ~8 steps, not a full chunk), and clamp
+            # by power-of-two halving to the smallest remaining budget among
+            # active rows so no row decodes discarded tokens past its
+            # max_new (the same ladder generate_batch uses; distinct sizes
+            # stay O(log chunk) compiled programs)
+            remaining = min(
+                req.max_new - req.n for req in slots if req is not None
             )
-        except Exception as e:
-            self.state.recover()
-            for r in batch:
-                r.error = e
-        finally:
-            for r in batch:
-                r.done.set()
+            n = min(8, self.chunk) if admitted else self.chunk
+            while n > max(remaining, 1):
+                n //= 2
+            n = max(n, 1)
+            try:
+                toks = session.step(n)
+            except Exception as e:
+                # engine failure: fail every in-flight request, rebuild the
+                # session on a recovered engine
+                for row, req in enumerate(slots):
+                    if req is not None:
+                        req.error = e
+                        self._finish(req, session, slots, row)
+                self.state.recover()
+                session = BatchSession(engine)
+                continue
+            for row, req in enumerate(slots):
+                if req is None:
+                    continue
+                for j in range(toks.shape[1]):
+                    t = int(toks[row, j])
+                    req.n += 1
+                    try:
+                        req.on_token(t)
+                    except Exception as e:
+                        # a per-ROW failure (typically the client dropping
+                        # its socket mid-stream) stops that row only —
+                        # co-batched requests and the engine are unaffected
+                        req.error = e
+                        req.stopped = True
+                    if req.stopped or req.n >= req.max_new:
+                        self._finish(req, session, slots, row)
+                        break
 
 
 class ApiState:

@@ -45,19 +45,26 @@ def dev_ms(label, make_fn, n=64, trials=3):
 
 
 def main():
-    from bench import ensure_model
+    import argparse
+
+    from bench import ensure_model, ensure_moe, ensure_qwen3
     from distributed_llama_tpu.runtime.engine import InferenceEngine
     from distributed_llama_tpu.runtime.decode import decode_chunk
     from distributed_llama_tpu.models.transformer import forward_uncompiled
     from distributed_llama_tpu.ops.quant import quant_matmul
     from distributed_llama_tpu.ops.attention import gqa_attention
 
-    path = ensure_model()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["1b", "qwen3", "moe"], default="1b",
+                    help="which bench model to itemize (the small models are "
+                    "the round-4 per-token-floor hunt)")
+    args = ap.parse_args()
+    path = {"1b": ensure_model, "qwen3": ensure_qwen3, "moe": ensure_moe}[args.model]()
     engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=64)
     cfg, params, rope = engine.cfg, engine.params, engine.rope
     print(f"cfg: dim={cfg.dim} layers={cfg.n_layers} heads={cfg.n_heads}/{cfg.n_kv_heads} "
           f"hd={cfg.head_dim} hidden={cfg.hidden_dim} vocab={cfg.vocab_size} seq={cfg.seq_len} "
-          f"cache_dtype={cfg.cache_dtype}")
+          f"cache_dtype={cfg.cache_dtype} qwen3={cfg.is_qwen3} moe={cfg.is_moe}")
     N = 64
 
     # ---- full decode step (forward t=1 + argmax), chained ----
@@ -81,11 +88,13 @@ def main():
             return fn, (params, cache.k, cache.v, jnp.zeros((1,), jnp.int32))
         return make
 
+    bucket = 1024 if cfg.dim >= 2048 else 512  # the bucket bench decode sees
     full_p = dev_ms("decode step (pallas)", mk_decode(True), N)
-    full_b = dev_ms("decode step (pallas, kv bucket 1024)", mk_decode(True, 1024), N)
+    full_b = dev_ms(f"decode step (pallas, kv bucket {bucket})",
+                    mk_decode(True, bucket), N)
     full_x = dev_ms("decode step (xla dequant)", mk_decode(False), N)
 
-    # ---- matmuls only: the 16-layer x 7-matmul chain + wcls ----
+    # ---- matmuls only: the per-layer matmul chain + wcls ----
     def mk_matmuls(use_pallas):
       def make(n):
         pallas = use_pallas
@@ -93,10 +102,12 @@ def main():
         def fn(params, x):
             def layer_body(x, lp):
                 qkv = quant_matmul(x, lp.wqkv, pallas=pallas)
-                x = quant_matmul(qkv[..., : cfg.dim], lp.wo, pallas=pallas)
-                h13 = quant_matmul(x, lp.w13, pallas=pallas)
-                ff = h13.shape[-1] // 2
-                x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=pallas)
+                q_out = cfg.n_heads * cfg.head_dim  # wo reads the q heads
+                x = quant_matmul(qkv[..., :q_out], lp.wo, pallas=pallas)
+                if not cfg.is_moe:
+                    h13 = quant_matmul(x, lp.w13, pallas=pallas)
+                    ff = h13.shape[-1] // 2
+                    x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=pallas)
                 return x, None
             def body(x, _):
                 x, _ = jax.lax.scan(layer_body, x, params.layers)
@@ -107,11 +118,35 @@ def main():
         return fn, (params, jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
       return make
 
-    mm_p = dev_ms("matmul chain (pallas)", mk_matmuls(True), N)
-    mm_x = dev_ms("matmul chain (xla)", mk_matmuls(False), N)
+    mm_label = "att matmuls + wcls" if cfg.is_moe else "matmul chain"
+    mm_p = dev_ms(f"{mm_label} (pallas)", mk_matmuls(True), N)
+    mm_x = dev_ms(f"{mm_label} (xla)", mk_matmuls(False), N)
 
-    # ---- attention only, 16 layers over the full cache ----
-    def mk_att():
+    # ---- MoE ffn only (router + per-slot i8 expert matmuls) ----
+    moe_ms = 0.0
+    if cfg.is_moe:
+        from distributed_llama_tpu.models.transformer import _moe_ffn
+
+        def mk_moe():
+          def make(n):
+            @jax.jit
+            def fn(params, y):
+                def layer_body(y, li):
+                    out = _moe_ffn(cfg, y, params.layers, li)
+                    return y + out.astype(y.dtype) * 1e-30, None
+                def body(y, _):
+                    y, _ = jax.lax.scan(
+                        layer_body, y, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+                    return y, None
+                y, _ = jax.lax.scan(body, y, None, length=n)
+                return y
+            return fn, (params, jnp.ones((1, 1, cfg.dim), jnp.bfloat16),)
+          return make
+
+        moe_ms = dev_ms(f"moe ffn x{cfg.n_layers} (router+experts)", mk_moe(), N)
+
+    # ---- attention only, all layers, full cache and the decode bucket ----
+    def mk_att(kv):
       def make(n):
         @jax.jit
         def fn(q, kc, vc, pos):
@@ -124,12 +159,13 @@ def main():
             q, _ = jax.lax.scan(body, q, None, length=n)
             return q
         q = jnp.ones((1, 1, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
-        kc = jnp.ones((1, cfg.seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
+        kc = jnp.ones((1, kv, cfg.n_kv_heads, cfg.head_dim), cfg.kv_dtype)
         pos = jnp.full((1, 1), 100, jnp.int32)
         return fn, (q, kc, kc, pos)
       return make
 
-    att = dev_ms("attention x16 (full cache)", mk_att(), N)
+    att = dev_ms(f"attention x{cfg.n_layers} (full cache)", mk_att(cfg.seq_len), N)
+    att_b = dev_ms(f"attention x{cfg.n_layers} (bucket {bucket})", mk_att(bucket), N)
 
     # ---- cache scan-update only (the per-step KV copy) ----
     def mk_cache():
@@ -165,17 +201,23 @@ def main():
         norm_w = jnp.ones((cfg.dim,), jnp.float32)
         rope_t = engine.rope
 
+        hd_w = jnp.ones((cfg.head_dim,), jnp.float32)
+
         @jax.jit
         def fn(x, pos):
             def body(x, _):
                 def layer(x, _):
                     y = rms_norm(x, norm_w, cfg.norm_epsilon)
-                    q = y[..., : cfg.n_heads * cfg.head_dim].reshape(
-                        1, 1, cfg.n_heads, cfg.head_dim
-                    )
-                    k = y[..., : cfg.n_kv_heads * cfg.head_dim].reshape(
+                    # q/k synthesized by tiling y (dim may be < heads*hd)
+                    qkv_dim = cfg.n_heads * cfg.head_dim
+                    yq = jnp.tile(y, (1, 1, -(-qkv_dim // cfg.dim)))
+                    q = yq[..., :qkv_dim].reshape(1, 1, cfg.n_heads, cfg.head_dim)
+                    k = yq[..., : cfg.n_kv_heads * cfg.head_dim].reshape(
                         1, 1, cfg.n_kv_heads, cfg.head_dim
                     )
+                    if cfg.is_qwen3:  # per-head q/k norms (the qwen3 extra)
+                        q = rms_norm(q, hd_w, cfg.norm_epsilon)
+                        k = rms_norm(k, hd_w, cfg.norm_epsilon)
                     q = apply_rope(q, rope_t, pos, cfg.rope_type)
                     k = apply_rope(k, rope_t, pos, cfg.rope_type)
                     y2 = rms_norm(x, norm_w, cfg.norm_epsilon)
@@ -190,7 +232,9 @@ def main():
         return fn, (jnp.ones((1, 1, cfg.dim), jnp.bfloat16), pos)
       return make
 
-    glue_ms = dev_ms("glue x16 (norms+rope+reshape)", mk_glue(), N)
+    glue_ms = dev_ms(
+        f"glue x{cfg.n_layers} (norms+rope+reshape"
+        + ("+qknorm" if cfg.is_qwen3 else "") + ")", mk_glue(), N)
 
     # ---- sampling + embedding row (once per token) ----
     def mk_sample():
@@ -213,8 +257,12 @@ def main():
     sample_ms = dev_ms("argmax+embedding row", mk_sample(), N)
 
     # ---- single pallas matmul bandwidth at each shape ----
-    for name, w in [("qkv 2048x3072", params.layers.wqkv), ("ffn13 2048x16384", params.layers.w13),
-                    ("wcls 32768x2048", params.wcls)]:
+    shape_list = [("qkv", params.layers.wqkv), ("wo", params.layers.wo)]
+    if not cfg.is_moe:
+        shape_list += [("ffn13", params.layers.w13), ("w2", params.layers.w2)]
+    shape_list.append(("wcls", params.wcls))
+    for name, w in shape_list:
+        name = f"{name} {w.in_features}x{w.out_features}"
         wq = w.q[0] if w.q.ndim == 4 else w.q
         wd = w.d[0] if w.d.ndim == 3 else w.d
         from distributed_llama_tpu.ops.quant import QuantTensor
@@ -234,9 +282,11 @@ def main():
         mb = ww.q.size / 1e6
         print(f"    -> {mb/ms:.0f} GB/s effective ({mb:.1f} MB)")
 
-    print(f"\nsummary ms/token: full={full_p:.3f} full@bucket1024={full_b:.3f} "
-          f"matmuls={mm_p:.3f} att={att:.3f} "
-          f"cacheupd={cache_ms:.3f} other={full_p-mm_p-att-cache_ms:.3f}")
+    print(f"\nsummary ms/token: full={full_p:.3f} full@bucket{bucket}={full_b:.3f} "
+          f"matmuls={mm_p:.3f} moe_ffn={moe_ms:.3f} att_full={att:.3f} "
+          f"att@bucket={att_b:.3f} glue={glue_ms:.3f} sample={sample_ms:.3f} "
+          f"cacheupd={cache_ms:.3f} "
+          f"other@bucket={full_b-mm_p-moe_ms-att_b-glue_ms-sample_ms-cache_ms:.3f}")
     print(f"xla-dequant full={full_x:.3f} matmuls={mm_x:.3f}")
 
 
