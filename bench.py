@@ -108,7 +108,12 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
         max_seq_len=max_seq, **ekw,
     )
     prompt = [(i % 1000) + 1 for i in range(prefill_tokens)]
-    steps = prefill_tokens + decode_tokens
+    # decode budget = steps - (len(prompt) - 1); the -1 makes the budget
+    # exactly `decode_tokens`, so the chunk ladder stays power-of-two — an
+    # off-by-one budget of 129 decays into 64+64+1 (or 128+1) chunks whose
+    # 1-token tail is pure dispatch latency and poisons a 2-element median
+    # (observed: a healthy 1.55 ms/token config reporting 19 tok/s)
+    steps = prefill_tokens + decode_tokens - 1
     eng.generate(prompt, steps, sampler=None)  # warmup: compiles
     eng.reset()
     res = eng.generate(prompt, steps, sampler=None)
@@ -294,9 +299,15 @@ def main():
     )
     del eng
 
+    # the small models are dispatch-overhead-bound at chunk 64 (compute
+    # ~46 ms/chunk < the ~100 ms tunnel round trip), so they decode in
+    # 128-token chunks; the 1B/8B are compute-bound at 64 and the lookahead
+    # already hides their dispatch
     extra_legs = [
-        ("qwen3-class q40 1chip", lambda: measure(ensure_qwen3(), 256, 128)),
-        ("qwen3-moe-class q40 1chip", lambda: measure(ensure_moe(), 256, 128)),
+        ("qwen3-class q40 1chip",
+         lambda: measure(ensure_qwen3(), 256, 256, decode_chunk_size=128)),
+        ("qwen3-moe-class q40 1chip",
+         lambda: measure(ensure_moe(), 256, 256, decode_chunk_size=128)),
     ]
     for name, fn in extra_legs:
         try:

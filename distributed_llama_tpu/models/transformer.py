@@ -323,9 +323,22 @@ def _layer(
     # power-of-two bucket covering pos_start + t, so decode reads scale with
     # the position, not the allocated cache (full-cache reads made 32k-seq
     # decode pay for the whole cache every token). None = full cache.
+    stacked_cache=False,  # True: k_cache/v_cache are the FULL [L, b, S, h,
+    # d] stacks riding the layer scan's CARRY, and this layer's rows are
+    # updated in place at index `cache_layer` (XLA keeps loop-carried
+    # buffers in place under a dynamic-update). False (the legacy
+    # threading): the per-layer slices arrive via the scan's xs and leave
+    # via its stacked ys — which REWRITES the whole allocation every call
+    # (measured: the scan ys stacking cost ~0.64 ms/token on a 134 MB
+    # cache, the round-3 small-model/32k per-token floor).
+    cache_layer=None,  # stacked_cache index; defaults to layer_idx (the
+    # pipeline path passes per-layer weight slices — layer_idx None — but
+    # still carries a stacked LOCAL cache, so the two indices differ there)
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
+    if cache_layer is None:
+        cache_layer = layer_idx
     b, t, _ = x.shape
     q80 = cfg.q80_activations
 
@@ -363,36 +376,71 @@ def _layer(
     k = apply_rope(k, rope, positions, cfg.rope_type)
 
     if sp_ctx is None:
-        if jnp.ndim(pos_start) == 0:
-            k_cache = jax.lax.dynamic_update_slice_in_dim(
-                k_cache, k.astype(k_cache.dtype), pos_start, axis=1
-            )
-            v_cache = jax.lax.dynamic_update_slice_in_dim(
-                v_cache, v.astype(v_cache.dtype), pos_start, axis=1
-            )
+        if stacked_cache:
+            # in-place update of this layer's rows inside the full carried
+            # stack; attention then reads a bucketed dynamic-slice view. The
+            # slice is the only cache traffic besides the row write — the
+            # legacy xs/ys threading instead re-stacked the WHOLE allocation
+            # per call.
+            li = cache_layer
+            S = k_cache.shape[2]
+            nh, hd = k_cache.shape[3], k_cache.shape[4]
+            if jnp.ndim(pos_start) == 0:
+                start = (li, 0, pos_start, 0, 0)
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype)[None], start
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype)[None], start
+                )
+            else:
+                # per-row positions: OOB-DROP scatter (see the unstacked
+                # branch below for why drop is load-bearing)
+                b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+                k_cache = k_cache.at[li, b_idx, positions].set(
+                    k.astype(k_cache.dtype), mode="drop", unique_indices=True
+                )
+                v_cache = v_cache.at[li, b_idx, positions].set(
+                    v.astype(v_cache.dtype), mode="drop", unique_indices=True
+                )
+            view_len = min(kv_len, S) if kv_len is not None else S
+            k_view = jax.lax.dynamic_slice(
+                k_cache, (li, 0, 0, 0, 0), (1, b, view_len, nh, hd)
+            )[0]
+            v_view = jax.lax.dynamic_slice(
+                v_cache, (li, 0, 0, 0, 0), (1, b, view_len, nh, hd)
+            )[0]
         else:
-            # per-row sequences (independent prompts per batch row): each
-            # row writes at its own positions — a scatter with OOB-DROP
-            # semantics, not a clamping dynamic_update_slice. The drop is
-            # load-bearing: a row whose positions reach seq_len writes
-            # NOTHING, so finished rows can keep riding decode chunks
-            # (generate_batch) and rolling admission can "park" a row at
-            # pos_start = seq_len, both without disturbing the row's live
-            # cache tail. Indices are pos_start + arange per row — strictly
-            # increasing, hence unique; all are >= 0 so none wrap before the
-            # drop applies.
-            b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
-            k_cache = k_cache.at[b_idx, positions].set(
-                k.astype(k_cache.dtype), mode="drop", unique_indices=True
-            )
-            v_cache = v_cache.at[b_idx, positions].set(
-                v.astype(v_cache.dtype), mode="drop", unique_indices=True
-            )
-        if kv_len is not None and kv_len < k_cache.shape[1]:
-            k_view = jax.lax.slice_in_dim(k_cache, 0, kv_len, axis=1)
-            v_view = jax.lax.slice_in_dim(v_cache, 0, kv_len, axis=1)
-        else:
-            k_view, v_view = k_cache, v_cache
+            if jnp.ndim(pos_start) == 0:
+                k_cache = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache, k.astype(k_cache.dtype), pos_start, axis=1
+                )
+                v_cache = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache, v.astype(v_cache.dtype), pos_start, axis=1
+                )
+            else:
+                # per-row sequences (independent prompts per batch row):
+                # each row writes at its own positions — a scatter with
+                # OOB-DROP semantics, not a clamping dynamic_update_slice.
+                # The drop is load-bearing: a row whose positions reach
+                # seq_len writes NOTHING, so finished rows can keep riding
+                # decode chunks (generate_batch) and rolling admission can
+                # "park" a row at pos_start = seq_len, both without
+                # disturbing the row's live cache tail. Indices are
+                # pos_start + arange per row — strictly increasing, hence
+                # unique; all are >= 0 so none wrap before the drop applies.
+                b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+                k_cache = k_cache.at[b_idx, positions].set(
+                    k.astype(k_cache.dtype), mode="drop", unique_indices=True
+                )
+                v_cache = v_cache.at[b_idx, positions].set(
+                    v.astype(v_cache.dtype), mode="drop", unique_indices=True
+                )
+            if kv_len is not None and kv_len < k_cache.shape[1]:
+                k_view = jax.lax.slice_in_dim(k_cache, 0, kv_len, axis=1)
+                v_view = jax.lax.slice_in_dim(v_cache, 0, kv_len, axis=1)
+            else:
+                k_view, v_view = k_cache, v_cache
         a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
     else:
         from ..ops.attention import (
@@ -403,8 +451,9 @@ def _layer(
         from ..ops.pallas_attention import flash_attention_aligned
 
         axis_name, shard_offset = sp_ctx
-        k_cache = scatter_cache_update_sp(k_cache, k, positions, shard_offset)
-        v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset)
+        li = cache_layer if stacked_cache else None
+        k_cache = scatter_cache_update_sp(k_cache, k, positions, shard_offset, layer=li)
+        v_cache = scatter_cache_update_sp(v_cache, v, positions, shard_offset, layer=li)
         # per-shard KV read bound: kv_len is the GLOBAL position bucket; a
         # static local bound of min(kv_len, local_seq) is EXACT for every
         # shard — rows past it are either beyond the bucket (shard 0) or at
@@ -413,9 +462,17 @@ def _layer(
         # uniform bound is the tightest static slice available; it caps the
         # worst case at sp * min(kv_len, local_seq) reads instead of the
         # full allocation every token (the round-2 behavior).
-        local_seq = k_cache.shape[1]
+        local_seq = k_cache.shape[2] if stacked_cache else k_cache.shape[1]
         local_kv = min(kv_len, local_seq) if kv_len is not None else local_seq
-        if local_kv < local_seq:
+        if stacked_cache:
+            nh, hd = k_cache.shape[3], k_cache.shape[4]
+            k_view = jax.lax.dynamic_slice(
+                k_cache, (li, 0, 0, 0, 0), (1, b, local_kv, nh, hd)
+            )[0]
+            v_view = jax.lax.dynamic_slice(
+                v_cache, (li, 0, 0, 0, 0), (1, b, local_kv, nh, hd)
+            )[0]
+        elif local_kv < local_seq:
             k_view = jax.lax.slice_in_dim(k_cache, 0, local_kv, axis=1)
             v_view = jax.lax.slice_in_dim(v_cache, 0, local_kv, axis=1)
         else:
@@ -477,22 +534,25 @@ def forward_uncompiled(
 
     x = params.embedding[tokens].astype(jnp.float32)
 
-    # the scan's xs carry only the layer index and this layer's cache slice;
-    # the stacked weights ride in via closure and each matmul selects its
-    # layer inside the kernel — scanning over sliced weights instead would
-    # copy every layer's weights out of the stack on every step (a
-    # dynamic-slice cannot fuse into a pallas_call)
-    def body(carry, per_layer):
-        x = carry
-        li, k_c, v_c = per_layer
+    # the scan's xs carry only the layer index; the stacked weights ride in
+    # via closure and each matmul selects its layer inside the kernel
+    # (scanning over sliced weights instead would copy every layer's weights
+    # out of the stack on every step — a dynamic-slice cannot fuse into a
+    # pallas_call). The FULL cache stack rides the CARRY and each layer
+    # updates its rows in place (stacked_cache): threading per-layer slices
+    # through xs/ys instead re-stacked the whole allocation every call —
+    # measured at ~0.64 ms/token on a 134 MB cache, the dominant term of the
+    # round-3 small-model and 32k-context decode floors.
+    def body(carry, li):
+        x, k_c, v_c = carry
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
-            layer_idx=li, kv_len=kv_len,
+            layer_idx=li, kv_len=kv_len, stacked_cache=True,
         )
-        return x, (k_c, v_c)
+        return (x, k_c, v_c), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    x, (new_k, new_v) = jax.lax.scan(body, x, (layer_ids, cache.k, cache.v))
+    (x, new_k, new_v), _ = jax.lax.scan(body, (x, cache.k, cache.v), layer_ids)
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":

@@ -97,10 +97,13 @@ def gqa_attention_sp(
 
 
 def scatter_cache_update_sp(
-    cache: jnp.ndarray,  # [b, local_seq, n_kv, head_dim] — this shard's slice
+    cache: jnp.ndarray,  # [b, local_seq, n_kv, head_dim] — this shard's
+    # slice; with `layer` given, the full [L, b, local_seq, n_kv, head_dim]
+    # stack (the in-place carried-cache threading, models/transformer.py)
     new: jnp.ndarray,  # [b, t, n_kv, head_dim]
     positions: jnp.ndarray,  # [b, t] GLOBAL positions of the new rows
     shard_offset: jnp.ndarray,
+    layer=None,  # scalar int32 layer index into the stacked cache
 ) -> jnp.ndarray:
     """Write new KV rows into a seq-sharded cache slice.
 
@@ -110,7 +113,8 @@ def scatter_cache_update_sp(
     else. (A round-2 one-hot formulation paid O(local_seq*t) mask work per
     layer per step — on a 16k shard that dwarfed the row writes themselves.)
     """
-    b, local_seq = cache.shape[0], cache.shape[1]
+    seq_axis = 1 if layer is None else 2
+    b, local_seq = new.shape[0], cache.shape[seq_axis]
     t = positions.shape[1]
     local_pos = positions - shard_offset  # [b, t]; negative/too-big = foreign
     # remap EVERY foreign row to local_seq + its own column index: negative
@@ -122,7 +126,11 @@ def scatter_cache_update_sp(
     col = jnp.arange(t, dtype=local_pos.dtype)[None, :]
     local_pos = jnp.where(oob, local_seq + col, local_pos)
     b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
-    return cache.at[b_idx, local_pos].set(
+    if layer is None:
+        return cache.at[b_idx, local_pos].set(
+            new.astype(cache.dtype), mode="drop", unique_indices=True
+        )
+    return cache.at[layer, b_idx, local_pos].set(
         new.astype(cache.dtype), mode="drop", unique_indices=True
     )
 

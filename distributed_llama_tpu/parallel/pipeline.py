@@ -90,11 +90,34 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
 
 def _local_stage(
     cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx,
-    ep_axis=None, kv_len=None,
+    ep_axis=None, kv_len=None, stacked_cache=False,
 ):
     """Run this device's resident layers over x (a scan, like the global
-    forward but over the local slice)."""
+    forward but over the local slice).
+
+    `stacked_cache`: the local [L_local, b, S, ...] cache rides the scan's
+    CARRY with in-place per-layer updates (models/transformer.py) instead of
+    being re-stacked through xs/ys — the decode path, where the re-stack was
+    the per-token floor. Weights still arrive as per-layer xs slices."""
     reduce_fn = lambda z: jax.lax.psum(z, "tp")
+
+    if stacked_cache:
+
+        def body(carry, per_layer):
+            x, k_c, v_c = carry
+            lp, li = per_layer
+            x, k_c, v_c = _layer(
+                cfg, rope, x, positions, pos_start, lp, k_c, v_c,
+                reduce_fn=reduce_fn, sp_ctx=sp_ctx, ep_axis=ep_axis,
+                kv_len=kv_len, stacked_cache=True, cache_layer=li,
+            )
+            return (x, k_c, v_c), None
+
+        lids = jnp.arange(k_cache.shape[0], dtype=jnp.int32)
+        (x, new_k, new_v), _ = jax.lax.scan(
+            body, (x, k_cache, v_cache), (layers, lids)
+        )
+        return x, new_k, new_v
 
     def body(carry, per_layer):
         x = carry
@@ -218,49 +241,66 @@ def _stage_rounds(
             x = jnp.where(pp_rank == 0, x_in, x)
         mb_idx = r - pp_rank  # which microbatch this stage holds this round
         pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
-        off = jnp.arange(mt, dtype=jnp.int32)
-        positions = (pos0[:, None] + off[None, :]) if per_row else (pos0 + off[None, :])
-        positions = jnp.broadcast_to(positions, (b, mt))
-
-        y, k_upd, v_upd = _local_stage(
-            cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
-            sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
-        )
-        # commit cache only when this stage held a real microbatch. Without
-        # sp, only rows [pos0, pos0+mt) can differ — select just that window
-        # (a full-cache jnp.where would read+write the whole allocation per
-        # round, per token, on decode)
         active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
-        if sp_ctx is None:
-            if per_row:
-                # per-row windows: each row's [pos0_r, pos0_r+mt) slice may
-                # start anywhere, so vmap the window select over the batch
-                # axis (cache axis 1). A parked row's pos0 clamps into the
-                # tail here, but _layer's drop-scatter left upd == full for
-                # it, so the re-write is an identity.
-                def commit(full, upd):
-                    def row(fr, ur, p):  # [L, S, h, d]
-                        new_win = jax.lax.dynamic_slice_in_dim(ur, p, mt, axis=1)
-                        old_win = jax.lax.dynamic_slice_in_dim(fr, p, mt, axis=1)
-                        win = jnp.where(active, new_win, old_win)
-                        return jax.lax.dynamic_update_slice_in_dim(fr, win, p, axis=1)
-
-                    return jax.vmap(row, in_axes=(1, 1, 0), out_axes=1)(full, upd, pos0)
-
-            else:
-
-                def commit(full, upd):
-                    new_win = jax.lax.dynamic_slice_in_dim(upd, pos0, mt, axis=2)
-                    old_win = jax.lax.dynamic_slice_in_dim(full, pos0, mt, axis=2)
-                    win = jnp.where(active, new_win, old_win)
-                    return jax.lax.dynamic_update_slice_in_dim(full, win, pos0, axis=2)
-
-            k_cache = commit(k_cache, k_upd)
-            v_cache = commit(v_cache, v_upd)
+        off = jnp.arange(mt, dtype=jnp.int32)
+        if mt == 1:
+            # decode rounds: the local cache stack updates IN PLACE inside
+            # the layer scan's carry (stacked_cache). An inactive stage is
+            # "parked": its rows point at the global seq_len, so the
+            # OOB-drop scatter writes nothing — replacing the old
+            # read+select+write window commit AND the xs/ys re-stack of the
+            # whole local allocation every round (the per-token floor).
+            pos_eff = jnp.broadcast_to(
+                jnp.where(active, pos0, jnp.int32(cfg.seq_len)), (b,)
+            )
+            positions = pos_eff[:, None] + off[None, :]
+            y, k_cache, v_cache = _local_stage(
+                cfg, rope_t, x, positions, pos_eff, params.layers, k_cache,
+                v_cache, sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
+                stacked_cache=True,
+            )
         else:
-            # sp scatters rows anywhere in the local shard — no window bound
-            k_cache = jnp.where(active, k_upd, k_cache)
-            v_cache = jnp.where(active, v_upd, v_cache)
+            positions = (pos0[:, None] + off[None, :]) if per_row else (pos0 + off[None, :])
+            positions = jnp.broadcast_to(positions, (b, mt))
+
+            y, k_upd, v_upd = _local_stage(
+                cfg, rope_t, x, positions, pos0, params.layers, k_cache, v_cache,
+                sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
+            )
+            # commit cache only when this stage held a real microbatch.
+            # Without sp, only rows [pos0, pos0+mt) can differ — select just
+            # that window (a full-cache jnp.where would read+write the whole
+            # allocation per round)
+            if sp_ctx is None:
+                if per_row:
+                    # per-row windows: each row's [pos0_r, pos0_r+mt) slice
+                    # may start anywhere, so vmap the window select over the
+                    # batch axis (cache axis 1). A parked row's pos0 clamps
+                    # into the tail here, but _layer's drop-scatter left
+                    # upd == full for it, so the re-write is an identity.
+                    def commit(full, upd):
+                        def row(fr, ur, p):  # [L, S, h, d]
+                            new_win = jax.lax.dynamic_slice_in_dim(ur, p, mt, axis=1)
+                            old_win = jax.lax.dynamic_slice_in_dim(fr, p, mt, axis=1)
+                            win = jnp.where(active, new_win, old_win)
+                            return jax.lax.dynamic_update_slice_in_dim(fr, win, p, axis=1)
+
+                        return jax.vmap(row, in_axes=(1, 1, 0), out_axes=1)(full, upd, pos0)
+
+                else:
+
+                    def commit(full, upd):
+                        new_win = jax.lax.dynamic_slice_in_dim(upd, pos0, mt, axis=2)
+                        old_win = jax.lax.dynamic_slice_in_dim(full, pos0, mt, axis=2)
+                        win = jnp.where(active, new_win, old_win)
+                        return jax.lax.dynamic_update_slice_in_dim(full, win, pos0, axis=2)
+
+                k_cache = commit(k_cache, k_upd)
+                v_cache = commit(v_cache, v_upd)
+            else:
+                # sp scatters rows anywhere in the local shard — no window bound
+                k_cache = jnp.where(active, k_upd, k_cache)
+                v_cache = jnp.where(active, v_upd, v_cache)
         # last stage's output for microbatch (r - pp + 1) is final
         if r >= pp - 1:
             done.append(jnp.where(pp_rank == pp - 1, y, 0.0))
@@ -338,7 +378,8 @@ def pipeline_decode_chunk(
     each forward crossing the pp stages via ppermute inside the scan — no
     per-token host round trip on PP/SP/EP meshes.
 
-    Returns (tokens [b, n_steps], cache).
+    Returns (tokens [b, n_steps], last_token [b], cache) — `last_token`
+    aliases tokens[:, -1] on device (see runtime/decode.decode_chunk).
     """
     per_row = jnp.ndim(pos_start) > 0
     fn = _cached_pipeline_fn(
@@ -370,7 +411,7 @@ def _build_pipeline_decode_fn(
             params_spec, rope_spec, cache_spec, P("dp"),
             P("dp") if per_row else P(), P(),
         ),
-        out_specs=(P("dp", None), cache_spec),
+        out_specs=(P("dp", None), P("dp"), cache_spec),
         check_vma=False,
     )
     def run(params, rope_t, cache, token, pos_start, key):
@@ -393,13 +434,13 @@ def _build_pipeline_decode_fn(
             nxt = sample_logits(logits, sub, temperature, topp)
             return (nxt, pos + 1, k_cache, v_cache, key), nxt
 
-        (_, _, k_cache, v_cache, _), toks = jax.lax.scan(
+        (last, _, k_cache, v_cache, _), toks = jax.lax.scan(
             step,
             (token, jnp.asarray(pos_start, jnp.int32), cache.k, cache.v, key),
             None,
             length=n_steps,
         )
-        return jnp.transpose(toks, (1, 0)), KVCache(k=k_cache, v=v_cache)
+        return jnp.transpose(toks, (1, 0)), last, KVCache(k=k_cache, v=v_cache)
 
     return jax.jit(run, donate_argnums=(2,))
 

@@ -50,7 +50,11 @@ def decode_chunk(
 ):
     """Run n_steps feed-forward+sample iterations on device.
 
-    Returns (tokens [b, n_steps] — the sampled continuations, cache).
+    Returns (tokens [b, n_steps], last_token [b], cache): `last_token`
+    aliases tokens[:, -1] on device so the caller can feed the next chunk
+    without issuing a separate slice op — through the driver tunnel every
+    host-issued device op costs a round trip, and the decode loop's per-chunk
+    op count is the serving overhead floor.
     """
 
     def step(carry, _):
@@ -63,7 +67,7 @@ def decode_chunk(
         nxt = sample_logits(logits, sub, temperature, topp)
         return (nxt, pos + 1, cache, key), nxt
 
-    (_, _, cache, _), toks = jax.lax.scan(
+    (last, _, cache, _), toks = jax.lax.scan(
         step, (token, jnp.asarray(pos_start, jnp.int32), cache, key), None, length=n_steps
     )
-    return jnp.transpose(toks, (1, 0)), cache
+    return jnp.transpose(toks, (1, 0)), last, cache

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import jax
@@ -94,6 +95,14 @@ def maybe_enable_compilation_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax without the knobs — cache is an optimization only
+
+
+def _next_subkey(key, temperature: float):
+    """(key, subkey) for one decode chunk. Greedy chunks never draw, so the
+    per-chunk split — a device op, i.e. a tunnel round trip — is skipped."""
+    if temperature == 0.0:
+        return key, key
+    return jax.random.split(key)
 
 
 def _sampler_prng_key(sampler) -> jax.Array:
@@ -243,6 +252,18 @@ class InferenceEngine:
         self._argmax_step = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
         )
+        # one worker for the decode loop's token fetches (they overlap the
+        # next chunk's dispatch round trip — see _decode_device)
+        self._fetch_pool = ThreadPoolExecutor(max_workers=1)
+
+    def close(self):
+        self._fetch_pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- low-level steps ----------------------------------------------------
 
@@ -350,8 +371,9 @@ class InferenceEngine:
         self, token, pos, key, n_steps, temperature, topp, kv_len=None
     ):
         """One on-device decode chunk on whichever execution path this
-        engine uses. `pos` may be a scalar or a [b] per-row position vector
-        (independent sequences); both paths accept either."""
+        engine uses; returns (tokens [b, n], last_token [b], cache). `pos`
+        may be a scalar or a [b] per-row position vector (independent
+        sequences); both paths accept either."""
         if self.use_pipeline:
             from ..parallel.pipeline import pipeline_decode_chunk
 
@@ -499,7 +521,7 @@ class InferenceEngine:
             while n > remaining():
                 n //= 2
             n = max(n, 1)
-            key, sub = jax.random.split(key)
+            key, sub = _next_subkey(key, temperature)
             # kv bucket covers the furthest position any ACTIVE row reaches
             # this chunk (finished rows still step, but their output is
             # discarded and their trailing cache writes are never read)
@@ -508,7 +530,7 @@ class InferenceEngine:
                 + n,
                 self.cfg.seq_len,
             )
-            toks, self.cache = self._decode_chunk_any(
+            toks, last, self.cache = self._decode_chunk_any(
                 token, pos, sub, n_steps=n, temperature=temperature,
                 topp=topp, kv_len=self._kv_bucket(max_end),
             )
@@ -527,7 +549,7 @@ class InferenceEngine:
                         done[r] = True
                     elif len(out[r]) >= budgets[r]:
                         done[r] = True
-            token = toks[:, -1]
+            token = last
             pos = pos + n
             produced += n
         return out
@@ -570,7 +592,8 @@ class InferenceEngine:
         key = [_sampler_prng_key(sampler)]
 
         def dispatch(at_pos, tok_arr, chunk=None):
-            """Queue one device chunk (async); returns (tokens_device, n)."""
+            """Queue one device chunk (async); returns (tokens_device,
+            last_token_device, n)."""
             limit = min(max_pos, self.cfg.seq_len) - at_pos
             n = chunk if chunk is not None else self.decode_chunk_size
             # largest power-of-two chunk that fits the remaining budget —
@@ -578,19 +601,25 @@ class InferenceEngine:
             while n > limit:
                 n //= 2
             n = max(n, 1)
-            key[0], sub = jax.random.split(key[0])
-            toks, self.cache = self._decode_chunk_any(
+            key[0], sub = _next_subkey(key[0], temperature)
+            toks, last, self.cache = self._decode_chunk_any(
                 tok_arr, jnp.int32(at_pos), sub, n_steps=n,
                 temperature=temperature, topp=topp,
                 kv_len=self._kv_bucket(at_pos + n),
             )
-            return toks, n
+            return toks, last, n
 
         if pos >= max_pos:
             return  # no decode budget (steps <= prompt length)
         # one-chunk lookahead: chunk i+1 is dispatched (its inputs are all
         # device-resident) before chunk i's tokens are fetched, so the
-        # ~tens-of-ms device->host transfer overlaps the next chunk's compute
+        # ~tens-of-ms device->host transfer overlaps the next chunk's compute.
+        # The fetch ALSO runs on the engine's worker thread: through the
+        # driver tunnel, dispatch and fetch are each a ~75 ms host-blocking
+        # round trip, and they are independent (the next dispatch consumes
+        # the DEVICE tokens array, not the host copy) — serializing them put
+        # a ~150 ms/chunk host floor under small-model decode (the round-3
+        # per-token floor's other half, beside the cache re-stack).
         first = True
         t_prev = time.perf_counter()
         # TTFT ramp — only when a consumer is streaming (on_token): the first
@@ -606,20 +635,22 @@ class InferenceEngine:
         pending = dispatch(
             pos, jnp.full((self.batch,), token, dtype=jnp.int32), chunk=first_chunk
         )
-        dispatched = pos + pending[1]
+        dispatched = pos + pending[2]
         while pending is not None:
-            toks, n = pending
+            toks, last, n = pending
+            # start the host fetch on the worker thread, then dispatch the
+            # lookahead chunk from this thread — the two tunnel round trips
+            # overlap. np.asarray(toks) transfers without enqueueing any
+            # device op (indexing toks[0] here would create a device slice
+            # op ordered *behind* the in-flight chunk and serialize; `last`
+            # comes back from the chunk program itself for the same reason).
+            fut = self._fetch_pool.submit(np.asarray, toks)
             nxt = None
             if dispatched < max_pos:
-                nxt = dispatch(dispatched, toks[:, -1])
-                dispatched += nxt[1]
+                nxt = dispatch(dispatched, last)
+                dispatched += nxt[2]
             with watchdog(f"decode[{n}]"):
-                # single bulk fetch of the READY buffer — np.asarray(toks)
-                # transfers without enqueueing any device op, so it runs
-                # concurrently with the in-flight lookahead chunk; indexing
-                # (toks[0]) would create a device slice op ordered *behind*
-                # that chunk and serialize fetch with compute
-                host_toks = np.asarray(toks)[0].tolist()
+                host_toks = fut.result()[0].tolist()
             now = time.perf_counter()
             dt = int((now - t_prev) * 1e6)
             t_prev = now

@@ -1,0 +1,43 @@
+"""Is the decode residual the full-cache rewrite through the scan's stacked
+ys? Chained decode steps at different ALLOCATED cache sizes (kv_len read
+bound held at 512): if the step time tracks the allocation, the scan is
+rewriting the whole cache every token and the cache should ride the carry
+with an in-place DUS instead. Diagnostic, not a test."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from profile_decode import dev_ms  # noqa: E402  (same dir)
+
+def main():
+    from bench import ensure_qwen3, ensure_model
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.models.transformer import forward_uncompiled
+    from distributed_llama_tpu.models.params import KVCache
+
+    for name, ensure in (("qwen3", ensure_qwen3), ("1b", ensure_model)):
+        path = ensure()
+        for max_seq in (512, 1024, 2048):
+            eng = InferenceEngine(path, compute_dtype="bfloat16", max_seq_len=max_seq)
+            cfg, params, rope = eng.cfg, eng.params, eng.rope
+            kv = 512
+            def make(n):
+                @jax.jit
+                def fn(params, ck, cv, tok):
+                    def body(carry, _):
+                        tok, pos, ck, cv = carry
+                        logits, cache = forward_uncompiled(
+                            cfg, params, rope, KVCache(k=ck, v=cv), tok[:, None], pos,
+                            kv_len=kv)
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (nxt, pos + 1, cache.k, cache.v), None
+                    (tok, _, ck, cv), _ = jax.lax.scan(
+                        body, (tok, jnp.int32(100), ck, cv), None, length=n)
+                    return tok
+                cache = eng._new_cache()
+                return fn, (params, cache.k, cache.v, jnp.zeros((1,), jnp.int32))
+            mb = 2 * np.prod(eng._new_cache().k.shape) * 2 / 1e6
+            ms = dev_ms(f"{name} seq_alloc={max_seq} (cache {mb:.0f} MB, kv_len 512)", make, 64)
+            del eng
+
+if __name__ == "__main__":
+    main()
