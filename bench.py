@@ -32,7 +32,11 @@ import statistics
 import sys
 import time
 
-CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+# DLT_BENCH_CACHE lets tools (scripts/ab_bench.py ref mode) point worktree
+# copies of this file at one shared model cache
+CACHE_DIR = os.environ.get("DLT_BENCH_CACHE") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_cache"
+)
 BASELINE_TOK_S = 26.4  # reference PP=4 best (see module docstring)
 
 # persistent XLA compile cache: first compiles of the big prefill graphs
@@ -93,13 +97,17 @@ def ensure_moe() -> str:
 
 
 def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw):
-    """(decode_tok_s, prefill_tok_s, ttft_ms, marginal_prefill, eng).
+    """(decode_tok_s, prefill_tok_s, ttft_ms, marginal_prefill,
+    prefill_wall_long_ms, eng).
 
     prefill_tok_s is the naive prompt/wall rate — at a 512-token prompt it
     is dominated by the ~70-90 ms tunnel dispatch of this environment, NOT
     compute (one chunk = one dispatch). marginal_prefill differences two
     prompt lengths so the fixed dispatch cancels: the steady-state rate a
     long prompt actually sees (and what non-tunnel deployments get).
+    prefill_wall_long_ms is the RAW wall of the 3x-length prompt — the
+    direct lower bound the marginal metric must reconcile with
+    (long_n tokens took this many ms, no differencing, no modeling).
     """
     from distributed_llama_tpu.runtime.engine import InferenceEngine
 
@@ -133,26 +141,29 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     # marginal prefill rate: difference long vs short prompt walls
     long_n = min(3 * prefill_tokens, eng.cfg.seq_len - 64)
     marginal = None
+    wall_long_ms = None
     if long_n > prefill_tokens:
-        def prefill_wall(n):
+        def prefill_wall(n, reps=5):
             walls = []
-            for _ in range(3):
+            for _ in range(reps):
                 eng.reset()
                 t0 = time.perf_counter()
                 eng.prefill([(i % 1000) + 1 for i in range(n)])
                 walls.append(time.perf_counter() - t0)
             return min(walls), max(walls) - min(walls)
-        prefill_wall(long_n)  # compile the extra chunk shapes
+        prefill_wall(long_n, reps=1)  # compile the extra chunk shapes
         t_long, spread_long = prefill_wall(long_n)
         t_short, spread_short = prefill_wall(prefill_tokens)
+        wall_long_ms = t_long * 1e3
         # the difference must clear the observed run-to-run jitter or the
         # quotient is noise (observed: a 2.4k tok/s config reporting 4M
         # through the tunnel's ~10-30 ms dispatch variance); the floor is
         # jitter-RELATIVE so fast direct-attached hardware, where the
-        # measurement is clean and small, still reports
+        # measurement is clean and small, still reports. 5 reps (min) keep
+        # the spreads tight enough that healthy windows rarely null out.
         if t_long - t_short > max(0.002, spread_long + spread_short):
             marginal = (long_n - prefill_tokens) / (t_long - t_short)
-    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, eng
+    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, wall_long_ms, eng
 
 
 def leg_8b():
@@ -172,7 +183,7 @@ def leg_8b():
     prev = os.environ.get("DLT_STALL_TIMEOUT_MS")
     os.environ.setdefault("DLT_STALL_TIMEOUT_MS", "1800000")
     try:
-        decode, prefill, ttft, marginal, eng = measure(path, 512, 128)
+        decode, prefill, ttft, marginal, wall_long, eng = measure(path, 512, 128)
     finally:
         if prev is None:
             os.environ.pop("DLT_STALL_TIMEOUT_MS", None)
@@ -188,6 +199,7 @@ def leg_8b():
         "decode_tok_s": round(decode, 2),
         "prefill_tok_s": round(prefill, 1),
         "prefill_tok_s_marginal": marginal and round(marginal, 1),
+        "prefill_wall_long_ms": wall_long and round(wall_long, 1),
         "ttft_ms": round(ttft, 1),
         "decode_eff_gb_s": round(gbs, 1),
         "hbm_roofline_pct": round(100 * gbs / 819, 1),
@@ -280,7 +292,7 @@ def main():
     # headline: 1B Llama
     model_path = ensure_model()
     t0 = time.time()
-    decode, prefill, ttft, marginal, eng = measure(model_path, 512, 256)
+    decode, prefill, ttft, marginal, wall_long, eng = measure(model_path, 512, 256)
     print(
         f"# llama1b: decode {decode:.1f} tok/s, prefill {prefill:.1f} tok/s "
         f"(marginal {marginal and round(marginal, 1)}), "
@@ -294,6 +306,7 @@ def main():
             "decode_tok_s": round(decode, 2),
             "prefill_tok_s": round(prefill, 1),
             "prefill_tok_s_marginal": marginal and round(marginal, 1),
+            "prefill_wall_long_ms": wall_long and round(wall_long, 1),
             "ttft_ms": round(ttft, 1),
         }
     )
@@ -302,22 +315,25 @@ def main():
     # the small models are dispatch-overhead-bound at chunk 64 (compute
     # ~46 ms/chunk < the ~100 ms tunnel round trip), so they decode in
     # 128-token chunks; the 1B/8B are compute-bound at 64 and the lookahead
-    # already hides their dispatch
+    # already hides their dispatch. MoE prefills a 1024-token prompt: its
+    # 512-token chunk computes in ~11 ms (profile_prefill --model moe), so
+    # short prompts measure only the ~100 ms per-chunk dispatch.
     extra_legs = [
         ("qwen3-class q40 1chip",
          lambda: measure(ensure_qwen3(), 256, 256, decode_chunk_size=128)),
         ("qwen3-moe-class q40 1chip",
-         lambda: measure(ensure_moe(), 256, 256, decode_chunk_size=128)),
+         lambda: measure(ensure_moe(), 1024, 256, decode_chunk_size=128)),
     ]
     for name, fn in extra_legs:
         try:
-            d, p, t, m, _ = fn()
+            d, p, t, m, wl, _ = fn()
             configs.append(
                 {
                     "config": name,
                     "decode_tok_s": round(d, 2),
                     "prefill_tok_s": round(p, 1),
                     "prefill_tok_s_marginal": m and round(m, 1),
+                    "prefill_wall_long_ms": wl and round(wl, 1),
                     "ttft_ms": round(t, 1),
                 }
             )

@@ -318,27 +318,36 @@ def run_chat(args) -> int:
     return 0
 
 
+def _worker_migration_message() -> int:
+    # the reference's cluster model (root + `dllama worker --port N`
+    # processes, src/app.cpp:425-489) has no analogue here:
+    # multi-controller SPMD runs the SAME command on every host. Greet
+    # migrating scripts with the mapping instead of an argparse error.
+    print(
+        "this framework has no worker processes: multi-chip/multi-host "
+        "execution runs the SAME command on every host.\n"
+        "  reference:  dllama inference --workers h1:port h2:port ...\n"
+        "  here:       <same inference command> --tp N      (one host)\n"
+        "              <same inference command> --distributed "
+        "--coordinator h0:port --num-processes P --process-id i  (pod)\n"
+        "see docs/DISTRIBUTED.md",
+        file=sys.stderr,
+    )
+    return 2
+
+
 def main(argv=None) -> int:
     raw = list(argv) if argv is not None else sys.argv[1:]
     if raw[:1] == ["worker"]:
-        # the reference's cluster model (root + `dllama worker --port N`
-        # processes, src/app.cpp:425-489) has no analogue here:
-        # multi-controller SPMD runs the SAME command on every host. Greet
-        # migrating scripts with the mapping instead of an argparse error
-        # (short-circuited before parsing so the reference's worker flags
-        # don't get in the way).
-        print(
-            "this framework has no worker processes: multi-chip/multi-host "
-            "execution runs the SAME command on every host.\n"
-            "  reference:  dllama inference --workers h1:port h2:port ...\n"
-            "  here:       <same inference command> --tp N      (one host)\n"
-            "              <same inference command> --distributed "
-            "--coordinator h0:port --num-processes P --process-id i  (pod)\n"
-            "see docs/DISTRIBUTED.md",
-            file=sys.stderr,
-        )
-        return 2
+        # short-circuited before parsing so the reference's worker flags
+        # don't get in the way
+        return _worker_migration_message()
     args = build_arg_parser().parse_args(raw)
+    if args.mode == "worker":
+        # `worker` anywhere else in argv (e.g. after --model/--tokenizer)
+        # parses fine — it is in the mode choices — and must get the same
+        # migration message, not a silent exit
+        return _worker_migration_message()
     if args.model is None or args.tokenizer is None:
         print("--model and --tokenizer are required", file=sys.stderr)
         return 2

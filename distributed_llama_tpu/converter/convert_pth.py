@@ -1,0 +1,268 @@
+"""Original Meta-distribution Llama checkpoint (`consolidated.*.pth` +
+`params.json`) -> `.m` model file.
+
+Mirrors the reference converter's behavior exactly
+(reference: converter/convert-llama.py):
+
+* same tensor write order (embedding, then per layer wq wk wv wo w1 w2 w3
+  attention_norm ffn_norm, then norm, output);
+* multi-shard concatenation: axis 1 for `tok_embeddings`/`wo`/`w2`
+  (column-split in the Meta sharding), axis 0 otherwise; 1-D tensors taken
+  from the first shard (convert-llama.py:74-92);
+* NO q/k permute — Meta layout is already interleaved-rope, matching the
+  runtime's Llama rope (the HF converter's permute exists to undo HF's
+  NeoX re-layout);
+* header from params.json (n_kv_heads defaults to n_heads, rope_theta
+  truncated to int, vocab_size must be patched positive —
+  convert-llama.py:14-27); hidden_dim inferred from w1's first axis times
+  the shard count (convert-llama.py:65).
+
+Torch is NOT a dependency: the `.pth` zip container's `data.pkl` is parsed
+with a restricted unpickler that understands exactly the torch tensor
+rebuild protocol (persistent-id storages + `_rebuild_tensor_v2`), and the
+raw storages are read straight from the zip — the same hand-rolled-format
+stance as the sentencepiece protobuf reader (convert_tokenizer_spm.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import struct
+import zipfile
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from ..formats import mfile
+from ..formats.mfile import ArchType, MFileWriter
+from ..formats.quants import FloatType
+
+# torch storage class name -> (numpy reader dtype, bytes per element)
+_STORAGE_DTYPES = {
+    "FloatStorage": ("<f4", 4),
+    "HalfStorage": ("<f2", 2),
+    "BFloat16Storage": ("<u2", 2),  # raw bits; converted below
+    "DoubleStorage": ("<f8", 8),
+}
+
+
+@dataclass
+class _Storage:
+    key: str
+    dtype_name: str
+    numel: int
+
+
+class _StorageRef:
+    """Marker class the unpickler maps torch.*Storage names onto."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
+    return (bits.astype(np.uint32) << 16).view(np.float32)
+
+
+class _TorchUnpickler(pickle.Unpickler):
+    """Restricted unpickler for torch checkpoint `data.pkl` files: resolves
+    only the symbols the tensor protocol needs and REFUSES everything else
+    (a .pth is arbitrary pickle; this never executes foreign constructors)."""
+
+    def find_class(self, module, name):
+        if module == "torch._utils" and name in (
+            "_rebuild_tensor_v2", "_rebuild_tensor",
+        ):
+            def rebuild(storage, storage_offset, size, stride, *unused):
+                return {"storage": storage, "offset": storage_offset,
+                        "size": tuple(size), "stride": tuple(stride)}
+            return rebuild
+        if module == "torch" and name in _STORAGE_DTYPES:
+            return _StorageRef(name)
+        if module == "collections" and name == "OrderedDict":
+            import collections
+
+            return collections.OrderedDict
+        raise pickle.UnpicklingError(f"refusing to load {module}.{name}")
+
+    def persistent_load(self, pid):
+        # ('storage', StorageRef, key, location, numel)
+        if not (isinstance(pid, tuple) and pid and pid[0] == "storage"):
+            raise pickle.UnpicklingError(f"unexpected persistent id {pid!r}")
+        ref, key, _loc, numel = pid[1], pid[2], pid[3], pid[4]
+        name = ref.name if isinstance(ref, _StorageRef) else str(ref)
+        return _Storage(key=str(key), dtype_name=name, numel=int(numel))
+
+
+class PthReader:
+    """Lazy tensor access into one `.pth` zip checkpoint."""
+
+    def __init__(self, path: str):
+        self.zf = zipfile.ZipFile(path)
+        names = self.zf.namelist()
+        pkl = next((n for n in names if n.endswith("/data.pkl")), None)
+        if pkl is None:
+            raise ValueError(
+                f"{path}: not a zip-format torch checkpoint (no data.pkl); "
+                "legacy tar-format .pth files are not supported"
+            )
+        self.prefix = pkl[: -len("data.pkl")]
+        with self.zf.open(pkl) as f:
+            self.manifest = dict(_TorchUnpickler(f).load())
+
+    def keys(self):
+        return self.manifest.keys()
+
+    def get(self, name: str) -> np.ndarray:
+        ent = self.manifest[name]
+        st: _Storage = ent["storage"]
+        dtype_str, esize = _STORAGE_DTYPES[st.dtype_name]
+        raw = self.zf.read(f"{self.prefix}data/{st.key}")
+        arr = np.frombuffer(raw, dtype=dtype_str, count=st.numel)
+        if st.dtype_name == "BFloat16Storage":
+            arr = _bf16_bits_to_f32(arr)
+        # contiguous-only: Meta checkpoints store dense row-major tensors
+        expect = []
+        acc = 1
+        for s in reversed(ent["size"]):
+            expect.append(acc)
+            acc *= s
+        if ent["size"] and tuple(reversed(expect)) != ent["stride"]:
+            raise ValueError(f"{name}: non-contiguous stride {ent['stride']}")
+        n = int(np.prod(ent["size"])) if ent["size"] else 1
+        arr = arr[ent["offset"] : ent["offset"] + n].reshape(ent["size"])
+        return arr.astype(np.float32)
+
+
+def header_kv_from_params(params: dict, weight_type: int, hidden_dim: int,
+                          max_seq_len: int = 0) -> dict:
+    if params.get("vocab_size", -1) < 1:
+        raise ValueError(
+            "vocab_size is invalid, please update params.json "
+            "(reference converter requires the same patch)"
+        )
+    if params.get("max_seq_len") is None:
+        # real Meta params.json files carry no max_seq_len — the reference
+        # demands a manual params.json patch; here --max-seq-len can supply
+        # it directly
+        if not max_seq_len:
+            raise ValueError(
+                "max_seq_len is required: add it to params.json or pass "
+                "--max-seq-len"
+            )
+        seq_len = int(max_seq_len)
+    else:
+        seq_len = int(params["max_seq_len"])
+        if max_seq_len and seq_len > max_seq_len:
+            seq_len = max_seq_len
+    kv = {
+        mfile.K_VERSION: 0,
+        mfile.K_ARCH_TYPE: ArchType.LLAMA,
+        mfile.K_DIM: int(params["dim"]),
+        mfile.K_HIDDEN_DIM: hidden_dim,
+        mfile.K_N_LAYERS: int(params["n_layers"]),
+        mfile.K_N_HEADS: int(params["n_heads"]),
+        mfile.K_N_KV_HEADS: int(params.get("n_kv_heads") or params["n_heads"]),
+        mfile.K_N_EXPERTS: 0,
+        mfile.K_N_ACTIVE_EXPERTS: 0,
+        mfile.K_VOCAB_SIZE: int(params["vocab_size"]),
+        mfile.K_SEQ_LEN: seq_len,
+        mfile.K_HIDDEN_ACT: 1,  # silu (all Meta Llama lineages)
+        mfile.K_WEIGHT_FLOAT_TYPE: weight_type,
+    }
+    if "rope_theta" in params:
+        kv[mfile.K_ROPE_THETA] = int(params["rope_theta"])
+    eps = params.get("norm_eps", 1e-5)
+    import math
+
+    eps_code = round(-math.log10(eps))
+    if eps_code not in (5, 6) or abs(eps - 10.0**-eps_code) > 1e-12:
+        raise ValueError(f"unsupported norm_eps {eps}")
+    kv[mfile.K_NORM_EPSILON] = eps_code
+    return kv
+
+
+# shards concatenate on axis 1 for these (column-split in the Meta layout)
+def _concat_axis(name: str) -> int:
+    if (
+        name == "tok_embeddings.weight"
+        or name.endswith(".attention.wo.weight")
+        or name.endswith(".feed_forward.w2.weight")
+    ):
+        return 1
+    return 0
+
+
+def convert_llama_pth(
+    model_dir: str,
+    out_path: str,
+    weight_type_name: str = "q40",
+    max_seq_len: int = 0,
+    progress=print,
+) -> None:
+    """Convert a Meta-distribution Llama checkpoint directory to `.m`."""
+    with open(os.path.join(model_dir, "params.json")) as f:
+        params = json.load(f)
+    shards = [
+        PthReader(str(p))
+        for p in sorted(Path(model_dir).glob("consolidated.*.pth"))
+    ]
+    if not shards:
+        raise FileNotFoundError(f"no consolidated.*.pth in {model_dir}")
+    wt = FloatType.parse(weight_type_name)
+    n_layers = int(params["n_layers"])
+    hidden_dim = shards[0].get("layers.0.feed_forward.w1.weight").shape[0] * len(shards)
+    kv = header_kv_from_params(params, wt, hidden_dim, max_seq_len=max_seq_len)
+
+    def merged(name: str) -> np.ndarray:
+        parts = [s.get(name) for s in shards]
+        if len(parts) == 1 or parts[0].ndim == 1:
+            return parts[0]
+        return np.concatenate(parts, axis=_concat_axis(name))
+
+    with MFileWriter(out_path, kv) as out:
+        def write(ft, name):
+            w = merged(name)
+            progress(f"🔶 writing {name} {tuple(w.shape)}")
+            out.write_tensor(w, ft)
+
+        write(FloatType.F32, "tok_embeddings.weight")
+        for l in range(n_layers):
+            pre = f"layers.{l}"
+            write(wt, f"{pre}.attention.wq.weight")
+            write(wt, f"{pre}.attention.wk.weight")
+            write(wt, f"{pre}.attention.wv.weight")
+            write(wt, f"{pre}.attention.wo.weight")
+            write(wt, f"{pre}.feed_forward.w1.weight")
+            write(wt, f"{pre}.feed_forward.w2.weight")
+            write(wt, f"{pre}.feed_forward.w3.weight")
+            write(FloatType.F32, f"{pre}.attention_norm.weight")
+            write(FloatType.F32, f"{pre}.ffn_norm.weight")
+        write(FloatType.F32, "norm.weight")
+        write(wt, "output.weight")
+    progress(f"✅ wrote {out_path}")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="convert-llama")
+    p.add_argument("model_dir")
+    p.add_argument("weight_type", choices=["f32", "f16", "q40", "q80"])
+    p.add_argument("--max-seq-len", type=int, default=0)
+    args = p.parse_args(argv)
+    name = os.path.basename(os.path.normpath(args.model_dir)).lower()
+    convert_llama_pth(
+        args.model_dir,
+        f"dllama_model_{name}_{args.weight_type}.m",
+        args.weight_type,
+        max_seq_len=args.max_seq_len,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,6 +71,35 @@ def initialize_distributed(
             # global_state probe above stops working in a future JAX
             if "already initialized" not in str(e).lower():
                 raise
+    else:
+        # bare --distributed, no explicit flags: let jax's cluster
+        # auto-detection have a shot (TPU pod metadata, GKE env vars live
+        # inside initialize() itself, not in any env var this code could
+        # check without initializing a backend). On plain TPU VM slices
+        # jax.devices() is natively global, so falling back to
+        # single-process is correct there; on an undetectable environment
+        # the fallback keeps single-machine runs working.
+        try:
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError) as e:
+            # ValueError = auto-detection found no usable cluster spec;
+            # RuntimeError with "before any JAX calls"/"already initialized"
+            # = this process already touched the backend (library use). Both
+            # fall back to single-process (plain TPU VM slices are already
+            # global). Connection/runtime failures on a DETECTED cluster
+            # propagate: silently running P duplicate single-process jobs
+            # would be far worse than a loud failure.
+            if isinstance(e, RuntimeError) and not (
+                "before any jax calls" in str(e).lower()
+                or "already initialized" in str(e).lower()
+            ):
+                raise
+            print(
+                f"ℹ️  --distributed: multi-host auto-init unavailable "
+                f"({type(e).__name__}); continuing single-process (pass "
+                f"--coordinator/--num-processes/--process-id on env-driven "
+                f"clusters)"
+            )
 
 
 def make_multihost_mesh(

@@ -21,14 +21,19 @@ from profile_decode import dev_ms  # differenced timing
 
 
 def main():
-    from bench import ensure_model
+    import argparse
+
+    from bench import ensure_model, ensure_moe, ensure_qwen3
     from distributed_llama_tpu.runtime.engine import InferenceEngine
     from distributed_llama_tpu.models.transformer import forward_uncompiled
     from distributed_llama_tpu.models.params import KVCache
     from distributed_llama_tpu.ops.quant import quant_matmul
     from distributed_llama_tpu.ops.pallas_attention import flash_attention
 
-    path = ensure_model()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["1b", "qwen3", "moe"], default="1b")
+    args = ap.parse_args()
+    path = {"1b": ensure_model, "qwen3": ensure_qwen3, "moe": ensure_moe}[args.model]()
     engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
     cfg, params, rope = engine.cfg, engine.params, engine.rope
     T = 512
@@ -62,10 +67,12 @@ def main():
             lp = params.layers
             def layer_body(x, li):
                 qkv = quant_matmul(x, lp.wqkv, pallas=True, layer=li)
-                x = quant_matmul(qkv[..., : cfg.dim], lp.wo, pallas=True, layer=li)
-                h13 = quant_matmul(x, lp.w13, pallas=True, layer=li)
-                ff = h13.shape[-1] // 2
-                x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=True, layer=li)
+                q_out = cfg.n_heads * cfg.head_dim
+                x = quant_matmul(qkv[..., :q_out], lp.wo, pallas=True, layer=li)
+                if not cfg.is_moe:
+                    h13 = quant_matmul(x, lp.w13, pallas=True, layer=li)
+                    ff = h13.shape[-1] // 2
+                    x = quant_matmul(h13[..., :ff] * h13[..., ff:], lp.w2, pallas=True, layer=li)
                 return x.astype(jnp.bfloat16), None
             def body(x, _):
                 x, _ = jax.lax.scan(layer_body, x, jnp.arange(cfg.n_layers, dtype=jnp.int32))
@@ -75,13 +82,101 @@ def main():
             return x
         return fn, (params, jnp.ones((1, T, cfg.dim), jnp.bfloat16))
 
-    mm = dev_ms(f"matmul chain t={T}", mk_mm, N)
+    mm_label = "att matmuls" if cfg.is_moe else "matmul chain"
+    mm = dev_ms(f"{mm_label} t={T}", mk_mm, N)
+    ffn_flops = 0 if cfg.is_moe else 3 * cfg.dim * cfg.hidden_dim
     flops = T * (cfg.n_layers * (
         cfg.dim * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim
         + cfg.dim * cfg.n_heads * cfg.head_dim
-        + 3 * cfg.dim * cfg.hidden_dim
+        + ffn_flops
     ) * 2)
     print(f"    -> {flops/mm/1e9:.1f} TFLOP/s ({100*flops/mm/1e9/197:.1f}% MFU)")
+
+    # MoE ffn itemization: full _moe_ffn, router alone, grouped matmuls on a
+    # frozen layout, and (by difference) the sort/layout/scatter glue
+    moe = router_ms = gdots_ms = 0.0
+    if cfg.is_moe:
+        from distributed_llama_tpu.models.transformer import _moe_ffn
+        from distributed_llama_tpu.ops.moe import _grouped_layout, moe_router
+        from distributed_llama_tpu.ops.pallas_q40 import q40_matmul_pallas_grouped
+        from distributed_llama_tpu.ops.activations import silu
+
+        def mk_moe(n):
+            @jax.jit
+            def fn(params, y):
+                def layer_body(y, li):
+                    out = _moe_ffn(cfg, y, params.layers, li)
+                    return (y + out.astype(y.dtype) * 1e-30).astype(y.dtype), None
+                def body(y, _):
+                    y, _ = jax.lax.scan(
+                        layer_body, y, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+                    return y, None
+                y, _ = jax.lax.scan(body, y, None, length=n)
+                return y
+            return fn, (params, jnp.ones((1, T, cfg.dim), jnp.bfloat16))
+
+        moe = dev_ms(f"moe ffn x{cfg.n_layers} t={T} (full)", mk_moe, N)
+
+        def mk_router(n):
+            @jax.jit
+            def fn(params, y):
+                def layer_body(y, li):
+                    gate = jax.lax.dynamic_index_in_dim(
+                        params.layers.moe_gate, li, 0, keepdims=False)
+                    idx, wts = moe_router(y, gate, cfg.n_active_experts)
+                    return (y + (wts.sum() * 1e-30).astype(y.dtype)
+                            + (idx.sum() * 0).astype(y.dtype)), None
+                def body(y, _):
+                    y, _ = jax.lax.scan(
+                        layer_body, y, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+                    return y, None
+                y, _ = jax.lax.scan(body, y, None, length=n)
+                return y
+            return fn, (params, jnp.ones((1, T, cfg.dim), jnp.bfloat16))
+
+        router_ms = dev_ms(f"router x{cfg.n_layers} t={T}", mk_router, N)
+
+        # grouped matmuls only: layout frozen outside the timed loop
+        rows = T * cfg.n_active_experts
+        k_act = cfg.n_active_experts
+        counts = jnp.full((cfg.n_experts,), rows // cfg.n_experts, jnp.int32)
+        avg = max(1, rows // cfg.n_experts)
+        block_r = 8
+        while block_r * 2 <= min(avg, 64):
+            block_r *= 2
+        # scatter/gather half deliberately excluded from the timed region
+        _, block_expert, R_pad = _grouped_layout(
+            counts, rows, cfg.n_experts, block_r)
+
+        def mk_gdots(n):
+            # weights ride as ARGS (a closure would bake them into the HLO
+            # as literals — the remote compiler rejects the request body)
+            @jax.jit
+            def fn(xp, be, w1q, w1d, w3q, w3d, w2q, w2d):
+                def layer_body(xp, li):
+                    def gd(x_, wq, wd):
+                        return q40_matmul_pallas_grouped(
+                            x_, wq[li], wd[li], be, block_r, dtype=jnp.bfloat16)
+                    h = (silu(gd(xp, w1q, w1d)) * gd(xp, w3q, w3d)).astype(xp.dtype)
+                    o = gd(h, w2q, w2d)
+                    return (xp + (o[..., :1] * 1e-30).astype(xp.dtype)), None
+                def body(xp, _):
+                    xp, _ = jax.lax.scan(
+                        layer_body, xp, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+                    return xp, None
+                xp, _ = jax.lax.scan(body, xp, None, length=n)
+                return xp
+            lp = params.layers
+            return fn, (jnp.ones((R_pad, cfg.dim), jnp.bfloat16), block_expert,
+                        lp.w1.q, lp.w1.d, lp.w3.q, lp.w3.d, lp.w2.q, lp.w2.d)
+
+        gdots_ms = dev_ms(
+            f"grouped matmuls x{cfg.n_layers} t={T} rows={rows}", mk_gdots, N)
+        mflops = T * cfg.n_layers * k_act * 3 * cfg.dim * cfg.hidden_dim * 2
+        print(f"    -> {mflops/gdots_ms/1e9:.1f} TFLOP/s MoE "
+              f"({100*mflops/gdots_ms/1e9/197:.1f}% MFU)")
+        print(f"    -> sort/layout/scatter glue ~= "
+              f"{moe - router_ms - gdots_ms:.1f} ms (full - router - gdots)")
 
     # flash attention at t=512 over 1024-bucket cache
     def mk_flash(n):
@@ -104,8 +199,11 @@ def main():
     # single multi-row matmuls at the fused shapes
     from distributed_llama_tpu.ops.quant import QuantTensor
 
-    for name, w in [("wqkv", params.layers.wqkv), ("w13", params.layers.w13),
-                    ("w2", params.layers.w2), ("wcls", params.wcls)]:
+    shape_list = [("wqkv", params.layers.wqkv)]
+    if not cfg.is_moe:
+        shape_list += [("w13", params.layers.w13), ("w2", params.layers.w2)]
+    shape_list.append(("wcls", params.wcls))
+    for name, w in shape_list:
         wq = w.q[0] if w.q.ndim == 4 else w.q
         wd = w.d[0] if w.d.ndim == 3 else w.d
         ww = QuantTensor(q=wq, d=wd)
@@ -122,8 +220,9 @@ def main():
         fl2 = 2 * T * ww.in_features * ww.out_features
         print(f"    -> {fl2/ms/1e9:.1f} TFLOP/s, {ww.q.size/ms/1e6:.0f} GB/s weights")
 
-    print(f"\nprefill t={T}: full={full:.1f} ms  matmuls={mm:.1f}  flash={fl:.1f}  "
-          f"other={full-mm-fl:.1f}")
+    print(f"\nprefill t={T}: full={full:.1f} ms  matmuls={mm:.1f}  moe={moe:.1f} "
+          f"(router={router_ms:.1f} gdots={gdots_ms:.1f})  flash={fl:.1f}  "
+          f"other={full-mm-moe-fl:.1f}")
 
 
 if __name__ == "__main__":

@@ -277,3 +277,318 @@ def test_llama3_original_tokenizer_converter(tmp_path):
     # rank-based scores: smaller rank = higher score; "abab" (rank 28) still
     # beats per-letter pieces via pair merging
     assert ids[-1] == 28
+
+
+# ---------------------------------------------------------------------------
+# Qwen3 / Qwen3-MoE converter equivalence (VERDICT r3 #6): the q/k-norm
+# tensors, the expert loop, and the NO-permute path (convert_hf.py writes HF
+# layout verbatim for qwen archs; runtime rope is Falcon/NeoX) ship with a
+# fabricated-checkpoint equivalence gate, like the Llama path above.
+# ---------------------------------------------------------------------------
+
+Q_DIM, Q_HEADS, Q_KV, Q_HD, Q_HIDDEN, Q_VOCAB, Q_LAYERS, Q_SEQ = 64, 4, 2, 32, 96, 128, 2, 64
+
+
+def _rms(x, w, eps=1e-5):
+    return w * x / np.sqrt((x**2).mean(-1, keepdims=True) + eps)
+
+
+def _rope_neox(x, pos, head_dim):  # x [heads, hd]
+    half = head_dim // 2
+    out = x.copy()
+    for h in range(x.shape[0]):
+        for j in range(half):
+            freq = 1.0 / 10000.0 ** (2.0 * j / head_dim)
+            c, s = np.cos(pos * freq), np.sin(pos * freq)
+            a, b = x[h, j], x[h, j + half]
+            out[h, j] = a * c - b * s
+            out[h, j + half] = a * s + b * c
+    return out
+
+
+def make_qwen3_checkpoint(d, rng, n_experts=0, n_active=0, moe_hidden=0):
+    cfg = {
+        "model_type": "qwen3_moe" if n_experts else "qwen3",
+        "hidden_size": Q_DIM,
+        "intermediate_size": Q_HIDDEN,
+        "num_hidden_layers": Q_LAYERS,
+        "num_attention_heads": Q_HEADS,
+        "num_key_value_heads": Q_KV,
+        "head_dim": Q_HD,  # != dim // n_heads, like the real qwen3 family
+        "vocab_size": Q_VOCAB,
+        "max_position_embeddings": Q_SEQ,
+        "hidden_act": "silu",
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+    }
+    if n_experts:
+        cfg["num_experts"] = n_experts
+        cfg["num_experts_per_tok"] = n_active
+        cfg["moe_intermediate_size"] = moe_hidden
+    (d / "config.json").write_text(json.dumps(cfg))
+    t = {}
+    r = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.05  # noqa: E731
+    t["model.embed_tokens.weight"] = r(Q_VOCAB, Q_DIM)
+    for l in range(Q_LAYERS):
+        p = f"model.layers.{l}"
+        t[f"{p}.self_attn.q_proj.weight"] = r(Q_HEADS * Q_HD, Q_DIM)
+        t[f"{p}.self_attn.k_proj.weight"] = r(Q_KV * Q_HD, Q_DIM)
+        t[f"{p}.self_attn.v_proj.weight"] = r(Q_KV * Q_HD, Q_DIM)
+        t[f"{p}.self_attn.o_proj.weight"] = r(Q_DIM, Q_HEADS * Q_HD)
+        t[f"{p}.self_attn.q_norm.weight"] = (1 + rng.standard_normal(Q_HD) * 0.05).astype(np.float32)
+        t[f"{p}.self_attn.k_norm.weight"] = (1 + rng.standard_normal(Q_HD) * 0.05).astype(np.float32)
+        if n_experts:
+            t[f"{p}.mlp.gate.weight"] = r(n_experts, Q_DIM) * 10  # spread router
+            for e in range(n_experts):
+                t[f"{p}.mlp.experts.{e}.gate_proj.weight"] = r(moe_hidden, Q_DIM)
+                t[f"{p}.mlp.experts.{e}.down_proj.weight"] = r(Q_DIM, moe_hidden)
+                t[f"{p}.mlp.experts.{e}.up_proj.weight"] = r(moe_hidden, Q_DIM)
+        else:
+            t[f"{p}.mlp.gate_proj.weight"] = r(Q_HIDDEN, Q_DIM)
+            t[f"{p}.mlp.down_proj.weight"] = r(Q_DIM, Q_HIDDEN)
+            t[f"{p}.mlp.up_proj.weight"] = r(Q_HIDDEN, Q_DIM)
+        t[f"{p}.input_layernorm.weight"] = (1 + rng.standard_normal(Q_DIM) * 0.01).astype(np.float32)
+        t[f"{p}.post_attention_layernorm.weight"] = (1 + rng.standard_normal(Q_DIM) * 0.01).astype(np.float32)
+    t["model.norm.weight"] = (1 + rng.standard_normal(Q_DIM) * 0.01).astype(np.float32)
+    safetensors.save_file(t, str(d / "model.safetensors"))
+    return cfg, t
+
+
+def qwen3_numpy_forward(t, tokens, n_experts=0, n_active=0):
+    """Qwen3 HF conventions: per-head q/k RMS-norm (over head_dim) BEFORE
+    NeoX rope, no permute; MoE: full-softmax router, top-k, renormalized
+    weights, per-expert SwiGLU."""
+    kv_mul = Q_HEADS // Q_KV
+    caches = [([], []) for _ in range(Q_LAYERS)]
+    logits = None
+    for pos, tok in enumerate(tokens):
+        x = t["model.embed_tokens.weight"][tok].astype(np.float64)
+        for l in range(Q_LAYERS):
+            p = f"model.layers.{l}"
+            y = _rms(x, t[f"{p}.input_layernorm.weight"])
+            q = (t[f"{p}.self_attn.q_proj.weight"] @ y).reshape(Q_HEADS, Q_HD)
+            k = (t[f"{p}.self_attn.k_proj.weight"] @ y).reshape(Q_KV, Q_HD)
+            v = (t[f"{p}.self_attn.v_proj.weight"] @ y).reshape(Q_KV, Q_HD)
+            q = np.stack([_rms(q[h], t[f"{p}.self_attn.q_norm.weight"]) for h in range(Q_HEADS)])
+            k = np.stack([_rms(k[h], t[f"{p}.self_attn.k_norm.weight"]) for h in range(Q_KV)])
+            q, k = _rope_neox(q, pos, Q_HD), _rope_neox(k, pos, Q_HD)
+            caches[l][0].append(k)
+            caches[l][1].append(v)
+            att = np.zeros((Q_HEADS, Q_HD))
+            for h in range(Q_HEADS):
+                kh = h // kv_mul
+                sc = np.array(
+                    [q[h] @ caches[l][0][tt][kh] / np.sqrt(Q_HD) for tt in range(pos + 1)]
+                )
+                e = np.exp(sc - sc.max())
+                a = e / e.sum()
+                for tt in range(pos + 1):
+                    att[h] += a[tt] * caches[l][1][tt][kh]
+            x = x + t[f"{p}.self_attn.o_proj.weight"] @ att.reshape(-1)
+            y = _rms(x, t[f"{p}.post_attention_layernorm.weight"])
+            if n_experts:
+                gl = t[f"{p}.mlp.gate.weight"] @ y
+                e_ = np.exp(gl - gl.max())
+                probs = e_ / e_.sum()
+                top = np.argsort(-probs)[:n_active]
+                w = probs[top] / probs[top].sum()
+                ff = np.zeros(Q_DIM)
+                for wi, ei in zip(w, top):
+                    g = t[f"{p}.mlp.experts.{ei}.gate_proj.weight"] @ y
+                    h_ = (g / (1 + np.exp(-g))) * (t[f"{p}.mlp.experts.{ei}.up_proj.weight"] @ y)
+                    ff += wi * (t[f"{p}.mlp.experts.{ei}.down_proj.weight"] @ h_)
+                x = x + ff
+            else:
+                g = t[f"{p}.mlp.gate_proj.weight"] @ y
+                h_ = (g / (1 + np.exp(-g))) * (t[f"{p}.mlp.up_proj.weight"] @ y)
+                x = x + t[f"{p}.mlp.down_proj.weight"] @ h_
+        xf = _rms(x, t["model.norm.weight"])
+        logits = t["model.embed_tokens.weight"] @ xf
+    return logits
+
+
+def _framework_logits(out, tokens):
+    reader = MFileReader(out)
+    cfg = config_from_header(reader.header, compute_dtype="float32")
+    params = load_params(reader, cfg)
+    rope = build_rope_tables(reader.header)
+    cache = init_kv_cache(cfg, batch=1)
+    logits, _ = forward(
+        cfg, params, rope, cache, jnp.asarray([tokens], jnp.int32), jnp.int32(0)
+    )
+    return np.asarray(logits[0])
+
+
+def test_convert_qwen3_matches_hf_semantics(tmp_path):
+    rng = np.random.default_rng(11)
+    _, tensors = make_qwen3_checkpoint(tmp_path, rng)
+    out = str(tmp_path / "qwen3.m")
+    convert_hf(str(tmp_path), out, "f32", progress=lambda *a: None)
+
+    reader = MFileReader(out)
+    from distributed_llama_tpu.formats.mfile import ArchType, RopeType
+    assert reader.header.arch_type == ArchType.QWEN3
+    assert reader.header.rope_type == RopeType.FALCON
+    assert reader.header.head_dim == Q_HD
+
+    tokens = [3, 17, 90, 5]
+    want = qwen3_numpy_forward(tensors, tokens)
+    got = _framework_logits(out, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_convert_qwen3_moe_matches_hf_semantics(tmp_path):
+    rng = np.random.default_rng(12)
+    n_experts, n_active, moe_hidden = 4, 2, 48
+    _, tensors = make_qwen3_checkpoint(
+        tmp_path, rng, n_experts=n_experts, n_active=n_active, moe_hidden=moe_hidden
+    )
+    out = str(tmp_path / "qwen3moe.m")
+    convert_hf(str(tmp_path), out, "f32", progress=lambda *a: None)
+
+    reader = MFileReader(out)
+    from distributed_llama_tpu.formats.mfile import ArchType
+    assert reader.header.arch_type == ArchType.QWEN3_MOE
+    assert reader.header.n_experts == n_experts
+
+    tokens = [3, 17, 90, 5]
+    want = qwen3_numpy_forward(tensors, tokens, n_experts=n_experts, n_active=n_active)
+    got = _framework_logits(out, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Legacy Meta-distribution .pth converter (VERDICT r3 #7): fabricated
+# 2-shard consolidated.*.pth -> .m -> framework forward must equal a
+# Meta-convention numpy forward (INTERLEAVED rope on unpermuted weights —
+# the layout convert-llama.py ships verbatim, no NeoX permute involved).
+# The checkpoint is written with torch (test-only dep); the converter itself
+# parses the zip/pickle container by hand.
+# ---------------------------------------------------------------------------
+
+
+def make_pth_checkpoint(d, rng, n_shards=2):
+    torch = pytest.importorskip("torch")
+    params = {
+        "dim": DIM, "n_layers": LAYERS, "n_heads": N_HEADS,
+        "n_kv_heads": N_KV, "vocab_size": VOCAB, "max_seq_len": SEQ,
+        "norm_eps": 1e-5, "rope_theta": 10000.0,
+    }
+    (d / "params.json").write_text(json.dumps(params))
+    t = {}
+    r = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.05  # noqa: E731
+    t["tok_embeddings.weight"] = r(VOCAB, DIM)
+    for l in range(LAYERS):
+        p = f"layers.{l}"
+        t[f"{p}.attention.wq.weight"] = r(DIM, DIM)
+        t[f"{p}.attention.wk.weight"] = r(N_KV * HEAD_DIM, DIM)
+        t[f"{p}.attention.wv.weight"] = r(N_KV * HEAD_DIM, DIM)
+        t[f"{p}.attention.wo.weight"] = r(DIM, DIM)
+        t[f"{p}.feed_forward.w1.weight"] = r(HIDDEN, DIM)
+        t[f"{p}.feed_forward.w2.weight"] = r(DIM, HIDDEN)
+        t[f"{p}.feed_forward.w3.weight"] = r(HIDDEN, DIM)
+        t[f"{p}.attention_norm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+        t[f"{p}.ffn_norm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+    t["norm.weight"] = (1 + rng.standard_normal(DIM) * 0.01).astype(np.float32)
+    t["output.weight"] = r(VOCAB, DIM)
+
+    # Meta sharding: embeddings/wo/w2 split on axis 1, other matrices on
+    # axis 0, 1-D tensors replicated (the converter takes shard 0's copy)
+    from distributed_llama_tpu.converter.convert_pth import _concat_axis
+
+    for s in range(n_shards):
+        shard = {}
+        for name, w in t.items():
+            if w.ndim == 1:
+                shard[name] = torch.from_numpy(w.copy())
+            else:
+                ax = _concat_axis(name)
+                parts = np.array_split(w, n_shards, axis=ax)
+                shard[name] = torch.from_numpy(parts[s].copy())
+        torch.save(shard, str(d / f"consolidated.{s:02d}.pth"))
+    return params, t
+
+
+def meta_numpy_forward(t, tokens):
+    """Meta llama conventions: INTERLEAVED rope (pairs 2j, 2j+1) on
+    unpermuted q/k — what ropeLlama_F32 computes in the reference."""
+
+    def rms(x, w):
+        return w * x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5)
+
+    def rope_interleaved(x, pos):  # x [heads, hd]
+        half = HEAD_DIM // 2
+        out = x.copy()
+        for h in range(x.shape[0]):
+            for j in range(half):
+                freq = 1.0 / 10000.0 ** (2.0 * j / HEAD_DIM)
+                c, s = np.cos(pos * freq), np.sin(pos * freq)
+                a, b = x[h, 2 * j], x[h, 2 * j + 1]
+                out[h, 2 * j] = a * c - b * s
+                out[h, 2 * j + 1] = a * s + b * c
+        return out
+
+    kv_mul = N_HEADS // N_KV
+    caches = [([], []) for _ in range(LAYERS)]
+    logits = None
+    for pos, tok in enumerate(tokens):
+        x = t["tok_embeddings.weight"][tok].astype(np.float64)
+        for l in range(LAYERS):
+            p = f"layers.{l}"
+            y = rms(x, t[f"{p}.attention_norm.weight"])
+            q = (t[f"{p}.attention.wq.weight"] @ y).reshape(N_HEADS, HEAD_DIM)
+            k = (t[f"{p}.attention.wk.weight"] @ y).reshape(N_KV, HEAD_DIM)
+            v = (t[f"{p}.attention.wv.weight"] @ y).reshape(N_KV, HEAD_DIM)
+            q, k = rope_interleaved(q, pos), rope_interleaved(k, pos)
+            caches[l][0].append(k)
+            caches[l][1].append(v)
+            att = np.zeros((N_HEADS, HEAD_DIM))
+            for h in range(N_HEADS):
+                kh = h // kv_mul
+                sc = np.array(
+                    [q[h] @ caches[l][0][tt][kh] / np.sqrt(HEAD_DIM) for tt in range(pos + 1)]
+                )
+                e = np.exp(sc - sc.max())
+                a = e / e.sum()
+                for tt in range(pos + 1):
+                    att[h] += a[tt] * caches[l][1][tt][kh]
+            x = x + t[f"{p}.attention.wo.weight"] @ att.reshape(-1)
+            y = rms(x, t[f"{p}.ffn_norm.weight"])
+            g = t[f"{p}.feed_forward.w1.weight"] @ y
+            h_ = (g / (1 + np.exp(-g))) * (t[f"{p}.feed_forward.w3.weight"] @ y)
+            x = x + t[f"{p}.feed_forward.w2.weight"] @ h_
+        xf = rms(x, t["norm.weight"])
+        logits = t["output.weight"] @ xf
+    return logits
+
+
+def test_convert_pth_round_trip_matches_meta_semantics(tmp_path):
+    from distributed_llama_tpu.converter.convert_pth import convert_llama_pth
+
+    rng = np.random.default_rng(13)
+    _, tensors = make_pth_checkpoint(tmp_path, rng, n_shards=2)
+    out = str(tmp_path / "meta.m")
+    convert_llama_pth(str(tmp_path), out, "f32", progress=lambda *a: None)
+
+    reader = MFileReader(out)
+    h = reader.header
+    assert h.dim == DIM and h.n_layers == LAYERS and h.hidden_dim == HIDDEN
+
+    tokens = [3, 17, 90, 5]
+    want = meta_numpy_forward(tensors, tokens)
+    got = _framework_logits(out, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_convert_pth_rejects_vocab_placeholder(tmp_path):
+    """Meta params.json ships vocab_size -1; the converter must demand the
+    patch the reference demands (convert-llama.py:16-17)."""
+    from distributed_llama_tpu.converter.convert_pth import convert_llama_pth
+
+    rng = np.random.default_rng(14)
+    params, _ = make_pth_checkpoint(tmp_path, rng, n_shards=1)
+    params["vocab_size"] = -1
+    (tmp_path / "params.json").write_text(json.dumps(params))
+    with pytest.raises(ValueError, match="vocab_size"):
+        convert_llama_pth(str(tmp_path), str(tmp_path / "x.m"), "f32",
+                          progress=lambda *a: None)

@@ -241,3 +241,15 @@ def test_generate_batch_dp_tp_mesh(tmp_path):
     got = eng.generate_batch(prompts, 8, sampler=None)
     for r in range(4):
         assert got[r] == solo[r], f"row {r}"
+
+
+def test_cli_worker_mode_mid_argv_gets_migration_message(tmp_path, capsys):
+    """`worker` parses as a mode anywhere in argv; it must print the
+    migration message and exit 2 instead of silently falling through
+    (ADVICE r3)."""
+    from distributed_llama_tpu.cli import main
+
+    path = _model(tmp_path)
+    rc = main(["--model", path, "--tokenizer", "unused", "worker"])
+    assert rc == 2
+    assert "no worker processes" in capsys.readouterr().err
