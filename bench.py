@@ -98,16 +98,17 @@ def ensure_moe() -> str:
 
 def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw):
     """(decode_tok_s, prefill_tok_s, ttft_ms, marginal_prefill,
-    prefill_wall_long_ms, eng).
+    wall_long, eng) where wall_long is (long_n, wall_ms) or None.
 
     prefill_tok_s is the naive prompt/wall rate — at a 512-token prompt it
     is dominated by the ~70-90 ms tunnel dispatch of this environment, NOT
     compute (one chunk = one dispatch). marginal_prefill differences two
     prompt lengths so the fixed dispatch cancels: the steady-state rate a
     long prompt actually sees (and what non-tunnel deployments get).
-    prefill_wall_long_ms is the RAW wall of the 3x-length prompt — the
-    direct lower bound the marginal metric must reconcile with
-    (long_n tokens took this many ms, no differencing, no modeling).
+    wall_long is the RAW wall of the long prompt arm — the direct lower
+    bound the marginal metric must reconcile with (long_n tokens took
+    wall_ms, no differencing, no modeling); both numbers are emitted so the
+    bound is checkable.
     """
     from distributed_llama_tpu.runtime.engine import InferenceEngine
 
@@ -138,8 +139,14 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
         res_stream = eng.generate(prompt, prefill_tokens + 16, sampler=None, on_token=sink)
     ttft_ms = res_stream.ttft_us / 1e3
 
-    # marginal prefill rate: difference long vs short prompt walls
-    long_n = min(3 * prefill_tokens, eng.cfg.seq_len - 64)
+    # marginal prefill rate: difference long vs short prompt walls. The
+    # long arm is at least prefill+1024 tokens so the differenced compute
+    # clears the tunnel's few-ms dispatch jitter even for short prompts
+    # (3x a 256-token prompt left only ~2 ms of differenced signal — the
+    # round-3 qwen3 leg's null marginal)
+    long_n = min(
+        max(3 * prefill_tokens, prefill_tokens + 1024), eng.cfg.seq_len - 64
+    )
     marginal = None
     wall_long_ms = None
     if long_n > prefill_tokens:
@@ -150,11 +157,15 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
                 t0 = time.perf_counter()
                 eng.prefill([(i % 1000) + 1 for i in range(n)])
                 walls.append(time.perf_counter() - t0)
-            return min(walls), max(walls) - min(walls)
+            walls.sort()
+            # jitter bound from the two BEST reps: min-max spread counts a
+            # single worst-case stall against the whole measurement and
+            # nulls healthy windows
+            return walls[0], walls[1] - walls[0]
         prefill_wall(long_n, reps=1)  # compile the extra chunk shapes
         t_long, spread_long = prefill_wall(long_n)
         t_short, spread_short = prefill_wall(prefill_tokens)
-        wall_long_ms = t_long * 1e3
+        wall_long_ms = (long_n, t_long * 1e3)
         # the difference must clear the observed run-to-run jitter or the
         # quotient is noise (observed: a 2.4k tok/s config reporting 4M
         # through the tunnel's ~10-30 ms dispatch variance); the floor is
@@ -199,7 +210,8 @@ def leg_8b():
         "decode_tok_s": round(decode, 2),
         "prefill_tok_s": round(prefill, 1),
         "prefill_tok_s_marginal": marginal and round(marginal, 1),
-        "prefill_wall_long_ms": wall_long and round(wall_long, 1),
+        "prefill_long_n": wall_long and wall_long[0],
+        "prefill_wall_long_ms": wall_long and round(wall_long[1], 1),
         "ttft_ms": round(ttft, 1),
         "decode_eff_gb_s": round(gbs, 1),
         "hbm_roofline_pct": round(100 * gbs / 819, 1),
@@ -216,7 +228,10 @@ def leg_longcontext():
     )
     from distributed_llama_tpu.runtime.engine import InferenceEngine
 
-    eng = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
+    # dim-1024 model: dispatch-overhead-bound at chunk 64 (see extra_legs)
+    eng = InferenceEngine(
+        path, compute_dtype="bfloat16", max_chunk=512, decode_chunk_size=128
+    )
 
     def decode_at(pos: int) -> float:
         """TIMING-ONLY leg: only the last 512 cache positions are prefilled,
@@ -306,7 +321,8 @@ def main():
             "decode_tok_s": round(decode, 2),
             "prefill_tok_s": round(prefill, 1),
             "prefill_tok_s_marginal": marginal and round(marginal, 1),
-            "prefill_wall_long_ms": wall_long and round(wall_long, 1),
+            "prefill_long_n": wall_long and wall_long[0],
+            "prefill_wall_long_ms": wall_long and round(wall_long[1], 1),
             "ttft_ms": round(ttft, 1),
         }
     )
@@ -333,7 +349,8 @@ def main():
                     "decode_tok_s": round(d, 2),
                     "prefill_tok_s": round(p, 1),
                     "prefill_tok_s_marginal": m and round(m, 1),
-                    "prefill_wall_long_ms": wl and round(wl, 1),
+                    "prefill_long_n": wl and wl[0],
+                    "prefill_wall_long_ms": wl and round(wl[1], 1),
                     "ttft_ms": round(t, 1),
                 }
             )

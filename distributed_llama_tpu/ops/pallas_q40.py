@@ -332,13 +332,15 @@ def _i8_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
       ffn-wide   (4096<=out<16384):   nb>=128: tn=2048 knb=16
                  (4096->14336: 82 µs, 14336->4096: 86 µs); smaller
                  contractions: tn=512 knb=32 (2048->8192: 25.6 µs)
-      vocab-wide (out>=16384): tn=2048 (chains down for ragged vocabs, e.g.
-                 128256 -> 256), knb=128 when nb allows (4096->128256:
-                 799 µs, 698 GB/s) else 32 (2048->32768: 97 µs)
+      vocab-wide (out>=16384): nb>=128: tn=2048 knb=128 (4096->128256:
+                 799 µs, 698 GB/s); nb<128: tn=1024 knb=64 — the round-4
+                 fused-shape sweep found deeper k-tiles best for SMALL
+                 contractions at huge out (w13-fused 2048->16384:
+                 57 -> 50 µs; 1B wcls 2048->32768: 98 µs, tied-best)
     """
     if out >= 16384:
-        tile_n = 2048
-        tile_knb = 128 if nb >= 128 else 32
+        tile_n = 2048 if nb >= 128 else 1024
+        tile_knb = 128 if nb >= 128 else 64
     elif out >= 4096:
         tile_n = 2048 if nb >= 128 else 512
         tile_knb = 16 if nb >= 128 else 32
@@ -377,22 +379,17 @@ def _i8_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
-    """x @ w via the int8-MXU kernel for decode-sized batches. x: [..., in]
-    with a small row count (quant_matmul gates rows <= 8); returns
-    [..., out] f32."""
+def _i8_call(x8, xs, qt, dt, interpret: bool = False) -> jnp.ndarray:
+    """The bare int8-MXU pallas_call on pre-quantized activations:
+    x8 [R, in] int8, xs [nb, R*128] scales, dt already `_dt_operand`-shaped.
+    Returns [R, out] f32. Split out so probes can time the kernel without
+    the quantize prologue (scripts/probe_quant_prologue.py)."""
     nb, _, out = qt.shape
-    in_features = nb * Q_BLOCK
-    lead = x.shape[:-1]
-    R = 1
-    for s in lead:
-        R *= s
-    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
-    dt = _dt_operand(dt)
+    R = x8.shape[0]
     tile_n, tile_knb = _i8_tiles(nb, out, rows=R)
     mask = _blockdiag_mask(tile_knb)
     grid = (out // tile_n, nb // tile_knb)
-    out2 = pl.pallas_call(
+    return pl.pallas_call(
         _kernel_i8,
         grid=grid,
         in_specs=[
@@ -406,6 +403,22 @@ def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
     )(x8, xs, mask, qt, dt)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
+    """x @ w via the int8-MXU kernel for decode-sized batches. x: [..., in]
+    with a small row count (quant_matmul gates rows <= 8); returns
+    [..., out] f32. Jitted so eager callers (compile checks) run prologue +
+    kernel as one program; inlines when traced inside a larger jit."""
+    nb, _, out = qt.shape
+    in_features = nb * Q_BLOCK
+    lead = x.shape[:-1]
+    R = 1
+    for s in lead:
+        R *= s
+    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
+    out2 = _i8_call(x8, xs, qt, _dt_operand(dt), interpret=interpret)
     return out2.reshape(*lead, out)
 
 

@@ -94,11 +94,14 @@ def initialize_distributed(
                 or "already initialized" in str(e).lower()
             ):
                 raise
+            import sys
+
             print(
                 f"ℹ️  --distributed: multi-host auto-init unavailable "
                 f"({type(e).__name__}); continuing single-process (pass "
                 f"--coordinator/--num-processes/--process-id on env-driven "
-                f"clusters)"
+                f"clusters)",
+                file=sys.stderr,
             )
 
 

@@ -56,8 +56,16 @@ class watchdog:
     reference, which also only detects, not cancels).
     """
 
-    def __init__(self, what: str, log_fn=print):
+    def __init__(self, what: str, log_fn=None):
         self.what = what
+        if log_fn is None:
+            import functools
+            import sys
+
+            # diagnostics go to STDERR: tools that contract to emit one
+            # machine-readable stdout line (bench.py) must not get a stall
+            # notice spliced into their output
+            log_fn = functools.partial(print, file=sys.stderr)
         self.log_fn = log_fn
         # defaults are wider than the reference's 2s/180s because a first
         # call legitimately spends 20-40s in XLA compilation

@@ -55,6 +55,9 @@ def _ref_worktree(ref: str) -> str:
     ).strip()
     wt = f"/tmp/ab_bench_wt_{sha[:12]}"
     if not os.path.isdir(wt):
+        # a tmp-cleaned machine may still have the worktree REGISTERED in
+        # .git/worktrees — prune first or `worktree add` refuses
+        subprocess.run(["git", "worktree", "prune"], cwd=REPO, check=False)
         subprocess.check_call(
             ["git", "worktree", "add", "--detach", wt, sha], cwd=REPO,
             stdout=subprocess.DEVNULL,

@@ -1,0 +1,56 @@
+"""How much does the per-matmul activation-quantize prologue cost at decode?
+Chained A/B at the 1B shapes: quant_matmul (prologue + kernel) vs the bare
+kernel on pre-quantized inputs. The difference x 65 calls/token bounds the
+available win from fusing quantization into the kernel."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from profile_decode import dev_ms
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.quant import QuantTensor, quant_matmul
+from distributed_llama_tpu.ops.pallas_q40 import (
+    _dt_operand, _i8_call, _quantize_rows_q80,
+)
+
+def main():
+    rng = np.random.default_rng(0)
+    for in_f, out in ((2048, 3072), (2048, 16384), (8192, 2048), (2048, 32768)):
+        nb = in_f // Q_BLOCK
+        qt = jnp.asarray(rng.integers(-8, 8, (nb, Q_BLOCK, out), dtype=np.int8))
+        d16 = (rng.standard_normal((nb, out)) * 0.01).astype(np.float16)
+        dt = jnp.asarray(d16.view(np.int16))
+        w = QuantTensor(q=qt, d=dt)
+        x = jnp.asarray(rng.standard_normal((1, in_f)), jnp.bfloat16)
+
+        def mk_full(n):
+            @jax.jit
+            def f(x, qt, dt):
+                def body(c, _):
+                    y = quant_matmul(c, QuantTensor(q=qt, d=dt), pallas=True)
+                    return c + (y[..., :1] * 1e-30).astype(c.dtype), None
+                c, _ = jax.lax.scan(body, x, None, length=n)
+                return c
+            return f, (x, qt, dt)
+
+        x8, xs = _quantize_rows_q80(x, nb)
+        dt_op = _dt_operand(dt)
+
+        def mk_kernel(n):
+            @jax.jit
+            def f(x8, xs, qt, dt, x):
+                def body(c, _):
+                    # call the kernel path on FIXED pre-quantized inputs; a
+                    # tiny bump keeps the chain data-dependent
+                    y = _i8_call(c[0], c[1], qt, dt)
+                    bump = (y[0, :1] * 1e-30).astype(jnp.int8)
+                    return (c[0] + bump, c[1]), None
+                c, _ = jax.lax.scan(body, (x8, xs), None, length=n)
+                return c[0]
+            return f, (x8, xs, qt, dt_op, x)
+
+        full = dev_ms(f"{in_f}->{out} quant_matmul (prologue+kernel)", mk_full, 256)
+        kern = dev_ms(f"{in_f}->{out} kernel only", mk_kernel, 256)
+        print(f"    -> prologue ~= {1000*(full-kern):.1f} us/call")
+
+if __name__ == "__main__":
+    main()

@@ -493,8 +493,18 @@ def make_pth_checkpoint(d, rng, n_shards=2):
     t["output.weight"] = r(VOCAB, DIM)
 
     # Meta sharding: embeddings/wo/w2 split on axis 1, other matrices on
-    # axis 0, 1-D tensors replicated (the converter takes shard 0's copy)
-    from distributed_llama_tpu.converter.convert_pth import _concat_axis
+    # axis 0, 1-D tensors replicated (the converter takes shard 0's copy).
+    # Axes are HARDCODED here, independent of the converter's _concat_axis —
+    # importing it would make the round trip circular (a wrong axis rule
+    # would split and reassemble consistently and still pass).
+    def shard_axis(name):
+        if (
+            name == "tok_embeddings.weight"
+            or name.endswith(".attention.wo.weight")
+            or name.endswith(".feed_forward.w2.weight")
+        ):
+            return 1
+        return 0
 
     for s in range(n_shards):
         shard = {}
@@ -502,8 +512,7 @@ def make_pth_checkpoint(d, rng, n_shards=2):
             if w.ndim == 1:
                 shard[name] = torch.from_numpy(w.copy())
             else:
-                ax = _concat_axis(name)
-                parts = np.array_split(w, n_shards, axis=ax)
+                parts = np.array_split(w, n_shards, axis=shard_axis(name))
                 shard[name] = torch.from_numpy(parts[s].copy())
         torch.save(shard, str(d / f"consolidated.{s:02d}.pth"))
     return params, t
