@@ -126,6 +126,45 @@ def _dequant_dot_accum(k, x_ref, qt_ref, dt_ref, out_ref):
         out_ref[...] += acc
 
 
+def _bf16_tile_cap(b: int, tile_n: int, tile_knb: int, nb: int):
+    """Shrink the bf16-dequant kernels' tiles so scoped VMEM stays under the
+    ~16 MB stack limit at large row counts (batched prefill pushes
+    b = batch x chunk rows; a real 4x256-row run OOMed at the w2 shape).
+    Budget model: x block (double-buffered bf16) + dequant temp + int8
+    weight block (double-buffered) + out/acc f32. The budget model
+    under-counts Mosaic's internal temporaries by ~4 MB (a 1024-row w2
+    config modeling 12 MB measured 16.24 MB scoped), so the cap is 10 MB.
+    k-depth shrinks first (less valuable than lane width)."""
+
+    def need(tn, knb):
+        return (
+            2 * b * knb * Q_BLOCK * 2
+            + knb * Q_BLOCK * tn * 2
+            + 2 * knb * Q_BLOCK * tn
+            + 2 * b * tn * 4
+        )
+
+    cap = 10 * 1024 * 1024
+    while need(tile_n, tile_knb) > cap and tile_knb > 8:
+        tile_knb //= 2
+    while need(tile_n, tile_knb) > cap and tile_n > 128:
+        tile_n //= 2
+    # Mosaic sublane rule: a multi-k-step scale block needs tile_knb % 8 == 0
+    # (only whole-dim blocks are exempt). Do NOT reset to nb here — that
+    # would discard the cap just computed (e.g. nb=24 halves to 12, then a
+    # reset back to 24 re-OOMs); 8 divides any nb that reaches this point
+    # via halving from a multiple of 8, else fall back to a whole-dim step
+    # with tile_n shrunk to fit.
+    if tile_knb != nb and tile_knb % 8:
+        if nb % 8 == 0:
+            tile_knb = 8
+        else:
+            tile_knb = nb  # ragged nb: whole-dim k step is always legal
+            while need(tile_n, tile_knb) > cap and tile_n > 128:
+                tile_n //= 2
+    return tile_n, tile_knb
+
+
 def _kernel(x_ref, qt_ref, dt_ref, out_ref):
     _dequant_dot_accum(pl.program_id(1), x_ref, qt_ref, dt_ref, out_ref)
 
@@ -173,6 +212,7 @@ def q40_matmul_pallas_stacked(
     tile_knb = min(DEFAULT_TILE_KNB, nb)
     while nb % tile_knb:
         tile_knb //= 2
+    tile_n, tile_knb = _bf16_tile_cap(b, tile_n, tile_knb, nb)
     # callers gate on q40_stacked_aligned (nb % 8 == 0), which guarantees the
     # chain above never lands below 8 — the sublane rule Mosaic enforces on
     # real TPUs for blocks that don't span the whole (flattened) leading dim
@@ -558,11 +598,9 @@ def q40_matmul_pallas(
     tile_knb = min(DEFAULT_TILE_KNB, nb)
     while nb % tile_knb:
         tile_knb //= 2
-    # ragged nb (e.g. 68) can chain below 8: a multi-step block violating
-    # Mosaic's sublane rule on real TPUs (interpret mode doesn't enforce it).
-    # One whole-dim k step is always legal and such weights are small.
-    if tile_knb != nb and tile_knb % 8:
-        tile_knb = nb
+    # _bf16_tile_cap owns BOTH the VMEM cap and the Mosaic sublane rule
+    # (ragged nb falls back to one whole-dim k step inside it)
+    tile_n, tile_knb = _bf16_tile_cap(b, tile_n, tile_knb, nb)
 
     grid = (out // tile_n, nb // tile_knb)
     out2 = pl.pallas_call(
