@@ -37,6 +37,26 @@ from jax.experimental.pallas import tpu as pltpu
 from ..formats.quants import Q_BLOCK
 
 LANE = 128
+
+
+def _i8_compiler_params():
+    """Experiment knob (DLT_I8_DIMSEM=1): declare the i8 kernels' grid as
+    (parallel out, arbitrary k). PROCESS-START-ONLY: the env var is read at
+    trace time, so flipping it mid-process is ignored by the jit cache —
+    A/B it with one subprocess per arm (as scripts did). Measured NEUTRAL
+    on the 1B full decode step (3 interleaved subprocess reps: 1.819-1.831
+    plain vs 1.823-1.830 dimsem ms); kept off by default."""
+    import os
+
+    if os.environ.get("DLT_I8_DIMSEM"):
+        return {
+            "compiler_params": pltpu.CompilerParams(
+                dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)
+            )
+        }
+    return {}
+
+
 DEFAULT_TILE_N = 256
 DEFAULT_TILE_KNB = 64  # 64 blocks = 2048 input features per k step
 
@@ -145,16 +165,20 @@ def _bf16_tile_cap(b: int, tile_n: int, tile_knb: int, nb: int):
         )
 
     cap = 10 * 1024 * 1024
-    while need(tile_n, tile_knb) > cap and tile_knb > 8:
-        tile_knb //= 2
+    while need(tile_n, tile_knb) > cap and tile_knb >= 16:
+        nxt = tile_knb // 2
+        if nb % nxt:
+            break  # a non-divisor would DROP k blocks from the grid —
+            # silently wrong results, not a perf choice; shrink lanes instead
+        tile_knb = nxt
     while need(tile_n, tile_knb) > cap and tile_n > 128:
         tile_n //= 2
     # Mosaic sublane rule: a multi-k-step scale block needs tile_knb % 8 == 0
     # (only whole-dim blocks are exempt). Do NOT reset to nb here — that
     # would discard the cap just computed (e.g. nb=24 halves to 12, then a
-    # reset back to 24 re-OOMs); 8 divides any nb that reaches this point
-    # via halving from a multiple of 8, else fall back to a whole-dim step
-    # with tile_n shrunk to fit.
+    # reset back to 24 re-OOMs). 12 -> 8 SHRINKS the footprint (budget still
+    # holds); ragged nb falls back to one whole-dim k step with tile_n
+    # shrunk to fit.
     if tile_knb != nb and tile_knb % 8:
         if nb % 8 == 0:
             tile_knb = 8
@@ -442,6 +466,7 @@ def _i8_call(x8, xs, qt, dt, interpret: bool = False) -> jnp.ndarray:
         out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
+        **_i8_compiler_params(),
     )(x8, xs, mask, qt, dt)
 
 
@@ -502,6 +527,7 @@ def q40_matmul_pallas_stacked_i8(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
+        **_i8_compiler_params(),
     )(jnp.asarray(layer, jnp.int32).reshape(1), x8, xs, mask, qt3, dt3)
     return out2.reshape(*lead, out)
 
