@@ -203,13 +203,15 @@ def _moe_ffn(
     if rows >= cfg.n_experts:
         from ..ops.moe import moe_ffn_ragged
 
-        # the ragged path streams every expert anyway, so slicing the layer
-        # out of the stack first costs nothing extra
+        # full stacks + layer index: the grouped kernel selects this layer's
+        # experts via flat scalar-prefetched group indices — a dynamic-slice
+        # of the stack here would MATERIALIZE every expert's weights per
+        # layer per chunk (~50 MB/layer at the bench MoE shape; a
+        # pallas_call cannot fuse the slice)
         return moe_ffn_ragged(
-            y, idx, wts,
-            _sel_layer(lp.w1, layer), _sel_layer(lp.w3, layer), _sel_layer(lp.w2, layer),
+            y, idx, wts, lp.w1, lp.w3, lp.w2,
             partial(_activation, cfg), cfg.dtype, q80=q80, ep_axis=ep_axis,
-            pallas=cfg.pallas_arg,
+            pallas=cfg.pallas_arg, layer=layer,
         )
 
     if ep_axis is not None:

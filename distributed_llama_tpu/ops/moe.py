@@ -35,7 +35,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .quant import QuantTensor, dequantize_t, quantize_q80_activations
+from .quant import QuantTensor, dequantize_t, quantize_q80_activations, slice_layer
 
 
 def moe_router(
@@ -131,12 +131,20 @@ def moe_ffn_ragged(
     wts: jnp.ndarray,  # [b, t, k] f32 combine weights
     w1,
     w3,
-    w2,  # stacked expert weights (QuantTensor T layout or dense [E?,out,in])
+    w2,  # stacked expert weights (QuantTensor T layout or dense [E?,out,in]);
+    # with `layer` given, the FULL all-layers stacks ([L, E, ...])
     act_fn,  # hidden activation (silu/gelu)
     dtype,  # MXU operand dtype
     q80: bool = False,  # reference-parity Q80 activation round-trip
     ep_axis: str | None = None,  # shard_map axis name when experts are sharded
     pallas=None,  # None=auto | False | True | "interpret" (ops/quant.py)
+    layer=None,  # scalar int32: weights are all-layers stacks and this
+    # layer's experts are selected INSIDE the grouped kernel (flat group
+    # index = layer * n_groups + e). The dynamic-slice alternative
+    # materializes every expert's weights per layer per chunk (~50 MB a
+    # layer at the bench MoE shape) — measured NEUTRAL there (3 interleaved
+    # A/B reps, DLT_MOE_LAYER_FOLD knob; XLA overlaps the copy), but the
+    # copy grows with E*ff (GB-scale at 30B-A3B) while the fold stays free
 ) -> jnp.ndarray:
     """Exact top-k expert SwiGLU via sort + grouped (ragged) matmuls.
 
@@ -157,7 +165,26 @@ def moe_ffn_ragged(
     xs = y.reshape(n_tok, dim)[tok]  # [rows, dim] expert-sorted inputs
 
     use_grouped = _grouped_quant_eligible(w1, w3, w2, dtype, q80, pallas)
-    n_local = w1.q.shape[0] if isinstance(w1, QuantTensor) else w1.shape[0]
+    stacked = layer is not None
+    if stacked and use_grouped:
+        import os
+
+        fold_off = os.environ.get("DLT_MOE_LAYER_FOLD", "1") == "0"
+        # EP pads zero experts around the stack; padding the FULL all-layers
+        # stack would copy every layer's experts (the very transient the
+        # fold avoids) — slice this layer first until the pad moves to load
+        # time. DLT_MOE_LAYER_FOLD=0 is the A/B knob (process-start-only,
+        # read at trace time): forces the dynamic-slice formulation.
+        if fold_off or ep_axis is not None:
+            w1, w3, w2 = (slice_layer(w, layer) for w in (w1, w3, w2))
+            stacked = False
+    if not use_grouped:
+        # the materialized/ragged_dot path works per layer — slice here
+        # (these parity paths are not the production bandwidth path)
+        w1, w3, w2 = (slice_layer(w, layer) for w in (w1, w3, w2))
+        stacked = False
+    e_axis = 1 if stacked else 0
+    n_local = w1.q.shape[e_axis] if isinstance(w1, QuantTensor) else w1.shape[e_axis]
     if not use_grouped:
         w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
         w3m = expert_stack_matrix(w3, dtype)
@@ -199,14 +226,18 @@ def moe_ffn_ragged(
         w1q, w3q, w2q = w1, w3, w2
         if ep_axis is not None:
             # boundary groups 0 and E_local+1 (other shards' rows) index
-            # zero experts padded onto both ends of the stack — their rows
-            # produce exact zeros, matching the materialized path's pad()
-            def padq2(w):
-                zq = jnp.zeros((1,) + w.q.shape[1:], w.q.dtype)
-                zd = jnp.zeros((1,) + w.d.shape[1:], w.d.dtype)
+            # zero experts padded onto both ends of the stack's EXPERT axis
+            # — their rows produce exact zeros, matching the materialized
+            # path's pad()
+            def padq2(w, ax=e_axis):
+                def z(a):
+                    shp = list(a.shape)
+                    shp[ax] = 1
+                    return jnp.zeros(shp, a.dtype)
+
                 return QuantTensor(
-                    q=jnp.concatenate([zq, w.q, zq], axis=0),
-                    d=jnp.concatenate([zd, w.d, zd], axis=0),
+                    q=jnp.concatenate([z(w.q), w.q, z(w.q)], axis=ax),
+                    d=jnp.concatenate([z(w.d), w.d, z(w.d)], axis=ax),
                 )
             w1q, w3q, w2q = padq2(w1), padq2(w3), padq2(w2)
 
@@ -222,6 +253,10 @@ def moe_ffn_ragged(
             group_sizes, rows, n_groups, block_r
         )
         xp = jnp.zeros((R_pad, dim), y.dtype).at[padded_idx].set(xs.astype(y.dtype))
+        if stacked:
+            # fold the layer into the FLAT group index: the kernel DMAs this
+            # layer's expert tiles straight out of the all-layers stack
+            block_expert = block_expert + layer * n_groups
 
         def gdot(x_, w_):
             return q40_matmul_pallas_grouped(

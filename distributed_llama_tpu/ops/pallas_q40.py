@@ -542,23 +542,30 @@ def _kernel_grouped(be_ref, x_ref, qt_ref, dt_ref, out_ref):
 def q40_matmul_pallas_grouped(
     xp: jnp.ndarray,  # [R_pad, in] — rows grouped by expert, groups padded
     # to block_r multiples (ops/moe.py _grouped_layout)
-    qt: jnp.ndarray,  # [E, nb, 32, out] int8 expert stack
-    dt: jnp.ndarray,  # [E, nb, out] scale plane
-    block_expert: jnp.ndarray,  # [R_pad // block_r] int32 — expert of each row block
+    qt: jnp.ndarray,  # [..., nb, 32, out] int8 expert stack — leading axes
+    # flatten to one group axis (e.g. [E, ...] or the full [L, E, ...] all-
+    # layers stack; block_expert then carries FLAT indices layer*E + e, so
+    # no per-layer slice of the stack is ever materialized)
+    dt: jnp.ndarray,  # [..., nb, out] scale plane
+    block_expert: jnp.ndarray,  # [R_pad // block_r] int32 — flat group
+    # index of each row block
     block_r: int,
     dtype=jnp.bfloat16,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Grouped (ragged) quantized matmul: row block i is multiplied by
-    expert block_expert[i]'s weight, streamed from HBM as int8 — the MoE
+    group block_expert[i]'s weight, streamed from HBM as int8 — the MoE
     prefill path's replacement for dequantize-the-whole-expert-stack +
     `lax.ragged_dot` (which writes and re-reads a bf16 copy of every expert,
-    and at 30B-A3B scale materializes GB-sized transients). The expert index
+    and at 30B-A3B scale materializes GB-sized transients). The group index
     rides the scalar-prefetch channel into the BlockSpec index maps exactly
     like the stacked kernels' layer index. Upgrades the formulation of the
     reference's per-expert indexed matmul (src/nn/nn-cpu-ops.cpp:1166-1192).
     """
-    E, nb, _, out = qt.shape
+    *lead, nb, _, out = qt.shape
+    E = 1
+    for s in lead:
+        E *= s
     in_features = nb * Q_BLOCK
     R_pad = xp.shape[0]
     xp = xp.astype(dtype)

@@ -131,6 +131,20 @@ def _quant_matmul_xla(x, q, d, dtype):
     )
 
 
+def slice_layer(w, i):
+    """w[i] of an all-layers stacked weight (QuantTensor-aware); identity
+    when i is None. Single owner of the stack-slicing idiom (the transformer
+    and the MoE dispatch both use it)."""
+    if i is None or w is None:
+        return w
+    if isinstance(w, QuantTensor):
+        return QuantTensor(
+            q=jax.lax.dynamic_index_in_dim(w.q, i, 0, keepdims=False),
+            d=jax.lax.dynamic_index_in_dim(w.d, i, 0, keepdims=False),
+        )
+    return jax.lax.dynamic_index_in_dim(w, i, 0, keepdims=False)
+
+
 def quant_matmul(
     x: jnp.ndarray,
     w: QuantTensor,

@@ -303,3 +303,58 @@ def test_grouped_quant_kernel_under_ep():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=5e-2, atol=5e-2,
     )
+
+
+def test_grouped_kernel_layer_fold_matches_sliced(monkeypatch):
+    """The production layer-fold path (full [L, E, ...] stacks + a layer
+    index resolved to flat group indices inside the grouped kernel) must
+    match the per-layer-sliced formulation for EVERY layer — an off-by-one
+    in the flat offset would silently read another layer's experts."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llama_tpu.ops.moe import moe_ffn_ragged, moe_router
+    from distributed_llama_tpu.ops.quant import QuantTensor, slice_layer
+    from distributed_llama_tpu.ops.activations import silu
+
+    rng = np.random.default_rng(5)
+    L, E, dim, ff, b, t, k = 3, 4, 128, 256, 1, 16, 2
+
+    def stack(out_f, in_f):
+        from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40
+        from distributed_llama_tpu.ops.quant import q40_to_t_layout
+        qs, ds = [], []
+        for _ in range(L * E):
+            w = rng.standard_normal((out_f, in_f)).astype(np.float32) * 0.1
+            raw = quantize_q40(w)
+            q, d = unpack_q40(raw, w.size)
+            qt, dt = q40_to_t_layout(
+                q.reshape(out_f, in_f // 32, 32), d.reshape(out_f, in_f // 32)
+            )
+            qs.append(qt)
+            ds.append(dt)
+        return QuantTensor(
+            q=jnp.asarray(np.stack(qs).reshape(L, E, *qs[0].shape)),
+            d=jnp.asarray(np.stack(ds).reshape(L, E, *ds[0].shape)),
+        )
+
+    w1, w3 = stack(ff, dim), stack(ff, dim)
+    w2 = stack(dim, ff)
+    y = jnp.asarray(rng.standard_normal((b, t, dim)), jnp.bfloat16)
+    gate = jnp.asarray(rng.standard_normal((E, dim)) * 3, jnp.float32)
+    idx, wts = moe_router(y, gate, k)
+
+    for layer in range(L):
+        fold = moe_ffn_ragged(
+            y, idx, wts, w1, w3, w2, silu, jnp.bfloat16,
+            pallas="interpret", layer=jnp.int32(layer),
+        )
+        sliced = moe_ffn_ragged(
+            y, idx, wts,
+            slice_layer(w1, layer), slice_layer(w3, layer), slice_layer(w2, layer),
+            silu, jnp.bfloat16, pallas="interpret",
+        )
+        np.testing.assert_allclose(
+            np.asarray(fold, np.float32), np.asarray(sliced, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )

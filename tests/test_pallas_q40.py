@@ -214,3 +214,40 @@ def test_i8_multi_row_via_quant_matmul_batch_dims():
             )
         ).astype(np.float32)
         np.testing.assert_allclose(got[r], solo, rtol=1e-5, atol=1e-5)
+
+
+def test_large_row_vmem_cap_keeps_results_exact():
+    """Large activation-row counts (batched prefill: b = batch x chunk)
+    trigger _bf16_tile_cap's tile shrinking — the capped tiles must compute
+    the same matmul (a round-4 real-chip OOM motivated the cap; a wrong
+    shrink that drops k blocks would be silently wrong, not slow)."""
+    from distributed_llama_tpu.ops.pallas_q40 import _bf16_tile_cap
+
+    rng = np.random.default_rng(7)
+    # ragged nb=24 (in=768): halving path 24 -> 12 -> sublane bump to 8
+    out_f, in_f, b = 256, 768, 1024
+    tn, knb = _bf16_tile_cap(b, 256, 24, 24)
+    assert 24 % knb == 0  # grid covers every k block
+    wt = make_weight(rng, out_f, in_f)
+    x = jnp.asarray(rng.standard_normal((b, in_f)), jnp.float32)
+    want = np.asarray(x) @ np.asarray(dequantize(wt)).T
+    got = np.asarray(
+        q40_matmul_pallas(x, wt.q, wt.d, dtype=jnp.float32, interpret=True)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_vmem_cap_divisor_safety_sweep():
+    """The cap must never return a tile_knb that fails to divide nb (a
+    non-divisor grid DROPS k blocks -> wrong activations) and never violate
+    the Mosaic sublane rule (knb % 8 != 0 only for whole-dim steps)."""
+    from distributed_llama_tpu.ops.pallas_q40 import _bf16_tile_cap
+
+    for nb in (8, 16, 17, 24, 33, 34, 64, 96, 256, 448):
+        for b in (1, 64, 512, 1024, 4096):
+            start_knb = min(64, nb)
+            while nb % start_knb:
+                start_knb //= 2
+            tn, knb = _bf16_tile_cap(b, 256, start_knb, nb)
+            assert nb % knb == 0, (nb, b, knb)
+            assert knb == nb or knb % 8 == 0, (nb, b, knb)
