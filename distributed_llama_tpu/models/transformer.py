@@ -69,15 +69,11 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 def _sel_layer(w: Any, i) -> Any:
     """w[i] for a stacked per-layer weight (QuantTensor-aware); identity when
-    i is None (w already belongs to one layer)."""
-    if i is None or w is None:
-        return w
-    if isinstance(w, QuantTensor):
-        return QuantTensor(
-            q=jax.lax.dynamic_index_in_dim(w.q, i, 0, keepdims=False),
-            d=jax.lax.dynamic_index_in_dim(w.d, i, 0, keepdims=False),
-        )
-    return jax.lax.dynamic_index_in_dim(w, i, 0, keepdims=False)
+    i is None (w already belongs to one layer). Delegates to the single
+    stack-slicing owner in ops/quant.py."""
+    from ..ops.quant import slice_layer
+
+    return slice_layer(w, i)
 
 
 def _dense_ffn(cfg: ModelConfig, y: jnp.ndarray, lp: LayerParams, layer=None) -> jnp.ndarray:

@@ -305,7 +305,7 @@ def test_grouped_quant_kernel_under_ep():
     )
 
 
-def test_grouped_kernel_layer_fold_matches_sliced(monkeypatch):
+def test_grouped_kernel_layer_fold_matches_sliced():
     """The production layer-fold path (full [L, E, ...] stacks + a layer
     index resolved to flat group indices inside the grouped kernel) must
     match the per-layer-sliced formulation for EVERY layer — an off-by-one
@@ -318,7 +318,10 @@ def test_grouped_kernel_layer_fold_matches_sliced(monkeypatch):
     from distributed_llama_tpu.ops.activations import silu
 
     rng = np.random.default_rng(5)
-    L, E, dim, ff, b, t, k = 3, 4, 128, 256, 1, 16, 2
+    # dim/ff chosen so nb % 8 == 0: the grouped-kernel gate must PASS or
+    # both arms silently take the sliced ragged_dot path and the test is
+    # vacuous (asserted below)
+    L, E, dim, ff, b, t, k = 3, 4, 256, 256, 1, 16, 2
 
     def stack(out_f, in_f):
         from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40
@@ -340,6 +343,8 @@ def test_grouped_kernel_layer_fold_matches_sliced(monkeypatch):
 
     w1, w3 = stack(ff, dim), stack(ff, dim)
     w2 = stack(dim, ff)
+    from distributed_llama_tpu.ops.moe import _grouped_quant_eligible
+    assert _grouped_quant_eligible(w1, w3, w2, jnp.bfloat16, False, "interpret")
     y = jnp.asarray(rng.standard_normal((b, t, dim)), jnp.bfloat16)
     gate = jnp.asarray(rng.standard_normal((E, dim)) * 3, jnp.float32)
     idx, wts = moe_router(y, gate, k)
