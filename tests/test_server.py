@@ -524,3 +524,33 @@ def test_mesh_engine_batches_concurrent_requests(mesh_batched_api_server):
         assert out[i] is not None
         assert out[i]["choices"][0]["message"]["content"] == \
             solo[i]["choices"][0]["message"]["content"], f"request {i}"
+
+
+def test_batcher_recovers_from_engine_failure(batched_api_server, monkeypatch):
+    """An engine failure mid-chunk fails the in-flight requests with a 500,
+    rebuilds the session on a recovered engine, and the NEXT request is
+    served normally (the reference instead restarts its whole server loop,
+    dllama-api.cpp:624-636)."""
+    from distributed_llama_tpu.runtime.batch_session import BatchSession
+
+    port = batched_api_server
+    boom = {"armed": True}
+    orig_step = BatchSession.step
+
+    def exploding_step(self, n):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected device failure")
+        return orig_step(self, n)
+
+    monkeypatch.setattr(BatchSession, "step", exploding_step)
+
+    payload = {"messages": [{"role": "user", "content": "hello"}], "max_tokens": 4}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, payload).read()
+    assert ei.value.code == 500
+
+    # next request lands on a rebuilt session and succeeds
+    with _post(port, payload) as r:
+        data = json.loads(r.read())
+    assert data["usage"]["completion_tokens"] > 0
