@@ -1,0 +1,258 @@
+"""Probe round 2 for 4-bit storage: byte-mask unpack that feeds the MXU.
+
+probe_int4/sweep_i4_tiles found the plane-extraction unpack is VPU-bound at
+~1 lane-op/element (w13 hit VPU peak; wcls 3x worse than int8). This probe
+tests the formulation that cuts VPU work to ~0.4 ops/element:
+
+SPLIT-HALF CODEC: byte [b, s, p] (p in [0, out/2)) holds weight col p's
+nibble (+8, unsigned) in its LOW nibble and weight col p + out/2's in its
+HIGH nibble. Then
+    lo = bitcast_i8(w32 & 0x0F0F0F0F)   -> int8 [knb, 32, tn] = cols tile j
+    hi = bitcast_i8((w32 >> 4) & 0x0F..)-> int8 same shape = cols j + half
+one masked i32 op covers 4 bytes = 8 weights, and the int8 results hit the
+MXU with NO per-element convert. The +8 offset folds into a per-block
+correction (8 * sum_block(x8), computed in the XLA prologue, rides in like
+xs). Output block is [R, 2, tn] over a [R, 2, out/2] reshape -- flattening
+gives natural column order, so no output permute exists anywhere.
+
+Variants probed (legalization unknowns, in preference order):
+  i8ops : int8 storage, int8 bitwise and/shift directly (no bitcasts)
+  i32st : i32 storage, i32 mask, bitcast i32->i8 + reshape to lanes
+Run on the real chip.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.pallas_q40 import (
+    _blockdiag_mask,
+    _dt_operand,
+    _i8_call,
+    _quantize_rows_q80,
+    _scale_f32,
+)
+from scripts.probe_int4 import chain
+
+
+def dev_us(make_fn, args, per_iter_guess_us, trials=3):
+    span = max(256, int(30e3 / max(per_iter_guess_us, 1.0)))
+    n1, n2 = 64, 64 + span
+    f1, f2 = make_fn(n1), make_fn(n2)
+    best = {n1: float("inf"), n2: float("inf")}
+    for f, n in ((f1, n1), (f2, n2)):
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = f(*args)
+            _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+            best[n] = min(best[n], time.perf_counter() - t0)
+    return (best[n2] - best[n1]) / (n2 - n1) * 1e6
+
+
+def pack_split_half(qt: np.ndarray) -> np.ndarray:
+    """[nb, 32, out] int8 in [-8,7] -> [nb, 32, out//2] uint8-in-int8:
+    byte [b,s,p] = (qt[b,s,p]+8) | ((qt[b,s,p+out//2]+8) << 4)."""
+    nb, _, out = qt.shape
+    u = (qt.astype(np.int16) + 8).astype(np.uint8)
+    return (u[:, :, : out // 2] | (u[:, :, out // 2 :] << 4)).astype(np.int8)
+
+
+def _kernel_sh(x8_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref, storage="i8ops"):
+    """Split-half 4-bit kernel. qp: packed [knb, 32, tn] int8 (i8ops) or
+    [knb, 32, tn//4] int32 (i32st); dt/out reshaped [.., 2, ..]."""
+    k = pl.program_id(1)
+    knb = dt_ref.shape[0]
+    tn = dt_ref.shape[2]
+    R = x8_ref.shape[0]
+    x8 = x8_ref[...]
+    mask = mask_ref[...]
+    blockdiag = jnp.where(mask != 0, jnp.broadcast_to(x8, mask.shape), jnp.int8(0))
+
+    if storage == "i8ops":
+        p8 = qp_ref[...]  # [knb, 32, tn] int8 (bytes)
+        lo = jnp.bitwise_and(p8, jnp.int8(0x0F))
+        hi = jnp.bitwise_and(jax.lax.shift_right_logical(p8, jnp.int8(4)), jnp.int8(0x0F))
+    else:  # i32st
+        w32 = qp_ref[...]  # [knb, 32, tn//4] i32
+        m = jnp.int32(0x0F0F0F0F)
+        lo32 = jnp.bitwise_and(w32, m)
+        hi32 = jnp.bitwise_and(jax.lax.shift_right_logical(w32, jnp.int32(4)), m)
+        lo = jax.lax.bitcast_convert_type(lo32, jnp.int8).reshape(knb, Q_BLOCK, tn)
+        hi = jax.lax.bitcast_convert_type(hi32, jnp.int8).reshape(knb, Q_BLOCK, tn)
+
+    dtf = _scale_f32(dt_ref[...])  # [knb, 2, tn]
+    xsc = xs_ref[...][:, 0:1]  # [knb, 1] activation scales
+    bsum = bs_ref[...][:, 0:1]  # [knb, 1] per-block sum of x8 (f32)
+
+    accs = []
+    for half, w in ((0, lo), (1, hi)):
+        partials = jax.lax.dot_general(
+            blockdiag,
+            w.reshape(knb * Q_BLOCK, tn),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [knb, tn] = sum x8 * (v+8)
+        corrected = partials.astype(jnp.float32) - 8.0 * bsum
+        accs.append(jnp.sum(corrected * (xsc * dtf[:, half, :]), axis=0)[None, None, :])
+    acc = jnp.concatenate(accs, axis=1)  # [1, 2, tn]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def sh_call(x8, xs, bs, qp, dt2, tile_n, tile_knb, storage, interpret=False):
+    """qp int8 [nb, 32, out//2] (i8ops) or int32 [nb, 32, out//8] (i32st);
+    dt2 [nb, 2, out//2] scale plane. Returns [R, 2, out//2] f32."""
+    nb = qp.shape[0]
+    half = dt2.shape[2]
+    R = x8.shape[0]
+    mask = _blockdiag_mask(tile_knb)
+    grid = (half // tile_n, nb // tile_knb)
+    if storage == "i8ops":
+        qp_spec = pl.BlockSpec((tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j))
+    else:
+        qp_spec = pl.BlockSpec((tile_knb, Q_BLOCK, tile_n // 4), lambda j, k: (k, 0, j))
+    return pl.pallas_call(
+        partial(_kernel_sh, storage=storage),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, tile_knb * Q_BLOCK), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k: (0, 0)),
+            qp_spec,
+            pl.BlockSpec((tile_knb, 2, tile_n), lambda j, k: (k, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((R, 2, tile_n), lambda j, k: (0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, 2, half), jnp.float32),
+        interpret=interpret,
+    )(x8, xs, bs, mask, qp, dt2)
+
+
+def block_sums(x8, nb):
+    """[R, nb*32] int8 -> [nb, R*128] f32 per-block sums, xs-layout."""
+    R = x8.shape[0]
+    s = jnp.sum(x8.reshape(R, nb, Q_BLOCK).astype(jnp.int32), axis=-1).astype(
+        jnp.float32
+    )  # [R, nb]
+    if R == 1:
+        return jnp.broadcast_to(s.reshape(nb, 1), (nb, 128))
+    return jnp.broadcast_to(jnp.transpose(s)[:, :, None], (nb, R, 128)).reshape(
+        nb, R * 128
+    )
+
+
+def main():
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    shapes = [
+        ("wqkv 2048->3072", 2048, 3072),
+        ("wo   2048->2048", 2048, 2048),
+        ("w13  2048->16384", 2048, 16384),
+        ("w2   8192->2048", 8192, 2048),
+        ("wcls 2048->32768", 2048, 32768),
+    ]
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    for label, k, n in shapes:
+        if only and only not in label:
+            continue
+        nb = k // Q_BLOCK
+        qt = rng.integers(-8, 8, (nb, Q_BLOCK, n), dtype=np.int8)
+        dt = (rng.random((nb, n), np.float32) * 0.02 + 0.001).astype(np.float16)
+        x = rng.standard_normal((1, k), np.float32)
+        x8, xs = _quantize_rows_q80(jnp.asarray(x), nb)
+        bs = block_sums(x8, nb)
+        qt_d = jnp.asarray(qt)
+        dt_d = _dt_operand(jnp.asarray(dt))
+        p8 = pack_split_half(qt)
+        qp8 = jnp.asarray(p8)
+        # i32 view of the same bytes (little-endian)
+        qp32 = jnp.asarray(
+            np.ascontiguousarray(p8).view(np.int32).reshape(nb, Q_BLOCK, n // 8)
+        )
+        dt2 = dt_d.reshape(nb, 2, n // 2)
+        ref = np.asarray(_i8_call(x8, xs, qt_d, dt_d, interpret=interpret))
+        phys_mb = (nb * 16 * n + 2 * nb * n) / 1e6
+        base = dev_us(
+            lambda nn: chain(lambda c, q, d, m_xs: _i8_call(c, m_xs, q, d), nn),
+            (x8, qt_d, dt_d, xs),
+            per_iter_guess_us=max(10.0, (nb * 34 * n) / 819e3),
+        )
+        print(f"== {label} packed {phys_mb:.1f} MB | i8 baseline {base:.1f} us ==")
+        results = []
+        for storage, qp in (("i8ops", qp8), ("i32st", qp32)):
+            for tile_n in (256, 512, 1024, 2048):
+                for tile_knb in (8, 16, 32, 64, 128, 256):
+                    half = n // 2
+                    if tile_n > half or tile_knb > nb or half % tile_n or nb % tile_knb:
+                        continue
+                    if tile_knb != nb and tile_knb % 8:
+                        continue
+                    if storage == "i32st" and tile_n % 4:
+                        continue
+                    vmem = 2 * tile_knb * 16 * tile_n + 2 * tile_knb * 32 * tile_n
+                    if vmem > 9 * 1024 * 1024:
+                        continue
+                    try:
+                        got = np.asarray(
+                            sh_call(
+                                x8, xs, bs, qp, dt2, tile_n, tile_knb, storage,
+                                interpret=interpret,
+                            )
+                        ).reshape(1, n)
+                        err = np.abs(got - ref).max()
+                        if err > 1e-3 * (np.abs(ref).max() + 1):
+                            print(
+                                f"  {storage} tn={tile_n} knb={tile_knb}: WRONG err={err:.2e}"
+                            )
+                            continue
+                        us = dev_us(
+                            lambda nn, tn=tile_n, tk=tile_knb, st=storage, q=qp: chain(
+                                lambda c, q2, d2, m_xs, m_bs: sh_call(
+                                    c, m_xs, m_bs, q2, d2, tn, tk, st, interpret=interpret
+                                ),
+                                nn,
+                            ),
+                            (x8, qp, dt2, xs, bs),
+                            per_iter_guess_us=max(10.0, phys_mb * 1e6 / 819e3 / 1e3),
+                        )
+                        gbs = phys_mb / 1e3 / (us / 1e6)
+                        print(
+                            f"  {storage:6s} tn={tile_n:4d} knb={tile_knb:3d}: "
+                            f"{us:7.1f} us  {gbs:6.0f} GB/s  ({base/us:4.2f}x i8)"
+                        )
+                        results.append((us, storage, tile_n, tile_knb))
+                    except Exception as e:
+                        msg = str(e).split("\n")[0][:140]
+                        print(
+                            f"  {storage} tn={tile_n} knb={tile_knb}: FAIL "
+                            f"{type(e).__name__}: {msg}"
+                        )
+        if results:
+            results.sort()
+            us, st, tn, tk = results[0]
+            gbs = phys_mb / 1e3 / (us / 1e6)
+            print(
+                f"  BEST: {st} tn={tn} knb={tk} {us:.1f} us {gbs:.0f} GB/s "
+                f"({base/us:.2f}x i8)"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""Probe round 3 for 4-bit storage: i32 mask + pltpu.bitcast -> int8 MXU.
+
+The winning formulation (probe_int4b's two both failed: Mosaic rejects int8
+bitwise ops, and jax.lax.bitcast can't change bitwidths in Pallas — but
+pltpu.bitcast CAN, expanding the 2nd-minor dim, and the byte->sublane
+mapping was probed natural little-endian: word g byte k -> sublane 4g+k).
+
+CODEC (feature-split): packed byte [b, s, o] (s in [0,16)) =
+    (v[b, s, o] + 8) | ((v[b, s+16, o] + 8) << 4)
+stored as int32 [nb, 4, out] (the numpy .view(int32) of the byte plane).
+In-kernel:
+    w32 [knb, 4, tn] -> lo = bitcast(w32 & 0x0F0F0F0F, int8) [knb, 16, tn]
+                        hi = bitcast((w32 >> 4) & 0x0F0F..., int8)
+    lo holds features 0..15 of each block, hi 16..31, both unsigned (+8).
+Two int8 MXU dots against per-group blockdiag expansions of the activation
+row; the +8 offset folds into -8 * (per-block sum of x8), computed in the
+XLA prologue. VPU work: 3 i32 ops per WORD (8 weights) = 0.375 ops/weight.
+HBM traffic: 0.5 bytes/weight + 2-byte/block scales. Bit-exact vs the int8
+path (integer arithmetic throughout).
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.pallas_q40 import (
+    _dt_operand,
+    _i8_call,
+    _quantize_rows_q80,
+    _scale_f32,
+)
+from scripts.probe_int4 import chain
+from scripts.probe_int4b import block_sums, dev_us
+
+HGRP = Q_BLOCK // 2  # 16 features per nibble plane
+
+
+def pack_feature_split(qt: np.ndarray) -> np.ndarray:
+    """[nb, 32, out] int8 in [-8,7] -> int32 [nb, 4, out] packed plane.
+
+    Byte plane b8 [nb, 16, out]: feature s's nibble pairs with feature
+    s+16's. Words pack along the SUBLANE axis little-endian (byte k of word
+    g = sublane 4g+k) to match pltpu.bitcast's probed expansion order."""
+    nb, _, out = qt.shape
+    u = (qt.astype(np.int16) + 8).astype(np.uint8)
+    b8 = (u[:, :HGRP, :] | (u[:, HGRP:, :] << 4)).astype(np.uint32)  # [nb,16,out]
+    b4 = b8.reshape(nb, 4, 4, out)  # [b, g, k, o]
+    w = (
+        b4[:, :, 0, :]
+        | (b4[:, :, 1, :] << 8)
+        | (b4[:, :, 2, :] << 16)
+        | (b4[:, :, 3, :] << 24)
+    )
+    return w.view(np.int32) if w.dtype == np.int32 else w.astype(np.uint32).view(np.int32)
+
+
+def _halfmask(tile_knb: int) -> jnp.ndarray:
+    """[tile_knb, tile_knb*16] int8: row b is 1 on block b's 16 columns."""
+    m = np.zeros((tile_knb, tile_knb * HGRP), np.int8)
+    for b in range(tile_knb):
+        m[b, b * HGRP : (b + 1) * HGRP] = 1
+    return jnp.asarray(m)
+
+
+def _kernel_fs(x8a_ref, x8b_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref):
+    k = pl.program_id(1)
+    knb, tn = dt_ref.shape
+    mask = mask_ref[...]  # [knb, knb*16]
+    w32 = qp_ref[...]  # [knb, 4, tn] i32
+    m = jnp.int32(0x0F0F0F0F)
+    lo = pltpu.bitcast(jnp.bitwise_and(w32, m), jnp.int8)  # [knb,16,tn]
+    hi = pltpu.bitcast(
+        jnp.bitwise_and(jax.lax.shift_right_logical(w32, jnp.int32(4)), m), jnp.int8
+    )
+    partials = None
+    for x_ref, w in ((x8a_ref, lo), (x8b_ref, hi)):
+        bd = jnp.where(mask != 0, jnp.broadcast_to(x_ref[...], mask.shape), jnp.int8(0))
+        p = jax.lax.dot_general(
+            bd,
+            w.reshape(knb * HGRP, tn),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [knb, tn]
+        partials = p if partials is None else partials + p
+    dtf = _scale_f32(dt_ref[...])
+    xsc = xs_ref[...][:, 0:1]
+    bsum = bs_ref[...][:, 0:1]
+    corrected = partials.astype(jnp.float32) - 8.0 * bsum
+    acc = jnp.sum(corrected * (xsc * dtf), axis=0)[None, :]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def _kernel_fs2d(x8a_ref, x8b_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref):
+    """2D-storage variant: qp block [knb*4, tn] i32 — full 8-sublane vreg
+    rows (the 3D [knb, 4, tn] layout leaves half of every i32 vreg empty).
+    pltpu.bitcast expands straight to the dot's [knb*16, tn] int8 operand."""
+    k = pl.program_id(1)
+    knb, tn = dt_ref.shape
+    mask = mask_ref[...]
+    w32 = qp_ref[...]  # [knb*4, tn] i32
+    m = jnp.int32(0x0F0F0F0F)
+    lo = pltpu.bitcast(jnp.bitwise_and(w32, m), jnp.int8)  # [knb*16, tn]
+    hi = pltpu.bitcast(
+        jnp.bitwise_and(jax.lax.shift_right_logical(w32, jnp.int32(4)), m), jnp.int8
+    )
+    partials = None
+    for x_ref, w in ((x8a_ref, lo), (x8b_ref, hi)):
+        bd = jnp.where(mask != 0, jnp.broadcast_to(x_ref[...], mask.shape), jnp.int8(0))
+        p = jax.lax.dot_general(
+            bd, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )
+        partials = p if partials is None else partials + p
+    dtf = _scale_f32(dt_ref[...])
+    xsc = xs_ref[...][:, 0:1]
+    bsum = bs_ref[...][:, 0:1]
+    corrected = partials.astype(jnp.float32) - 8.0 * bsum
+    acc = jnp.sum(corrected * (xsc * dtf), axis=0)[None, :]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def fs2d_call(x8, xs, bs, qp2d, dt, tile_n, tile_knb, interpret=False):
+    """qp2d int32 [nb*4, out] (the [nb,4,out] pack flattened — same bytes)."""
+    nb = qp2d.shape[0] // 4
+    out = qp2d.shape[1]
+    R = x8.shape[0]
+    x83 = x8.reshape(R, nb, Q_BLOCK)
+    x8a = x83[:, :, :HGRP].reshape(R, nb * HGRP)
+    x8b = x83[:, :, HGRP:].reshape(R, nb * HGRP)
+    mask = _halfmask(tile_knb)
+    grid = (out // tile_n, nb // tile_knb)
+    return pl.pallas_call(
+        _kernel_fs2d,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * HGRP), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb * 4, tile_n), lambda j, k: (k, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
+        interpret=interpret,
+    )(x8a, x8b, xs, bs, mask, qp2d, dt)
+
+
+def fs_call(x8, xs, bs, qp, dt, tile_n, tile_knb, interpret=False):
+    """qp int32 [nb, 4, out]; dt [nb, out] (i16 bits); x8 [R, nb*32] int8.
+    Returns [R, out] f32. R=1 probe."""
+    nb = qp.shape[0]
+    out = qp.shape[2]
+    R = x8.shape[0]
+    x83 = x8.reshape(R, nb, Q_BLOCK)
+    x8a = x83[:, :, :HGRP].reshape(R, nb * HGRP)
+    x8b = x83[:, :, HGRP:].reshape(R, nb * HGRP)
+    mask = _halfmask(tile_knb)
+    grid = (out // tile_n, nb // tile_knb)
+    return pl.pallas_call(
+        _kernel_fs,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * HGRP), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb, 4, tile_n), lambda j, k: (k, 0, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
+        interpret=interpret,
+    )(x8a, x8b, xs, bs, mask, qp, dt)
+
+
+def main():
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    shapes = [
+        ("wqkv 2048->3072", 2048, 3072),
+        ("wo   2048->2048", 2048, 2048),
+        ("w13  2048->16384", 2048, 16384),
+        ("w2   8192->2048", 8192, 2048),
+        ("wcls 2048->32768", 2048, 32768),
+    ]
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    for label, k, n in shapes:
+        if only and only not in label:
+            continue
+        nb = k // Q_BLOCK
+        qt = rng.integers(-8, 8, (nb, Q_BLOCK, n), dtype=np.int8)
+        dt = (rng.random((nb, n), np.float32) * 0.02 + 0.001).astype(np.float16)
+        x = rng.standard_normal((1, k), np.float32)
+        x8, xs = _quantize_rows_q80(jnp.asarray(x), nb)
+        bs = block_sums(x8, nb)
+        qt_d = jnp.asarray(qt)
+        dt_d = _dt_operand(jnp.asarray(dt))
+        qp = jnp.asarray(pack_feature_split(qt))
+        ref = np.asarray(_i8_call(x8, xs, qt_d, dt_d, interpret=interpret))
+        phys_mb = (nb * 16 * n + 2 * nb * n) / 1e6
+        base = dev_us(
+            lambda nn: chain(lambda c, q, d, m_xs: _i8_call(c, m_xs, q, d), nn),
+            (x8, qt_d, dt_d, xs),
+            per_iter_guess_us=max(10.0, (nb * 34 * n) / 819e3),
+        )
+        print(f"== {label} packed {phys_mb:.1f} MB | i8 baseline {base:.1f} us ==")
+        qp2d = qp.reshape(nb * 4, n)
+        results = []
+        for variant in ("fs2d", "fs3d"):
+            call = fs2d_call if variant == "fs2d" else fs_call
+            qarg = qp2d if variant == "fs2d" else qp
+            for tile_n in (256, 512, 1024, 2048, 4096):
+                for tile_knb in (8, 16, 32, 64, 128, 256):
+                    if tile_n > n or tile_knb > nb or n % tile_n or nb % tile_knb:
+                        continue
+                    if tile_knb != nb and tile_knb % 8:
+                        continue
+                    # VMEM: packed block (x2 double-buffer) + lo/hi int8 temps
+                    vmem = 2 * tile_knb * 16 * tile_n + 2 * tile_knb * 32 * tile_n
+                    if vmem > 9 * 1024 * 1024:
+                        continue
+                    try:
+                        got = np.asarray(
+                            call(x8, xs, bs, qarg, dt_d, tile_n, tile_knb, interpret=interpret)
+                        )
+                        err = np.abs(got - ref).max()
+                        if err > 1e-3 * (np.abs(ref).max() + 1):
+                            print(f"  {variant} tn={tile_n} knb={tile_knb}: WRONG err={err:.2e}")
+                            continue
+                        us = dev_us(
+                            lambda nn, tn=tile_n, tk=tile_knb, cl=call, q=qarg: chain(
+                                lambda c, q2, d, m_xs, m_bs: cl(
+                                    c, m_xs, m_bs, q2, d, tn, tk, interpret=interpret
+                                ),
+                                nn,
+                            ),
+                            (x8, qarg, dt_d, xs, bs),
+                            per_iter_guess_us=max(10.0, phys_mb * 1e6 / 819e3 / 1e3),
+                        )
+                        gbs = phys_mb / 1e3 / (us / 1e6)
+                        print(
+                            f"  {variant} tn={tile_n:4d} knb={tile_knb:3d}: {us:7.1f} us  "
+                            f"{gbs:6.0f} GB/s  ({base/us:4.2f}x i8, err {err:.1e})"
+                        )
+                        results.append((us, variant, tile_n, tile_knb))
+                    except Exception as e:
+                        msg = str(e).split("\n")[0][:130]
+                        print(f"  {variant} tn={tile_n} knb={tile_knb}: FAIL {type(e).__name__}: {msg}")
+        if results:
+            results.sort()
+            us, v, tn, tk = results[0]
+            gbs = phys_mb / 1e3 / (us / 1e6)
+            print(f"  BEST: {v} tn={tn} knb={tk} {us:.1f} us {gbs:.0f} GB/s ({base/us:.2f}x i8)")
+
+
+if __name__ == "__main__":
+    main()
