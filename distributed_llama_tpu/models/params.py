@@ -144,7 +144,10 @@ def _load_one(reader: MFileReader, spec: TensorSpec, dense_dtype) -> Any:
 
         nat = q40_unpack_t_native(reader.raw(spec), out_f, in_f)
         if nat is not None:
-            return nat
+            from ..ops.quant import pack_q
+
+            qt, dt = nat
+            return pack_q(qt), dt
         from ..ops.quant import q40_to_t_layout
 
         q, d = reader.tensor_q40(spec)  # [out, in//32, 32], [out, in//32]

@@ -48,7 +48,7 @@ def linear(
         if q80:
             x = quantize_q80_activations(x)
         return quant_matmul(
-            x, w, dtype=dtype, pallas=pallas, layer=layer if w.q.ndim == 4 else None
+            x, w, dtype=dtype, pallas=pallas, layer=layer if w.q.ndim == 3 else None
         )
     if layer is not None and w.ndim == 3:
         w = jax.lax.dynamic_index_in_dim(w, layer, 0, keepdims=False)
@@ -104,7 +104,7 @@ def _gather_expert(w: Any, idx: jnp.ndarray) -> Any:
 
 def _expert_matmul(x: jnp.ndarray, w: Any, dtype, q80: bool = False) -> jnp.ndarray:
     """Per-token expert matmul: x [b,t,k,in] with per-token gathered expert
-    weights — QuantTensor in the T layout ([...,nb,32,out]) or dense
+    weights — QuantTensor in the packed T layout ([...,nb*4,out]) or dense
     [...,out,in]."""
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     if isinstance(w, QuantTensor):
@@ -261,9 +261,9 @@ def _moe_decode_i8(cfg, y, lp, layer, idx, wts):
     from ..ops.pallas_q40 import q40_matmul_pallas_stacked_i8
 
     def flat(w):
-        # [L, E, nb, 32, out] -> [L*E, nb, 32, out] (free reshape); a
+        # [L, E, nb*4, out] -> [L*E, nb*4, out] (free reshape); a
         # layer-sliced [E, ...] stack (pipeline path) passes through as-is
-        if w.q.ndim == 5:
+        if w.q.ndim == 4:
             return (
                 w.q.reshape(-1, *w.q.shape[2:]),
                 w.d.reshape(-1, *w.d.shape[2:]),
@@ -273,7 +273,7 @@ def _moe_decode_i8(cfg, y, lp, layer, idx, wts):
     w1q, w1d = flat(lp.w1)
     w3q, w3d = flat(lp.w3)
     w2q, w2d = flat(lp.w2)
-    n_e = _n_local_experts(lp.w1, stacked=lp.w1.q.ndim == 5)
+    n_e = _n_local_experts(lp.w1, stacked=lp.w1.q.ndim == 4)
     base = (layer * n_e) if layer is not None else 0
     interp = cfg.pallas_interpret
 

@@ -1,18 +1,38 @@
-"""Fused Q40 dequant-matmul Pallas TPU kernel.
+"""Fused Q40 dequant-matmul Pallas TPU kernels — true 4-bit residency.
 
 TPU-native replacement for the reference's hot loop, `matmul_Q80_Q40_F32`
 (reference: src/nn/nn-cpu-ops.cpp:231-449, NEON/AVX-512/AVX2 paths): instead
-of SIMD nibble tricks over CPU cache lines, the weight streams from HBM as
-int8 (the T layout, see ops/quant.py), is dequantized in VMEM with one
-broadcast-multiply, and hits the MXU as bf16 — HBM traffic is ~1 byte/weight
-instead of the 2-4 bytes the dequant-materialize XLA fallback pays.
+of SIMD nibble tricks over CPU cache lines, the weight streams from HBM
+NIBBLE-PACKED (0.5 bytes/weight — the packed T layout, ops/quant.py; the
+reference's own 4.5 bits/weight Q40 trait, nn-quants.hpp:64-72) and unpacks
+in VMEM with two i32 mask ops + a pltpu.bitcast to int8 (~0.4 VPU
+ops/weight). HBM traffic is half the round-4 int8 layout's and 4-8x less
+than the dequant-materialize XLA fallback pays.
+
+The unpack (the FEATURE-SPLIT codec, ops/quant.py docstring): a packed
+block arrives as [TILE_KNB*4, TILE_N] int32; `w & 0x0F0F0F0F` yields the
+bytes of features 0..15 of each 32-block (+8, unsigned), `(w >> 4) & ...`
+features 16..31, and pltpu.bitcast reinterprets each masked word as 4 int8
+sublanes (probed natural little-endian order) — no per-element VPU work.
+  * decode (row counts <= 8): the int8 results feed the MXU directly via
+    two block-diagonal dots (one per nibble plane); the +8 offset folds
+    into a per-block correction 8*sum(x8_block) computed in the prologue.
+    Bit-exact vs the reference's Q80xQ40 integer dot.
+  * prefill (large row counts): the planes concat to [TILE_KNB, 32, TILE_N]
+    and dequantize to bf16 ((u - 8) * scale) — the per-element convert
+    amortizes over the activation rows, MXU work dominates.
+Probes and tile sweeps: scripts/probe_int4*.py (also documents the dead
+ends: native s4 arrays cannot cross jit boundaries on this platform, int8
+bitwise ops and bitwidth-changing jax.lax.bitcasts don't legalize in
+Mosaic, and plane-extraction unpacks are VPU-bound).
 
 Tiling:
   grid = (out/TILE_N, nb/TILE_KNB), k innermost (output tile revisited,
   f32 accumulation in place);
-  qt block [TILE_KNB, 32, TILE_N] int8 — the 32-sublane dim is exactly
-  int8's min tile, TILE_N sits on the 128-lane dim;
-  dt block [TILE_KNB, TILE_N] broadcasts over the sublane axis.
+  packed block [TILE_KNB*4, TILE_N] int32 — full 8-sublane i32 vregs (a
+  3D [TILE_KNB, 4, TILE_N] block leaves half of every vreg empty and
+  measures ~2x slower);
+  dt block [TILE_KNB, TILE_N] broadcasts over the unpacked sublane axis.
 
 Scale plane: the .m file's per-block scales are f16; the T layout carries
 them verbatim (2 bytes/block — half the round-2 f32 plane's HBM traffic and
@@ -62,12 +82,12 @@ DEFAULT_TILE_KNB = 64  # 64 blocks = 2048 input features per k step
 
 
 def q40_matmul_aligned(x, w) -> bool:
-    """Kernel supports: an unstacked (3D) weight with lane-aligned
+    """Kernel supports: an unstacked (2D packed) weight with lane-aligned
     out_features and a matching x. (Unaligned weights fall back to the XLA
     dequant path; expert stacks never reach quant_matmul — they go through
     models.transformer._expert_matmul.)"""
     return (
-        w.q.ndim == 3
+        w.q.ndim == 2
         and w.out_features % LANE == 0
         and x.shape[-1] == w.in_features
     )
@@ -116,25 +136,47 @@ def _dt_operand(dt: jnp.ndarray) -> jnp.ndarray:
     return dt
 
 
-def _dequant_dot_accum(k, x_ref, qt_ref, dt_ref, out_ref):
-    """Shared body of the bf16-dequant kernels: dequantize this k-step's
-    weight tile, matmul against the x tile, accumulate into out over the k
-    grid axis. Single owner of the dequant rounding choice — the unstacked,
-    stacked, and grouped kernels differ only in how their BlockSpec
-    index_maps pick the tile (plain / scalar-prefetched layer / per-row-block
-    expert), never in the math."""
+HGRP = Q_BLOCK // 2  # features per nibble plane (ops/quant.py codec)
+NIBBLE_MASK = 0x0F0F0F0F
+
+
+def _fs_lo_hi(w32: jnp.ndarray):
+    """Packed block [knb*4, tn] int32 -> (lo, hi) int8 [knb*16, tn]: the
+    unsigned (+8) values of features 0..15 / 16..31 of each 32-block. Two
+    i32 vector ops + a shift, then pltpu.bitcast reinterprets each masked
+    word's 4 bytes as 4 int8 sublanes (probed little-endian — the codec
+    packs to match, so this is layout-free)."""
+    m = jnp.int32(NIBBLE_MASK)
+    lo = pltpu.bitcast(jnp.bitwise_and(w32, m), jnp.int8)
+    hi = pltpu.bitcast(
+        jnp.bitwise_and(jax.lax.shift_right_logical(w32, jnp.int32(4)), m), jnp.int8
+    )
+    return lo, hi
+
+
+def _dequant_dot_accum(k, x_ref, qp_ref, dt_ref, out_ref):
+    """Shared body of the bf16-dequant (prefill / multi-row) kernels:
+    unpack + dequantize this k-step's packed weight tile, matmul against the
+    x tile, accumulate into out over the k grid axis. Single owner of the
+    dequant rounding choice — the unstacked, stacked, and grouped kernels
+    differ only in how their BlockSpec index_maps pick the tile (plain /
+    scalar-prefetched layer / per-row-block expert), never in the math."""
+    knb, tn = dt_ref.shape
+    lo, hi = _fs_lo_hi(qp_ref[...])
+    u = jnp.concatenate(
+        [lo.reshape(knb, HGRP, tn), hi.reshape(knb, HGRP, tn)], axis=1
+    )  # [knb, 32, tn] unsigned (+8) values, natural feature order
+    dtf = _scale_f32(dt_ref[...])
     if x_ref.dtype == jnp.bfloat16:
-        # dequant in bf16: the weight lands in bf16 either way (x's dtype);
-        # multiplying in bf16 vs f32-then-cast differs only by one rounding
-        w = qt_ref[...].astype(jnp.bfloat16) * _scale_f32(dt_ref[...])[
-            :, None, :
-        ].astype(jnp.bfloat16)
+        # dequant in bf16: (u - 8) is exact in bf16 (small integers); the
+        # scale multiply rounds once, same class as the pre-pack kernels
+        w = (u.astype(jnp.bfloat16) - jnp.bfloat16(8)) * dtf[:, None, :].astype(
+            jnp.bfloat16
+        )
     else:
         # f32 multiply keeps full f16-scale precision, then cast once
-        w = (
-            qt_ref[...].astype(jnp.float32) * _scale_f32(dt_ref[...])[:, None, :]
-        ).astype(x_ref.dtype)
-    w = w.reshape(w.shape[0] * Q_BLOCK, w.shape[2])
+        w = ((u.astype(jnp.float32) - 8.0) * dtf[:, None, :]).astype(x_ref.dtype)
+    w = w.reshape(knb * Q_BLOCK, tn)
     acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
 
     @pl.when(k == 0)
@@ -157,10 +199,14 @@ def _bf16_tile_cap(b: int, tile_n: int, tile_knb: int, nb: int):
     k-depth shrinks first (less valuable than lane width)."""
 
     def need(tn, knb):
+        # x (bf16, dbl-buffered) + dequant w (2B) + unpack temps (lo/hi/cat
+        # int8 ~ 2x the unpacked bytes) + packed i32 block (dbl-buffered,
+        # 0.5B/weight) + out/acc f32
         return (
             2 * b * knb * Q_BLOCK * 2
             + knb * Q_BLOCK * tn * 2
             + 2 * knb * Q_BLOCK * tn
+            + 2 * knb * HGRP * tn
             + 2 * b * tn * 4
         )
 
@@ -203,25 +249,26 @@ def _kernel_stacked(l_ref, x_ref, qt_ref, dt_ref, out_ref):
 @partial(jax.jit, static_argnames=("dtype", "interpret"))
 def q40_matmul_pallas_stacked(
     x: jnp.ndarray,  # [..., in_features]
-    qt: jnp.ndarray,  # [L, nb, 32, out] — all layers, resident in HBM
+    qt: jnp.ndarray,  # [L, nb*4, out] int32 packed — all layers, in HBM
     dt: jnp.ndarray,  # [L, nb, out]
     layer: jnp.ndarray,  # scalar int32 — which layer's weight to use
     dtype=jnp.bfloat16,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """x @ w[layer] for a stacked Q40 weight, without materializing the
-    layer's slice.
+    """x @ w[layer] for a stacked packed Q40 weight, without materializing
+    the layer's slice.
 
     The layer index rides in as a scalar-prefetch argument and offsets the
     BlockSpec index_maps, so the kernel DMAs only layer `layer`'s tiles out
     of the full stacked array. This is what lets the transformer `lax.scan`
-    over layers (one compiled body) while keeping weight traffic at ~1
-    byte/weight: scanning over sliced weights instead would force XLA to
+    over layers (one compiled body) while keeping weight traffic at ~0.5
+    bytes/weight: scanning over sliced weights instead would force XLA to
     materialize a full copy of every layer's weights each step, because a
     dynamic-slice cannot fuse into an opaque pallas_call (the copies dominated
     the round-1 decode profile).
     """
-    L, nb, _, out = qt.shape
+    L, rows4, out = qt.shape
+    nb = rows4 // 4
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     b = 1
@@ -242,10 +289,10 @@ def q40_matmul_pallas_stacked(
     # real TPUs for blocks that don't span the whole (flattened) leading dim
 
     # flatten the layer axis into the block-row axis (a free bitcast — the
-    # memory is contiguous) so the kernel sees the same 3D blocks as the
+    # memory is contiguous) so the kernel sees the same 2D blocks as the
     # unstacked kernel; the layer offset folds into the block index
     k_steps = nb // tile_knb
-    qt3 = qt.reshape(L * nb, Q_BLOCK, out)
+    qt2 = qt.reshape(L * rows4, out)
     dt3 = dt.reshape(L * nb, out)
 
     grid = (out // tile_n, k_steps)
@@ -255,7 +302,7 @@ def q40_matmul_pallas_stacked(
         in_specs=[
             pl.BlockSpec((b, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
             pl.BlockSpec(
-                (tile_knb, Q_BLOCK, tile_n), lambda j, k, l: (l[0] * k_steps + k, 0, j)
+                (tile_knb * 4, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)
             ),
             pl.BlockSpec((tile_knb, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)),
         ],
@@ -266,7 +313,7 @@ def q40_matmul_pallas_stacked(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, out), jnp.float32),
         interpret=interpret,
-    )(jnp.asarray(layer, jnp.int32).reshape(1), x2, qt3, dt3)
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x2, qt2, dt3)
     return out2.reshape(*lead, out)
 
 
@@ -442,12 +489,158 @@ def _i8_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
     return tile_n, tile_knb
 
 
+def _halfmask(tile_knb: int) -> jnp.ndarray:
+    """[tile_knb, tile_knb*16] int8: row b is 1 on block b's 16 columns —
+    the blockdiag mask for one nibble plane's feature group."""
+    import numpy as np
+
+    m = np.zeros((tile_knb, tile_knb * HGRP), np.int8)
+    for b in range(tile_knb):
+        m[b, b * HGRP : (b + 1) * HGRP] = 1
+    return jnp.asarray(m)
+
+
+def _quantize_rows_q80_split(x2: jnp.ndarray, nb: int):
+    """[R, in] rows -> (x8a, x8b [R, nb*16] int8, xs, bs [nb, R*128] f32).
+
+    Same Q80 numerics as `_quantize_rows_q80`; additionally splits each
+    32-block's int8 values into the two nibble-plane feature groups the
+    packed kernels dot separately (a/b = features 0..15 / 16..31), and
+    computes the per-block sums `bs` that fold the codec's +8 offset out of
+    the integer partials (partial - 8*bs == the exact signed dot). Layouts
+    mirror xs (row r's scalars at columns [r*128, (r+1)*128))."""
+    R = x2.shape[0]
+    xb = x2.reshape(R, nb, Q_BLOCK).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    x8 = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)  # [R, nb, 32]
+    scale16 = scale.astype(jnp.float16).astype(jnp.float32)  # [R, nb, 1]
+    bsum = jnp.sum(x8.astype(jnp.int32), axis=-1).astype(jnp.float32)  # [R, nb]
+    if R == 1:
+        # hot decode path: plain [nb, 1] -> [nb, 128] broadcasts (the 3D
+        # transpose in the general branch costs a ~16 us relayout per call)
+        xs = jnp.broadcast_to(scale16.reshape(nb, 1), (nb, 128))
+        bs = jnp.broadcast_to(bsum.reshape(nb, 1), (nb, 128))
+    else:
+        xs = jnp.broadcast_to(
+            jnp.transpose(scale16, (1, 0, 2)), (nb, R, 128)
+        ).reshape(nb, R * 128)
+        bs = jnp.broadcast_to(
+            jnp.transpose(bsum, (1, 0))[:, :, None], (nb, R, 128)
+        ).reshape(nb, R * 128)
+    x8a = x8[:, :, :HGRP].reshape(R, nb * HGRP)
+    x8b = x8[:, :, HGRP:].reshape(R, nb * HGRP)
+    return x8a, x8b, xs, bs
+
+
+def _fs_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
+    """Tile shapes for the packed (feature-split) int8 decode kernels, from
+    the round-5 on-chip sweeps (scripts/probe_int4c.py; us per decode
+    matmul, 2D [nb*4, out] storage):
+      big-out   (out >= 4096):           tn=2048 knb=32 (w13 28.1 us
+                672 GB/s = 1.83x the int8 kernel; wcls 51.9 us 728 GB/s =
+                2.12x)
+      deep-k    (nb >= 256, out < 4096): tn=2048 knb=8  (w2-class)
+      square    (else):                  tn=1024 knb=32 (wqkv 1.27x)
+    """
+    if out >= 4096:
+        tile_n, tile_knb = 2048, 32
+    elif nb >= 256:
+        tile_n, tile_knb = 2048, 8
+    else:
+        tile_n, tile_knb = 1024, 32
+    tile_n = min(tile_n, out)
+    while out % tile_n:
+        tile_n //= 2
+    tile_knb = min(tile_knb, nb)
+    while nb % tile_knb:
+        tile_knb //= 2
+    # VMEM: packed i32 block (dbl-buffered, 16*knb*tn bytes) + lo/hi int8
+    # temps + the per-row blockdiag expansions [rows*knb, knb*16] x2
+    while 4 * tile_knb * 16 * tile_n > 8 * 1024 * 1024 and tile_knb > 8:
+        tile_knb //= 2
+    while 2 * rows * tile_knb * tile_knb * HGRP > 4 * 1024 * 1024 and tile_knb > 8:
+        tile_knb //= 2
+    # Mosaic sublane rule for the [tile_knb, tile_n] scale block (multi-k
+    # grids need tile_knb % 8 unless the block spans the whole leading dim)
+    if tile_knb != nb and tile_knb % 8:
+        tile_knb = nb
+    return tile_n, tile_knb
+
+
+def _kernel_fs_i8(
+    x8a_ref, x8b_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref
+):
+    """Packed-weight int8-MXU decode kernel: two i32 mask ops + pltpu.bitcast
+    unpack the nibble planes straight into int8 MXU operands (module
+    docstring). Per plane, the blockdiag trick gives every block's partial
+    dot in ONE 2D int8 matmul; the two planes' partials add (they are
+    disjoint halves of each block's features), the +8 offset leaves via the
+    prologue-computed per-block sums, and per-block scales combine on the
+    VPU at 1/32nd the element count. Bit-exact vs the reference's Q80xQ40
+    integer dot (all-integer until the final f32 scale combine)."""
+    k = pl.program_id(1)
+    knb, tn = dt_ref.shape
+    R = x8a_ref.shape[0]
+    mask = mask_ref[...]  # [knb, knb*16]
+    lo, hi = _fs_lo_hi(qp_ref[...])  # int8 [knb*16, tn] each
+    partials = None
+    for x_ref, w in ((x8a_ref, lo), (x8b_ref, hi)):
+        x8 = x_ref[...]  # [R, knb*16] int8
+        if R == 1:
+            bd = jnp.where(mask != 0, jnp.broadcast_to(x8, mask.shape), jnp.int8(0))
+        else:
+            # strictly 2D per-row broadcast-select + sublane concat (3D int8
+            # broadcasts fail Mosaic's shape-cast lowering on this platform)
+            bd = jnp.concatenate(
+                [
+                    jnp.where(
+                        mask != 0,
+                        jnp.broadcast_to(x8[r : r + 1], mask.shape),
+                        jnp.int8(0),
+                    )
+                    for r in range(R)
+                ],
+                axis=0,
+            )  # [R*knb, knb*16]
+        p = jax.lax.dot_general(
+            bd, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )  # [R*knb, tn]
+        partials = p if partials is None else partials + p
+    dtf = _scale_f32(dt_ref[...])  # [knb, tn]
+    rows = []
+    for r in range(R):
+        pr = partials[r * knb : (r + 1) * knb].astype(jnp.float32)
+        pr = pr - 8.0 * bs_ref[...][:, r * 128 : r * 128 + 1]
+        scale = xs_ref[...][:, r * 128 : r * 128 + 1] * dtf
+        rows.append(jnp.sum(pr * scale, axis=0)[None, :])
+    acc = rows[0] if R == 1 else jnp.concatenate(rows, axis=0)  # [R, tn]
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[...] = acc
+
+    @pl.when(k != 0)
+    def _():
+        out_ref[...] += acc
+
+
+def _kernel_fs_stacked_i8(
+    l_ref, x8a_ref, x8b_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref
+):
+    # identical math to _kernel_fs_i8; the layer offset was folded into the
+    # weight block index by the scalar-prefetch index_map
+    _kernel_fs_i8(x8a_ref, x8b_ref, xs_ref, bs_ref, mask_ref, qp_ref, dt_ref, out_ref)
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def _i8_call(x8, xs, qt, dt, interpret: bool = False) -> jnp.ndarray:
-    """The bare int8-MXU pallas_call on pre-quantized activations:
-    x8 [R, in] int8, xs [nb, R*128] scales, dt already `_dt_operand`-shaped.
-    Returns [R, out] f32. Split out so probes can time the kernel without
-    the quantize prologue (scripts/probe_quant_prologue.py)."""
+    """LEGACY (probe support): the round-4 unpacked-int8 MXU pallas_call on
+    pre-quantized activations — the A/B baseline the packed kernels are
+    measured against (scripts/probe_int4*.py). x8 [R, in] int8, xs
+    [nb, R*128] scales, dt already `_dt_operand`-shaped; qt UNPACKED
+    [nb, 32, out] int8. Returns [R, out] f32."""
     nb, _, out = qt.shape
     R = x8.shape[0]
     tile_n, tile_knb = _i8_tiles(nb, out, rows=R)
@@ -472,18 +665,40 @@ def _i8_call(x8, xs, qt, dt, interpret: bool = False) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("interpret",))
 def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
-    """x @ w via the int8-MXU kernel for decode-sized batches. x: [..., in]
-    with a small row count (quant_matmul gates rows <= 8); returns
-    [..., out] f32. Jitted so eager callers (compile checks) run prologue +
-    kernel as one program; inlines when traced inside a larger jit."""
-    nb, _, out = qt.shape
+    """x @ w via the packed int8-MXU kernel for decode-sized batches. x:
+    [..., in] with a small row count (quant_matmul gates rows <= 8); qt the
+    PACKED [nb*4, out] int32 plane; returns [..., out] f32. Jitted so eager
+    callers (compile checks) run prologue + kernel as one program; inlines
+    when traced inside a larger jit."""
+    rows4, out = qt.shape
+    nb = rows4 // 4
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     R = 1
     for s in lead:
         R *= s
-    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
-    out2 = _i8_call(x8, xs, qt, _dt_operand(dt), interpret=interpret)
+    x8a, x8b, xs, bs = _quantize_rows_q80_split(x.reshape(R, in_features), nb)
+    dt = _dt_operand(dt)
+    tile_n, tile_knb = _fs_tiles(nb, out, rows=R)
+    mask = _halfmask(tile_knb)
+    grid = (out // tile_n, nb // tile_knb)
+    out2 = pl.pallas_call(
+        _kernel_fs_i8,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * HGRP), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb * 4, tile_n), lambda j, k: (k, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
+        interpret=interpret,
+        **_i8_compiler_params(),
+    )(x8a, x8b, xs, bs, mask, qt, dt)
     return out2.reshape(*lead, out)
 
 
@@ -491,44 +706,47 @@ def q40_matmul_pallas_i8(x, qt, dt, interpret: bool = False) -> jnp.ndarray:
 def q40_matmul_pallas_stacked_i8(
     x, qt, dt, layer, interpret: bool = False
 ) -> jnp.ndarray:
-    """x @ w[layer] for a stacked Q40 weight via the int8-MXU kernel at
-    decode-sized batches; the layer index scalar-prefetches into the DMA
+    """x @ w[layer] for a stacked packed Q40 weight via the int8-MXU kernel
+    at decode-sized batches; the layer index scalar-prefetches into the DMA
     offsets exactly like q40_matmul_pallas_stacked."""
-    L, nb, _, out = qt.shape
+    L, rows4, out = qt.shape
+    nb = rows4 // 4
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     R = 1
     for s in lead:
         R *= s
-    x8, xs = _quantize_rows_q80(x.reshape(R, in_features), nb)
+    x8a, x8b, xs, bs = _quantize_rows_q80_split(x.reshape(R, in_features), nb)
     dt = _dt_operand(dt)
-    tile_n, tile_knb = _i8_tiles(nb, out, rows=R)
-    mask = _blockdiag_mask(tile_knb)
+    tile_n, tile_knb = _fs_tiles(nb, out, rows=R)
+    mask = _halfmask(tile_knb)
     k_steps = nb // tile_knb
-    qt3 = qt.reshape(L * nb, Q_BLOCK, out)
+    qt2 = qt.reshape(L * rows4, out)
     dt3 = dt.reshape(L * nb, out)
     grid = (out // tile_n, k_steps)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((R, tile_knb * Q_BLOCK), lambda j, k, l: (0, k)),
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k, l: (0, k)),
+            pl.BlockSpec((R, tile_knb * HGRP), lambda j, k, l: (0, k)),
             pl.BlockSpec((tile_knb, R * 128), lambda j, k, l: (k, 0)),
-            pl.BlockSpec((tile_knb, tile_knb * Q_BLOCK), lambda j, k, l: (0, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k, l: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * HGRP), lambda j, k, l: (0, 0)),
             pl.BlockSpec(
-                (tile_knb, Q_BLOCK, tile_n), lambda j, k, l: (l[0] * k_steps + k, 0, j)
+                (tile_knb * 4, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)
             ),
             pl.BlockSpec((tile_knb, tile_n), lambda j, k, l: (l[0] * k_steps + k, j)),
         ],
         out_specs=pl.BlockSpec((R, tile_n), lambda j, k, l: (0, j)),
     )
     out2 = pl.pallas_call(
-        _kernel_stacked_i8,
+        _kernel_fs_stacked_i8,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
         interpret=interpret,
         **_i8_compiler_params(),
-    )(jnp.asarray(layer, jnp.int32).reshape(1), x8, xs, mask, qt3, dt3)
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x8a, x8b, xs, bs, mask, qt2, dt3)
     return out2.reshape(*lead, out)
 
 
@@ -542,7 +760,7 @@ def _kernel_grouped(be_ref, x_ref, qt_ref, dt_ref, out_ref):
 def q40_matmul_pallas_grouped(
     xp: jnp.ndarray,  # [R_pad, in] — rows grouped by expert, groups padded
     # to block_r multiples (ops/moe.py _grouped_layout)
-    qt: jnp.ndarray,  # [..., nb, 32, out] int8 expert stack — leading axes
+    qt: jnp.ndarray,  # [..., nb*4, out] int32 packed expert stack — leading axes
     # flatten to one group axis (e.g. [E, ...] or the full [L, E, ...] all-
     # layers stack; block_expert then carries FLAT indices layer*E + e, so
     # no per-layer slice of the stack is ever materialized)
@@ -562,7 +780,8 @@ def q40_matmul_pallas_grouped(
     like the stacked kernels' layer index. Upgrades the formulation of the
     reference's per-expert indexed matmul (src/nn/nn-cpu-ops.cpp:1166-1192).
     """
-    *lead, nb, _, out = qt.shape
+    *lead, rows4, out = qt.shape
+    nb = rows4 // 4
     E = 1
     for s in lead:
         E *= s
@@ -581,7 +800,7 @@ def q40_matmul_pallas_grouped(
         tile_knb = nb
     k_steps = nb // tile_knb
 
-    qt3 = qt.reshape(E * nb, Q_BLOCK, out)
+    qt2 = qt.reshape(E * rows4, out)
     dt3 = dt.reshape(E * nb, out)
     grid = (R_pad // block_r, out // tile_n, k_steps)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -590,8 +809,8 @@ def q40_matmul_pallas_grouped(
         in_specs=[
             pl.BlockSpec((block_r, tile_knb * Q_BLOCK), lambda i, j, k, be: (i, k)),
             pl.BlockSpec(
-                (tile_knb, Q_BLOCK, tile_n),
-                lambda i, j, k, be, ks=k_steps: (be[i] * ks + k, 0, j),
+                (tile_knb * 4, tile_n),
+                lambda i, j, k, be, ks=k_steps: (be[i] * ks + k, j),
             ),
             pl.BlockSpec(
                 (tile_knb, tile_n), lambda i, j, k, be, ks=k_steps: (be[i] * ks + k, j)
@@ -604,19 +823,20 @@ def q40_matmul_pallas_grouped(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R_pad, out), jnp.float32),
         interpret=interpret,
-    )(jnp.asarray(block_expert, jnp.int32), xp, qt3, dt3)
+    )(jnp.asarray(block_expert, jnp.int32), xp, qt2, dt3)
 
 
 @partial(jax.jit, static_argnames=("dtype", "interpret"))
 def q40_matmul_pallas(
     x: jnp.ndarray,  # [..., in_features]
-    qt: jnp.ndarray,  # [nb, 32, out]
+    qt: jnp.ndarray,  # [nb*4, out] int32 packed
     dt: jnp.ndarray,  # [nb, out]
     dtype=jnp.bfloat16,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Returns x @ w (logical x @ w.T for the [out, in] weight), f32."""
-    nb, _, out = qt.shape
+    rows4, out = qt.shape
+    nb = rows4 // 4
     in_features = nb * Q_BLOCK
     lead = x.shape[:-1]
     b = 1
@@ -644,7 +864,7 @@ def q40_matmul_pallas(
                 (b, tile_knb * Q_BLOCK), lambda j, k: (0, k), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (tile_knb, Q_BLOCK, tile_n), lambda j, k: (k, 0, j), memory_space=pltpu.VMEM
+                (tile_knb * 4, tile_n), lambda j, k: (k, j), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j), memory_space=pltpu.VMEM),
         ],

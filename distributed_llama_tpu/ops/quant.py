@@ -11,18 +11,33 @@ row dotted against Q40 block-quantized weight rows with NEON/AVX intrinsics
   dequant+dot fallback.
 
 Device layout (the "T" layout, chosen for TPU tiling): a logical
-[out_features, in_features] Q40 weight is stored *transposed and
-block-major*:
+[out_features, in_features] Q40 weight is stored *transposed, block-major
+and nibble-packed*:
 
-    q: [in_features // 32, 32, out_features]  int8  (values in [-8, 7])
-    d: [in_features // 32, out_features]      f16   (per-block scales — the
-                                                     file's f16 bits verbatim)
+    q: [in_features // 8, out_features]   int32  (8 weights per word)
+    d: [in_features // 32, out_features]  f16    (per-block scales — the
+                                                  file's f16 bits verbatim)
 
 so that the innermost axis (out_features, the matmul's N) sits on the
-128-lane dimension, the 32 elements of a quantization block sit exactly on
-int8's 32-sublane min tile, and dequantization is a broadcast of d over the
-sublane axis — no lane shuffles. ``x @ w.T`` becomes ``x @ dequant(q, d)``
-with no transpose.
+128-lane dimension and each int32 word carries 8 nibble-packed weights of
+one output column — true 4-bit residency (4.5 bits/weight with scales, the
+reference's defining Q40 trait, nn-quants.hpp:64-72) at HALF the round-4
+int8 layout's HBM traffic and footprint.
+
+The packing is the FEATURE-SPLIT codec the Pallas kernels unpack with two
+i32 mask ops + a pltpu.bitcast (~0.4 VPU ops/weight — probed as the only
+formulation that stays DMA-bound; plane-extraction unpacks are VPU-bound
+and s4 arrays can't cross jit boundaries on this platform, see
+scripts/probe_int4*.py): within block b, feature s in [0,16) shares a byte
+with feature s+16 —
+
+    byte[b, s, o]  = (v[b, s, o] + 8) | ((v[b, s + 16, o] + 8) << 4)
+    word[b, g, o]  = bytes 4g..4g+3 little-endian, rows flattened to
+                     [nb*4, out]
+
+matching pltpu.bitcast's probed byte->sublane expansion (word row r ->
+int8 sublanes 4r..4r+3), so the in-kernel unpack is layout-free.
+``x @ w.T`` becomes ``x @ dequant(q, d)`` with no transpose.
 
 Activation quantization to Q80 exists only to *emulate the reference's
 numerics* when parity testing (`quantize_q80_activations`); the production
@@ -46,11 +61,12 @@ from ..formats.quants import Q_BLOCK
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class QuantTensor:
-    """A Q40 weight on device in the T layout (see module docstring).
+    """A Q40 weight on device in the packed T layout (see module docstring).
 
-    q: [..., in//32, 32, out] int8;  d: [..., in//32, out] f16 (the file's
-    scale bits verbatim; f32 also accepted for hand-built test tensors).
-    Logical value[o, i] = q[i//32, i%32, o] * d[i//32, o].
+    q: [..., in//8, out] int32 nibble-packed words;  d: [..., in//32, out]
+    f16 (the file's scale bits verbatim; f32 also accepted for hand-built
+    test tensors). `unpack_q(q)` recovers the logical [..., in//32, 32, out]
+    int8 values.
     """
 
     q: jnp.ndarray
@@ -62,12 +78,12 @@ class QuantTensor:
 
     @property
     def in_features(self) -> int:
-        return self.q.shape[-3] * Q_BLOCK
+        return self.q.shape[-2] * 8
 
     @property
     def shape(self) -> tuple:
         """Logical [..., out_features, in_features] shape."""
-        return (*self.q.shape[:-3], self.out_features, self.in_features)
+        return (*self.q.shape[:-2], self.out_features, self.in_features)
 
     def tree_flatten(self):
         return (self.q, self.d), None
@@ -77,15 +93,55 @@ class QuantTensor:
         return cls(*children)
 
 
+HGRP = Q_BLOCK // 2  # features per nibble plane (feature s pairs with s+16)
+
+
+def pack_q(qt: np.ndarray) -> np.ndarray:
+    """Host-side nibble pack: [..., nb, 32, out] int8 in [-8, 7] ->
+    [..., nb*4, out] int32 feature-split words (module docstring codec)."""
+    *lead, nb, _, out = qt.shape
+    u = (qt.astype(np.int16) + 8).astype(np.uint32)
+    b8 = u[..., :HGRP, :] | (u[..., HGRP:, :] << 4)  # [..., nb, 16, out]
+    b4 = b8.reshape(*lead, nb, 4, 4, out)  # [..., b, g, k, o]
+    w = (
+        b4[..., 0, :]
+        | (b4[..., 1, :] << 8)
+        | (b4[..., 2, :] << 16)
+        | (b4[..., 3, :] << 24)
+    )
+    return w.reshape(*lead, nb * 4, out).astype(np.uint32).view(np.int32)
+
+
+def unpack_q(qp: jnp.ndarray) -> jnp.ndarray:
+    """[..., nb*4, out] int32 packed words -> [..., nb, 32, out] int8 values
+    in [-8, 7]. Plain XLA ops — the fallback/parity dequant path and tests;
+    the Pallas kernels unpack in-kernel with pltpu.bitcast instead."""
+    *lead, rows, out = qp.shape
+    nb = rows // 4
+    planes = [
+        (jnp.bitwise_and(jax.lax.shift_right_logical(qp, 4 * j), 0xF) - 8).astype(
+            jnp.int8
+        )
+        for j in range(8)
+    ]
+    # plane j holds feature 16*(j%2) + 4*g + j//2 of word row (b*4+g)
+    pj = jnp.stack(planes, axis=-3)  # [..., 8(j), nb*4, out]
+    pj = pj.reshape(*lead, 4, 2, nb, 4, out)  # [..., k, h, b, g, o]
+    v = jnp.transpose(
+        pj, (*range(len(lead)), len(lead) + 2, len(lead) + 1, len(lead) + 3, len(lead), len(lead) + 4)
+    )  # [..., b, h, g, k, o]
+    return v.reshape(*lead, nb, Q_BLOCK, out)
+
+
 def q40_to_t_layout(q: np.ndarray, d: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Host-side transform from the file layout ([out, in//32, 32] values +
-    [out, in//32] scales, `unpack_q40`) to the device T layout. The single
-    source of truth for the layout contract — used by both the param loader
-    and `quant_tensor_from_q40`. The scale plane keeps the file's f16 dtype
-    (bit-exact, and half the HBM traffic/footprint of an f32 plane)."""
+    [out, in//32] scales, `unpack_q40`) to the packed device T layout. The
+    single source of truth for the layout contract — used by both the param
+    loader and `quant_tensor_from_q40`. The scale plane keeps the file's f16
+    dtype (bit-exact, and half the HBM traffic/footprint of an f32 plane)."""
     qt = np.ascontiguousarray(np.transpose(q, (1, 2, 0)))
     dt = np.ascontiguousarray(np.transpose(d, (1, 0))).astype(np.float16)
-    return qt, dt
+    return pack_q(qt), dt
 
 
 def quant_tensor_from_q40(q: np.ndarray, d: np.ndarray) -> QuantTensor:
@@ -95,13 +151,21 @@ def quant_tensor_from_q40(q: np.ndarray, d: np.ndarray) -> QuantTensor:
     return QuantTensor(q=jnp.asarray(qt), d=jnp.asarray(dt))
 
 
+def quant_tensor_from_t(qt: np.ndarray, dt: np.ndarray) -> QuantTensor:
+    """From UNPACKED T-layout host values (qt [..., nb, 32, out] int8,
+    dt [..., nb, out]): pack and wrap — the constructor tests and hand-built
+    fixtures use."""
+    return QuantTensor(q=jnp.asarray(pack_q(qt)), d=jnp.asarray(dt))
+
+
 def dequantize_t(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
     """Materialize the [..., in_features, out_features] matmul-ready matrix
     (the T layout's natural orientation). Single owner of the dequant
     formula: value = q * d broadcast over the 32-sublane axis, scale multiply
     in f32, one cast at the end."""
-    x = (w.q.astype(jnp.float32) * w.d[..., None, :].astype(jnp.float32)).astype(dtype)
-    return x.reshape(*w.q.shape[:-3], w.in_features, w.out_features)
+    qv = unpack_q(w.q)
+    x = (qv.astype(jnp.float32) * w.d[..., None, :].astype(jnp.float32)).astype(dtype)
+    return x.reshape(*w.q.shape[:-2], w.in_features, w.out_features)
 
 
 def dequantize(w: QuantTensor, dtype=jnp.float32) -> jnp.ndarray:
@@ -119,8 +183,9 @@ def _use_pallas() -> bool:
 def _quant_matmul_xla(x, q, d, dtype):
     # w [in, out] dequantized on the fly; dequant multiply in f32 (scale
     # precision — f16 scales upcast exactly), operands cast to `dtype`
-    w = (q.astype(jnp.float32) * d[:, None, :].astype(jnp.float32)).astype(dtype)
-    w = w.reshape(q.shape[-3] * Q_BLOCK, q.shape[-1])
+    qv = unpack_q(q)
+    w = (qv.astype(jnp.float32) * d[:, None, :].astype(jnp.float32)).astype(dtype)
+    w = w.reshape(qv.shape[-3] * Q_BLOCK, qv.shape[-1])
     precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
     return jax.lax.dot_general(
         x.astype(dtype),
@@ -155,8 +220,8 @@ def quant_matmul(
 ) -> jnp.ndarray:
     """``x @ w.T`` (logical): x [..., in_features] -> [..., out_features].
 
-    `w` is either an unstacked (3D q) QuantTensor, or — with `layer` given —
-    an all-layers stack (4D q, [L, nb, 32, out]): the matmul then uses
+    `w` is either an unstacked (2D packed q) QuantTensor, or — with `layer`
+    given — an all-layers stack (3D q, [L, nb*4, out]): the matmul then uses
     ``w[layer]`` *without materializing the slice* (the Pallas kernel offsets
     its DMA by a scalar-prefetched layer index; the XLA fallback pays a
     dynamic-slice). This is how the transformer's `lax.scan` over layers
@@ -206,7 +271,7 @@ def quant_matmul(
     for s in x.shape[:-1]:
         rows *= s
     use_i8 = pallas and rows <= 8 and dtype == jnp.bfloat16
-    if layer is not None and w.q.ndim == 4:
+    if layer is not None and w.q.ndim == 3:
         stack_aligned = (
             x.shape[-1] == w.in_features
             and q40_stacked_aligned(w.in_features, w.out_features)
@@ -225,7 +290,7 @@ def quant_matmul(
             d = jax.lax.dynamic_index_in_dim(w.d, layer, 0, keepdims=False)
             out = _quant_matmul_xla(x, q, d, dtype)
         return out.astype(out_dtype if out_dtype is not None else x.dtype)
-    assert w.q.ndim == 3, "quant_matmul handles unstacked weights only"
+    assert w.q.ndim == 2, "quant_matmul handles unstacked weights only"
     if pallas and q40_matmul_aligned(x, w):
         if use_i8:
             out = q40_matmul_pallas_i8(x, w.q, w.d, interpret=interpret)

@@ -49,14 +49,15 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
     def entry(quant_pair, dense):
         return {"quant": quant_pair, "dense": dense}
 
-    # T-layout quant pairs (ops/quant.py): q [L, nb, 32, out], d [L, nb, out]
-    row = entry((_ns("pp", None, None, "tp"), _ns("pp", None, "tp")), _ns("pp", "tp", None))
-    col = entry((_ns("pp", "tp", None, None), _ns("pp", "tp", None)), _ns("pp", None, "tp"))
+    # packed T-layout quant pairs (ops/quant.py): q [L, nb*4, out] int32,
+    # d [L, nb, out]
+    row = entry((_ns("pp", None, "tp"), _ns("pp", None, "tp")), _ns("pp", "tp", None))
+    col = entry((_ns("pp", "tp", None), _ns("pp", "tp", None)), _ns("pp", None, "tp"))
     # expert stacks [L, E, ...]: expert axis over `ep` (true expert
     # placement), ff axis over `tp` (the reference's TP-within-expert)
-    erow = entry((_ns("pp", "ep", None, None, "tp"), _ns("pp", "ep", None, "tp")),
+    erow = entry((_ns("pp", "ep", None, "tp"), _ns("pp", "ep", None, "tp")),
                  _ns("pp", "ep", "tp", None))
-    ecol = entry((_ns("pp", "ep", "tp", None, None), _ns("pp", "ep", "tp", None)),
+    ecol = entry((_ns("pp", "ep", "tp", None), _ns("pp", "ep", "tp", None)),
                  _ns("pp", "ep", None, "tp"))
     lrep = entry((_ns("pp"), _ns("pp")), _ns("pp"))  # per-layer vectors
     rep = entry((_ns(), _ns()), _ns())
@@ -73,7 +74,7 @@ def pp_param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "w1": erow if moe else row,
         "w3": erow if moe else row,
         "w2": ecol if moe else col,
-        "wcls": entry((_ns(None, None, "tp"), _ns(None, "tp")), _ns("tp", None)),
+        "wcls": entry((_ns(None, "tp"), _ns(None, "tp")), _ns("tp", None)),
         "embedding": rep,
         "final_norm": rep,
         "norm0": lrep,

@@ -20,7 +20,7 @@ output projections and after logits (reference: SYNC_NODE_SLICES at
 src/llm.cpp:418,569,633).
 
 Q40 weights are (q, d) component pairs in the T layout (ops/quant.py):
-q: [L, in/32, 32, out], d: [L, in/32, out]. The out axis is the LAST axis
+q: [L, in/8, out] int32 packed words, d: [L, in/32, out]. The out axis is the LAST axis
 (row-split shards it); the in axis is the blocks axis at index 1 (col-split
 shards it). Dense weights remain logical [L, out, in].
 
@@ -47,18 +47,20 @@ def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
     def entry(quant_pair, dense):
         return {"quant": quant_pair, "dense": dense}
 
-    # Quant weights use the T layout (ops/quant.py): q [L, nb, 32, out],
-    # d [L, nb, out]; dense weights stay [L, out, in].
+    # Quant weights use the packed T layout (ops/quant.py): q [L, nb*4, out]
+    # int32 words, d [L, nb, out]; dense weights stay [L, out, in].
     # row-split = shard the out axis (q/d last axis; dense axis 1)
-    row = entry((_ns(mesh, None, None, None, "tp"), _ns(mesh, None, None, "tp")),
+    row = entry((_ns(mesh, None, None, "tp"), _ns(mesh, None, None, "tp")),
                 _ns(mesh, None, "tp", None))
-    # col-split = shard the in axis (q/d blocks axis; dense axis 2)
-    col = entry((_ns(mesh, None, "tp", None, None), _ns(mesh, None, "tp", None)),
+    # col-split = shard the in axis (q word-rows axis — block-aligned for any
+    # tp dividing nb, since each block owns 4 contiguous word rows; d blocks
+    # axis; dense axis 2)
+    col = entry((_ns(mesh, None, "tp", None), _ns(mesh, None, "tp", None)),
                 _ns(mesh, None, None, "tp"))
     # MoE expert stacks: [L, E, ...] — ff axis sharded (TP-within-expert)
-    erow = entry((_ns(mesh, None, None, None, None, "tp"), _ns(mesh, None, None, None, "tp")),
+    erow = entry((_ns(mesh, None, None, None, "tp"), _ns(mesh, None, None, None, "tp")),
                  _ns(mesh, None, None, "tp", None))
-    ecol = entry((_ns(mesh, None, None, "tp", None, None), _ns(mesh, None, None, "tp", None)),
+    ecol = entry((_ns(mesh, None, None, "tp", None), _ns(mesh, None, None, "tp", None)),
                  _ns(mesh, None, None, None, "tp"))
     rep = entry((_ns(mesh), _ns(mesh)), _ns(mesh))
 
@@ -74,9 +76,9 @@ def param_shardings(mesh: Mesh, moe: bool = False) -> dict:
         "w1": erow if moe else row,
         "w3": erow if moe else row,
         "w2": ecol if moe else col,
-        # wcls row-split over vocab: quant q [nb, 32, vocab] / d [nb, vocab];
+        # wcls row-split over vocab: quant q [nb*4, vocab] / d [nb, vocab];
         # dense [vocab, dim]
-        "wcls": entry((_ns(mesh, None, None, "tp"), _ns(mesh, None, "tp")), _ns(mesh, "tp", None)),
+        "wcls": entry((_ns(mesh, None, "tp"), _ns(mesh, None, "tp")), _ns(mesh, "tp", None)),
         "embedding": rep,
         "final_norm": rep,
         "norm0": rep,

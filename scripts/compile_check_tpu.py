@@ -56,7 +56,9 @@ def main():
             lead += (E,)
         q = rng.integers(-8, 8, lead + (nb, Q_BLOCK, out)).astype(np.int8)
         d = (rng.standard_normal(lead + (nb, out)) * 0.01).astype(np.float16)
-        return QuantTensor(q=jnp.asarray(q), d=jnp.asarray(d))
+        from distributed_llama_tpu.ops.quant import pack_q
+
+        return QuantTensor(q=jnp.asarray(pack_q(q)), d=jnp.asarray(d))
 
     # weight-shape matrix: (label, in, out) for the 1B, qwen3 and 8B bench
     # models (fused wqkv/w13 shapes included)

@@ -28,7 +28,11 @@ def test_unpack_t_matches_numpy(codec_available):
     got = native.q40_unpack_t_native(raw, out_f, in_f)
     assert got is not None
     qt, dt = got
-    np.testing.assert_array_equal(qt, want_qt)
+    # the codec emits the UNPACKED T layout; the loader nibble-packs it
+    # (models/params.py _load_one), so compare packed-vs-packed
+    from distributed_llama_tpu.ops.quant import pack_q
+
+    np.testing.assert_array_equal(pack_q(qt), want_qt)
     np.testing.assert_array_equal(dt, want_dt)
 
 

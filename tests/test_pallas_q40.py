@@ -61,7 +61,7 @@ def test_alignment_gate():
     # unaligned out (not a multiple of 128) -> gate rejects
     wt_small = make_weight(rng, 96, 64)
     assert not q40_matmul_aligned(jnp.zeros((1, 64)), wt_small)
-    # expert-stacked (4D q) -> gate rejects
+    # expert-stacked (3D packed q) -> gate rejects
     stacked = QuantTensor(q=wt.q[None], d=wt.d[None])
     assert not q40_matmul_aligned(x, stacked)
 
@@ -82,7 +82,9 @@ def _q80_reference(x, wt):
     x8 = np.clip(np.round(xb * inv), -127, 127).astype(np.int32)
     # dequant uses the f16-rounded scale (the Q80 codec's stored scale)
     scale = scale.astype(np.float16).astype(np.float32)
-    q = np.asarray(wt.q, np.int32)  # [nb, 32, out]
+    from distributed_llama_tpu.ops.quant import unpack_q
+
+    q = np.asarray(unpack_q(wt.q), np.int32)  # [nb, 32, out]
     d = np.asarray(wt.d, np.float32)  # [nb, out]
     partials = np.einsum("bk,bko->bo", x8, q)  # exact int dots
     return (partials * (scale * d)).sum(axis=0)[None, :]
