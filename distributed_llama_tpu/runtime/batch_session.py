@@ -214,6 +214,15 @@ class BatchSession:
         (junk in parked rows). Advances every row's position by n_steps."""
         eng = self.engine
         ends = [int(self.pos[r]) + 1 + n_steps for r in self.active_rows()]
+        if ends and max(ends) > self.seq_len:
+            # without this, an overrunning caller would get silently-dropped
+            # cache writes (the parked-row OOB-scatter semantics) and junk
+            # tokens instead of an error — the Batcher clamps its chunks to
+            # seq_len headroom, but a direct API caller must hear about it
+            raise ValueError(
+                f"decode chunk would overrun seq_len={self.seq_len}: "
+                f"max row end {max(ends)} (step n_steps={n_steps})"
+            )
         kv_len = eng._kv_bucket(min(max(ends, default=1), self.seq_len))
         token = jnp.asarray(self.token)
         pos = jnp.asarray(self.pos)

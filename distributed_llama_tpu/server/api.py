@@ -109,9 +109,20 @@ def chunk_json(delta: str | None, stop: bool) -> dict:
 
 
 class _BatchReq:
-    """One request's slot in a batched generation round."""
+    """One request's slot in a batched generation round.
+
+    Tokens flow from the batch thread to the client through `emit`, a
+    bounded queue drained by the REQUEST's own handler thread (Batcher
+    .submit): the step loop never runs client I/O, so one slow client's
+    socket cannot stall co-batched streams (the reference's serial accept
+    loop stalls everyone, dllama-api.cpp:571-576). A client that falls
+    more than EMIT_DEPTH tokens behind is dropped — that row alone."""
+
+    EMIT_DEPTH = 8192
 
     def __init__(self, ids, max_new, temperature, topp, seed, on_token):
+        import queue
+
         self.ids = ids
         self.max_new = max_new
         self.temperature = temperature
@@ -122,6 +133,7 @@ class _BatchReq:
         self.n = 0
         self.error = None
         self.done = threading.Event()
+        self.emit: "queue.Queue[int | None]" = queue.Queue(maxsize=self.EMIT_DEPTH)
 
 
 class Batcher:
@@ -163,7 +175,44 @@ class Batcher:
         self._thread.start()
 
     def submit(self, req: _BatchReq):
+        """Enqueue and then act as the request's emit-queue writer: client
+        I/O (on_token -> SSE socket writes) happens HERE, on the handler's
+        thread, never on the batch step loop. An on_token failure (client
+        gone, or just too slow to drain) marks the row stopped; the loop
+        retires it at the next chunk boundary."""
+        import queue
+
         self.q.put(req)
+        while True:
+            try:
+                t = req.emit.get(timeout=0.5)
+            except queue.Empty:
+                if req.done.is_set():
+                    break
+                continue
+            if t is None:  # sentinel from _finish
+                break
+            if req.stopped:
+                continue  # drain and discard after a failed write
+            try:
+                req.on_token(t)
+            except Exception as e:
+                req.error = req.error or e
+                req.stopped = True
+        # the row is retired; deliver any tokens still queued behind the
+        # sentinel (generated in the final chunk before done was set)
+        while not req.stopped:
+            try:
+                t = req.emit.get_nowait()
+            except queue.Empty:
+                break
+            if t is None:
+                continue
+            try:
+                req.on_token(t)
+            except Exception as e:
+                req.error = req.error or e
+                req.stopped = True
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -182,9 +231,15 @@ class Batcher:
         return np.asarray(jax.random.key_data(_sampler_prng_key(s)))
 
     def _finish(self, req: _BatchReq, session, slots, row):
+        import queue
+
         session.release(row)
         slots[row] = None
         req.done.set()
+        try:
+            req.emit.put_nowait(None)  # wake the writer (FIFO: after tokens)
+        except queue.Full:
+            pass  # writer will notice done via its get timeout
 
     def _loop(self):
         import queue
@@ -197,6 +252,7 @@ class Batcher:
         session = BatchSession(engine)
         slots: list[_BatchReq | None] = [None] * engine.batch
         backlog: "collections.deque[_BatchReq]" = collections.deque()
+        ramped_last = False
 
         while True:
             # drain the queue into the FIFO backlog; block only when fully
@@ -231,16 +287,24 @@ class Batcher:
                 continue
             # chunk size: ramp to 8 right after an admission (a fresh
             # request's first tokens — and a tiny request's only tokens —
-            # reach the client after ~8 steps, not a full chunk), and clamp
-            # by power-of-two halving to the smallest remaining budget among
-            # active rows so no row decodes discarded tokens past its
-            # max_new (the same ladder generate_batch uses; distinct sizes
-            # stay O(log chunk) compiled programs)
-            remaining = min(
-                req.max_new - req.n for req in slots if req is not None
+            # reach the client after ~8 steps, not a full chunk). The ramp
+            # alternates: never two ramped chunks in a row, so sustained
+            # admission traffic costs at most half the chunks (the round-4
+            # loop re-ramped on EVERY admission and could run at chunk=8
+            # permanently). The clamp is only the HARD seq_len headroom —
+            # a row hitting its own max_new mid-chunk just has its surplus
+            # tokens discarded and its slot released (no more shrinking
+            # every co-tenant's chunks to the smallest remaining budget,
+            # which fragmented steady-state traffic into 1-2-token
+            # dispatches, each a ~75-100 ms tunnel round trip).
+            headroom = min(
+                session.seq_len - 1 - int(session.pos[row])
+                for row in range(engine.batch)
+                if slots[row] is not None
             )
-            n = min(8, self.chunk) if admitted else self.chunk
-            while n > max(remaining, 1):
+            n = min(8, self.chunk) if admitted and not ramped_last else self.chunk
+            ramped_last = admitted and not ramped_last
+            while n > max(headroom, 1):
                 n //= 2
             n = max(n, 1)
             try:
@@ -262,14 +326,20 @@ class Batcher:
                     t = int(toks[row, j])
                     req.n += 1
                     try:
-                        req.on_token(t)
-                    except Exception as e:
-                        # a per-ROW failure (typically the client dropping
-                        # its socket mid-stream) stops that row only —
-                        # co-batched requests and the engine are unaffected
-                        req.error = e
+                        req.emit.put_nowait(t)
+                    except queue.Full:
+                        # this client is EMIT_DEPTH tokens behind its writer
+                        # — drop that row only; co-batched requests and the
+                        # engine are unaffected (the writer thread owns the
+                        # socket, so a merely-slow client costs nothing here)
+                        req.error = req.error or RuntimeError(
+                            "client fell too far behind the token stream"
+                        )
                         req.stopped = True
                     if req.stopped or req.n >= req.max_new:
+                        # surplus tokens past max_new in this chunk are
+                        # discarded; the row parks (session.release) so
+                        # co-tenants keep full-size chunks
                         self._finish(req, session, slots, row)
                         break
 

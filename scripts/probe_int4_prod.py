@@ -1,0 +1,139 @@
+"""Production-path probe for the packed 4-bit decode matmul: isolates the
+activation-quantize prologue (now with nibble-plane splits + block sums)
+from the kernel, at each 1B shape.
+
+Rows: (a) kernel-only (pre-quantized operands as chain carry-adjacent
+constants), (b) prologue+kernel = the production q40_matmul_pallas_i8 body,
+(c) prologue-only. b - a - c > 0 means composition costs (relayouts between
+prologue outputs and kernel operands)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.pallas_q40 import (
+    _dt_operand,
+    _fs_tiles,
+    _halfmask,
+    _kernel_fs_i8,
+    _quantize_rows_q80_split,
+)
+from distributed_llama_tpu.ops.quant import pack_q
+from jax.experimental import pallas as pl
+
+
+def dev_us(make_fn, args, per_iter_guess_us, trials=3):
+    span = max(256, int(40e3 / max(per_iter_guess_us, 1.0)))
+    span = min(span, 4096)
+    n1, n2 = 64, 64 + span
+    f1, f2 = make_fn(n1), make_fn(n2)
+    best = {n1: float("inf"), n2: float("inf")}
+    for f, n in ((f1, n1), (f2, n2)):
+        r = f(*args)
+        _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = f(*args)
+            _ = np.asarray(jax.tree.leaves(r)[0]).ravel()[:1]
+            best[n] = min(best[n], time.perf_counter() - t0)
+    return (best[n2] - best[n1]) / (n2 - n1) * 1e6
+
+
+def fs_call_tiles(x8a, x8b, xs, bs, qp, dt, tile_n, tile_knb):
+    nb = qp.shape[0] // 4
+    out = qp.shape[1]
+    R = x8a.shape[0]
+    HG = Q_BLOCK // 2
+    mask = _halfmask(tile_knb)
+    grid = (out // tile_n, nb // tile_knb)
+    return pl.pallas_call(
+        _kernel_fs_i8,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((R, tile_knb * HG), lambda j, k: (0, k)),
+            pl.BlockSpec((R, tile_knb * HG), lambda j, k: (0, k)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, R * 128), lambda j, k: (k, 0)),
+            pl.BlockSpec((tile_knb, tile_knb * HG), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb * 4, tile_n), lambda j, k: (k, j)),
+            pl.BlockSpec((tile_knb, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((R, tile_n), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((R, out), jnp.float32),
+    )(x8a, x8b, xs, bs, mask, qp, dt)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shapes = [
+        ("wqkv", 2048, 3072),
+        ("wo  ", 2048, 2048),
+        ("w13 ", 2048, 16384),
+        ("w2  ", 8192, 2048),
+        ("wcls", 2048, 32768),
+    ]
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    for label, k, n in shapes:
+        if only and only.strip() not in label.strip():
+            continue
+        nb = k // Q_BLOCK
+        qt = rng.integers(-8, 8, (nb, Q_BLOCK, n), dtype=np.int8)
+        dt = (rng.random((nb, n), np.float32) * 0.02 + 0.001).astype(np.float16)
+        qp = jnp.asarray(pack_q(qt))
+        dt_d = _dt_operand(jnp.asarray(dt))
+        x = jnp.asarray(rng.standard_normal((1, k)), jnp.bfloat16)
+        x8a, x8b, xs, bs = _quantize_rows_q80_split(x.astype(jnp.float32), nb)
+        phys_mb = (nb * 16 * n + 2 * nb * n) / 1e6
+        guess = max(8.0, phys_mb * 1e6 / 700e3 / 1e3)
+        tn0, tk0 = _fs_tiles(nb, n)
+
+        def chain(fn):
+            def make(nn):
+                @jax.jit
+                def run(x0, *rest):
+                    def body(c, _):
+                        y = fn(c, *rest)
+                        return (
+                            c.astype(jnp.float32) + jnp.sum(y) * jnp.float32(1e-30)
+                        ).astype(c.dtype), None
+
+                    c, _ = jax.lax.scan(body, x0, None, length=nn)
+                    return c
+
+                return run
+
+            return make
+
+        # (a) kernel-only: carry is x8a
+        a = dev_us(
+            chain(lambda c, xb, m_xs, m_bs, q, d: fs_call_tiles(c, xb, m_xs, m_bs, q, d, tn0, tk0)),
+            (x8a, x8b, xs, bs, qp, dt_d),
+            guess,
+        )
+        # (b) prologue+kernel: carry is the bf16 activation row
+        def prod(c, q, d):
+            pa, pb, pxs, pbs = _quantize_rows_q80_split(c.astype(jnp.float32), nb)
+            return fs_call_tiles(pa, pb, pxs, pbs, q, d, tn0, tk0)
+
+        b = dev_us(chain(prod), (x, qp, dt_d), guess)
+        # (c) prologue-only
+        def prologue(c):
+            pa, pb, pxs, pbs = _quantize_rows_q80_split(c.astype(jnp.float32), nb)
+            return pa.astype(jnp.float32).sum() + pb.astype(jnp.float32).sum() + pxs.sum() + pbs.sum()
+
+        c_us = dev_us(chain(lambda c: prologue(c)[None, None]), (x,), 8.0)
+        print(
+            f"{label} {k}->{n}: kernel {a:7.1f} us ({phys_mb/1e3/(a/1e6):4.0f} GB/s) | "
+            f"prologue+kernel {b:7.1f} | prologue alone {c_us:5.1f} | comp {b-a-c_us:+6.1f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -263,7 +263,7 @@ def main():
     shape_list.append(("wcls", params.wcls))
     for name, w in shape_list:
         name = f"{name} {w.in_features}x{w.out_features}"
-        wq = w.q[0] if w.q.ndim == 4 else w.q
+        wq = w.q[0] if w.q.ndim == 3 else w.q
         wd = w.d[0] if w.d.ndim == 3 else w.d
         from distributed_llama_tpu.ops.quant import QuantTensor
         ww = QuantTensor(q=wq, d=wd)
@@ -279,7 +279,7 @@ def main():
             return fn, (ww, jnp.ones((1, ww.in_features), jnp.bfloat16),)
           return make
         ms = dev_ms(f"pallas {name}", mk(), N)
-        mb = ww.q.size / 1e6
+        mb = ww.q.size * ww.q.dtype.itemsize / 1e6
         print(f"    -> {mb/ms:.0f} GB/s effective ({mb:.1f} MB)")
 
     print(f"\nsummary ms/token: full={full_p:.3f} full@bucket{bucket}={full_b:.3f} "

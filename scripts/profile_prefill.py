@@ -204,7 +204,7 @@ def main():
         shape_list += [("w13", params.layers.w13), ("w2", params.layers.w2)]
     shape_list.append(("wcls", params.wcls))
     for name, w in shape_list:
-        wq = w.q[0] if w.q.ndim == 4 else w.q
+        wq = w.q[0] if w.q.ndim == 3 else w.q
         wd = w.d[0] if w.d.ndim == 3 else w.d
         ww = QuantTensor(q=wq, d=wd)
         def mk(n, ww=ww):

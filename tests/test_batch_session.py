@@ -188,3 +188,19 @@ def test_parked_rows_preserve_cache_tail(tmp_path):
     for _ in range(2):
         _collect(s.step(4), 0, got)
     assert got == want
+
+
+def test_step_overrunning_seq_len_raises(tmp_path):
+    """A direct caller stepping an active row past seq_len gets a loud
+    ValueError, not silently-dropped cache writes + junk tokens (ADVICE r4:
+    the parked-row write-drop semantics masked the bug)."""
+    import pytest
+
+    path = _model(tmp_path, seq_len=32)
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.admit(0, [5, 9, 17, 3])
+    for _ in range(3):
+        s.step(8)  # pos 4 -> 28
+    with pytest.raises(ValueError, match="overrun seq_len"):
+        s.step(8)  # 28 + 1 + 8 > 32

@@ -554,3 +554,98 @@ def test_batcher_recovers_from_engine_failure(batched_api_server, monkeypatch):
     with _post(port, payload) as r:
         data = json.loads(r.read())
     assert data["usage"]["completion_tokens"] > 0
+
+
+# ---- Batcher hardening (round 5): slow clients and heterogeneous budgets ----
+
+
+def _batcher_engine(tmp_path_factory, batch=2, seq_len=256):
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    d = tmp_path_factory.mktemp("batcher")
+    h = tiny_header(dim=64, n_layers=2, seq_len=seq_len, vocab_size=128)
+    path = str(d / "m.m")
+    write_tiny_model(path, h, seed=77)
+    return InferenceEngine(path, compute_dtype="float32", batch=batch, max_chunk=8)
+
+
+def test_slow_client_does_not_stall_cobatched_stream(tmp_path_factory):
+    """A co-batched client whose on_token (socket write) BLOCKS must not
+    stall the other stream: token delivery runs on each request's own
+    writer thread (Batcher.submit), the step loop only enqueues. The
+    round-4 loop called on_token inline and one wedged socket froze every
+    co-tenant."""
+    import types
+
+    eng = _batcher_engine(tmp_path_factory)
+    state = types.SimpleNamespace(engine=eng, recover=lambda: None)
+    b = api_mod.Batcher(state, chunk_size=4)
+
+    gate = threading.Event()  # the slow client's socket "unwedges" here
+    slow_tokens, fast_tokens = [], []
+
+    def slow_tok(t):
+        slow_tokens.append(t)
+        assert gate.wait(timeout=60), "test gate never opened"
+
+    errors = []
+
+    def run(req):
+        try:
+            b.submit(req)
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(e)
+
+    slow = api_mod._BatchReq([3, 5], 12, 0.0, 0.9, None, slow_tok)
+    fast = api_mod._BatchReq([7, 1], 12, 0.0, 0.9, None, fast_tokens.append)
+    ts = threading.Thread(target=run, args=(slow,))
+    tf = threading.Thread(target=run, args=(fast,))
+    ts.start()
+    tf.start()
+    tf.join(timeout=120)
+    assert not tf.is_alive(), "fast client stalled behind the wedged one"
+    assert len(fast_tokens) == 12
+    gate.set()
+    ts.join(timeout=120)
+    assert not ts.is_alive()
+    assert len(slow_tokens) == 12, "slow client must still get every token"
+    assert not errors
+
+
+def test_heterogeneous_budgets_keep_full_chunks(tmp_path_factory, monkeypatch):
+    """A nearly-done row (tiny max_new) co-batched with a long request must
+    not fragment the long request's chunks: the round-4 loop clamped every
+    chunk to the MINIMUM remaining budget across rows (ADVICE r4), decaying
+    steady traffic into 1-2-token dispatches; now rows just park at their
+    own budget and surplus chunk tokens are discarded."""
+    import types
+
+    from distributed_llama_tpu.runtime.batch_session import BatchSession
+
+    eng = _batcher_engine(tmp_path_factory)
+    state = types.SimpleNamespace(engine=eng, recover=lambda: None)
+    sizes = []
+    orig_step = BatchSession.step
+
+    def spy(self, n):
+        sizes.append(n)
+        return orig_step(self, n)
+
+    monkeypatch.setattr(BatchSession, "step", spy)
+    b = api_mod.Batcher(state, chunk_size=8)
+
+    long_req = api_mod._BatchReq([5, 9], 40, 0.0, 0.9, None, lambda t: None)
+    short_req = api_mod._BatchReq([7], 3, 0.0, 0.9, None, lambda t: None)
+    tl = threading.Thread(target=b.submit, args=(long_req,))
+    tsh = threading.Thread(target=b.submit, args=(short_req,))
+    tl.start()
+    time.sleep(0.05)
+    tsh.start()
+    tl.join(timeout=120)
+    tsh.join(timeout=120)
+    assert not tl.is_alive() and not tsh.is_alive()
+    assert long_req.n >= 40 and short_req.n >= 3
+    # the long request needs ceil(40/8)=5 full chunks; the short co-tenant
+    # (remaining budget 3) must not have shrunk them (old behavior: chunks
+    # collapse to 2 while it is active)
+    assert sizes.count(8) >= 5, f"fragmented chunk ladder: {sizes}"
