@@ -81,28 +81,35 @@ def initialize_distributed(
         # the fallback keeps single-machine runs working.
         try:
             jax.distributed.initialize()
-        except (ValueError, RuntimeError) as e:
-            # ValueError = auto-detection found no usable cluster spec;
-            # RuntimeError with "before any JAX calls"/"already initialized"
-            # = this process already touched the backend (library use). Both
-            # fall back to single-process (plain TPU VM slices are already
-            # global). Connection/runtime failures on a DETECTED cluster
-            # propagate: silently running P duplicate single-process jobs
-            # would be far worse than a loud failure.
-            if isinstance(e, RuntimeError) and not (
-                "before any jax calls" in str(e).lower()
-                or "already initialized" in str(e).lower()
-            ):
-                raise
+        except ValueError as e:
+            # auto-detection found no usable cluster spec: fall back to
+            # single-process (plain TPU VM slices are already global; a
+            # single machine with --distributed just runs local). Runtime
+            # failures on a DETECTED cluster propagate: silently running P
+            # duplicate single-process jobs would be far worse than a loud
+            # failure.
             import sys
 
             print(
-                f"ℹ️  --distributed: multi-host auto-init unavailable "
-                f"({type(e).__name__}); continuing single-process (pass "
-                f"--coordinator/--num-processes/--process-id on env-driven "
-                f"clusters)",
+                f"ℹ️  --distributed: no cluster detected ({e}); continuing "
+                f"single-process (pass --coordinator/--num-processes/"
+                f"--process-id on env-driven clusters)",
                 file=sys.stderr,
             )
+        except RuntimeError as e:
+            if "already initialized" in str(e).lower():
+                return
+            if "before any jax calls" in str(e).lower():
+                # The caller explicitly asked for distributed but something
+                # touched the backend first. Falling back here would run
+                # every host as an independent single-process job — the
+                # duplicate-job hazard — so this is a HARD error (ADVICE r4).
+                raise RuntimeError(
+                    "--distributed requested but the JAX backend was already "
+                    "initialized before initialize_distributed(); call it "
+                    "before any jax.devices()/array op, or drop --distributed"
+                ) from e
+            raise
 
 
 def make_multihost_mesh(
