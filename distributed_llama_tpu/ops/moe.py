@@ -99,6 +99,38 @@ def _grouped_layout(group_sizes: jnp.ndarray, rows: int, n_groups: int, block_r:
     return padded_idx, block_expert, R_pad
 
 
+def _grouped_layout_direct(g_flat: jnp.ndarray, n_groups: int, block_r: int):
+    """Sort-free grouped layout: for each ORIGINAL row r (group id
+    g_flat[r]), its destination in the expert-grouped padded buffer, plus
+    each row block's group id.
+
+    Replaces argsort + per-row searchsorted (the round-5 glue profile: one
+    stable argsort over rows costs ~0.6 ms on TPU, paid per layer per
+    chunk). Group ids are small ints, so a one-hot cumsum gives each row's
+    stable rank within its group directly — O(rows * n_groups) VPU work
+    instead of a sort network. Returns (dest [rows] int32, block_expert
+    [R_pad // block_r] int32, R_pad)."""
+    rows = g_flat.shape[0]
+    R_pad = rows + n_groups * block_r
+    oh = (g_flat[:, None] == jnp.arange(n_groups, dtype=g_flat.dtype)).astype(
+        jnp.int32
+    )  # [rows, n_groups]
+    within = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=1) - 1  # stable rank
+    counts = jnp.sum(oh, axis=0)
+    padded_sizes = ((counts + block_r - 1) // block_r) * block_r
+    padded_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded_sizes.astype(jnp.int32))[:-1]]
+    )
+    dest = padded_starts[g_flat] + within
+    blocks = jnp.arange(R_pad // block_r, dtype=jnp.int32) * block_r
+    block_expert = jnp.clip(
+        jnp.searchsorted(padded_starts, blocks, side="right").astype(jnp.int32) - 1,
+        0,
+        n_groups - 1,
+    )
+    return dest, block_expert, R_pad
+
+
 def _grouped_quant_eligible(w1, w3, w2, dtype, q80: bool, pallas) -> bool:
     """The grouped Pallas kernel serves the production path: bf16 compute,
     Q40 expert stacks, Pallas on, tile-aligned shapes. The f32/q80 parity
@@ -160,9 +192,6 @@ def moe_ffn_ragged(
     rows = n_tok * k
 
     e_flat = idx.reshape(rows)
-    order = jnp.argsort(e_flat, stable=True)  # row r -> (token r//k, slot r%k)
-    tok = order // k
-    xs = y.reshape(n_tok, dim)[tok]  # [rows, dim] expert-sorted inputs
 
     use_grouped = _grouped_quant_eligible(w1, w3, w2, dtype, q80, pallas)
     stacked = layer is not None
@@ -185,50 +214,38 @@ def moe_ffn_ragged(
         stacked = False
     e_axis = 1 if stacked else 0
     n_local = w1.q.shape[e_axis] if isinstance(w1, QuantTensor) else w1.shape[e_axis]
-    if not use_grouped:
-        w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
-        w3m = expert_stack_matrix(w3, dtype)
-        w2m = expert_stack_matrix(w2, dtype)  # [E_local, ff, dim]
-
-    if ep_axis is None:
-        group_sizes = jnp.bincount(e_flat, length=n_local).astype(jnp.int32)
-    else:
-        # this shard owns experts [e0, e0 + n_local); rows for other shards'
-        # experts are contiguous prefix/suffix runs of the sorted order —
-        # fold them into two zero-weight boundary groups so they contribute
-        # exact zeros, then psum the shards' partials
-        ep = jax.lax.psum(1, ep_axis)
-        n_experts = n_local * ep
-        counts = jnp.bincount(e_flat, length=n_experts)
-        e0 = jax.lax.axis_index(ep_axis) * n_local
-        ar = jnp.arange(n_experts)
-        before = jnp.sum(jnp.where(ar < e0, counts, 0))
-        after = jnp.sum(jnp.where(ar >= e0 + n_local, counts, 0))
-        local = jax.lax.dynamic_slice(counts, (e0,), (n_local,))
-        group_sizes = jnp.concatenate(
-            [before[None], local, after[None]]
-        ).astype(jnp.int32)
-
-        if not use_grouped:
-            def pad(w):
-                z = jnp.zeros((1,) + w.shape[1:], w.dtype)
-                return jnp.concatenate([z, w, z], axis=0)
-
-            w1m, w3m, w2m = pad(w1m), pad(w3m), pad(w2m)
 
     if use_grouped:
-        # production path: the grouped Pallas kernel streams the int8
+        # production path: the grouped Pallas kernel streams the packed
         # expert stacks directly (ops/pallas_q40.py q40_matmul_pallas_grouped)
-        # — no dequantized [E, dim, ff] transient exists at ANY expert count
+        # — no dequantized [E, dim, ff] transient exists at ANY expert count.
+        # Layout is SORT-FREE (_grouped_layout_direct): one stable argsort
+        # over the rows cost ~0.6 ms per layer per chunk on TPU — more than
+        # the expert matmuls after 4-bit packing — and group ids are small
+        # ints, so a one-hot cumsum replaces the sort entirely. Every
+        # gather/scatter runs in ORIGINAL row order (dest map), so the
+        # combine is a plain reshape + k-sum instead of a scatter-add.
         from .pallas_q40 import q40_matmul_pallas_grouped
 
         interpret = pallas == "interpret"
         w1q, w3q, w2q = w1, w3, w2
-        if ep_axis is not None:
-            # boundary groups 0 and E_local+1 (other shards' rows) index
-            # zero experts padded onto both ends of the stack's EXPERT axis
-            # — their rows produce exact zeros, matching the materialized
-            # path's pad()
+        if ep_axis is None:
+            g_flat = e_flat
+            n_groups = n_local
+        else:
+            # this shard owns experts [e0, e0 + n_local); other shards' rows
+            # map to two zero-weight boundary groups (0 and n_local+1) so
+            # they contribute exact zeros, then the shards' partials psum.
+            # The boundary groups index zero experts padded onto both ends
+            # of the stack's expert axis.
+            e0 = jax.lax.axis_index(ep_axis) * n_local
+            g_flat = jnp.where(
+                e_flat < e0,
+                0,
+                jnp.where(e_flat >= e0 + n_local, n_local + 1, e_flat - e0 + 1),
+            ).astype(jnp.int32)
+            n_groups = n_local + 2
+
             def padq2(w, ax=e_axis):
                 def z(a):
                     shp = list(a.shape)
@@ -241,7 +258,6 @@ def moe_ffn_ragged(
                 )
             w1q, w3q, w2q = padq2(w1), padq2(w3), padq2(w2)
 
-        n_groups = int(group_sizes.shape[0])
         # block_r trades tail-padding waste (small blocks) against expert
         # weight re-reads across row blocks (large groups split into many
         # blocks re-stream the same expert): target ~rows/n_groups, clamped
@@ -249,10 +265,9 @@ def moe_ffn_ragged(
         block_r = 8
         while block_r * 2 <= min(avg, 64):
             block_r *= 2
-        padded_idx, block_expert, R_pad = _grouped_layout(
-            group_sizes, rows, n_groups, block_r
-        )
-        xp = jnp.zeros((R_pad, dim), y.dtype).at[padded_idx].set(xs.astype(y.dtype))
+        dest, block_expert, R_pad = _grouped_layout_direct(g_flat, n_groups, block_r)
+        xrep = jnp.repeat(y.reshape(n_tok, dim), k, axis=0)  # row r = token r//k
+        xp = jnp.zeros((R_pad, dim), y.dtype).at[dest].set(xrep.astype(y.dtype))
         if stacked:
             # fold the layer into the FLAT group index: the kernel DMAs this
             # layer's expert tiles straight out of the all-layers stack
@@ -265,8 +280,38 @@ def moe_ffn_ragged(
             )
 
         h = (act_fn(gdot(xp, w1q)) * gdot(xp, w3q)).astype(y.dtype)
-        out_rows = gdot(h, w2q)[padded_idx]  # [rows, dim] f32
+        per_row = gdot(h, w2q)[dest].reshape(n_tok, k, dim)  # original order
+        out = jnp.sum(per_row * wts.reshape(n_tok, k, 1).astype(jnp.float32), axis=1)
     else:
+        # parity paths (f32 / q80 / unquantized): the sort-based
+        # expert-grouped formulation feeding `lax.ragged_dot`
+        order = jnp.argsort(e_flat, stable=True)  # row -> (token r//k, slot)
+        tok = order // k
+        xs = y.reshape(n_tok, dim)[tok]  # [rows, dim] expert-sorted inputs
+        w1m = expert_stack_matrix(w1, dtype)  # [E_local, dim, ff]
+        w3m = expert_stack_matrix(w3, dtype)
+        w2m = expert_stack_matrix(w2, dtype)  # [E_local, ff, dim]
+        if ep_axis is None:
+            group_sizes = jnp.bincount(e_flat, length=n_local).astype(jnp.int32)
+        else:
+            ep = jax.lax.psum(1, ep_axis)
+            n_experts = n_local * ep
+            counts = jnp.bincount(e_flat, length=n_experts)
+            e0 = jax.lax.axis_index(ep_axis) * n_local
+            ar = jnp.arange(n_experts)
+            before = jnp.sum(jnp.where(ar < e0, counts, 0))
+            after = jnp.sum(jnp.where(ar >= e0 + n_local, counts, 0))
+            local = jax.lax.dynamic_slice(counts, (e0,), (n_local,))
+            group_sizes = jnp.concatenate(
+                [before[None], local, after[None]]
+            ).astype(jnp.int32)
+
+            def pad(w):
+                z = jnp.zeros((1,) + w.shape[1:], w.dtype)
+                return jnp.concatenate([z, w, z], axis=0)
+
+            w1m, w3m, w2m = pad(w1m), pad(w3m), pad(w2m)
+
         precision = jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
 
         def rdot(x_, w_):
@@ -279,11 +324,10 @@ def moe_ffn_ragged(
         h = (act_fn(rdot(xq, w1m)) * rdot(xq, w3m)).astype(y.dtype)
         hq = quantize_q80_activations(h) if q80 else h
         out_rows = rdot(hq, w2m)  # [rows, dim] f32
-
-    w_flat = wts.reshape(rows)[order].astype(jnp.float32)
-    out = jnp.zeros((n_tok, dim), jnp.float32).at[tok].add(
-        out_rows * w_flat[:, None]
-    )
+        w_flat = wts.reshape(rows)[order].astype(jnp.float32)
+        out = jnp.zeros((n_tok, dim), jnp.float32).at[tok].add(
+            out_rows * w_flat[:, None]
+        )
     if ep_axis is not None:
         out = jax.lax.psum(out, ep_axis)
     return out.reshape(b, t, dim).astype(y.dtype)

@@ -1,0 +1,129 @@
+"""Attribute the MoE ragged-dispatch glue (round 5: after 4-bit packing the
+grouped dots are ~3.9 ms and the GLUE ~4.5 ms of the 512-token chunk —
+sort/gather/scatter now dominate). Times each piece chained at the bench
+MoE shape (dim=1024, E=32, k=4, t=512 -> rows=2048, moe_ff=512)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N1, N2 = 16, 80
+
+
+def dev_ms(label, fn, args, trials=3):
+    def chain(n):
+        @jax.jit
+        def run(x, *rest):
+            def body(c, _):
+                y = fn(c, *rest)
+                return (c + jax.tree.leaves(y)[0].ravel()[0].astype(c.dtype) * 1e-30), None
+
+            c, _ = jax.lax.scan(body, x, None, length=n)
+            return c
+
+        return run
+
+    f1, f2 = chain(N1), chain(N2)
+    best = {N1: float("inf"), N2: float("inf")}
+    for f, n in ((f1, N1), (f2, N2)):
+        r = f(*args)
+        _ = np.asarray(r).ravel()[:1]
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = f(*args)
+            _ = np.asarray(r).ravel()[:1]
+            best[n] = min(best[n], time.perf_counter() - t0)
+    ms = (best[N2] - best[N1]) / (N2 - N1) * 1e3
+    print(f"{label}: {ms:.3f} ms/iter")
+    return ms
+
+
+def main():
+    rng = np.random.default_rng(0)
+    b, t, dim, E, k, ff = 1, 512, 1024, 32, 4, 512
+    n_tok = b * t
+    rows = n_tok * k
+    block_r = 64
+    R_pad = rows + (E + 0) * block_r  # un-sharded: n_groups = E
+
+    y = jnp.asarray(rng.standard_normal((n_tok, dim)), jnp.bfloat16)
+    idx = jnp.asarray(rng.integers(0, E, (n_tok, k)), jnp.int32)
+    wts = jnp.asarray(rng.random((n_tok, k)), jnp.float32)
+    out_rows_c = jnp.asarray(rng.standard_normal((R_pad, dim)), jnp.float32)
+
+    # piece 1: router-side sort machinery
+    def sort_piece(y, idx):
+        e_flat = idx.reshape(rows)
+        order = jnp.argsort(e_flat, stable=True)
+        return order
+
+    dev_ms("argsort", sort_piece, (y, idx))
+
+    # piece 2: activation gather xs = y[tok]
+    order = jnp.argsort(idx.reshape(rows), stable=True)
+    tok = order // k
+
+    def gather_piece(y, tok):
+        return y[tok]
+
+    dev_ms("xs gather [rows, dim]", gather_piece, (y, tok))
+
+    # piece 3: padded scatter xp = zeros.at[padded_idx].set(xs)
+    from distributed_llama_tpu.ops.moe import _grouped_layout
+
+    gs = jnp.bincount(idx.reshape(rows), length=E).astype(jnp.int32)
+    padded_idx, block_expert, R_pad2 = _grouped_layout(gs, rows, E, block_r)
+    xs = y[tok]
+
+    def scatter_piece(xs, padded_idx):
+        return jnp.zeros((R_pad2, dim), xs.dtype).at[padded_idx].set(xs)
+
+    dev_ms("xp row-scatter set", scatter_piece, (xs, padded_idx))
+
+    # piece 3b: gather formulation of the same layout
+    def gather_layout(xs, padded_idx):
+        src = (
+            jnp.full((R_pad2,), rows, jnp.int32).at[padded_idx].set(
+                jnp.arange(rows, dtype=jnp.int32)
+            )
+        )
+        xz = jnp.concatenate([xs, jnp.zeros((1, dim), xs.dtype)], axis=0)
+        return xz[jnp.minimum(src, rows)]
+
+    dev_ms("xp via 1D-int-scatter + row-gather", gather_layout, (xs, padded_idx))
+
+    # piece 4: combine scatter-add out.at[tok].add(...)
+    w_flat = wts.reshape(rows)[order].astype(jnp.float32)
+    orc = out_rows_c[:rows]
+
+    def combine_scatter(orc, tok, w_flat):
+        return jnp.zeros((n_tok, dim), jnp.float32).at[tok].add(orc * w_flat[:, None])
+
+    dev_ms("combine row-scatter-ADD", combine_scatter, (orc, tok, w_flat))
+
+    # piece 4b: gather formulation: unsort then reshape-sum over k
+    inv = jnp.argsort(order)
+
+    def combine_gather(orc, inv, wts):
+        un = orc[inv].reshape(n_tok, k, dim)
+        return jnp.sum(un * wts[..., None].astype(jnp.float32), axis=1)
+
+    dev_ms("combine unsort-gather + k-sum", combine_gather, (orc, inv, wts))
+
+    # check equivalence
+    a = np.asarray(combine_scatter(orc, tok, w_flat))
+    bb = np.asarray(combine_gather(orc, inv, wts))
+    print("combine formulations agree:", np.allclose(a, bb, rtol=1e-5, atol=1e-5))
+    ga = np.asarray(scatter_piece(xs, padded_idx))
+    gb = np.asarray(gather_layout(xs, padded_idx))
+    print("layout formulations agree:", np.array_equal(ga, gb))
+
+
+if __name__ == "__main__":
+    main()
