@@ -123,6 +123,13 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     # 1-token tail is pure dispatch latency and poisons a 2-element median
     # (observed: a healthy 1.55 ms/token config reporting 19 tok/s)
     steps = prefill_tokens + decode_tokens - 1
+    # COLD TTFT first: the first streaming request on a fresh engine,
+    # compile (or persistent-cache load) included — what a real deployment's
+    # first user sees (VERDICT r4 #6). Runs before any warmup on purpose.
+    sink0 = lambda t: None  # noqa: E731
+    res_cold = eng.generate(prompt, prefill_tokens + 16, sampler=None, on_token=sink0)
+    ttft_cold_ms = res_cold.ttft_us / 1e3
+    eng.reset()
     eng.generate(prompt, steps, sampler=None)  # warmup: compiles
     eng.reset()
     res = eng.generate(prompt, steps, sampler=None)
@@ -176,7 +183,7 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
         # the spreads tight enough that healthy windows rarely null out.
         if t_long - t_short > max(0.002, spread_long + spread_short):
             marginal = (long_n - prefill_tokens) / (t_long - t_short)
-    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, wall_long_ms, eng
+    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, wall_long_ms, ttft_cold_ms, eng
 
 
 def leg_8b():
@@ -196,20 +203,22 @@ def leg_8b():
     prev = os.environ.get("DLT_STALL_TIMEOUT_MS")
     os.environ.setdefault("DLT_STALL_TIMEOUT_MS", "1800000")
     try:
-        decode, prefill, ttft, marginal, wall_long, eng = measure(path, 512, 128)
+        decode, prefill, ttft, marginal, wall_long, ttft_cold, eng = measure(path, 512, 128)
     finally:
         if prev is None:
             os.environ.pop("DLT_STALL_TIMEOUT_MS", None)
         else:
             os.environ["DLT_STALL_TIMEOUT_MS"] = prev
-    # bytes per decoded token: all layer weights + wcls, int8 + f16 scales
+    # bytes per decoded token: all layer weights + wcls, nibble-packed
+    # int4 + f16 per-32-block scales (round 5: 0.5 + 2/32 bytes/weight)
     n_w = 32 * (4096 * (4096 + 1024 + 1024 + 4096) + 3 * 4096 * 14336) + 4096 * 128256
-    bytes_tok = n_w * (1 + 2 / 32)
+    bytes_tok = n_w * (0.5 + 2 / 32)
     gbs = bytes_tok * decode / 1e9
     del eng
     return {
         "config": "llama-8B-class q40 1chip",
         "decode_tok_s": round(decode, 2),
+        "ttft_cold_ms": round(ttft_cold, 1),
         "prefill_tok_s": round(prefill, 1),
         "prefill_tok_s_marginal": marginal and round(marginal, 1),
         "prefill_long_n": wall_long and wall_long[0],
@@ -357,7 +366,9 @@ def main():
     # headline: 1B Llama
     model_path = ensure_model()
     t0 = time.time()
-    decode, prefill, ttft, marginal, wall_long, eng = measure(model_path, 512, 256)
+    decode, prefill, ttft, marginal, wall_long, ttft_cold, eng = measure(
+        model_path, 512, 256, decode_chunk_size=128
+    )
     print(
         f"# llama1b: decode {decode:.1f} tok/s, prefill {prefill:.1f} tok/s "
         f"(marginal {marginal and round(marginal, 1)}), "
@@ -374,6 +385,7 @@ def main():
             "prefill_long_n": wall_long and wall_long[0],
             "prefill_wall_long_ms": wall_long and round(wall_long[1], 1),
             "ttft_ms": round(ttft, 1),
+            "ttft_cold_ms": round(ttft_cold, 1),
         }
     )
     del eng
@@ -392,7 +404,7 @@ def main():
     ]
     for name, fn in extra_legs:
         try:
-            d, p, t, m, wl, _ = fn()
+            d, p, t, m, wl, tc, _ = fn()
             configs.append(
                 {
                     "config": name,
@@ -402,6 +414,7 @@ def main():
                     "prefill_long_n": wl and wl[0],
                     "prefill_wall_long_ms": wl and round(wl[1], 1),
                     "ttft_ms": round(t, 1),
+                    "ttft_cold_ms": round(tc, 1),
                 }
             )
             print(f"# {name}: decode {d:.1f}, prefill {p:.1f}", file=sys.stderr)

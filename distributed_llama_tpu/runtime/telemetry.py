@@ -56,8 +56,8 @@ class watchdog:
     reference, which also only detects, not cancels).
     """
 
-    def __init__(self, what: str, log_fn=None):
-        self.what = what
+    def __init__(self, what: str, log_fn=None, compiling: bool = False):
+        self.what = ("compile " + what) if compiling else what
         if log_fn is None:
             import functools
             import sys
@@ -68,8 +68,16 @@ class watchdog:
             log_fn = functools.partial(print, file=sys.stderr)
         self.log_fn = log_fn
         # defaults are wider than the reference's 2s/180s because a first
-        # call legitimately spends 20-40s in XLA compilation
-        self.log_ms = _env_ms("DLT_STALL_LOG_MS", 60000)
+        # call legitimately spends 20-40s in XLA compilation. `compiling`
+        # marks a first-shape call (the engine tracks which shapes it has
+        # run): the log threshold widens so an expected cold compile is not
+        # reported as a stall (BENCH_r04 tripped EXEC_STALL on the 8B
+        # prefill's first compile — a false alarm that cost the round's
+        # measurement discipline a hole), and the label says what it is
+        self.log_ms = _env_ms(
+            "DLT_COMPILE_LOG_MS" if compiling else "DLT_STALL_LOG_MS",
+            300000 if compiling else 60000,
+        )
         self.timeout_ms = _env_ms("DLT_STALL_TIMEOUT_MS", 600000)
         self._done = threading.Event()
         self._timed_out = False

@@ -699,6 +699,12 @@ def serve(args) -> HTTPServer:
 
     engine = make_engine(args)
     tokenizer = Tokenizer(args.tokenizer)
+    import os as _os
+
+    if not _os.environ.get("DLT_NO_WARMUP"):
+        # compile the chunk ladder before accepting connections so the first
+        # request pays serving latency, not XLA compile (cold-TTFT)
+        engine.warmup()
     Handler.state = ApiState(engine, tokenizer, args)
     cls = ThreadingHTTPServer if Handler.state.batcher is not None else HTTPServer
     return cls(("0.0.0.0", args.port), Handler)
