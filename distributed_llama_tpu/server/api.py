@@ -705,9 +705,15 @@ def serve(args) -> HTTPServer:
         # compile the chunk ladder before accepting connections so the first
         # request pays serving latency, not XLA compile (cold-TTFT)
         engine.warmup()
-    Handler.state = ApiState(engine, tokenizer, args)
-    cls = ThreadingHTTPServer if Handler.state.batcher is not None else HTTPServer
-    return cls(("0.0.0.0", args.port), Handler)
+    state = ApiState(engine, tokenizer, args)
+    # a fresh Handler subclass per server: `state` as a class attribute on
+    # the shared Handler would make two in-process replicas (gateway tests,
+    # library embedders) clobber each other's engines. Handler.state stays
+    # assigned for the single-server common case and back-compat.
+    handler_cls = type("Handler", (Handler,), {"state": state})
+    Handler.state = state
+    cls = ThreadingHTTPServer if state.batcher is not None else HTTPServer
+    return cls(("0.0.0.0", args.port), handler_cls)
 
 
 def main(argv=None) -> int:

@@ -649,3 +649,214 @@ def test_heterogeneous_budgets_keep_full_chunks(tmp_path_factory, monkeypatch):
     # (remaining budget 3) must not have shrunk them (old behavior: chunks
     # collapse to 2 while it is active)
     assert sizes.count(8) >= 5, f"fragmented chunk ladder: {sizes}"
+
+
+# ---- Gateway end-to-end over live HTTP replicas (VERDICT r4 #7) ----
+
+
+def _mk_api_server(mp, tp, port):
+    from distributed_llama_tpu.cli import build_arg_parser
+
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(
+        [
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.0",
+            "--port", str(port),
+        ]
+    )
+    httpd = api_mod.serve(args)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd
+
+
+@pytest.fixture(scope="module")
+def gateway_stack(tmp_path_factory):
+    """2 live API replicas behind a live gateway, all over localhost HTTP —
+    the reference's dllama-gateway + dllama-api deployment shape
+    (dllama-gateway.cpp:266-373)."""
+    import os
+
+    os.environ["DLT_NO_WARMUP"] = "1"  # CPU fixture startup time
+    d = tmp_path_factory.mktemp("gwe2e")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=256,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+
+    ports = [free_port(), free_port()]
+    servers = [_mk_api_server(mp, tp, p) for p in ports]
+    cfg = GatewayConfig(
+        backends=[Backend("127.0.0.1", p) for p in ports],
+        max_inflight_per_backend=4,
+        health_retry_ms=120000,  # tests control recovery explicitly
+        queue_size=4,
+        queue_timeout_s=5.0,
+    )
+    bal = Balancer(cfg)
+    gw_port = free_port()
+    stop = threading.Event()
+    t = threading.Thread(target=gw_mod.run, args=(gw_port, bal, stop), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    yield {"gw": gw_port, "ports": ports, "servers": servers, "bal": bal,
+           "cfg": cfg, "mp": mp, "tp": tp}
+    stop.set()
+    for s in servers:
+        with contextlib_suppress():
+            s.shutdown()
+    os.environ.pop("DLT_NO_WARMUP", None)
+
+
+class contextlib_suppress:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return True
+
+
+def test_gateway_streams_sse_passthrough(gateway_stack):
+    """A streaming completion through the gateway arrives as the same SSE
+    framing a direct backend connection produces, terminated by [DONE]."""
+    gw = gateway_stack["gw"]
+    payload = {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6, "stream": True,
+    }
+    with _post(gw, payload) as r:
+        via_gw = r.read().decode()
+    with _post(gateway_stack["ports"][0], payload) as r:
+        direct = r.read().decode()
+    events = [e for e in via_gw.split("\r\n\r\n") if e.strip()]
+    assert events[0].startswith("data: ")
+    assert events[-1].strip() == "data: [DONE]"
+    # deterministic tiny model at temperature 0: same content either way
+    assert via_gw == direct
+
+
+def test_gateway_balances_load_across_backends(gateway_stack):
+    """Concurrent requests spread over BOTH replicas (least-inflight +
+    round-robin tie-break), observed via each backend's engine stats."""
+    gw = gateway_stack["gw"]
+
+    def served_counts():
+        out = []
+        for s in gateway_stack["servers"]:
+            st = s.RequestHandlerClass.state
+            snap = st.engine.stats.snapshot() if hasattr(st.engine.stats, "snapshot") else None
+            out.append(st)
+        return out
+
+    states = [s.RequestHandlerClass.state for s in gateway_stack["servers"]]
+    before = [len(st.naive_cache.items) for st in states]
+
+    results = [None] * 6
+
+    def ask(i):
+        with _post(gw, {"messages": [{"role": "user", "content": f"q {i}"}],
+                        "max_tokens": 4}) as r:
+            results[i] = json.loads(r.read())
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert all(r is not None and r["usage"]["completion_tokens"] > 0 for r in results)
+    # both replicas served at least one request (the naive cache records the
+    # last conversation a backend handled)
+    after = [len(st.naive_cache.items) for st in states]
+    served = [a != b or len(st.naive_cache.items) > 0 for (a, b, st) in
+              zip(after, before, states)]
+    assert all(served), f"a replica served nothing: before={before} after={after}"
+
+
+def test_gateway_502_then_routes_around_dead_backend(gateway_stack):
+    """Killing one replica: at most one request eats the 502 (which marks
+    the backend unhealthy), every later request lands on the survivor;
+    clearing the cooldown after a restart brings the replica back."""
+    gw = gateway_stack["gw"]
+    cfg = gateway_stack["cfg"]
+    victim = gateway_stack["servers"][1]
+    victim.shutdown()
+    victim.server_close()
+
+    codes = []
+    for i in range(4):
+        try:
+            with _post(gw, {"messages": [{"role": "user", "content": f"x{i}"}],
+                            "max_tokens": 3}) as r:
+                json.loads(r.read())
+                codes.append(200)
+        except urllib.error.HTTPError as e:
+            codes.append(e.code)
+    assert codes.count(200) >= 3, codes
+    assert all(c in (200, 502) for c in codes), codes
+    if 502 in codes:
+        assert cfg.backends[1].unhealthy_until > time.monotonic()
+
+    # recovery: restart on the same port, cooldown elapses
+    gateway_stack["servers"][1] = _mk_api_server(
+        gateway_stack["mp"], gateway_stack["tp"], gateway_stack["ports"][1]
+    )
+    cfg.backends[1].unhealthy_until = 0.0
+    ok = 0
+    for i in range(4):
+        with _post(gw, {"messages": [{"role": "user", "content": f"y{i}"}],
+                        "max_tokens": 3}) as r:
+            ok += json.loads(r.read())["usage"]["completion_tokens"] > 0
+    assert ok == 4
+    revived = gateway_stack["servers"][1].RequestHandlerClass.state
+    assert len(revived.naive_cache.items) > 0, "revived replica never served"
+
+
+def test_gateway_429_past_queue_cap():
+    """Saturated backends + full wait queue -> immediate 429 (the
+    reference's bounded queue, dllama-gateway.cpp:332-373). Backends are
+    stalling sockets so the inflight slots stay held."""
+    import socket as sock_mod
+
+    stallers, ports = [], []
+    for _ in range(2):
+        s = sock_mod.socket()
+        s.setsockopt(sock_mod.SOL_SOCKET, sock_mod.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.listen(8)
+        stallers.append(s)
+        ports.append(s.getsockname()[1])
+    cfg = GatewayConfig(
+        backends=[Backend("127.0.0.1", p) for p in ports],
+        max_inflight_per_backend=1,
+        queue_size=1,
+        queue_timeout_s=0.4,
+    )
+    bal = Balancer(cfg)
+    gw_port = free_port()
+    stop = threading.Event()
+    threading.Thread(target=gw_mod.run, args=(gw_port, bal, stop), daemon=True).start()
+    time.sleep(0.2)
+
+    payload = {"messages": [{"role": "user", "content": "z"}], "max_tokens": 2}
+
+    def hold():
+        with contextlib_suppress():
+            _post(gw_port, payload).read()
+
+    holders = [threading.Thread(target=hold, daemon=True) for _ in range(3)]
+    for t in holders:
+        t.start()
+    time.sleep(0.5)  # 2 held inflight + 1 queued
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(gw_port, payload).read()
+    assert ei.value.code == 429
+    assert time.time() - t0 < 5
+    stop.set()
+    for s in stallers:
+        s.close()
