@@ -113,6 +113,16 @@ class PthReader:
         with self.zf.open(pkl) as f:
             self.manifest = dict(_TorchUnpickler(f).load())
 
+    def close(self):
+        self.zf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def keys(self):
         return self.manifest.keys()
 

@@ -37,6 +37,15 @@ import jax.numpy as jnp
 
 from .quant import QuantTensor, dequantize_t, quantize_q80_activations, slice_layer
 
+# A/B knob for the layer-fold formulation (measured NEUTRAL at bench scale,
+# kept for stacks where the dynamic-slice transient grows with E*ff). Read
+# ONCE at import: the value is baked into traced functions by the jit cache
+# anyway, so a module-level constant makes the process-start-only contract
+# structural instead of conventional (ADVICE r4).
+import os as _os
+
+MOE_LAYER_FOLD = _os.environ.get("DLT_MOE_LAYER_FOLD", "1") != "0"
+
 
 def moe_router(
     x: jnp.ndarray, gate: jnp.ndarray, n_active: int, norm_topk: bool = True
@@ -196,9 +205,7 @@ def moe_ffn_ragged(
     use_grouped = _grouped_quant_eligible(w1, w3, w2, dtype, q80, pallas)
     stacked = layer is not None
     if stacked and use_grouped:
-        import os
-
-        fold_off = os.environ.get("DLT_MOE_LAYER_FOLD", "1") == "0"
+        fold_off = not MOE_LAYER_FOLD
         # EP pads zero experts around the stack; padding the FULL all-layers
         # stack would copy every layer's experts (the very transient the
         # fold avoids) — slice this layer first until the pad moves to load

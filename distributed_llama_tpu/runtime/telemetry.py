@@ -160,6 +160,25 @@ class StepStats:
         pick = lambda p: float(arr[min(len(arr) - 1, int(len(arr) * p))])
         return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
 
+    def snapshot(self) -> dict:
+        """JSON-able view of every series (the /stats endpoint's payload;
+        same numbers `report()` prints)."""
+        out = {}
+        for kind, s in sorted(self.series.items()):
+            if s.count == 0:
+                continue
+            p = self.percentiles(kind)
+            out[kind] = {
+                "count": s.count,
+                "avg_ms": round(s.total_us / s.count / 1000, 3),
+                "min_ms": round(s.min_us / 1000, 3),
+                "max_ms": round(s.max_us / 1000, 3),
+                "p50_ms": round(p.get("p50", 0) / 1000, 3),
+                "p95_ms": round(p.get("p95", 0) / 1000, 3),
+                "p99_ms": round(p.get("p99", 0) / 1000, 3),
+            }
+        return out
+
     def report(self) -> str:
         lines = ["📊 Step performance report:"]
         for kind, s in sorted(self.series.items()):

@@ -171,8 +171,22 @@ class Batcher:
         # the two for throughput.
         self.chunk = chunk_size or engine.decode_chunk_size
         self.q: "queue.Queue[_BatchReq]" = queue.Queue()
+        # observable serving state (/stats): the loop owns the mutations,
+        # readers take racy-but-consistent-enough snapshots
+        self.slots: list[_BatchReq | None] = [None] * engine.batch
+        self.backlog: "object" = None  # set by the loop (deque)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def stats(self) -> dict:
+        slots = list(self.slots)
+        return {
+            "batch_slots": len(slots),
+            "slots_active": sum(1 for s in slots if s is not None),
+            "queue_depth": (len(self.backlog) if self.backlog is not None else 0)
+            + self.q.qsize(),
+            "chunk_size": self.chunk,
+        }
 
     def submit(self, req: _BatchReq):
         """Enqueue and then act as the request's emit-queue writer: client
@@ -250,8 +264,9 @@ class Batcher:
 
         engine = self.state.engine
         session = BatchSession(engine)
-        slots: list[_BatchReq | None] = [None] * engine.batch
+        slots = self.slots
         backlog: "collections.deque[_BatchReq]" = collections.deque()
+        self.backlog = backlog
         ramped_last = False
 
         while True:
@@ -564,6 +579,19 @@ class Handler(BaseHTTPRequestHandler):
             self._json(200, body)
         elif self.path == "/health":
             self._json(200, b'{"status":"ok"}')
+        elif self.path == "/stats":
+            # operator view of the serving loop (the reference prints its
+            # network perf report only at shutdown, nn-network.cpp:883-1053;
+            # this surfaces the same numbers live, plus Batcher occupancy)
+            st = self.state
+            payload = {
+                "steps": st.engine.stats.snapshot(),
+                "batcher": st.batcher.stats() if st.batcher is not None else None,
+                "model": MODEL_NAME,
+                "batch": st.engine.batch,
+                "seq_len": st.engine.cfg.seq_len,
+            }
+            self._json(200, json.dumps(payload).encode())
         else:
             self._json(404, b'{"error":"not found"}')
 

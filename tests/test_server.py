@@ -860,3 +860,17 @@ def test_gateway_429_past_queue_cap():
     stop.set()
     for s in stallers:
         s.close()
+
+
+def test_stats_endpoint(batched_api_server):
+    """/stats surfaces live step latencies + Batcher occupancy (the
+    reference only prints its perf report at shutdown)."""
+    port = batched_api_server
+    _post(port, {"messages": [{"role": "user", "content": "warm"}], "max_tokens": 4}).read()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=30) as r:
+        data = json.loads(r.read())
+    assert data["batcher"] is not None
+    assert data["batcher"]["batch_slots"] >= 2
+    assert data["batcher"]["slots_active"] == 0
+    assert isinstance(data["steps"], dict)
+    assert data["batch"] >= 2
