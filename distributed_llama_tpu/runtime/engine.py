@@ -547,38 +547,61 @@ class InferenceEngine:
         out: list[list[int]] = [[] for _ in range(self.batch)]
         produced = 0
 
-        def remaining() -> int:
-            return max(
-                (budgets[r] - len(out[r]) for r in range(self.batch) if not done[r]),
-                default=0,
-            )
+        # One-chunk lookahead + worker-thread fetch, exactly like
+        # _decode_device: chunk i+1's dispatch (device-resident inputs)
+        # overlaps chunk i's ~100 ms tunnel fetch. Without this the round-4
+        # batched loop paid a full synchronous round trip per chunk — the
+        # dominant share of the batched-serving per-stream tax (measured:
+        # the batched chunk program computes ~1.9 ms/step with the batch
+        # axis nearly free, but e2e ran at ~3.5 ms/step). Chunks are
+        # PLANNED against the max per-row budget (tokens aren't visible at
+        # dispatch time); rows cap at their own budgets at consume time,
+        # and a stop_fn early-exit wastes at most the lookahead chunk
+        # (same overrun tradeoff the solo path accepts).
+        total_needed = max(budgets)
+        planned = 0
+        key_box = [key]
+        state = {"token": token, "pos": pos}
 
-        while remaining() > 0:
-            # same TTFT ramp as _decode_device (and same caveat: only when a
-            # streaming consumer exists — the small first chunk fragments a
-            # fixed budget's chunk ladder and each chunk pays a dispatch)
-            ramp = produced == 0 and on_token is not None
+        def dispatch_chunk():
+            nonlocal planned
+            ramp = planned == 0 and on_token is not None
             n = min(8, self.decode_chunk_size) if ramp else self.decode_chunk_size
-            while n > remaining():
+            while n > (total_needed - planned):
                 n //= 2
             n = max(n, 1)
-            key, sub = _next_subkey(key, temperature)
-            # kv bucket covers the furthest position any ACTIVE row reaches
-            # this chunk (finished rows still step, but their output is
-            # discarded and their trailing cache writes are never read)
+            key_box[0], sub = _next_subkey(key_box[0], temperature)
+            # kv bucket covers the furthest position any not-yet-done row
+            # reaches this chunk (finished rows still step, but their
+            # output is discarded and their trailing writes never read)
             max_end = min(
-                max(lens[r] + len(out[r]) for r in range(self.batch) if not done[r])
+                max(
+                    lens[r] + planned
+                    for r in range(self.batch)
+                    if not done[r]
+                )
                 + n,
                 self.cfg.seq_len,
             )
+            kvb = self._kv_bucket(max_end)
             toks, last, self.cache = self._decode_chunk_any(
-                token, pos, sub, n_steps=n, temperature=temperature,
-                topp=topp, kv_len=self._kv_bucket(max_end),
+                state["token"], state["pos"], sub, n_steps=n,
+                temperature=temperature, topp=topp, kv_len=kvb,
             )
-            with self._guard(
-                f"decode_batch[{n}]", ("decode_batch", n, self._kv_bucket(max_end))
-            ):
-                host = np.asarray(toks)  # [b, n]
+            state["token"] = last
+            state["pos"] = state["pos"] + n
+            planned += n
+            return toks, n, kvb
+
+        pending = dispatch_chunk()
+        while pending is not None:
+            toks, n, kvb = pending
+            fut = self._fetch_pool.submit(np.asarray, toks)
+            nxt = None
+            if planned < total_needed:
+                nxt = dispatch_chunk()
+            with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
+                host = fut.result()  # [b, n]
             for j in range(n):
                 for r in range(self.batch):
                     if done[r] or len(out[r]) >= budgets[r]:
@@ -592,9 +615,14 @@ class InferenceEngine:
                         done[r] = True
                     elif len(out[r]) >= budgets[r]:
                         done[r] = True
-            token = last
-            pos = pos + n
             produced += n
+            if all(done):
+                # a dispatched lookahead chunk past this point is discarded:
+                # its cache writes sit beyond every returned sequence, junk
+                # the same way padded prefill tails are
+                pending = None
+            else:
+                pending = nxt
         return out
 
     def _decode_host(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
