@@ -1,0 +1,128 @@
+"""Pure-DMA streaming probe (VERDICT r4 #3): what is the REAL per-shape HBM
+bandwidth ceiling for the packed T-layout weight tensors, with no unpack and
+(almost) no compute?
+
+Each kernel streams the packed [nb*4, out] int32 plane through VMEM with the
+same grid/BlockSpec shapes the fs decode kernels use, and only accumulates an
+[8, 128] corner of each block into the output (enough of a data dependency
+that nothing is elided; ~1e-4 of the elements touched by the VPU). The gap
+between this and the fs kernel at the same tiles is the cost of
+unpack+dot+scale; the gap between this and 819 GB/s paper peak is the
+per-shape DMA floor no kernel can beat.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from distributed_llama_tpu.formats.quants import Q_BLOCK
+from distributed_llama_tpu.ops.quant import pack_q
+
+
+def _kernel_stream(b_ref, qp_ref, out_ref):
+    k = pl.program_id(1)
+    w = qp_ref[...]  # [knb*4, tn] i32
+
+    @pl.when((k == 0) & (pl.program_id(0) == 0))
+    def _():
+        out_ref[...] = b_ref[...]  # carry-dependent init defeats hoisting
+
+    out_ref[...] += w[:8, :128].astype(jnp.float32)
+
+
+def stream_call(bias, qp, tile_n, tile_knb):
+    rows4, out = qp.shape
+    nb = rows4 // 4
+    grid = (out // tile_n, nb // tile_knb)
+    return pl.pallas_call(
+        _kernel_stream,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda j, k: (0, 0)),
+            pl.BlockSpec((tile_knb * 4, tile_n), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda j, k: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(bias, qp)
+
+
+def dev_us(fn, args, guess_us, trials=3):
+    span = max(256, min(4096, int(40e3 / max(guess_us, 1.0))))
+    n1, n2 = 64, 64 + span
+
+    def chain(nn):
+        @jax.jit
+        def run(x, qp):
+            def body(c, _):
+                y = fn(c, qp)
+                return y * jnp.float32(1e-6), None
+
+            c, _ = jax.lax.scan(body, x, None, length=nn)
+            return c
+
+        return run
+
+    best = {}
+    for n in (n1, n2):
+        f = chain(n)
+        r = f(*args)
+        np.asarray(r).ravel()[:1]
+        b = 1e9
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            r = f(*args)
+            np.asarray(r).ravel()[:1]
+            b = min(b, time.perf_counter() - t0)
+        best[n] = b
+    return (best[n2] - best[n1]) / (n2 - n1) * 1e6
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shapes = [
+        ("wqkv", 2048, 3072),
+        ("wo  ", 2048, 2048),
+        ("w13 ", 2048, 16384),
+        ("w2  ", 8192, 2048),
+        ("wcls", 2048, 32768),
+    ]
+    for label, k, n in shapes:
+        nb = k // Q_BLOCK
+        qt = rng.integers(-8, 8, (nb, Q_BLOCK, n), dtype=np.int8)
+        qp = jnp.asarray(pack_q(qt).reshape(nb * 4, n))
+        mb = nb * 16 * n / 1e6
+        x0 = jnp.zeros((8, 128), jnp.float32)
+        best = None
+        for tile_n in (1024, 2048, 4096):
+            for tile_knb in (8, 16, 32, 64):
+                if tile_n > n or tile_knb > nb or n % tile_n or nb % tile_knb:
+                    continue
+                if 2 * tile_knb * 16 * tile_n > 8 * 1024 * 1024:
+                    continue
+                try:
+                    us = dev_us(
+                        lambda b, q, tn=tile_n, tk=tile_knb: stream_call(b, q, tn, tk),
+                        (x0, qp),
+                        guess_us=mb * 1e6 / 819e3 / 1e3,
+                    )
+                    gbs = mb / 1e3 / (us / 1e6)
+                    if best is None or us < best[0]:
+                        best = (us, tile_n, tile_knb, gbs)
+                except Exception as e:
+                    print(f"  {label} tn={tile_n} knb={tile_knb}: FAIL {str(e)[:80]}")
+        us, tn, tk, gbs = best
+        print(
+            f"{label} packed {mb:6.1f} MB: DMA floor {us:7.1f} us = {gbs:5.0f} GB/s "
+            f"(tn={tn} knb={tk})"
+        )
+
+
+if __name__ == "__main__":
+    main()
