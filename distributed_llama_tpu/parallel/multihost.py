@@ -107,8 +107,10 @@ def initialize_distributed(
                 # r4). Without any cluster signal, bare --distributed on a
                 # single machine (library/tests with a live backend) keeps
                 # the documented single-process fallback.
-                # only EXPLICIT coordinator env counts as intent — single-
-                # host TPU VMs legitimately carry TPU_* worker metadata
+                # explicit coordinator env counts as intent, and so does a
+                # TPU worker list naming MORE THAN ONE host (a pod slice;
+                # single-host TPU VMs carry their own name there, which is
+                # why presence alone is not a signal)
                 cluster_env = [
                     v
                     for v in (
@@ -118,6 +120,9 @@ def initialize_distributed(
                     )
                     if os.environ.get(v)
                 ]
+                hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+                if len([h for h in hosts.split(",") if h.strip()]) > 1:
+                    cluster_env.append("TPU_WORKER_HOSTNAMES(multi-host)")
                 if cluster_env:
                     raise RuntimeError(
                         f"--distributed on a detected cluster ({cluster_env[0]} "

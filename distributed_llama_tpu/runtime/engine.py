@@ -349,7 +349,11 @@ class InferenceEngine:
             from .batch_session import BatchSession
 
             s = BatchSession(self)
-            s.admit(0, [1, 2])
+            # a max_chunk admission prompt compiles the per-row admission
+            # prefill ladder (prefill_row is a DIFFERENT program from the
+            # whole-batch _forward that generate() warms) — without it the
+            # first real request still paid full compile inside the request
+            s.admit(0, [1] * max(2, min(self.max_chunk, self.cfg.seq_len // 2)))
             for chunk in (8, self.decode_chunk_size):
                 if s.pos[0] + 1 + chunk <= self.cfg.seq_len:
                     s.step(chunk)
@@ -397,7 +401,11 @@ class InferenceEngine:
         if sync:
             with self._guard(
                 f"prefill[{len(tokens)}]",
-                ("prefill", tuple(sz for sz, _ in chunk_sizes)),
+                # the kv bucket matters to the compiled shape: a prefix-cache
+                # continuation at a deeper position is a NEW compile even
+                # with a seen chunk ladder
+                ("prefill", tuple(sz for sz, _ in chunk_sizes),
+                 self._kv_bucket(pos_start + n)),
             ):
                 # single scalar fetch = the only host round trip of the prefill
                 np.asarray(jnp.sum(out))
@@ -545,7 +553,6 @@ class InferenceEngine:
         token = jnp.asarray([p[-1] for p in prompts], jnp.int32)
         done = [False] * self.batch
         out: list[list[int]] = [[] for _ in range(self.batch)]
-        produced = 0
 
         # One-chunk lookahead + worker-thread fetch, exactly like
         # _decode_device: chunk i+1's dispatch (device-resident inputs)
@@ -559,6 +566,8 @@ class InferenceEngine:
         # and a stop_fn early-exit wastes at most the lookahead chunk
         # (same overrun tradeoff the solo path accepts).
         total_needed = max(budgets)
+        if total_needed <= 0:
+            return out
         planned = 0
         key_box = [key]
         state = {"token": token, "pos": pos}
@@ -615,7 +624,6 @@ class InferenceEngine:
                         done[r] = True
                     elif len(out[r]) >= budgets[r]:
                         done[r] = True
-            produced += n
             if all(done):
                 # a dispatched lookahead chunk past this point is discarded:
                 # its cache writes sit beyond every returned sequence, junk

@@ -156,7 +156,8 @@ class StepStats:
         s = self.series.get(kind)
         if not s or not s.recent:
             return {}
-        arr = np.sort(np.asarray(s.recent))
+        # list() first: record() on another thread appends concurrently
+        arr = np.sort(np.asarray(list(s.recent)))
         pick = lambda p: float(arr[min(len(arr) - 1, int(len(arr) * p))])
         return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
 
@@ -164,7 +165,9 @@ class StepStats:
         """JSON-able view of every series (the /stats endpoint's payload;
         same numbers `report()` prints)."""
         out = {}
-        for kind, s in sorted(self.series.items()):
+        # materialize the items: engine threads insert new kinds while the
+        # /stats handler iterates
+        for kind, s in sorted(list(self.series.items())):
             if s.count == 0:
                 continue
             p = self.percentiles(kind)
