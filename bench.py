@@ -239,9 +239,10 @@ def leg_longcontext():
     )
     from distributed_llama_tpu.runtime.engine import InferenceEngine
 
-    # dim-1024 model: dispatch-overhead-bound at chunk 64 (see extra_legs)
+    # dim-1024 model: dispatch-overhead-bound below 256-token chunks (see
+    # extra_legs)
     eng = InferenceEngine(
-        path, compute_dtype="bfloat16", max_chunk=512, decode_chunk_size=128
+        path, compute_dtype="bfloat16", max_chunk=512, decode_chunk_size=256
     )
 
     def decode_at(pos: int) -> float:
@@ -249,14 +250,14 @@ def leg_longcontext():
         so decode at 30k attends mostly zero K/V rows — the read volume (and
         thus the timing) is identical to a fully-written cache, but the
         generated tokens are numerically meaningless. Numerics at depth are
-        covered by the parity/perplexity legs. 384 decode tokens = three
-        128-chunks, so the median is a steady-state chunk (a single chunk's
+        covered by the parity/perplexity legs. 768 decode tokens = three
+        256-chunks, so the median is a steady-state chunk (a single chunk's
         wall carries its un-overlapped dispatch+fetch round trips)."""
         eng.reset()
         prompt = [(i % 999) + 1 for i in range(512)]
         # place the prompt so decode runs at `pos`
         eng.prefill(prompt, pos_start=pos - 512)
-        res = eng.generate([1], pos + 384, sampler=None, pos_start=pos)
+        res = eng.generate([1], pos + 768, sampler=None, pos_start=pos)
         per = statistics.median(s.eval_us / s.n_tokens for s in res.pred_steps)
         return 1e6 / per
 
@@ -395,17 +396,18 @@ def main():
     )
     del eng
 
-    # the small models are dispatch-overhead-bound at chunk 64 (compute
-    # ~46 ms/chunk < the ~100 ms tunnel round trip), so they decode in
-    # 128-token chunks; the 1B/8B are compute-bound at 64 and the lookahead
-    # already hides their dispatch. MoE prefills a 1024-token prompt: its
+    # the small models are dispatch-overhead-bound below ~256-token chunks
+    # (compute/chunk must clear the ~100 ms tunnel round trip for the
+    # lookahead to hide it; r5 A/B at qwen3: chunk 256 = 1.14x chunk 128),
+    # and their budgets are 3 chunks so the median samples a steady-state
+    # chunk. The 1B/8B are compute-bound earlier. MoE prefills a 1024-token prompt: its
     # 512-token chunk computes in ~11 ms (profile_prefill --model moe), so
     # short prompts measure only the ~100 ms per-chunk dispatch.
     extra_legs = [
         ("qwen3-class q40 1chip",
-         lambda: measure(ensure_qwen3(), 256, 256, decode_chunk_size=128)),
+         lambda: measure(ensure_qwen3(), 256, 768, decode_chunk_size=256)),
         ("qwen3-moe-class q40 1chip",
-         lambda: measure(ensure_moe(), 1024, 256, decode_chunk_size=128)),
+         lambda: measure(ensure_moe(), 1024, 768, decode_chunk_size=256)),
     ]
     for name, fn in extra_legs:
         try:
