@@ -352,8 +352,11 @@ class InferenceEngine:
             # a max_chunk admission prompt compiles the per-row admission
             # prefill ladder (prefill_row is a DIFFERENT program from the
             # whole-batch _forward that generate() warms) — without it the
-            # first real request still paid full compile inside the request
-            s.admit(0, [1] * max(2, min(self.max_chunk, self.cfg.seq_len // 2)))
+            # first real request still paid full compile inside the request.
+            # Cap leaves exactly the room the step(8)+step(chunk) below need
+            # so the max_chunk bucket itself gets warmed whenever it fits
+            room = self.cfg.seq_len - self.decode_chunk_size - 10
+            s.admit(0, [1] * max(2, min(self.max_chunk, room)))
             for chunk in (8, self.decode_chunk_size):
                 if s.pos[0] + 1 + chunk <= self.cfg.seq_len:
                     s.step(chunk)
@@ -390,12 +393,13 @@ class InferenceEngine:
         t0 = time.perf_counter()
         chunk_sizes: list[tuple[int, int]] = []  # (bucket, n_real)
         out = None
+        last_kvb = 0
         for i, size, n_real in chunk_plan(n, pos_start, self.max_chunk, self.cfg.seq_len):
             chunk = tokens[i : i + n_real] + [0] * (size - n_real)
             arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
+            last_kvb = self._kv_bucket(pos_start + i + size)
             out, self.cache = self._forward(
-                arr, jnp.int32(pos_start + i),
-                kv_len=self._kv_bucket(pos_start + i + size),
+                arr, jnp.int32(pos_start + i), kv_len=last_kvb,
             )
             chunk_sizes.append((size, n_real))
         if sync:
@@ -403,9 +407,11 @@ class InferenceEngine:
                 f"prefill[{len(tokens)}]",
                 # the kv bucket matters to the compiled shape: a prefix-cache
                 # continuation at a deeper position is a NEW compile even
-                # with a seen chunk ladder
-                ("prefill", tuple(sz for sz, _ in chunk_sizes),
-                 self._kv_bucket(pos_start + n)),
+                # with a seen chunk ladder. Key on the LAST chunk's PADDED
+                # end bucket — the same value the forward calls actually
+                # compile with (the unpadded pos_start+n can alias an
+                # already-warm bucket and mis-tag a fresh compile as warm)
+                ("prefill", tuple(sz for sz, _ in chunk_sizes), last_kvb),
             ):
                 # single scalar fetch = the only host round trip of the prefill
                 np.asarray(jnp.sum(out))

@@ -110,13 +110,16 @@ def main():
                     us = dev_us(
                         lambda b, q, tn=tile_n, tk=tile_knb: stream_call(b, q, tn, tk),
                         (x0, qp),
-                        guess_us=mb * 1e6 / 819e3 / 1e3,
+                        guess_us=mb * 1e6 / 819e3,
                     )
                     gbs = mb / 1e3 / (us / 1e6)
                     if best is None or us < best[0]:
                         best = (us, tile_n, tile_knb, gbs)
                 except Exception as e:
                     print(f"  {label} tn={tile_n} knb={tile_knb}: FAIL {str(e)[:80]}")
+        if best is None:
+            print(f"{label} packed {mb:6.1f} MB: no tile config ran")
+            continue
         us, tn, tk, gbs = best
         print(
             f"{label} packed {mb:6.1f} MB: DMA floor {us:7.1f} us = {gbs:5.0f} GB/s "
