@@ -534,25 +534,41 @@ def _quantize_rows_q80_split(x2: jnp.ndarray, nb: int):
     return x8a, x8b, xs, bs
 
 
+def _lane_tile(out: int, target: int) -> int:
+    """Largest multiple-of-128 divisor of `out` that is <= target. The old
+    halving chain collapsed non-power-of-two outs to tiny tiles (the 8B's
+    128256 vocab fell from a 2048 target to 256 lanes; 128256 = 167 * 768,
+    so 768 is the honest answer)."""
+    tn = min(target, out)
+    tn -= tn % LANE
+    while tn >= LANE:
+        if out % tn == 0:
+            return tn
+        tn -= LANE
+    return out
+
+
 def _fs_tiles(nb: int, out: int, rows: int = 1) -> tuple[int, int]:
     """Tile shapes for the packed (feature-split) int8 decode kernels, from
-    the round-5 on-chip sweeps (scripts/probe_int4c.py; us per decode
-    matmul, 2D [nb*4, out] storage):
-      big-out   (out >= 4096):           tn=2048 knb=32 (w13 28.1 us
-                672 GB/s = 1.83x the int8 kernel; wcls 51.9 us 728 GB/s =
-                2.12x)
-      deep-k    (nb >= 256, out < 4096): tn=2048 knb=8  (w2-class)
+    the round-5 on-chip sweeps (scripts/probe_int4c.py at 1B shapes plus an
+    8B-shape sweep; us per decode matmul, 2D [nb*4, out] storage):
+      big-out   (out >= 4096):  tn=2048; knb=64 at nb>=128 (8B wqkv 19.5 us
+                725 GB/s, w13 76.8 us 860 GB/s), knb=32 at smaller
+                contractions (1B w13 28.1 us 672 GB/s; wcls 51.9 us 728)
+      deep-k    (nb >= 256, out < 4096): tn=1024 knb=64 (8B w2 47.5 us
+                695 GB/s; the r5.0 (2048, 8) choice measured ~14.6 us at
+                the 1B w2 shape but loses at 8B scale)
       square    (else):                  tn=1024 knb=32 (wqkv 1.27x)
+    Lane tiles come from `_lane_tile` so ragged outs (128256 vocab) keep
+    wide tiles.
     """
     if out >= 4096:
-        tile_n, tile_knb = 2048, 32
+        tile_n, tile_knb = 2048, (64 if nb >= 128 else 32)
     elif nb >= 256:
-        tile_n, tile_knb = 2048, 8
+        tile_n, tile_knb = 1024, 64
     else:
         tile_n, tile_knb = 1024, 32
-    tile_n = min(tile_n, out)
-    while out % tile_n:
-        tile_n //= 2
+    tile_n = _lane_tile(out, tile_n)
     tile_knb = min(tile_knb, nb)
     while nb % tile_knb:
         tile_knb //= 2
