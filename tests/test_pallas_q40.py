@@ -253,3 +253,24 @@ def test_vmem_cap_divisor_safety_sweep():
             tn, knb = _bf16_tile_cap(b, 256, start_knb, nb)
             assert nb % knb == 0, (nb, b, knb)
             assert knb == nb or knb % 8 == 0, (nb, b, knb)
+
+
+def test_i8_kernel_ragged_vocab_out():
+    """A non-power-of-two out dim (the 8B's 128256-vocab shape class, here
+    768 = 6*128) must keep wide lane tiles via the divisor search AND stay
+    correct — the old halving-only search collapsed such shapes to tiny
+    tiles (2.17x slower at the real 8B wcls)."""
+    from distributed_llama_tpu.ops.pallas_q40 import (
+        _fs_tiles,
+        q40_matmul_pallas_i8,
+    )
+
+    rng = np.random.default_rng(3)
+    out_f, in_f = 768, 256  # 768 is not a power of two; 128256 = 167 * 768
+    wt = make_weight(rng, out_f, in_f)
+    tn, tk = _fs_tiles(in_f // 32, out_f)
+    assert tn == 768, (tn, tk)  # full-width, not the halving chain's 256
+    x = jnp.asarray(rng.standard_normal((1, in_f)), jnp.float32)
+    want = _q80_reference(x, wt)
+    got = np.asarray(q40_matmul_pallas_i8(x, wt.q, wt.d, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
