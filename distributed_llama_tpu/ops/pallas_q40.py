@@ -54,6 +54,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed upstream from TPUCompilerParams; alias locally (don't mutate jax)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from ..formats.quants import Q_BLOCK
 
 LANE = 128
@@ -70,7 +73,7 @@ def _i8_compiler_params():
 
     if os.environ.get("DLT_I8_DIMSEM"):
         return {
-            "compiler_params": pltpu.CompilerParams(
+            "compiler_params": _CompilerParams(
                 dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)
             )
         }
@@ -867,7 +870,7 @@ def q40_matmul_pallas_grouped(
         # Declaring that is a measured 10x on this kernel (62.7 vs 619 us
         # at the bench MoE w1 shape — without it Mosaic serializes the
         # whole (i, j, k) grid behind each scalar-prefetched block index)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)
         ),
     )(jnp.asarray(block_expert, jnp.int32), xp, qt2, dt3)

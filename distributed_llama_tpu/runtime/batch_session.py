@@ -241,7 +241,14 @@ class BatchSession:
                 eng.cfg, eng.params, eng.rope, eng.cache,
                 token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
             )
-        host = np.asarray(toks)
+        # the fetch is the batch path's one blocking device call — watchdog
+        # it like the solo decode path, so a wedged device raises StallError
+        # into the Batcher loop (reset + bounded client retry) instead of
+        # hanging every co-batched request forever
+        with eng._guard(
+            f"batch_decode[{n_steps}]", ("batch_decode", n_steps, kv_len)
+        ):
+            host = np.asarray(toks)
         # np.array (copy): asarray of a device array is READ-ONLY, and admit
         # writes rows into these between chunks
         self.keys = np.array(keys)

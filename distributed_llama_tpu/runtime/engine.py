@@ -368,7 +368,7 @@ class InferenceEngine:
         compiled shape so first-time calls get the compile threshold."""
         first = key not in self._warm
         self._warm.add(key)
-        return watchdog(label, compiling=first)
+        return watchdog(label, compiling=first, stats=self.stats)
 
     def prefill(
         self, tokens: list[int], pos_start: int = 0, on_chunk=None, sync: bool = True
@@ -392,26 +392,29 @@ class InferenceEngine:
             return
         t0 = time.perf_counter()
         chunk_sizes: list[tuple[int, int]] = []  # (bucket, n_real)
+        chunk_shapes: list[tuple[int, int]] = []  # (bucket, kv_bucket) per chunk
         out = None
-        last_kvb = 0
         for i, size, n_real in chunk_plan(n, pos_start, self.max_chunk, self.cfg.seq_len):
             chunk = tokens[i : i + n_real] + [0] * (size - n_real)
             arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
-            last_kvb = self._kv_bucket(pos_start + i + size)
+            kvb = self._kv_bucket(pos_start + i + size)
             out, self.cache = self._forward(
-                arr, jnp.int32(pos_start + i), kv_len=last_kvb,
+                arr, jnp.int32(pos_start + i), kv_len=kvb,
             )
             chunk_sizes.append((size, n_real))
+            chunk_shapes.append((size, kvb))
         if sync:
             with self._guard(
                 f"prefill[{len(tokens)}]",
                 # the kv bucket matters to the compiled shape: a prefix-cache
                 # continuation at a deeper position is a NEW compile even
-                # with a seen chunk ladder. Key on the LAST chunk's PADDED
-                # end bucket — the same value the forward calls actually
-                # compile with (the unpadded pos_start+n can alias an
-                # already-warm bucket and mis-tag a fresh compile as warm)
-                ("prefill", tuple(sz for sz, _ in chunk_sizes), last_kvb),
+                # with a seen chunk ladder. Key on EVERY chunk's (size,
+                # kv_bucket) pair — the exact shapes the forward calls
+                # compile with. Keying only the last bucket aliased ladders
+                # whose intermediate buckets differ (different pos_start),
+                # mis-tagging a genuine first compile as warm and running it
+                # under the narrow stall threshold (false EXEC_STALL)
+                ("prefill", tuple(chunk_shapes)),
             ):
                 # single scalar fetch = the only host round trip of the prefill
                 np.asarray(jnp.sum(out))

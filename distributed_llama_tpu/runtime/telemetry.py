@@ -56,8 +56,9 @@ class watchdog:
     reference, which also only detects, not cancels).
     """
 
-    def __init__(self, what: str, log_fn=None, compiling: bool = False):
+    def __init__(self, what: str, log_fn=None, compiling: bool = False, stats=None):
         self.what = ("compile " + what) if compiling else what
+        self.stats = stats  # optional StepStats: stall events become counters
         if log_fn is None:
             import functools
             import sys
@@ -101,6 +102,8 @@ class watchdog:
                     f"⏳ [EXEC_STALL] {self.what} exceeded {self.log_ms:.0f} ms "
                     f"(elapsed {elapsed_ms:.0f} ms)"
                 )
+                if self.stats is not None:
+                    self.stats.incr("exec_stall_logged")
                 logged = True
             if elapsed_ms >= self.timeout_ms:
                 self._timed_out = True
@@ -108,6 +111,8 @@ class watchdog:
                     f"🚨 [EXEC_STALL] {self.what} exceeded hard timeout "
                     f"{self.timeout_ms:.0f} ms"
                 )
+                if self.stats is not None:
+                    self.stats.incr("exec_stall_timeout")
                 return
 
     def __enter__(self):
@@ -137,10 +142,25 @@ class _Series:
 
 class StepStats:
     """Per-step-type latency aggregation with percentile report
-    (the reference's NetworkPerfMonitor shape, applied to device steps)."""
+    (the reference's NetworkPerfMonitor shape, applied to device steps),
+    plus named event counters (stall resets/retries, shed requests) so the
+    robustness layer is observable through the same snapshot `/health`,
+    `/stats`, and `/gateway/stats` read."""
 
     def __init__(self, window: int = 100):
         self.series: dict[str, _Series] = defaultdict(lambda: _Series(window=window))
+        self.counters: dict[str, int] = {}
+        self._counter_lock = threading.Lock()
+
+    def incr(self, name: str, n: int = 1):
+        """Bump a named event counter (thread-safe; shows up in
+        `snapshot()["counters"]`)."""
+        with self._counter_lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def counters_snapshot(self) -> dict:
+        with self._counter_lock:
+            return dict(self.counters)
 
     def record(self, kind: str, us: float):
         s = self.series[kind]
@@ -163,8 +183,10 @@ class StepStats:
 
     def snapshot(self) -> dict:
         """JSON-able view of every series (the /stats endpoint's payload;
-        same numbers `report()` prints)."""
-        out = {}
+        same numbers `report()` prints) plus, under the reserved
+        ``"counters"`` key, the event counters — the one source `/health`
+        and the gateway's `/gateway/stats` both agree with."""
+        out = {"counters": self.counters_snapshot()}
         # materialize the items: engine threads insert new kinds while the
         # /stats handler iterates
         for kind, s in sorted(list(self.series.items())):

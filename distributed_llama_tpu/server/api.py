@@ -45,6 +45,16 @@ class PromptTooLong(ValueError):
     pass
 
 
+class Overloaded(Exception):
+    """The serving queue is past its shed threshold: fail fast with
+    503 + Retry-After instead of letting the request sit in a backlog it
+    will very likely time out of anyway (load shedding under pressure)."""
+
+    def __init__(self, retry_after_s: int = 1):
+        super().__init__("server overloaded")
+        self.retry_after_s = retry_after_s
+
+
 class ClientDisconnected(Exception):
     """The HTTP client dropped mid-stream (raised from the emit path). The
     engine state is fine — distinguished by TYPE from engine failures so
@@ -120,7 +130,8 @@ class _BatchReq:
 
     EMIT_DEPTH = 8192
 
-    def __init__(self, ids, max_new, temperature, topp, seed, on_token):
+    def __init__(self, ids, max_new, temperature, topp, seed, on_token,
+                 eos_ids=frozenset()):
         import queue
 
         self.ids = ids
@@ -129,8 +140,14 @@ class _BatchReq:
         self.topp = topp
         self.seed = seed
         self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        # token ids that END the row — checked IN the step loop, so a row
+        # stops decoding at its EOS token instead of running up to a full
+        # extra chunk before the writer thread's `stopped` flag is seen
+        self.eos_ids = frozenset(eos_ids)
         self.stopped = False
-        self.n = 0
+        self.n = 0  # tokens decoded into this row (budget accounting)
+        self.n_out = 0  # tokens actually delivered to on_token (usage
+        # accounting: excludes post-stop overrun the writer drains away)
         self.error = None
         self.done = threading.Event()
         self.emit: "queue.Queue[int | None]" = queue.Queue(maxsize=self.EMIT_DEPTH)
@@ -161,7 +178,8 @@ class Batcher:
     independent fresh sequences).
     """
 
-    def __init__(self, state: "ApiState", chunk_size: int | None = None):
+    def __init__(self, state: "ApiState", chunk_size: int | None = None,
+                 max_backlog: int | None = None):
         import queue
 
         self.state = state
@@ -170,6 +188,10 @@ class Batcher:
         # more dispatch round trips per token; the engine default balances
         # the two for throughput.
         self.chunk = chunk_size or engine.decode_chunk_size
+        # shed threshold: with this many requests already waiting for a
+        # slot, a newcomer is turned away with 503 + Retry-After instead of
+        # joining a backlog it would likely rot in (see ApiState shedding)
+        self.max_backlog = max_backlog if max_backlog is not None else 8 * engine.batch
         self.q: "queue.Queue[_BatchReq]" = queue.Queue()
         # observable serving state (/stats): the loop owns the mutations,
         # readers take racy-but-consistent-enough snapshots
@@ -183,10 +205,16 @@ class Batcher:
         return {
             "batch_slots": len(slots),
             "slots_active": sum(1 for s in slots if s is not None),
-            "queue_depth": (len(self.backlog) if self.backlog is not None else 0)
-            + self.q.qsize(),
+            "queue_depth": self.queue_depth(),
+            "max_backlog": self.max_backlog,
             "chunk_size": self.chunk,
         }
+
+    def queue_depth(self) -> int:
+        return (len(self.backlog) if self.backlog is not None else 0) + self.q.qsize()
+
+    def overloaded(self) -> bool:
+        return self.queue_depth() >= self.max_backlog
 
     def submit(self, req: _BatchReq):
         """Enqueue and then act as the request's emit-queue writer: client
@@ -209,6 +237,7 @@ class Batcher:
             if req.stopped:
                 continue  # drain and discard after a failed write
             try:
+                req.n_out += 1
                 req.on_token(t)
             except Exception as e:
                 req.error = req.error or e
@@ -223,6 +252,7 @@ class Batcher:
             if t is None:
                 continue
             try:
+                req.n_out += 1
                 req.on_token(t)
             except Exception as e:
                 req.error = req.error or e
@@ -300,6 +330,17 @@ class Batcher:
 
             if all(s is None for s in slots):
                 continue
+            # a row at pos == seq_len-1 has zero decode headroom: finish it
+            # (the request keeps what it generated) instead of flooring the
+            # chunk clamp at 1 and letting session.step's overrun guard fail
+            # every co-batched request — reachable for library users driving
+            # the Batcher directly; the HTTP path's budget clamp never gets
+            # here
+            for row, req in enumerate(slots):
+                if req is not None and session.seq_len - 1 - int(session.pos[row]) <= 0:
+                    self._finish(req, session, slots, row)
+            if all(s is None for s in slots):
+                continue
             # chunk size: ramp to 8 right after an admission (a fresh
             # request's first tokens — and a tiny request's only tokens —
             # reach the client after ~8 steps, not a full chunk). The ramp
@@ -351,10 +392,14 @@ class Batcher:
                             "client fell too far behind the token stream"
                         )
                         req.stopped = True
-                    if req.stopped or req.n >= req.max_new:
+                    if req.stopped or req.n >= req.max_new or t in req.eos_ids:
                         # surplus tokens past max_new in this chunk are
                         # discarded; the row parks (session.release) so
-                        # co-tenants keep full-size chunks
+                        # co-tenants keep full-size chunks. The eos_ids
+                        # check is the row-local EOS signal: without it the
+                        # loop decodes up to a full extra chunk before the
+                        # writer thread's `stopped` flag is visible,
+                        # inflating req.n and burning decode compute
                         self._finish(req, session, slots, row)
                         break
 
@@ -402,10 +447,12 @@ class ApiState:
                 "samples on-device); concurrent requests will queue"
             )
 
-    def complete_batched(self, params: dict, emit):
+    def complete_batched(self, params: dict, emit, client_visible: bool = True):
         """One request's slice of a batched generation: encode, submit to the
         Batcher, stream deltas from this row's tokens as they arrive.
-        Returns (full_text, n_prompt_tokens, n_completion_tokens)."""
+        Returns (full_text, n_prompt_tokens, n_completion_tokens).
+        `client_visible=False` widens stall-retry eligibility exactly like
+        `complete` (see there)."""
         tok = self.tokenizer
         items = [ChatItem(m["role"], m["content"]) for m in params["messages"]]
         prompt = self.template.generate(items, True)
@@ -421,47 +468,116 @@ class ApiState:
         max_tokens = params.get("max_tokens", -1)
         budget = max_tokens if max_tokens and max_tokens > 0 else seq_len
         budget = max(1, min(budget, seq_len - len(ids)))
+        # load shedding: past the backlog cap a request would sit in a queue
+        # it will likely rot in — fail fast with 503 + Retry-After (roughly
+        # one chunk's worth of drain time) instead of burning the client's
+        # patience and a slot's worth of queue memory
+        if self.batcher.overloaded():
+            self.engine.stats.incr("shed_503")
+            raise Overloaded(retry_after_s=1)
 
-        buffer = []
+        base = []
         if prompt.public_prompt:
             emit(prompt.public_prompt)
-            buffer.append(prompt.public_prompt)
+            base.append(prompt.public_prompt)
 
-        dec = tok.stream_decoder()  # per-row UTF-8 carry state
-        detector = EosDetector(
-            tok.eos_token_ids,
-            self.stops,
-            max((len(s) for s in self.stops), default=0),
-            max((len(s) for s in self.stops), default=0),
-        )
         req_box = []
+        deltas_box = []
 
-        def on_token(t):
-            piece = dec.decode(t)
-            eos_type = detector.append(t, piece)
-            if eos_type != EOS_MAYBE:
-                delta = detector.get_delta()
-                if delta:
-                    emit(delta)
-                    buffer.append(delta)
-                detector.reset()
-            if eos_type == EOS_FOUND:
-                req_box[0].stopped = True
+        def make_req():
+            """Fresh request + decode state + delta buffer (a stall retry
+            must not inherit the failed attempt's UTF-8 carry, stop-string
+            window, or partial text)."""
+            dec = tok.stream_decoder()  # per-row UTF-8 carry state
+            detector = EosDetector(
+                tok.eos_token_ids,
+                self.stops,
+                max((len(s) for s in self.stops), default=0),
+                max((len(s) for s in self.stops), default=0),
+            )
+            deltas = []
+            deltas_box[:] = [deltas]
 
-        req = _BatchReq(
-            ids, budget,
-            params.get("temperature", self.args.temperature),
-            params.get("top_p", self.args.topp),
-            params.get("seed"),
-            on_token,
-        )
-        req_box.append(req)
-        self.batcher.submit(req)
-        return "".join(buffer), len(ids), req.n
+            def on_token(t):
+                piece = dec.decode(t)
+                eos_type = detector.append(t, piece)
+                if eos_type != EOS_MAYBE:
+                    delta = detector.get_delta()
+                    if delta:
+                        emit(delta)
+                        deltas.append(delta)
+                    detector.reset()
+                if eos_type == EOS_FOUND:
+                    req_box[0].stopped = True
 
-    def complete(self, params: dict, emit):
+            req = _BatchReq(
+                ids, budget,
+                params.get("temperature", self.args.temperature),
+                params.get("top_p", self.args.topp),
+                params.get("seed"),
+                on_token,
+                eos_ids=frozenset(tok.eos_token_ids),
+            )
+            req_box[:] = [req]
+            return req
+
+        from ..runtime.telemetry import StallError
+
+        for attempt in range(2):
+            req = make_req()
+            try:
+                self.batcher.submit(req)
+                break
+            except StallError:
+                # the decode watchdog fired mid-chunk: the Batcher loop
+                # already reset the engine and rebuilt the session. Retry
+                # IN PLACE exactly once — safe when nothing reached this
+                # client yet (streamed bytes cannot be replayed without
+                # duplication), or always on the non-stream path
+                # (client_visible=False: emit is a no-op and the response
+                # is built from the final attempt's deltas alone)
+                self.engine.stats.incr("stall_resets")
+                if attempt == 0 and (req.n_out == 0 or not client_visible):
+                    self.engine.stats.incr("stall_retries")
+                    continue
+                raise
+        # n_out counts tokens the writer actually delivered (the EOS token
+        # included) — req.n also counts post-stop overrun decoded before the
+        # step loop noticed, which must not inflate usage accounting
+        return "".join(base + deltas_box[0]), len(ids), req.n_out
+
+    def complete(self, params: dict, emit, client_visible: bool = True):
         """Run one completion; calls emit(delta_text) per safe-to-send chunk.
-        Returns (full_text, n_prompt_tokens, n_completion_tokens)."""
+        Returns (full_text, n_prompt_tokens, n_completion_tokens).
+
+        A `StallError` from the decode watchdog (wedged device step) gets
+        ONE bounded in-place retry on the recovered engine — but only when
+        nothing reached the client yet: a half-streamed response cannot be
+        transparently replayed. `client_visible=False` (the non-stream
+        handler, whose emit is a no-op and whose response is built solely
+        from the return value) makes the retry unconditionally safe."""
+        from ..runtime.telemetry import StallError
+
+        emitted = [False]
+
+        def traced_emit(delta):
+            emitted[0] = True
+            emit(delta)
+
+        try:
+            return self._complete_once(params, traced_emit)
+        except StallError:
+            # _complete_once's failure path already ran recover() (engine
+            # reset + prefix cache dropped), so the retry starts clean and
+            # re-prefills from position 0 (the retry builds a fresh buffer,
+            # so nothing from the failed attempt leaks into the result)
+            self.engine.stats.incr("stall_resets")
+            if emitted[0] and client_visible:
+                raise
+            self.engine.stats.incr("stall_retries")
+            return self._complete_once(params, traced_emit)
+
+    def _complete_once(self, params: dict, emit):
         engine, tok = self.engine, self.tokenizer
         messages = params["messages"]
         delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(messages)
@@ -578,7 +694,16 @@ class Handler(BaseHTTPRequestHandler):
             ).encode()
             self._json(200, body)
         elif self.path == "/health":
-            self._json(200, b'{"status":"ok"}')
+            # the gateway's active prober reads this: status plus the same
+            # robustness counters /stats exports (StepStats counters), so
+            # the two views can never disagree about what the engine saw
+            st = self.state
+            payload = {
+                "status": "ok",
+                "counters": st.engine.stats.counters_snapshot(),
+                "queue_depth": st.batcher.queue_depth() if st.batcher is not None else 0,
+            }
+            self._json(200, json.dumps(payload).encode())
         elif self.path == "/stats":
             # operator view of the serving loop (the reference prints its
             # network perf report only at shutdown, nn-network.cpp:883-1053;
@@ -653,6 +778,16 @@ class Handler(BaseHTTPRequestHandler):
                         self._json(400, json.dumps({"error": str(e)}).encode())
                         return
                     raise
+                except Overloaded as e:
+                    # shed BEFORE any SSE byte goes out (the backlog check
+                    # runs ahead of the first emit), so the 503 is clean
+                    if not started[0]:
+                        self._json(
+                            503, b'{"error":"server overloaded"}',
+                            headers={"Retry-After": str(e.retry_after_s)},
+                        )
+                        return
+                    raise
                 except ClientDisconnected:
                     return  # nothing to send — the socket is gone
                 except Exception as e:
@@ -672,9 +807,20 @@ class Handler(BaseHTTPRequestHandler):
                 self.close_connection = True
             else:
                 try:
-                    text, n_prompt, n_completion = complete_fn(params, lambda d: None)
+                    # non-stream: emit is a no-op and the response is built
+                    # from the return value only — a stall retry can never
+                    # duplicate client-visible bytes
+                    text, n_prompt, n_completion = complete_fn(
+                        params, lambda d: None, client_visible=False
+                    )
                 except PromptTooLong as e:
                     self._json(400, json.dumps({"error": str(e)}).encode())
+                    return
+                except Overloaded as e:
+                    self._json(
+                        503, b'{"error":"server overloaded"}',
+                        headers={"Retry-After": str(e.retry_after_s)},
+                    )
                     return
                 except Exception as e:  # engine failure: recovered by
                     # complete(); report it instead of dropping the socket
@@ -702,10 +848,12 @@ class Handler(BaseHTTPRequestHandler):
                 ).encode()
                 self._json(200, body)
 
-    def _json(self, code: int, body: bytes):
+    def _json(self, code: int, body: bytes, headers: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         # close after every response (reference: dllama-api.cpp:202-235):
         # the server handles one connection at a time, so a pooled keep-alive
         # client would otherwise wedge it for everyone else

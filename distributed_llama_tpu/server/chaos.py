@@ -1,0 +1,284 @@
+"""Fault-injection harness: a deterministic chaos proxy for serving tests.
+
+The robustness layer (gateway breakers, zero-byte retry, active probes,
+engine stall recovery) is only trustworthy if its failure modes are
+reproducible ON DEMAND — waiting for a real TPU host to die is not a test
+plan. `ChaosProxy` fronts a real backend and injects scripted faults at the
+TCP layer, so a test can state "backend 2 resets every stream after 100
+bytes from request 3 on" and assert the exact client-visible outcome.
+
+Fault modes (each maps to a distinct real-world failure):
+
+* ``refuse``        — accept and immediately RST (dead service; the OS
+                      accept queue makes a true pre-accept refusal
+                      unscriptable per-connection, so the reset lands on
+                      the client's first read/write). For a true
+                      ECONNREFUSED use :meth:`ChaosProxy.down`, which
+                      closes the listener entirely (host down);
+* ``reset_on_accept`` — read the full request, then RST before any
+                      response byte (backend crashed mid-handling);
+* ``midstream_reset`` — proxy normally, forward ``after_bytes`` of the
+                      response, then RST (backend crashed mid-stream);
+* ``stall``         — read the request, then hold the connection silent
+                      for ``delay_s`` before RST (slow-loris / wedged
+                      runtime; exercises upstream read timeouts);
+* ``latency``       — sleep ``delay_s``, then proxy transparently (slow
+                      network; request still succeeds);
+* ``pass``          — transparent proxy.
+
+Faults are scheduled by a `FaultPlan`: explicit per-connection rules keyed
+on the proxy's accept counter, an optional default, and an optional seeded
+random mix. Connection indices are assigned in accept order under a single
+accept loop, so a fixed plan (and fixed seed) replays the same fault
+sequence every run — determinism is the whole point.
+
+Example — "backend dies on request 3, recovers after 2 s"::
+
+    plan = FaultPlan(rules={3: Fault(REFUSE)})
+    proxy = ChaosProxy("127.0.0.1", backend_port, plan)
+    proxy.start()
+    ...
+    proxy.down()          # host vanishes: connections now refused
+    time.sleep(2.0)
+    proxy.up()            # host back; gateway's prober re-admits it
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+# one HTTP-request framer for the whole serving layer: the harness must
+# read requests EXACTLY the way the gateway it exercises does, or the two
+# drift apart on framing edge cases
+from .gateway import _read_http_request as _read_request
+
+PASS = "pass"
+REFUSE = "refuse"
+RESET_ON_ACCEPT = "reset_on_accept"
+MIDSTREAM_RESET = "midstream_reset"
+STALL = "stall"
+LATENCY = "latency"
+
+_KINDS = {PASS, REFUSE, RESET_ON_ACCEPT, MIDSTREAM_RESET, STALL, LATENCY}
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str = PASS
+    after_bytes: int = 0  # midstream_reset: response bytes forwarded before RST
+    delay_s: float = 0.0  # stall: silence duration; latency: added delay
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault schedule over the proxy's accept counter.
+
+    * ``rules``: explicit per-connection faults — ``{3: Fault(REFUSE)}``
+      injects on the 4th accepted connection (0-indexed);
+    * ``default``: fault for connections with no rule (``Fault(PASS)``);
+    * ``random_mix`` + ``seed``: optional seeded randomness — each unruled
+      connection draws from ``random.Random(seed)`` and picks the first
+      ``(probability, fault)`` whose cumulative range covers the draw.
+      The stream is indexed by accept order, so a fixed seed replays the
+      identical fault sequence.
+    """
+
+    rules: dict[int, Fault] = field(default_factory=dict)
+    default: Fault = field(default_factory=Fault)
+    random_mix: list[tuple[float, Fault]] = field(default_factory=list)
+    seed: int = 0
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def fault_for(self, conn_index: int) -> Fault:
+        # one draw per connection, rule hit or not: adding a rule to a
+        # seeded plan must not SHIFT the random stream under every later
+        # connection (the draw happens even when a rule overrides it)
+        draw = self._rng.random() if self.random_mix else 0.0
+        if conn_index in self.rules:
+            return self.rules[conn_index]
+        acc = 0.0
+        for p, fault in self.random_mix:
+            acc += p
+            if draw < acc:
+                return fault
+        return self.default
+
+
+def _rst_close(sock: socket.socket):
+    """Close with RST (SO_LINGER 0): the peer sees ECONNRESET, not FIN —
+    the signature of a crashed process, which is what we are simulating."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ChaosProxy:
+    """TCP proxy fronting one real backend, injecting `FaultPlan` faults.
+
+    Thread-per-connection like the gateway itself; `start()` returns once
+    the listener is accepting (`self.port` is bound either way). `stop()`
+    tears everything down; `down()`/`up()` simulate the whole host
+    vanishing and returning (connections are REFUSED while down — the one
+    failure mode an accepting socket cannot fake)."""
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        plan: FaultPlan | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.upstream = (upstream_host, upstream_port)
+        self.plan = plan or FaultPlan()
+        self.host = host
+        self._requested_port = port
+        self.port = 0
+        self.conn_count = 0  # accept counter = the FaultPlan index
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._down = threading.Event()
+        self._listener: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _bind(self) -> socket.socket:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self._requested_port or self.port))
+        srv.listen(64)
+        srv.settimeout(0.1)
+        return srv
+
+    def start(self) -> "ChaosProxy":
+        self._listener = self._bind()
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"chaos:{self.port}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def down(self):
+        """Simulate the host vanishing: close the listener so new
+        connections get ECONNREFUSED (nothing is listening)."""
+        self._down.set()
+
+    def up(self):
+        """Bring the host back on the same port."""
+        self._down.clear()
+
+    # -- accept loop --------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            if self._down.is_set():
+                if self._listener is not None:
+                    try:
+                        self._listener.close()
+                    except OSError:
+                        pass
+                    self._listener = None
+                time.sleep(0.02)
+                continue
+            if self._listener is None:
+                try:
+                    self._listener = self._bind()
+                except OSError:
+                    time.sleep(0.05)
+                    continue
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                continue
+            with self._lock:
+                idx = self.conn_count
+                self.conn_count += 1
+                fault = self.plan.fault_for(idx)
+            threading.Thread(
+                target=self._handle, args=(client, fault), daemon=True
+            ).start()
+
+    # -- per-connection fault execution -------------------------------------
+
+    def _handle(self, client: socket.socket, fault: Fault):
+        try:
+            if fault.kind == REFUSE:
+                _rst_close(client)
+                return
+            if fault.kind == LATENCY:
+                time.sleep(fault.delay_s)
+            request = _read_request(client)
+            if not request:
+                client.close()
+                return
+            if fault.kind == RESET_ON_ACCEPT:
+                _rst_close(client)
+                return
+            if fault.kind == STALL:
+                # slow-loris: hold the line silent, then die. An interrupted
+                # wait (proxy stopped) still RSTs so nothing leaks.
+                self._stop.wait(fault.delay_s)
+                _rst_close(client)
+                return
+            self._proxy(client, request, fault)
+        except OSError:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def _proxy(self, client: socket.socket, request: bytes, fault: Fault):
+        budget = fault.after_bytes if fault.kind == MIDSTREAM_RESET else None
+        sent = 0
+        try:
+            with socket.create_connection(self.upstream, timeout=10) as upstream:
+                upstream.sendall(request)
+                upstream.settimeout(60)
+                while True:
+                    chunk = upstream.recv(16384)
+                    if not chunk:
+                        break
+                    if budget is not None and sent + len(chunk) >= budget:
+                        client.sendall(chunk[: max(0, budget - sent)])
+                        _rst_close(client)
+                        return
+                    client.sendall(chunk)
+                    sent += len(chunk)
+        except OSError:
+            pass
+        try:
+            client.close()
+        except OSError:
+            pass

@@ -1,14 +1,35 @@
-"""Load-balancer gateway: reverse proxy over dllama-api replicas.
+"""Load-balancer gateway: resilient reverse proxy over dllama-api replicas.
 
-Behavior-parity port of the reference gateway (reference:
-src/dllama-gateway.cpp):
+Started as a behavior-parity port of the reference gateway (reference:
+src/dllama-gateway.cpp:266-373) and grew the fault-tolerance layer the
+reference's fixed 3s blackout only gestures at:
 
-* backend selection: among healthy backends under their inflight cap, pick
-  least-inflight, tie-broken by a round-robin cursor
+* backend selection: among assignable backends under their inflight cap,
+  pick least-inflight, tie-broken by a round-robin cursor — closed-breaker
+  backends are preferred over half-open ones
   (selectBackendAndAcquire, dllama-gateway.cpp:266-301);
-* a failed backend is marked unhealthy for `health_retry_ms` and routed
-  around (releaseBackend, dllama-gateway.cpp:303-316);
-* all backends busy -> 429; backend I/O failure -> 502;
+* **circuit breaker** per backend: `breaker_failure_threshold` consecutive
+  failures OPEN the breaker (exponential backoff, capped at
+  `breaker_backoff_max_s`); once the backoff elapses the breaker goes
+  HALF_OPEN and admits exactly one trial (a prober health check or one
+  client request) — success closes it, failure re-opens with doubled
+  backoff. This replaces the old fixed `health_retry_ms` blackout;
+* **active health probes**: a background prober thread hits each backend's
+  ``GET /health`` on `probe_interval_s`, so a dead backend is discovered
+  (and a recovering one re-admitted) without sacrificing client requests;
+* **zero-byte retry**: a request whose upstream failed before ANY response
+  byte was forwarded to the client is transparently retried on a different
+  backend (bounded by `retry_attempts`, excluding backends already tried).
+  Mid-stream failures still surface as EOF — appending a second status
+  line to a half-streamed response would corrupt the client's stream;
+* **load shedding**: when no backend is even conceptually routable (every
+  breaker open or every backend draining), requests are shed immediately
+  with ``503 + Retry-After`` instead of burning the full `queue_timeout_s`
+  in the wait queue; saturated-but-healthy still queues and 429s;
+* **control endpoints**: ``GET /gateway/stats`` (per-backend inflight,
+  breaker state, failure/retry counters, queue depth) and
+  ``POST /gateway/drain?backend=host:port`` / ``undrain`` — draining stops
+  new assignments while inflight requests finish;
 * thread-per-connection, streaming the backend response through unchanged
   (SSE included).
 
@@ -20,10 +41,16 @@ reference's replica-level DP (SURVEY.md §2 "DP / replica parallel").
 from __future__ import annotations
 
 import argparse
+import json
+import math
 import socket
 import threading
 import time
 from dataclasses import dataclass, field
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
 
 
 @dataclass
@@ -31,23 +58,75 @@ class Backend:
     host: str
     port: int
     inflight: int = 0
-    unhealthy_until: float = 0.0
+    draining: bool = False
+    # -- circuit breaker state (mutated only under the Balancer lock) --
+    breaker: str = BREAKER_CLOSED
+    consecutive_failures: int = 0
+    open_until: float = 0.0  # monotonic deadline while OPEN
+    backoff_s: float = 0.0  # current backoff (0 = next open uses the initial)
+    # HALF_OPEN single-trial slot: None = free, "probe" = the prober owns
+    # it, "request" = a client request owns it. A request-trial is only
+    # admitted when inflight == 0, so while trial_kind == "request" the ONE
+    # inflight request IS the trial — release() can attribute the outcome
+    # without per-request identity
+    trial_kind: str | None = None
+    # -- counters (observability; monotonic) --
+    n_served: int = 0
+    n_failures: int = 0
+    n_retries_away: int = 0  # zero-byte failures retried onto another backend
+    n_breaker_opens: int = 0
+    n_probes_ok: int = 0
+    n_probes_failed: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
 
 
 @dataclass
 class GatewayConfig:
     backends: list
     max_inflight_per_backend: int = 4
-    health_retry_ms: int = 3000
     connect_timeout_s: float = 5.0
+    # upstream read timeout: a backend that accepts but never answers (the
+    # slow-loris failure mode) is treated as failed — with zero bytes
+    # forwarded that means a transparent retry, not a hung client
+    upstream_read_timeout_s: float = 600.0
     # bounded wait queue: when every backend is saturated, up to queue_size
     # requests wait (max queue_timeout_s) for capacity before 429 — the
     # reference queues to a cap first too (dllama-gateway.cpp:332-373)
     queue_size: int = 16
     queue_timeout_s: float = 30.0
+    # circuit breaker: this many CONSECUTIVE failures open the breaker for
+    # breaker_backoff_s, doubling per re-open up to breaker_backoff_max_s
+    breaker_failure_threshold: int = 3
+    breaker_backoff_s: float = 1.0
+    breaker_backoff_max_s: float = 30.0
+    # active prober: <= 0 disables (unit tests drive the breaker directly)
+    probe_interval_s: float = 2.0
+    probe_timeout_s: float = 2.0
+    probe_path: str = "/health"
+    # zero-byte retry: how many ADDITIONAL backends to try after a failure
+    # that forwarded nothing to the client
+    retry_attempts: int = 2
+    # legacy knob (the old fixed blackout). When set, it seeds the breaker's
+    # INITIAL backoff so old call sites keep their intent: "don't re-admit a
+    # failed backend for N ms" becomes the first open interval.
+    health_retry_ms: int | None = None
+
+    def __post_init__(self):
+        if self.health_retry_ms is not None:
+            self.breaker_backoff_s = self.health_retry_ms / 1000.0
+            self.breaker_backoff_max_s = max(
+                self.breaker_backoff_max_s, self.breaker_backoff_s
+            )
 
 
 class Balancer:
+    # acquire() sentinels
+    BUSY = -1  # saturated (queue full or queued wait timed out) -> 429
+    SHED = -2  # no routable backend at all (breakers open / draining) -> 503
+
     def __init__(self, config: GatewayConfig):
         self.config = config
         self.lock = threading.Lock()
@@ -59,38 +138,151 @@ class Balancer:
         # requests into 429 timeouts while latecomers sail through)
         self._queue: list[int] = []
         self._next_ticket = 0
+        # gateway-level counters (under the lock)
+        self.counters = {
+            "requests": 0,
+            "proxied_ok": 0,
+            "zero_byte_retries": 0,
+            "midstream_failures": 0,
+            "rejected_429": 0,
+            "shed_503": 0,
+            "bad_gateway_502": 0,
+        }
 
-    def _select_locked(self) -> int:
+    def count(self, name: str, n: int = 1):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    # -- breaker transitions (call under self.lock) -------------------------
+
+    def _maybe_half_open_locked(self, b: Backend, now: float):
+        if b.breaker == BREAKER_OPEN and now >= b.open_until:
+            b.breaker = BREAKER_HALF_OPEN
+            b.trial_kind = None
+
+    def _record_success_locked(self, b: Backend):
+        b.consecutive_failures = 0
+        b.backoff_s = 0.0
+        if b.breaker != BREAKER_CLOSED:
+            b.breaker = BREAKER_CLOSED
+            b.trial_kind = None
+
+    def _record_failure_locked(self, b: Backend, now: float):
+        b.consecutive_failures += 1
+        b.n_failures += 1
+        if b.breaker == BREAKER_OPEN:
+            # already open: a STALE failure (a request admitted before the
+            # breaker opened, finishing late) must not extend or double the
+            # backoff — escalation is driven by half-open trial outcomes
+            return
+        if (
+            b.breaker == BREAKER_HALF_OPEN
+            or b.consecutive_failures >= self.config.breaker_failure_threshold
+        ):
+            b.backoff_s = (
+                self.config.breaker_backoff_s
+                if b.backoff_s <= 0
+                else min(b.backoff_s * 2, self.config.breaker_backoff_max_s)
+            )
+            b.open_until = now + b.backoff_s
+            b.breaker = BREAKER_OPEN
+            b.trial_kind = None
+            b.n_breaker_opens += 1
+
+    def _assignable_locked(self, b: Backend, now: float) -> bool:
+        """May this backend receive a NEW client request right now?"""
+        if b.draining or b.inflight >= self.config.max_inflight_per_backend:
+            return False
+        self._maybe_half_open_locked(b, now)
+        if b.breaker == BREAKER_OPEN:
+            return False
+        if b.breaker == BREAKER_HALF_OPEN:
+            # exactly one trial at a time, and only onto an otherwise-idle
+            # backend — leftover pre-open inflight requests would make the
+            # trial's outcome unattributable at release time
+            return b.trial_kind is None and b.inflight == 0
+        return True
+
+    def _routable_in_principle_locked(self, exclude, now: float) -> bool:
+        """Is there any point waiting? True when some backend could take the
+        request once capacity frees (closed breaker, or a half-open trial in
+        flight that may succeed). All-open/all-draining means waiting burns
+        queue_timeout_s for nothing -> shed with 503."""
+        for i, b in enumerate(self.config.backends):
+            if i in exclude or b.draining:
+                continue
+            self._maybe_half_open_locked(b, now)
+            if b.breaker != BREAKER_OPEN:
+                return True
+        return False
+
+    def retry_after_hint_s(self) -> float:
+        """Seconds until the earliest open breaker re-admits a trial."""
+        with self.lock:
+            now = time.monotonic()
+            deadlines = [
+                b.open_until - now
+                for b in self.config.backends
+                if b.breaker == BREAKER_OPEN and not b.draining
+            ]
+        return max(0.0, min(deadlines)) if deadlines else 1.0
+
+    def _select_locked(self, exclude=frozenset()) -> int:
         now = time.monotonic()
         n = len(self.config.backends)
-        selected, min_inflight = -1, None
+        selected, best = -1, None
         for i in range(n):
             idx = (self.rr_cursor + i) % n
             b = self.config.backends[idx]
-            if b.unhealthy_until > now:
+            if idx in exclude or not self._assignable_locked(b, now):
                 continue
-            if b.inflight >= self.config.max_inflight_per_backend:
-                continue
-            if min_inflight is None or b.inflight < min_inflight:
-                min_inflight = b.inflight
+            # closed breakers beat half-open trials; a backend with PENDING
+            # consecutive failures (below the breaker threshold) only gets
+            # traffic when clean backends are saturated — without this, a
+            # black-holing backend (connect timeouts, inflight always 0)
+            # stays the least-inflight favorite and every request burns a
+            # connect timeout until the breaker finally opens; then
+            # least-inflight
+            score = (
+                0 if b.breaker == BREAKER_CLOSED else 1,
+                1 if b.consecutive_failures > 0 else 0,
+                b.inflight,
+            )
+            if best is None or score < best:
+                best = score
                 selected = idx
         if selected >= 0:
-            self.config.backends[selected].inflight += 1
+            b = self.config.backends[selected]
+            b.inflight += 1
+            if b.breaker == BREAKER_HALF_OPEN:
+                b.trial_kind = "request"
             self.rr_cursor = (selected + 1) % n
         return selected
 
-    def acquire(self) -> int:
-        """Returns backend index, or -1 when every backend is saturated AND
-        the wait queue is full (or the queued wait timed out)."""
+    def acquire(self, exclude=frozenset()) -> int:
+        """Returns a backend index, or BUSY (-1) when every backend is
+        saturated AND the wait queue is full (or the queued wait timed out),
+        or SHED (-2) when no backend is routable at all (every breaker open
+        or every backend draining) — the caller should 503 immediately."""
+        exclude = frozenset(exclude)
         with self.cond:
+            if not self._routable_in_principle_locked(exclude, time.monotonic()):
+                return self.SHED
             # fast path only when nobody is already waiting — otherwise this
             # caller must take its place at the back of the line
             if not self._queue:
-                idx = self._select_locked()
+                idx = self._select_locked(exclude)
                 if idx >= 0:
                     return idx
+            if exclude:
+                # a zero-byte retry is opportunistic: it must NOT join the
+                # FIFO queue, where its exclude set would sit at the head
+                # idling capacity on its excluded backend (only the head may
+                # claim, and tickets don't carry excludes) while waiters
+                # behind it could have used that slot
+                return self.BUSY
             if len(self._queue) >= self.config.queue_size:
-                return -1  # queue full -> immediate 429
+                return self.BUSY  # queue full -> immediate 429
             ticket = self._next_ticket
             self._next_ticket += 1
             self._queue.append(ticket)
@@ -99,14 +291,21 @@ class Balancer:
                 while True:
                     # only the head of the line may claim capacity
                     if self._queue[0] == ticket:
-                        idx = self._select_locked()
+                        idx = self._select_locked(exclude)
                         if idx >= 0:
                             return idx
-                    remaining = deadline - time.monotonic()
+                    now = time.monotonic()
+                    # conditions changed mid-wait? (breaker opened on the
+                    # last healthy backend) -> shed instead of burning the
+                    # remaining timeout
+                    if not self._routable_in_principle_locked(exclude, now):
+                        return self.SHED
+                    remaining = deadline - now
                     if remaining <= 0:
-                        return -1
-                    # short wait slices so an unhealthy backend coming back
-                    # (a timed event no release() announces) is picked up
+                        return self.BUSY
+                    # short wait slices so a timed event no release()
+                    # announces — a breaker's backoff elapsing into
+                    # half-open — is picked up mid-wait
                     self.cond.wait(min(remaining, 0.25))
             finally:
                 self._queue.remove(ticket)
@@ -121,9 +320,172 @@ class Balancer:
             b = self.config.backends[idx]
             if b.inflight > 0:
                 b.inflight -= 1
+            # the admission precondition (trial only onto an idle backend)
+            # makes the sole inflight request the trial — this release
+            # resolves it. A "probe" trial is resolved only by record_probe;
+            # an old request completing must not clear it
+            was_trial = b.trial_kind == "request"
+            if was_trial:
+                b.trial_kind = None
             if mark_unhealthy:
-                b.unhealthy_until = time.monotonic() + self.config.health_retry_ms / 1000.0
+                self._record_failure_locked(b, time.monotonic())
+            else:
+                b.n_served += 1
+                if was_trial or b.breaker == BREAKER_CLOSED:
+                    self._record_success_locked(b)
+                # else: a STALE success — a request admitted before the
+                # breaker opened, finishing late. It must not close an open
+                # breaker and zero the backoff escalation; re-admission goes
+                # through the attributed half-open trial
             self.cond.notify_all()
+
+    # -- prober interface ---------------------------------------------------
+
+    def claim_probe(self, idx: int) -> bool:
+        """May the prober check this backend now? CLOSED backends are always
+        checkable (proactive death detection); OPEN ones only once their
+        backoff elapsed — the probe then becomes the half-open trial."""
+        with self.lock:
+            b = self.config.backends[idx]
+            self._maybe_half_open_locked(b, time.monotonic())
+            if b.breaker == BREAKER_CLOSED:
+                # only probe IDLE closed backends: a serialized (batch=1)
+                # replica handles one connection at a time, so a probe
+                # racing a long completion would time out and open the
+                # breaker on a healthy-but-busy backend. With requests in
+                # flight, their outcomes are the health signal
+                return b.inflight == 0
+            if b.breaker == BREAKER_HALF_OPEN and b.trial_kind is None:
+                b.trial_kind = "probe"
+                return True
+            return False
+
+    def record_probe(self, idx: int, ok: bool):
+        with self.cond:
+            b = self.config.backends[idx]
+            was_trial = b.trial_kind == "probe"
+            if was_trial:
+                b.trial_kind = None
+            if ok:
+                b.n_probes_ok += 1
+                if was_trial or b.breaker == BREAKER_CLOSED:
+                    self._record_success_locked(b)
+                # else: the breaker opened while this (pre-open) probe was in
+                # flight — stale evidence, leave re-admission to a fresh trial
+            else:
+                if not was_trial and b.breaker == BREAKER_CLOSED and b.inflight > 0:
+                    # ambiguous timeout: a request was assigned after the
+                    # idle-claim and a serialized backend answers one
+                    # connection at a time — that request's outcome is the
+                    # health signal, not this probe's
+                    pass
+                else:
+                    b.n_probes_failed += 1
+                    self._record_failure_locked(b, time.monotonic())
+            self.cond.notify_all()
+
+    # -- operator controls --------------------------------------------------
+
+    def _find(self, key: str) -> int:
+        for i, b in enumerate(self.config.backends):
+            if b.key == key:
+                return i
+        return -1
+
+    def set_draining(self, key: str, draining: bool) -> bool:
+        with self.cond:
+            idx = self._find(key)
+            if idx < 0:
+                return False
+            self.config.backends[idx].draining = draining
+            self.cond.notify_all()
+            return True
+
+    def reset_breaker(self, idx: int):
+        """Force-close a breaker (operator/test override after a restart)."""
+        with self.cond:
+            self._record_success_locked(self.config.backends[idx])
+            self.cond.notify_all()
+
+    def stats(self) -> dict:
+        with self.lock:
+            now = time.monotonic()
+            backends = []
+            for b in self.config.backends:
+                backends.append(
+                    {
+                        "backend": b.key,
+                        "inflight": b.inflight,
+                        "draining": b.draining,
+                        "breaker": b.breaker,
+                        "consecutive_failures": b.consecutive_failures,
+                        "open_for_ms": max(0, int((b.open_until - now) * 1000))
+                        if b.breaker == BREAKER_OPEN
+                        else 0,
+                        "served": b.n_served,
+                        "failures": b.n_failures,
+                        "retries_away": b.n_retries_away,
+                        "breaker_opens": b.n_breaker_opens,
+                        "probes_ok": b.n_probes_ok,
+                        "probes_failed": b.n_probes_failed,
+                    }
+                )
+            return {
+                "backends": backends,
+                "queue_depth": len(self._queue),
+                "counters": dict(self.counters),
+            }
+
+
+class HealthProber(threading.Thread):
+    """Background active prober: one ``GET /health`` per backend per
+    interval. Probe outcomes drive the same breaker transitions as request
+    outcomes, so a dead backend opens its breaker before any client lands on
+    it and a recovered one is re-admitted via the half-open trial."""
+
+    def __init__(self, balancer: Balancer, stop_event: threading.Event):
+        super().__init__(daemon=True, name="gateway-prober")
+        self.balancer = balancer
+        self.stop_event = stop_event
+
+    def probe_once(self):
+        cfg = self.balancer.config
+        for idx in range(len(cfg.backends)):
+            if self.stop_event.is_set():
+                return
+            if not self.balancer.claim_probe(idx):
+                continue
+            b = cfg.backends[idx]
+            ok = probe_health(
+                b.host, b.port, cfg.probe_timeout_s, cfg.probe_path
+            )
+            self.balancer.record_probe(idx, ok)
+
+    def run(self):
+        interval = self.balancer.config.probe_interval_s
+        while not self.stop_event.wait(interval):
+            self.probe_once()
+
+
+def probe_health(host: str, port: int, timeout_s: float, path: str = "/health") -> bool:
+    """One health-check round trip; True iff the backend answered 200."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            s.sendall(
+                f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                "Connection: close\r\n\r\n".encode()
+            )
+            data = b""
+            while b"\r\n" not in data:
+                chunk = s.recv(1024)
+                if not chunk:
+                    break
+                data += chunk
+            parts = data.split(b"\r\n", 1)[0].split()
+            return len(parts) >= 2 and parts[0].startswith(b"HTTP/") and parts[1] == b"200"
+    except OSError:
+        return False
 
 
 def _read_http_request(sock: socket.socket) -> bytes | None:
@@ -153,12 +515,26 @@ def _read_http_request(sock: socket.socket) -> bytes | None:
     return b"\r\n".join(lines) + b"\r\n\r\n" + rest
 
 
-def _plain_response(sock: socket.socket, code: int, text: str, body: str):
+def _request_line(request: bytes) -> tuple[str, str]:
+    """(method, path) from the raw request bytes; ("", "") if unparseable."""
+    try:
+        first = request.split(b"\r\n", 1)[0].decode("latin-1")
+        method, path, _ = first.split(" ", 2)
+        return method.upper(), path
+    except ValueError:
+        return "", ""
+
+
+def _plain_response(
+    sock: socket.socket, code: int, text: str, body: str, headers: dict | None = None
+):
     payload = body.encode()
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
     resp = (
         f"HTTP/1.1 {code} {text}\r\n"
         "Content-Type: application/json; charset=utf-8\r\n"
         "Connection: close\r\n"
+        f"{extra}"
         f"Content-Length: {len(payload)}\r\n\r\n"
     ).encode() + payload
     try:
@@ -167,44 +543,138 @@ def _plain_response(sock: socket.socket, code: int, text: str, body: str):
         pass
 
 
+def _handle_control(client: socket.socket, balancer: Balancer, method: str, path: str):
+    """The gateway's own control endpoints (never proxied)."""
+    route, _, query = path.partition("?")
+    if route == "/gateway/stats" and method == "GET":
+        _plain_response(client, 200, "OK", json.dumps(balancer.stats()))
+        return
+    if route in ("/gateway/drain", "/gateway/undrain") and method == "POST":
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        key = params.get("backend", "")
+        draining = route == "/gateway/drain"
+        if balancer.set_draining(key, draining):
+            _plain_response(
+                client, 200, "OK",
+                json.dumps({"backend": key, "draining": draining}),
+            )
+        else:
+            _plain_response(
+                client, 404, "Not Found",
+                json.dumps({"error": f"unknown backend {key!r}"}),
+            )
+        return
+    _plain_response(client, 404, "Not Found", '{"error":"not found"}')
+
+
+def _proxy_once(client, request, b: Backend, config) -> tuple[bool, bool, bool]:
+    """Forward `request` to backend `b`, streaming the response to `client`.
+    Returns (failed, forwarded_any, client_gone): `failed` = the UPSTREAM
+    leg errored; `forwarded_any` = at least one response byte reached the
+    client (the zero-byte-retry eligibility bit); `client_gone` = the CLIENT
+    socket died (not the backend's fault — never counts against it)."""
+    forwarded = False
+    try:
+        with socket.create_connection(
+            (b.host, b.port), timeout=config.connect_timeout_s
+        ) as upstream:
+            upstream.sendall(request)
+            upstream.settimeout(config.upstream_read_timeout_s)
+            while True:
+                chunk = upstream.recv(16384)
+                if not chunk:
+                    # EOF before ANY response byte is a failure too (backend
+                    # accepted, then FIN-closed mid-shutdown): an HTTP
+                    # response is never legitimately empty, and treating it
+                    # as success would hand the client an empty reply
+                    # instead of the zero-byte retry
+                    return not forwarded, forwarded, False
+                try:
+                    client.sendall(chunk)
+                except OSError:
+                    return False, forwarded, True
+                forwarded = True
+    except OSError:
+        return True, forwarded, False
+
+
 def handle_client(client: socket.socket, balancer: Balancer):
     config = balancer.config
-    backend_idx = -1
+    held = -1  # acquired-but-unreleased backend (crash safety net)
     try:
         request = _read_http_request(client)
         if not request:
             return
-        backend_idx = balancer.acquire()
-        if backend_idx < 0:
-            _plain_response(client, 429, "Too Many Requests", '{"error":"all backends busy"}')
+        method, path = _request_line(request)
+        if path.startswith("/gateway/"):
+            _handle_control(client, balancer, method, path)
             return
-        b = config.backends[backend_idx]
-        failed = False
-        forwarded = False
-        try:
-            with socket.create_connection(
-                (b.host, b.port), timeout=config.connect_timeout_s
-            ) as upstream:
-                upstream.sendall(request)
-                upstream.settimeout(600)
-                while True:
-                    chunk = upstream.recv(16384)
-                    if not chunk:
-                        break
-                    client.sendall(chunk)
-                    forwarded = True
-        except OSError:
-            failed = True
-            # only emit a 502 if nothing was forwarded yet — appending a
-            # second status line to a partially streamed response would
-            # corrupt the client's stream; mid-stream failures surface as EOF
-            if not forwarded:
-                _plain_response(client, 502, "Bad Gateway", '{"error":"backend failure"}')
-        balancer.release(backend_idx, mark_unhealthy=failed)
-        backend_idx = -1
+        balancer.count("requests")
+        tried: set[int] = set()
+        while True:
+            idx = balancer.acquire(exclude=tried)
+            held = idx if idx >= 0 else -1
+            if idx < 0 and tried:
+                # this request already failed zero-byte on some backend and
+                # no alternative can take it (every other backend excluded,
+                # open, or full): the original failure is the honest signal
+                # — 502, not a shed/busy code that would misattribute it
+                balancer.count("bad_gateway_502")
+                _plain_response(
+                    client, 502, "Bad Gateway", '{"error":"backend failure"}'
+                )
+                return
+            if idx == Balancer.SHED:
+                balancer.count("shed_503")
+                retry_after = max(1, math.ceil(balancer.retry_after_hint_s()))
+                _plain_response(
+                    client, 503, "Service Unavailable",
+                    '{"error":"no healthy backend"}',
+                    headers={"Retry-After": str(retry_after)},
+                )
+                return
+            if idx < 0:
+                balancer.count("rejected_429")
+                _plain_response(
+                    client, 429, "Too Many Requests",
+                    '{"error":"all backends busy"}',
+                )
+                return
+            b = config.backends[idx]
+            failed, forwarded, client_gone = _proxy_once(client, request, b, config)
+            balancer.release(idx, mark_unhealthy=failed)
+            held = -1
+            if client_gone:
+                return
+            if not failed:
+                balancer.count("proxied_ok")
+                return
+            if forwarded:
+                # mid-stream failure: appending a second status line to a
+                # partially streamed response would corrupt the client's
+                # stream; EOF is the only honest signal left — no retry
+                balancer.count("midstream_failures")
+                return
+            # zero bytes reached the client: transparently retry on a
+            # DIFFERENT backend (bounded; the failed one is excluded)
+            tried.add(idx)
+            if len(tried) > config.retry_attempts:
+                balancer.count("bad_gateway_502")
+                _plain_response(
+                    client, 502, "Bad Gateway", '{"error":"backend failure"}'
+                )
+                return
+            with balancer.lock:
+                b.n_retries_away += 1
+            balancer.count("zero_byte_retries")
     finally:
-        if backend_idx >= 0:
-            balancer.release(backend_idx, mark_unhealthy=False)
+        if held >= 0:
+            # an unexpected exception escaped between acquire and release:
+            # give the slot back (a leak here would silently and permanently
+            # remove the backend from rotation once it eats the inflight cap)
+            balancer.release(held, mark_unhealthy=False)
         try:
             client.close()
         except OSError:
@@ -222,8 +692,13 @@ def serve(port: int, balancer: Balancer) -> socket.socket:
 def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
     srv = serve(port, balancer)
     srv.settimeout(0.5)
+    stop = stop_event if stop_event is not None else threading.Event()
+    prober = None
+    if balancer.config.probe_interval_s > 0:
+        prober = HealthProber(balancer, stop)
+        prober.start()
     print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
-    while stop_event is None or not stop_event.is_set():
+    while not stop.is_set():
         try:
             client, _ = srv.accept()
         except socket.timeout:
@@ -242,16 +717,32 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=9999)
     p.add_argument("--backend", action="append", required=True, help="host:port (repeatable)")
     p.add_argument("--max-inflight-per-backend", type=int, default=4)
-    p.add_argument("--health-retry-ms", type=int, default=3000)
     p.add_argument("--queue-size", type=int, default=16)
     p.add_argument("--queue-timeout-s", type=float, default=30.0)
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive failures before the breaker opens")
+    p.add_argument("--breaker-backoff-s", type=float, default=1.0)
+    p.add_argument("--breaker-backoff-max-s", type=float, default=30.0)
+    p.add_argument("--probe-interval-s", type=float, default=2.0,
+                   help="active /health probe interval; <=0 disables")
+    p.add_argument("--retry-attempts", type=int, default=2,
+                   help="additional backends tried after a zero-byte failure")
+    p.add_argument("--upstream-timeout-s", type=float, default=600.0)
+    p.add_argument("--health-retry-ms", type=int, default=None,
+                   help="legacy: seeds the breaker's initial backoff")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
         max_inflight_per_backend=args.max_inflight_per_backend,
-        health_retry_ms=args.health_retry_ms,
         queue_size=args.queue_size,
         queue_timeout_s=args.queue_timeout_s,
+        breaker_failure_threshold=args.breaker_threshold,
+        breaker_backoff_s=args.breaker_backoff_s,
+        breaker_backoff_max_s=args.breaker_backoff_max_s,
+        probe_interval_s=args.probe_interval_s,
+        retry_attempts=args.retry_attempts,
+        upstream_read_timeout_s=args.upstream_timeout_s,
+        health_retry_ms=args.health_retry_ms,
     )
     run(args.port, Balancer(config))
     return 0

@@ -249,7 +249,7 @@ def test_grouped_quant_kernel_under_ep():
     kernel."""
     from functools import partial
 
-    from jax import shard_map
+    from distributed_llama_tpu.parallel.pipeline import shard_map  # version compat
     from jax.sharding import PartitionSpec as P
 
     from distributed_llama_tpu.formats.quants import quantize_q40, unpack_q40

@@ -8,7 +8,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
+from distributed_llama_tpu.parallel.pipeline import shard_map  # version compat
 from jax.sharding import PartitionSpec as P
 
 from distributed_llama_tpu.formats.mfile import ArchType, MFileReader

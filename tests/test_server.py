@@ -262,16 +262,199 @@ class TestBalancer:
         assert [tag for tag, _ in order] == [0, 1, 2]
         assert all(idx == 0 for _, idx in order)
 
-    def test_unhealthy_cooldown(self):
-        b = Balancer(self.cfg(n=2))
+    def test_breaker_opens_after_threshold_and_routes_around(self):
+        cfg = self.cfg(n=2, cap=2)
+        cfg.breaker_failure_threshold = 2
+        cfg.breaker_backoff_s = 60.0  # recovery driven explicitly below
+        b = Balancer(cfg)
         idx = b.acquire()
         b.release(idx, mark_unhealthy=True)
-        # unhealthy backend is skipped until cooldown expires
+        # ONE failure is below the threshold: the backend is deprioritized
+        # (clean backends win first) but still assignable once they fill up
+        other = 1 - idx
+        got1, got2 = b.acquire(), b.acquire()
+        assert got1 == other and got2 == other  # clean backend preferred
+        got3 = b.acquire()
+        assert got3 == idx  # clean one saturated -> failed-once backend serves
+        b.release(got3, mark_unhealthy=True)  # second consecutive failure
+        from distributed_llama_tpu.server.gateway import BREAKER_OPEN
+
+        assert cfg.backends[idx].breaker == BREAKER_OPEN
+        b.release(got1, mark_unhealthy=False)
+        b.release(got2, mark_unhealthy=False)
+        # open breaker is skipped
         for _ in range(4):
             got = b.acquire()
             assert got != idx
             b.release(got, mark_unhealthy=False)
-        b.config.backends[idx].unhealthy_until = 0.0
+        # operator/test override re-admits it
+        b.reset_breaker(idx)
+        seen = {b.acquire() for _ in range(2)}
+        assert idx in seen
+
+    def test_half_open_admits_single_trial_then_closes(self):
+        from distributed_llama_tpu.server.gateway import (
+            BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+        )
+
+        cfg = self.cfg(n=1, cap=4)
+        cfg.breaker_failure_threshold = 1
+        cfg.breaker_backoff_s = 0.05
+        b = Balancer(cfg)
+        b.release(b.acquire(), mark_unhealthy=True)
+        assert cfg.backends[0].breaker == BREAKER_OPEN
+        assert b.acquire() == Balancer.SHED  # still backing off
+        time.sleep(0.08)
+        # backoff elapsed: exactly ONE trial may proceed
+        assert b.acquire() == 0
+        assert cfg.backends[0].breaker == BREAKER_HALF_OPEN
+        # trial in flight: a 2nd caller is refused capacity (BUSY, not
+        # SHED — the in-flight trial may well succeed, so waiting is sane)
+        assert b.acquire() == Balancer.BUSY
+        b.release(0, mark_unhealthy=False)  # trial succeeded
+        assert cfg.backends[0].breaker == BREAKER_CLOSED
+        assert b.acquire() == 0  # fully re-admitted
+
+    def test_half_open_failure_doubles_backoff(self):
+        cfg = self.cfg(n=1, cap=4)
+        cfg.breaker_failure_threshold = 1
+        cfg.breaker_backoff_s = 0.05
+        cfg.breaker_backoff_max_s = 10.0
+        b = Balancer(cfg)
+        b.release(b.acquire(), mark_unhealthy=True)
+        first = cfg.backends[0].backoff_s
+        time.sleep(0.08)
+        assert b.acquire() == 0  # half-open trial
+        b.release(0, mark_unhealthy=True)  # trial failed
+        assert cfg.backends[0].backoff_s == first * 2
+
+    def test_breaker_reentry_mid_wait(self):
+        """A QUEUED waiter picks up a backend whose breaker backoff elapses
+        mid-wait (a timed event no release() announces): backend 0 is
+        saturated, backend 1's breaker is open with a short backoff — the
+        waiter must come back with backend 1, well before the queue
+        timeout."""
+        cfg = self.cfg(n=2, cap=1, queue_size=4, queue_timeout_s=10.0)
+        cfg.breaker_failure_threshold = 1
+        cfg.breaker_backoff_s = 0.4
+        b = Balancer(cfg)
+        # open backend 1's breaker
+        got = b.acquire()
+        if got == 0:
+            hold0 = got
+            got1 = b.acquire()
+            assert got1 == 1
+            b.release(got1, mark_unhealthy=True)
+        else:
+            b.release(got, mark_unhealthy=True)
+            hold0 = b.acquire()
+            assert hold0 == 0
+        # backend 0 saturated (cap 1, held), backend 1 open -> must queue
+        t0 = time.monotonic()
+        res = []
+        t = threading.Thread(target=lambda: res.append(b.acquire()))
+        t.start()
+        t.join(timeout=5)
+        waited = time.monotonic() - t0
+        assert res == [1], res  # picked up the half-open trial mid-wait
+        assert 0.2 < waited < 5.0, waited
+        b.release(1, mark_unhealthy=False)
+        b.release(hold0, mark_unhealthy=False)
+
+    def test_shed_when_no_backend_routable(self):
+        """Every breaker open -> acquire sheds IMMEDIATELY (503 path), it
+        does not burn queue_timeout_s waiting for capacity that cannot
+        come."""
+        cfg = self.cfg(n=2, cap=1, queue_size=4, queue_timeout_s=30.0)
+        cfg.breaker_failure_threshold = 1
+        cfg.breaker_backoff_s = 60.0
+        b = Balancer(cfg)
+        for _ in range(2):
+            b.release(b.acquire(), mark_unhealthy=True)
+        t0 = time.monotonic()
+        assert b.acquire() == Balancer.SHED
+        assert time.monotonic() - t0 < 1.0
+        assert b.retry_after_hint_s() > 0
+
+    def test_shed_mid_wait_when_last_backend_opens(self):
+        """A waiter queued behind a saturated (healthy) backend sheds early
+        when that backend's breaker opens mid-wait."""
+        cfg = self.cfg(n=1, cap=1, queue_size=4, queue_timeout_s=30.0)
+        cfg.breaker_failure_threshold = 1
+        cfg.breaker_backoff_s = 60.0
+        b = Balancer(cfg)
+        idx = b.acquire()
+        res = []
+        t = threading.Thread(target=lambda: res.append(b.acquire()))
+        t.start()
+        time.sleep(0.2)
+        assert res == []  # queued
+        t0 = time.monotonic()
+        b.release(idx, mark_unhealthy=True)  # opens the only breaker
+        t.join(timeout=5)
+        assert res == [Balancer.SHED]
+        assert time.monotonic() - t0 < 2.0  # did not wait out the 30s
+
+    def test_stale_outcomes_do_not_resolve_open_breaker(self):
+        """A request admitted BEFORE the breaker opened must not, on late
+        completion, close the breaker (success) or extend/double the backoff
+        (failure) — re-admission belongs to the attributed half-open trial."""
+        from distributed_llama_tpu.server.gateway import BREAKER_OPEN
+
+        cfg = self.cfg(n=1, cap=4)
+        cfg.breaker_failure_threshold = 2
+        cfg.breaker_backoff_s = 60.0
+        b = Balancer(cfg)
+        # two long-running requests admitted while healthy
+        stale_a, stale_b = b.acquire(), b.acquire()
+        assert (stale_a, stale_b) == (0, 0)
+        for _ in range(2):  # two newer requests fail -> breaker opens
+            b.release(b.acquire(), mark_unhealthy=True)
+        assert cfg.backends[0].breaker == BREAKER_OPEN
+        backoff = cfg.backends[0].backoff_s
+        deadline = cfg.backends[0].open_until
+        # stale FAILURE: counted, but no re-open/doubling
+        b.release(stale_a, mark_unhealthy=True)
+        assert cfg.backends[0].backoff_s == backoff
+        assert cfg.backends[0].open_until == deadline
+        # stale SUCCESS: breaker stays open, backoff not zeroed
+        b.release(stale_b, mark_unhealthy=False)
+        assert cfg.backends[0].breaker == BREAKER_OPEN
+        assert cfg.backends[0].backoff_s == backoff
+
+    def test_probe_timeout_on_busy_backend_is_ignored(self):
+        """A probe that raced a just-assigned request on a CLOSED backend
+        (serialized backends answer one connection at a time) is ambiguous:
+        it must not count a failure against a healthy backend."""
+        b = Balancer(self.cfg(n=1, cap=4))
+        assert b.claim_probe(0)
+        idx = b.acquire()  # request lands while the probe is in flight
+        assert idx == 0
+        b.record_probe(0, False)  # probe timed out behind the request
+        assert b.config.backends[0].consecutive_failures == 0
+        assert b.config.backends[0].n_probes_failed == 0
+        # idle-backend probe failures still count
+        b.release(idx, mark_unhealthy=False)
+        b.record_probe(0, False)
+        assert b.config.backends[0].consecutive_failures == 1
+        assert b.config.backends[0].n_probes_failed == 1
+
+    def test_drain_stops_new_assignments_inflight_finishes(self):
+        cfg = self.cfg(n=2)
+        b = Balancer(cfg)
+        idx = b.acquire()
+        key = cfg.backends[idx].key
+        assert b.set_draining(key, True)
+        # no NEW assignments land on the draining backend
+        for _ in range(4):
+            got = b.acquire()
+            assert got != idx
+            b.release(got, mark_unhealthy=False)
+        # the inflight request finishes normally and is counted served
+        b.release(idx, mark_unhealthy=False)
+        assert cfg.backends[idx].n_served == 1
+        assert b.set_draining(key, False)
+        assert b.set_draining("10.0.0.1:1", False) is False  # unknown
         seen = {b.acquire() for _ in range(2)}
         assert idx in seen
 
@@ -280,11 +463,12 @@ def test_gateway_proxies_to_api(api_server):
     gw_port = free_port()
     config = GatewayConfig(
         backends=[
-            Backend("127.0.0.1", 1),  # dead backend -> marked unhealthy
+            Backend("127.0.0.1", 1),  # dead backend
             Backend("127.0.0.1", api_server),
         ],
         health_retry_ms=60000,
         connect_timeout_s=0.5,
+        probe_interval_s=0,  # deterministic: breaker driven by requests only
     )
     stop = threading.Event()
     t = threading.Thread(
@@ -295,20 +479,12 @@ def test_gateway_proxies_to_api(api_server):
 
     time.sleep(0.3)
     try:
-        # first request may land on the dead backend (502) and mark it
-        # unhealthy; retry then always routes to the live one
-        ok = None
-        for _ in range(3):
-            try:
-                with _post(gw_port, {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 4}) as r:
-                    ok = json.loads(r.read())
-                    break
-            except urllib.error.HTTPError as e:
-                assert e.code == 502
-        assert ok is not None and ok["object"] == "chat.completion"
-        # dead backend now unhealthy; all traffic flows
-        with _post(gw_port, {"messages": [{"role": "user", "content": "again"}], "max_tokens": 4}) as r:
-            assert json.loads(r.read())["object"] == "chat.completion"
+        # a request landing on the dead backend forwarded zero bytes, so the
+        # gateway transparently retries it on the live one — the client must
+        # NEVER see the 502 the seed gateway surfaced here
+        for text in ("hi", "again"):
+            with _post(gw_port, {"messages": [{"role": "user", "content": text}], "max_tokens": 4}) as r:
+                assert json.loads(r.read())["object"] == "chat.completion"
     finally:
         stop.set()
 
@@ -694,9 +870,10 @@ def gateway_stack(tmp_path_factory):
     cfg = GatewayConfig(
         backends=[Backend("127.0.0.1", p) for p in ports],
         max_inflight_per_backend=4,
-        health_retry_ms=120000,  # tests control recovery explicitly
+        health_retry_ms=120000,  # breaker backoff: tests control recovery
         queue_size=4,
         queue_timeout_s=5.0,
+        probe_interval_s=0,  # deterministic: no prober racing the asserts
     )
     bal = Balancer(cfg)
     gw_port = free_port()
@@ -777,35 +954,35 @@ def test_gateway_balances_load_across_backends(gateway_stack):
     assert all(served), f"a replica served nothing: before={before} after={after}"
 
 
-def test_gateway_502_then_routes_around_dead_backend(gateway_stack):
-    """Killing one replica: at most one request eats the 502 (which marks
-    the backend unhealthy), every later request lands on the survivor;
-    clearing the cooldown after a restart brings the replica back."""
+def test_gateway_routes_around_dead_backend_with_zero_client_errors(gateway_stack):
+    """Killing one replica: NO request sees an error — a dead-backend hit
+    forwards zero bytes and is transparently retried on the survivor (the
+    seed gateway let one client eat a 502 here). The victim's consecutive
+    failures open its breaker; a restart + breaker reset re-admits it."""
     gw = gateway_stack["gw"]
     cfg = gateway_stack["cfg"]
+    bal = gateway_stack["bal"]
     victim = gateway_stack["servers"][1]
     victim.shutdown()
     victim.server_close()
 
-    codes = []
-    for i in range(4):
-        try:
-            with _post(gw, {"messages": [{"role": "user", "content": f"x{i}"}],
-                            "max_tokens": 3}) as r:
-                json.loads(r.read())
-                codes.append(200)
-        except urllib.error.HTTPError as e:
-            codes.append(e.code)
-    assert codes.count(200) >= 3, codes
-    assert all(c in (200, 502) for c in codes), codes
-    if 502 in codes:
-        assert cfg.backends[1].unhealthy_until > time.monotonic()
+    for i in range(6):
+        with _post(gw, {"messages": [{"role": "user", "content": f"x{i}"}],
+                        "max_tokens": 3}) as r:
+            assert json.loads(r.read())["usage"]["completion_tokens"] > 0
+    # the victim accumulated consecutive zero-byte failures; past the
+    # threshold its breaker opened (no prober in this fixture — request
+    # outcomes alone drive it)
+    assert cfg.backends[1].n_failures >= 1
+    st = bal.stats()
+    assert st["counters"]["zero_byte_retries"] >= 1
+    assert st["counters"]["bad_gateway_502"] == 0
 
-    # recovery: restart on the same port, cooldown elapses
+    # recovery: restart on the same port, force the breaker shut
     gateway_stack["servers"][1] = _mk_api_server(
         gateway_stack["mp"], gateway_stack["tp"], gateway_stack["ports"][1]
     )
-    cfg.backends[1].unhealthy_until = 0.0
+    bal.reset_breaker(1)
     ok = 0
     for i in range(4):
         with _post(gw, {"messages": [{"role": "user", "content": f"y{i}"}],
@@ -835,6 +1012,7 @@ def test_gateway_429_past_queue_cap():
         max_inflight_per_backend=1,
         queue_size=1,
         queue_timeout_s=0.4,
+        probe_interval_s=0,
     )
     bal = Balancer(cfg)
     gw_port = free_port()
