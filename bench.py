@@ -23,7 +23,11 @@ Measurement notes:
   64-step on-device chunks and prefill with one big padded chunk, so the
   steady-state numbers below reflect device compute, not tunnel latency;
 * decode tok/s = median over measured decode chunks (chunk wall / tokens);
-* prefill tok/s = prompt tokens / synced prefill wall time.
+* prefill tok/s = prompt tokens / synced prefill wall time. The prefill
+  pipeline double-buffers chunk dispatches (input prep on a worker thread,
+  one bare ready-wait as the only sync); each leg reports
+  `prefill_dispatch_overlap_pct` — the share of the prefill wall spent
+  inside dispatches, i.e. how completely compute hid behind them.
 """
 
 import json
@@ -98,7 +102,9 @@ def ensure_moe() -> str:
 
 def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw):
     """(decode_tok_s, prefill_tok_s, ttft_ms, marginal_prefill,
-    wall_long, eng) where wall_long is (long_n, wall_ms) or None.
+    wall_long, ttft_cold_ms, overlap_pct, eng) where wall_long is
+    (long_n, wall_ms) or None and overlap_pct is the measured run's
+    prefill dispatch-vs-compute overlap (engine.last_prefill_timing).
 
     prefill_tok_s is the naive prompt/wall rate — at a 512-token prompt it
     is dominated by the ~70-90 ms tunnel dispatch of this environment, NOT
@@ -136,6 +142,10 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
     per_tok_us = statistics.median(s.eval_us / s.n_tokens for s in res.pred_steps)
     decode_tok_s = 1e6 / per_tok_us
     prefill_tok_s = res.eval_tok_per_s
+    # dispatch-vs-compute overlap of the measured run's prefill: the share
+    # of the prefill wall spent inside (async) chunk dispatches — ~100%
+    # means the final sync found the device already done (fully hidden)
+    overlap_pct = (eng.last_prefill_timing or {}).get("overlap_pct")
 
     # TTFT as a streaming client sees it: on_token enables the engine's
     # first-chunk ramp (chunk of 8), which non-streaming runs skip to keep
@@ -183,7 +193,10 @@ def measure(path: str, prefill_tokens: int, decode_tokens: int, max_seq=0, **ekw
         # the spreads tight enough that healthy windows rarely null out.
         if t_long - t_short > max(0.002, spread_long + spread_short):
             marginal = (long_n - prefill_tokens) / (t_long - t_short)
-    return decode_tok_s, prefill_tok_s, ttft_ms, marginal, wall_long_ms, ttft_cold_ms, eng
+    return (
+        decode_tok_s, prefill_tok_s, ttft_ms, marginal, wall_long_ms,
+        ttft_cold_ms, overlap_pct, eng,
+    )
 
 
 def leg_8b():
@@ -203,7 +216,9 @@ def leg_8b():
     prev = os.environ.get("DLT_STALL_TIMEOUT_MS")
     os.environ.setdefault("DLT_STALL_TIMEOUT_MS", "1800000")
     try:
-        decode, prefill, ttft, marginal, wall_long, ttft_cold, eng = measure(path, 512, 128)
+        decode, prefill, ttft, marginal, wall_long, ttft_cold, overlap, eng = measure(
+            path, 512, 128
+        )
     finally:
         if prev is None:
             os.environ.pop("DLT_STALL_TIMEOUT_MS", None)
@@ -223,6 +238,7 @@ def leg_8b():
         "prefill_tok_s_marginal": marginal and round(marginal, 1),
         "prefill_long_n": wall_long and wall_long[0],
         "prefill_wall_long_ms": wall_long and round(wall_long[1], 1),
+        "prefill_dispatch_overlap_pct": overlap,
         "ttft_ms": round(ttft, 1),
         "decode_eff_gb_s": round(gbs, 1),
         "hbm_roofline_pct": round(100 * gbs / 819, 1),
@@ -319,6 +335,76 @@ def leg_batched_serving():
     }
 
 
+def leg_serving_interleave():
+    """Decode-stream latency under a concurrently-prefilling long prompt —
+    the Batcher's interleaved-admission path (Sarathi-style chunked-prefill
+    piggyback). A live decode stream runs alone for a latency baseline, then
+    a 1.5k-token prompt is staged with `begin_admit` and its prefill
+    advances in bounded 256-token chunks BETWEEN the stream's decode chunks
+    (exactly the server loop's schedule). Reported: per-step p95 decode
+    latency solo vs interleaved (the acceptance bar is <=2x), and the
+    newcomer's prefill wall under interleaving."""
+    from distributed_llama_tpu.runtime.batch_session import BatchSession
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    path = ensure_model()
+    chunk = 64
+    budget = 256
+    eng = InferenceEngine(
+        path, compute_dtype="bfloat16", batch=2, max_chunk=budget,
+        decode_chunk_size=chunk,
+    )
+    long_prompt = [(i % 1000) + 1 for i in range(1536)]
+    short = [(i % 997) + 1 for i in range(128)]
+
+    def run(n_solo_chunks):
+        """One full cycle at the same positions/kv buckets: solo decode
+        chunk walls, then interleaved walls + the newcomer's prefill wall."""
+        session = BatchSession(eng)  # resets the engine/cache
+        session.admit(0, short)
+        solo = []
+        for _ in range(n_solo_chunks):
+            t0 = time.perf_counter()
+            session.step(chunk)
+            solo.append((time.perf_counter() - t0) * 1e3)
+        inter = []
+        t_admit = time.perf_counter()
+        session.begin_admit(1, long_prompt)
+        remaining = len(long_prompt) - 1
+        prefill_wall_ms = None
+        while remaining:
+            remaining = session.prefill_pending(1, budget)
+            if remaining == 0:
+                prefill_wall_ms = (time.perf_counter() - t_admit) * 1e3
+            t0 = time.perf_counter()
+            session.step(chunk)
+            inter.append((time.perf_counter() - t0) * 1e3)
+        session.release(0)
+        session.release(1)
+        return solo, inter, prefill_wall_ms
+
+    run(2)  # warmup: compiles the decode chunks + the admission ladder
+    solo, inter, prefill_wall_ms = run(6)
+
+    def p95(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.95))]
+
+    solo_step_p95 = p95(solo) / chunk
+    inter_step_p95 = p95(inter) / chunk
+    # the interleave wall includes the boundary prefill dispatch: per-step
+    # latency a co-batched stream actually observes during admission
+    return {
+        "config": "llama-1B q40 1chip interleaved-prefill b=2",
+        "decode_step_p95_ms_solo": round(solo_step_p95, 3),
+        "decode_step_p95_ms_while_prefill": round(inter_step_p95, 3),
+        "decode_p95_inflation_x": round(inter_step_p95 / solo_step_p95, 2),
+        "prefill_1535_wall_ms_interleaved": prefill_wall_ms
+        and round(prefill_wall_ms, 1),
+        "interleaved_prefill_chunks": len(inter),
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -367,13 +453,16 @@ def main():
     # headline: 1B Llama
     model_path = ensure_model()
     t0 = time.time()
-    # 384 decode tokens = THREE 128-chunks: the median then samples a
-    # steady-state chunk (lookahead fully hides the ~100 ms tunnel round
-    # trip behind 157 ms of chunk compute). At 4-bit the 1B computes
-    # 1.23 ms/token; a 2-chunk budget has only edge chunks and re-measures
-    # the tunnel, not the chip (r5: 595 vs 811 tok/s, same code)
-    decode, prefill, ttft, marginal, wall_long, ttft_cold, eng = measure(
-        model_path, 512, 384, decode_chunk_size=128
+    # 896 decode tokens = SEVEN 128-chunks, so the median samples among
+    # FIVE steady-state chunks (lookahead fully hides the ~100 ms tunnel
+    # round trip behind 157 ms of chunk compute). The r5 384-token budget
+    # had exactly ONE steady chunk between the two edge chunks: in a
+    # degraded window the edges win a 3-element median and the leg
+    # collapses (the 847-vs-730 PERF/BENCH discrepancy — VERDICT r5 weak
+    # #1). With >=5 steady chunks the median is a steady chunk in any
+    # window ordering.
+    decode, prefill, ttft, marginal, wall_long, ttft_cold, overlap, eng = measure(
+        model_path, 512, 896, decode_chunk_size=128
     )
     print(
         f"# llama1b: decode {decode:.1f} tok/s, prefill {prefill:.1f} tok/s "
@@ -390,6 +479,7 @@ def main():
             "prefill_tok_s_marginal": marginal and round(marginal, 1),
             "prefill_long_n": wall_long and wall_long[0],
             "prefill_wall_long_ms": wall_long and round(wall_long[1], 1),
+            "prefill_dispatch_overlap_pct": overlap,
             "ttft_ms": round(ttft, 1),
             "ttft_cold_ms": round(ttft_cold, 1),
         }
@@ -411,7 +501,7 @@ def main():
     ]
     for name, fn in extra_legs:
         try:
-            d, p, t, m, wl, tc, _ = fn()
+            d, p, t, m, wl, tc, ov, _ = fn()
             configs.append(
                 {
                     "config": name,
@@ -420,6 +510,7 @@ def main():
                     "prefill_tok_s_marginal": m and round(m, 1),
                     "prefill_long_n": wl and wl[0],
                     "prefill_wall_long_ms": wl and round(wl[1], 1),
+                    "prefill_dispatch_overlap_pct": ov,
                     "ttft_ms": round(t, 1),
                     "ttft_cold_ms": round(tc, 1),
                 }
@@ -441,6 +532,13 @@ def main():
         print(f"# batched-serving: {bs}", file=sys.stderr)
     except Exception as e:
         print(f"# batched-serving leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        il = leg_serving_interleave()
+        configs.append(il)
+        print(f"# interleaved-prefill: {il}", file=sys.stderr)
+    except Exception as e:
+        print(f"# interleaved-prefill leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

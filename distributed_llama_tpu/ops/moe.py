@@ -78,17 +78,32 @@ def expert_stack_matrix(w, dtype) -> jnp.ndarray:
     return jnp.swapaxes(w, -1, -2).astype(dtype)
 
 
+def _padded_rows_bound(rows: int, n_groups: int, block_r: int) -> int:
+    """Tight static bound on the expert-grouped padded row count, i.e. the
+    grouped kernel's grid extent. Each NONEMPTY group wastes at most
+    block_r - 1 pad rows (it rounds up to a block_r multiple); a zero-count
+    group pads to ZERO rows, and at most min(n_groups, rows) groups can be
+    nonempty. The old bound (rows + n_groups * block_r) carried a full
+    block per group regardless — at decode shapes (rows ≈ b·k, many
+    experts) the clip in the block→group map spilled up to n_groups
+    all-zero row blocks onto the last group, each running a whole-expert
+    matmul grid step for nothing (ADVICE r5 #4). Rounded up to a block_r
+    multiple so the grid's floor division still covers every real block."""
+    bound = rows + min(n_groups, rows) * (block_r - 1)
+    return -(-bound // block_r) * block_r
+
+
 def _grouped_layout(group_sizes: jnp.ndarray, rows: int, n_groups: int, block_r: int):
     """Row layout for the grouped Pallas kernel: each group padded to a
     block_r multiple so every row block belongs to exactly one expert.
 
     Returns (padded_idx [rows] — where sorted row r lands in the padded
     buffer, block_expert [n_blocks] — which group each row block computes,
-    R_pad — static padded row count = rows + n_groups*block_r worst case).
-    Pad rows are zeros; their outputs are garbage-free (0 @ w = 0) and are
-    never gathered back.
+    R_pad — the tight static bound on the padded row count, see
+    `_padded_rows_bound`). Pad rows are zeros; their outputs are
+    garbage-free (0 @ w = 0) and are never gathered back.
     """
-    R_pad = rows + n_groups * block_r
+    R_pad = _padded_rows_bound(rows, n_groups, block_r)
     starts = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes.astype(jnp.int32))[:-1]]
     )
@@ -120,7 +135,7 @@ def _grouped_layout_direct(g_flat: jnp.ndarray, n_groups: int, block_r: int):
     instead of a sort network. Returns (dest [rows] int32, block_expert
     [R_pad // block_r] int32, R_pad)."""
     rows = g_flat.shape[0]
-    R_pad = rows + n_groups * block_r
+    R_pad = _padded_rows_bound(rows, n_groups, block_r)
     oh = (g_flat[:, None] == jnp.arange(n_groups, dtype=g_flat.dtype)).astype(
         jnp.int32
     )  # [rows, n_groups]

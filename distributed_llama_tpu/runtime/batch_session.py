@@ -18,6 +18,11 @@ decision instead:
   other rows' cache is untouched), on mesh paths via the per-row-position
   pipeline forward with every other row parked at pos seq_len (their cache
   writes are dropped by the OOB scatter, models/transformer.py);
+* admission can be INTERLEAVED: `begin_admit` stages the prompt and
+  `prefill_pending(row, budget)` advances it a bounded number of tokens at a
+  time, so a long prompt's prefill slots between decode chunks instead of
+  stalling every co-batched stream for the whole prompt (Sarathi-Serve's
+  chunked-prefill piggyback; the server's Batcher drives this);
 * `step(n)` decodes n tokens for ALL slots in one on-device chunk with
   per-row positions, per-row threefry key chains, and per-row
   temperature/top-p vectors (ops/sampling.py sample_logits_per_row) — so
@@ -127,13 +132,27 @@ class BatchSession:
         self.topp = np.full((b,), 0.9, np.float32)
         self.keys = np.zeros((b, 2), np.uint32)
         self._admits = 0  # distinguishes unseeded admissions' default keys
+        # rows mid-admission: prompt + prefill progress, armed on completion
+        # (begin_admit / prefill_pending — the Batcher's interleaved path)
+        self._pending: dict[int, dict] = {}
         engine.reset()
 
     def free_rows(self) -> list[int]:
-        return [r for r in range(len(self.active)) if not self.active[r]]
+        return [
+            r
+            for r in range(len(self.active))
+            if not self.active[r] and r not in self._pending
+        ]
 
     def active_rows(self) -> list[int]:
         return [r for r in range(len(self.active)) if self.active[r]]
+
+    def pending_rows(self) -> list[int]:
+        """Rows whose admission prefill is staged/in progress (begin_admit
+        called, not yet armed), in STAGING order — the Batcher advances the
+        earliest-staged admission first, so a later arrival can't preempt an
+        in-flight prefill and grow its TTFT."""
+        return list(self._pending)
 
     def admit(
         self,
@@ -143,10 +162,29 @@ class BatchSession:
         topp: float = 0.9,
         key_data=None,  # (hi, lo) uint32 pair; None derives from the row+pos
     ) -> None:
-        """Prefill `prompt_tokens[:-1]` into `row` and arm the slot. The
-        row starts decoding on the next `step` call — admission latency is
-        one prefill plus at most one in-flight chunk boundary."""
-        eng = self.engine
+        """Prefill `prompt_tokens[:-1]` into `row` and arm the slot in one
+        call (begin_admit + an unbounded prefill_pending). The row starts
+        decoding on the next `step` call — admission latency is one prefill
+        plus at most one in-flight chunk boundary."""
+        self.begin_admit(row, prompt_tokens, temperature, topp, key_data)
+        self.prefill_pending(row)
+
+    def begin_admit(
+        self,
+        row: int,
+        prompt_tokens: list[int],
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        key_data=None,
+    ) -> None:
+        """Stage an admission without running its prefill: the prompt then
+        advances in bounded chunks via `prefill_pending`, scheduled by the
+        caller BETWEEN decode chunks (the Batcher interleaves one prefill
+        chunk per chunk boundary, so co-batched decode streams see a bounded
+        per-token latency bump instead of a whole-prompt stall — the
+        Sarathi-style chunked-prefill piggyback). The row stays parked
+        (inactive, junk-stepping) until its prefill completes and the slot
+        arms itself."""
         n = len(prompt_tokens)
         if n == 0:
             raise ValueError("empty prompt")
@@ -156,58 +194,108 @@ class BatchSession:
             )
         if self.active[row]:
             raise ValueError(f"row {row} is still active")
-
-        pre = prompt_tokens[:-1]
-        if pre:
-            from .engine import chunk_plan
-
-            for i, size, n_real in chunk_plan(len(pre), 0, eng.max_chunk, self.seq_len):
-                chunk = pre[i : i + n_real] + [0] * (size - n_real)
-                kv_len = eng._kv_bucket(i + size)
-                if eng.use_pipeline:
-                    # mesh path: whole-batch forward with every other row
-                    # parked at seq_len (writes dropped)
-                    from ..parallel.pipeline import pipeline_forward
-
-                    toks = np.zeros((eng.batch, size), np.int32)
-                    toks[row, :] = chunk
-                    pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
-                    pos_vec[row] = i
-                    _, eng.cache = pipeline_forward(
-                        eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
-                        jnp.asarray(toks), jnp.asarray(pos_vec),
-                        logits_mode="last", kv_len=kv_len,
-                    )
-                else:
-                    eng.cache = prefill_row(
-                        eng.cfg, eng.params, eng.rope, eng.cache,
-                        jnp.asarray([chunk], jnp.int32), jnp.int32(i),
-                        jnp.int32(row), kv_len=kv_len,
-                    )
-
-        self.pos[row] = n - 1
-        self.token[row] = prompt_tokens[-1]
-        self.temp[row] = temperature
-        self.topp[row] = topp
+        if row in self._pending:
+            raise ValueError(f"row {row} already has a pending admission")
         if key_data is None:
             # unseeded: a fresh chain per admission (deterministic within a
-            # session, distinct across re-used rows). Seeded callers pass
-            # key_data derived from the seed alone, so the stream reproduces
-            # regardless of which row/chunks it lands in.
+            # session, distinct across re-used rows, numbered in ARRIVAL
+            # order so interleaved and stall-free admissions draw the same
+            # streams). Seeded callers pass key_data derived from the seed
+            # alone, so the stream reproduces regardless of which row/chunks
+            # it lands in.
             self._admits += 1
             key_data = (
                 np.uint32(0x9E3779B9),
                 np.uint32((self._admits * 2654435761) & 0xFFFFFFFF),
             )
-        self.keys[row] = np.asarray(key_data, np.uint32)
-        self.active[row] = True
+        self._pending[row] = {
+            "tokens": list(prompt_tokens),
+            "done": 0,  # prefilled prefix length within tokens[:-1]
+            "temperature": temperature,
+            "topp": topp,
+            "key_data": key_data,
+        }
+
+    def prefill_pending(self, row: int, max_tokens: int | None = None) -> int:
+        """Advance `row`'s staged prompt prefill by up to `max_tokens` tokens
+        (None = to completion); returns the prefill tokens still remaining.
+        Chunks follow the same padded power-of-two ladder as `admit` (same
+        compiled shapes — an interleaved admission warms nothing new), each
+        dispatched with its operands in ONE host->device transfer. When the
+        last chunk lands the slot arms exactly as `admit` would have."""
+        eng = self.engine
+        st = self._pending[row]
+        pre = st["tokens"][:-1]
+        budget = len(pre) if max_tokens is None else max_tokens
+        from .engine import chunk_plan
+
+        while st["done"] < len(pre) and budget > 0:
+            done = st["done"]
+            # plan against the REMAINING BUDGET too, so a budget below
+            # max_chunk is honored exactly (the chunk's bucket may pad past
+            # an odd budget, but its real tokens never exceed it) instead of
+            # overshooting by up to a whole max_chunk chunk
+            _, size, n_real = next(
+                iter(
+                    chunk_plan(
+                        min(len(pre) - done, budget), done, eng.max_chunk,
+                        self.seq_len,
+                    )
+                )
+            )
+            chunk = pre[done : done + n_real] + [0] * (size - n_real)
+            kv_len = eng._kv_bucket(done + size)
+            if eng.use_pipeline:
+                # mesh path: whole-batch forward with every other row
+                # parked at seq_len (writes dropped)
+                from ..parallel.pipeline import pipeline_forward
+
+                toks = np.zeros((eng.batch, size), np.int32)
+                toks[row, :] = chunk
+                pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
+                pos_vec[row] = done
+                toks_dev, pos_dev = jax.device_put((toks, pos_vec))
+                _, eng.cache = pipeline_forward(
+                    eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
+                    toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
+                )
+            else:
+                toks_dev, pos_dev, row_dev = jax.device_put(
+                    (
+                        np.asarray([chunk], np.int32),
+                        np.int32(done),
+                        np.int32(row),
+                    )
+                )
+                eng.cache = prefill_row(
+                    eng.cfg, eng.params, eng.rope, eng.cache,
+                    toks_dev, pos_dev, row_dev, kv_len=kv_len,
+                )
+            st["done"] = done + n_real
+            budget -= n_real
+
+        remaining = len(pre) - st["done"]
+        if remaining <= 0:
+            tokens = st["tokens"]
+            self.pos[row] = len(tokens) - 1
+            self.token[row] = tokens[-1]
+            self.temp[row] = st["temperature"]
+            self.topp[row] = st["topp"]
+            self.keys[row] = np.asarray(st["key_data"], np.uint32)
+            self.active[row] = True
+            del self._pending[row]
+            return 0
+        return remaining
 
     def release(self, row: int) -> None:
         """Park the row: its cache writes drop from the next chunk on, so
-        the slot can be re-admitted later without disturbing anyone."""
+        the slot can be re-admitted later without disturbing anyone. Also
+        drops any staged admission mid-prefill (its partial KV is junk past
+        every live row's view, same as any parked interval)."""
         self.active[row] = False
         self.pos[row] = self.seq_len
         self.temp[row] = 0.0  # greedy is the cheap sampling path for junk
+        self._pending.pop(row, None)
 
     def step(self, n_steps: int) -> np.ndarray:
         """One decode chunk for every slot; returns host tokens [b, n_steps]

@@ -173,6 +173,9 @@ class InferenceEngine:
         verbose: bool = False,
         q80_activations: bool = False,
         execution: str = "auto",
+        prefill_pipelined: bool | None = None,  # None = env default (on);
+        # False = strict serial dispatch->block->dispatch chunks (the
+        # bit-parity reference path for the overlap smoke test)
     ):
         maybe_enable_compilation_cache()
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
@@ -252,9 +255,16 @@ class InferenceEngine:
         self._argmax_step = jax.jit(
             lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
         )
-        # one worker for the decode loop's token fetches (they overlap the
-        # next chunk's dispatch round trip — see _decode_device)
+        # one worker for the decode loop's token fetches and the prefill
+        # pipeline's input prep (each overlaps a dispatch round trip on the
+        # main thread — see _decode_device and prefill)
         self._fetch_pool = ThreadPoolExecutor(max_workers=1)
+        if prefill_pipelined is None:
+            prefill_pipelined = os.environ.get("DLT_PREFILL_PIPELINE", "1") != "0"
+        self.prefill_pipelined = prefill_pipelined
+        # dispatch-vs-compute overlap summary of the most recent prefill
+        # (bench.py reads it; /stats exports the gauge twin)
+        self.last_prefill_timing: dict | None = None
         # shape keys this engine has executed at least once: a first-shape
         # call legitimately blocks on XLA compilation, so its watchdog runs
         # with the (much wider) compile threshold and a "compile" label
@@ -370,56 +380,136 @@ class InferenceEngine:
         self._warm.add(key)
         return watchdog(label, compiling=first, stats=self.stats)
 
+    def _pipelined_chunks(self, n_chunks: int, prep, dispatch):
+        """The ONE owner of the double-buffered prep/dispatch loop shared by
+        `prefill` and `generate_batch`: while chunk k's dispatch round trip
+        is in flight on this thread, the worker thread runs `prep(k+1)`
+        (token slicing + the chunk's single combined device_put). Honors
+        `prefill_pipelined` — the strict serial arm preps inline and blocks
+        on the cache after every dispatch (the dispatch->block->dispatch
+        reference path). `dispatch(idx, operands)` returns the chunk's
+        output; the last one is returned."""
+        out = None
+        if self.prefill_pipelined:
+            fut = self._fetch_pool.submit(prep, 0)
+            for idx in range(n_chunks):
+                operands = fut.result()
+                if idx + 1 < n_chunks:
+                    fut = self._fetch_pool.submit(prep, idx + 1)
+                out = dispatch(idx, operands)
+        else:
+            for idx in range(n_chunks):
+                out = dispatch(idx, prep(idx))
+                jax.block_until_ready(self.cache.k)
+        return out
+
     def prefill(
         self, tokens: list[int], pos_start: int = 0, on_chunk=None, sync: bool = True
     ) -> None:
-        """Feed `tokens` through the model in padded power-of-two chunks.
+        """Feed `tokens` through the model in padded power-of-two chunks,
+        with the whole pipeline asynchronous end to end.
 
         Only the KV cache matters here: logits for the first generated token
         come from the subsequent decode step feeding the final prompt token
         (the reference's shape: prefill covers nInputTokens-1 tokens,
         dllama.cpp:44-85), so chunks run with logits_mode="last" (one wcls
-        row) and nothing is fetched to the host.
+        row) and nothing is fetched to the host until the final sync.
 
-        All chunks are dispatched asynchronously — the device runs them
-        back-to-back with no host round trip in between — and one tiny fetch
-        at the end syncs for an honest wall-clock measurement (`sync=False`
-        skips even that, letting decode dispatch chain straight on). Per-chunk
-        timings are attributed proportionally from the synced total.
+        Through the driver tunnel every host-blocking device call is a
+        ~75-100 ms round trip, so the chunk loop is double-buffered: while
+        chunk k's dispatch round trip is in flight on this thread, the worker
+        thread slices chunk k+1's tokens and `device_put`s its operands
+        (tokens + pos scalar in ONE transfer) — the same two-concurrent-RPCs
+        pattern the decode loop's dispatch/fetch overlap relies on. The final
+        sync is a bare ready-wait on the last chunk's logits
+        (`jax.block_until_ready`) instead of the old `np.asarray(jnp.sum(out))`,
+        which enqueued one EXTRA dispatch round trip per prefill and then
+        fetched its scalar (`sync=False` skips the wait entirely, letting
+        decode dispatch chain straight on). Per-chunk dispatch walls land in
+        StepStats
+        (`prefill_dispatch[size]`), the sync wait in `prefill_sync`, and
+        `last_prefill_timing` carries the dispatch-vs-compute overlap summary
+        the bench and `/stats` export. `DLT_PREFILL_PIPELINE=0` (or
+        engine `prefill_pipelined=False`) forces the strict serial
+        dispatch->block->dispatch path — the bit-parity reference for the
+        overlap smoke test, and a probe mode for tunnel triage.
         """
         n = len(tokens)
         if n == 0:
             return
         t0 = time.perf_counter()
-        chunk_sizes: list[tuple[int, int]] = []  # (bucket, n_real)
-        chunk_shapes: list[tuple[int, int]] = []  # (bucket, kv_bucket) per chunk
-        out = None
-        for i, size, n_real in chunk_plan(n, pos_start, self.max_chunk, self.cfg.seq_len):
+        plan = list(chunk_plan(n, pos_start, self.max_chunk, self.cfg.seq_len))
+        chunk_shapes = [
+            (size, self._kv_bucket(pos_start + i + size)) for i, size, _ in plan
+        ]
+
+        def prep(idx):
+            """Host-side work for one chunk: token slicing + ONE combined
+            host->device transfer of its operands. Runs on the worker thread
+            so it overlaps the previous chunk's dispatch round trip."""
+            i, size, n_real = plan[idx]
             chunk = tokens[i : i + n_real] + [0] * (size - n_real)
-            arr = jnp.asarray([chunk] * self.batch, dtype=jnp.int32)
-            kvb = self._kv_bucket(pos_start + i + size)
-            out, self.cache = self._forward(
-                arr, jnp.int32(pos_start + i), kv_len=kvb,
-            )
-            chunk_sizes.append((size, n_real))
-            chunk_shapes.append((size, kvb))
-        if sync:
-            with self._guard(
-                f"prefill[{len(tokens)}]",
-                # the kv bucket matters to the compiled shape: a prefix-cache
-                # continuation at a deeper position is a NEW compile even
-                # with a seen chunk ladder. Key on EVERY chunk's (size,
-                # kv_bucket) pair — the exact shapes the forward calls
-                # compile with. Keying only the last bucket aliased ladders
-                # whose intermediate buckets differ (different pos_start),
-                # mis-tagging a genuine first compile as warm and running it
-                # under the narrow stall threshold (false EXEC_STALL)
-                ("prefill", tuple(chunk_shapes)),
-            ):
-                # single scalar fetch = the only host round trip of the prefill
-                np.asarray(jnp.sum(out))
+            arr = np.asarray([chunk] * self.batch, dtype=np.int32)
+            return jax.device_put((arr, np.int32(pos_start + i)))
+
+        timing = {"dispatch_us": 0}
+        sync_us = 0
+
+        def dispatch(idx, operands):
+            arr, pos_dev = operands
+            size, kvb = chunk_shapes[idx]
+            td = time.perf_counter()
+            out, self.cache = self._forward(arr, pos_dev, kv_len=kvb)
+            dus = int((time.perf_counter() - td) * 1e6)
+            timing["dispatch_us"] += dus
+            self.stats.record(f"prefill_dispatch[{size}]", dus)
+            return out
+
+        # the guard now covers the dispatch loop too (not just the sync): a
+        # first-shape chunk's dispatch can block on XLA compilation, and an
+        # in-flight-but-uncompiled chunk must run under the compile-aware
+        # threshold, not the narrow stall one.
+        with self._guard(
+            f"prefill[{len(tokens)}]",
+            # the kv bucket matters to the compiled shape: a prefix-cache
+            # continuation at a deeper position is a NEW compile even
+            # with a seen chunk ladder. Key on EVERY chunk's (size,
+            # kv_bucket) pair — the exact shapes the forward calls
+            # compile with. Keying only the last bucket aliased ladders
+            # whose intermediate buckets differ (different pos_start),
+            # mis-tagging a genuine first compile as warm and running it
+            # under the narrow stall threshold (false EXEC_STALL)
+            ("prefill", tuple(chunk_shapes)),
+        ):
+            out = self._pipelined_chunks(len(plan), prep, dispatch)
+            if sync:
+                ts = time.perf_counter()
+                # block on the last chunk's logits — the ONE host round trip
+                # of a pipelined prefill: a ready-wait, no extra device op
+                # enqueued (jnp.sum was a dispatch round trip) and no buffer
+                # payload transferred (np.asarray would ship the logits row)
+                jax.block_until_ready(out)
+                sync_us = int((time.perf_counter() - ts) * 1e6)
+                self.stats.record("prefill_sync", sync_us)
         total_us = int((time.perf_counter() - t0) * 1e6)
-        for size, n_real in chunk_sizes:
+        # dispatch-vs-compute overlap: the fraction of the prefill wall spent
+        # inside dispatch calls, during which the device concurrently runs
+        # previously-dispatched chunks. 100% = the final sync found all
+        # compute already done (fully hidden); low = the sync wait re-paid
+        # compute the dispatches failed to hide.
+        dispatch_us = timing["dispatch_us"]
+        self.last_prefill_timing = {
+            "n_tokens": n,
+            "n_chunks": len(plan),
+            "total_us": total_us,
+            "dispatch_us": dispatch_us,
+            "sync_us": sync_us,
+            "overlap_pct": round(100.0 * dispatch_us / max(total_us, 1), 1),
+        }
+        self.stats.gauge(
+            "prefill_dispatch_overlap_pct", self.last_prefill_timing["overlap_pct"]
+        )
+        for _, size, n_real in plan:
             dt = total_us * n_real // n
             self.stats.record(f"prefill[{size}]", dt)
             if on_chunk is not None:
@@ -542,17 +632,31 @@ class InferenceEngine:
                     f"exceeds the sequence length ({self.cfg.seq_len})"
                 )
 
-        # prefill all-but-last per row, rows right-padded to a common length
+        # prefill all-but-last per row, rows right-padded to a common length,
+        # through the shared double-buffered chunk pipeline (worker-thread
+        # prep overlapping dispatch; honors prefill_pipelined like `prefill`)
         pre_t = max(lens) - 1
         if pre_t > 0:
             padded = [list(p[:-1]) + [0] * (pre_t - (len(p) - 1)) for p in prompts]
-            for i, size, _ in chunk_plan(pre_t, 0, self.max_chunk, self.cfg.seq_len):
+            plan = list(chunk_plan(pre_t, 0, self.max_chunk, self.cfg.seq_len))
+
+            def prep(idx):
+                i, size, _ = plan[idx]
                 rows = [row[i : i + size] for row in padded]
                 rows = [r + [0] * (size - len(r)) for r in rows]
-                _, self.cache = self._forward(
-                    jnp.asarray(rows, dtype=jnp.int32), jnp.int32(i),
-                    kv_len=self._kv_bucket(i + size),
+                return jax.device_put(
+                    (np.asarray(rows, dtype=np.int32), np.int32(i))
                 )
+
+            def dispatch(idx, operands):
+                arr, pos_dev = operands
+                i, size, _ = plan[idx]
+                out, self.cache = self._forward(
+                    arr, pos_dev, kv_len=self._kv_bucket(i + size),
+                )
+                return out
+
+            self._pipelined_chunks(len(plan), prep, dispatch)
 
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9

@@ -150,6 +150,7 @@ class StepStats:
     def __init__(self, window: int = 100):
         self.series: dict[str, _Series] = defaultdict(lambda: _Series(window=window))
         self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
         self._counter_lock = threading.Lock()
 
     def incr(self, name: str, n: int = 1):
@@ -158,9 +159,21 @@ class StepStats:
         with self._counter_lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
+    def gauge(self, name: str, value: float):
+        """Set a last-value metric (e.g. the most recent prefill's
+        dispatch-vs-compute overlap percentage) — exported in
+        `snapshot()["gauges"]` next to the latency series, so `/stats`
+        surfaces derived quantities the series alone can't express."""
+        with self._counter_lock:
+            self.gauges[name] = float(value)
+
     def counters_snapshot(self) -> dict:
         with self._counter_lock:
             return dict(self.counters)
+
+    def gauges_snapshot(self) -> dict:
+        with self._counter_lock:
+            return dict(self.gauges)
 
     def record(self, kind: str, us: float):
         s = self.series[kind]
@@ -184,9 +197,10 @@ class StepStats:
     def snapshot(self) -> dict:
         """JSON-able view of every series (the /stats endpoint's payload;
         same numbers `report()` prints) plus, under the reserved
-        ``"counters"`` key, the event counters — the one source `/health`
-        and the gateway's `/gateway/stats` both agree with."""
-        out = {"counters": self.counters_snapshot()}
+        ``"counters"`` and ``"gauges"`` keys, the event counters and
+        last-value gauges — the one source `/health` and the gateway's
+        `/gateway/stats` both agree with."""
+        out = {"counters": self.counters_snapshot(), "gauges": self.gauges_snapshot()}
         # materialize the items: engine threads insert new kinds while the
         # /stats handler iterates
         for kind, s in sorted(list(self.series.items())):

@@ -145,6 +145,8 @@ class _BatchReq:
         # extra chunk before the writer thread's `stopped` flag is seen
         self.eos_ids = frozenset(eos_ids)
         self.stopped = False
+        self.prefilling = False  # admitted, prompt still prefilling in
+        # bounded chunks between decode steps (interleaved admission)
         self.n = 0  # tokens decoded into this row (budget accounting)
         self.n_out = 0  # tokens actually delivered to on_token (usage
         # accounting: excludes post-stop overrun the writer drains away)
@@ -179,7 +181,8 @@ class Batcher:
     """
 
     def __init__(self, state: "ApiState", chunk_size: int | None = None,
-                 max_backlog: int | None = None):
+                 max_backlog: int | None = None,
+                 prefill_budget: int | None = None):
         import queue
 
         self.state = state
@@ -188,6 +191,13 @@ class Batcher:
         # more dispatch round trips per token; the engine default balances
         # the two for throughput.
         self.chunk = chunk_size or engine.decode_chunk_size
+        # interleaved admission: a newcomer's prompt prefills at most this
+        # many tokens per decode-chunk boundary (one max_chunk prefill chunk
+        # by default), bounding the latency bump co-batched decode streams
+        # see while a long prompt lands. With NO live decode streams the
+        # budget is ignored and the prompt prefills in one go (nothing to
+        # starve, minimal TTFT).
+        self.prefill_budget = prefill_budget or engine.max_chunk
         # shed threshold: with this many requests already waiting for a
         # slot, a newcomer is turned away with 503 + Retry-After instead of
         # joining a backlog it would likely rot in (see ApiState shedding)
@@ -205,9 +215,13 @@ class Batcher:
         return {
             "batch_slots": len(slots),
             "slots_active": sum(1 for s in slots if s is not None),
+            "slots_prefilling": sum(
+                1 for s in slots if s is not None and s.prefilling
+            ),
             "queue_depth": self.queue_depth(),
             "max_backlog": self.max_backlog,
             "chunk_size": self.chunk,
+            "prefill_budget": self.prefill_budget,
         }
 
     def queue_depth(self) -> int:
@@ -310,56 +324,102 @@ class Batcher:
                     backlog.append(self.q.get_nowait())
                 except queue.Empty:
                     break
-            # admit in arrival order into free slots at this chunk boundary
-            admitted = False
+            # admit in arrival order into free slots at this chunk boundary.
+            # Admission only STAGES the prompt (begin_admit): the prefill
+            # itself advances in bounded chunks interleaved between decode
+            # steps below, so a long newcomer prompt no longer stalls every
+            # co-batched decode stream for its whole prefill (the old
+            # admit-then-full-prefill behavior; Sarathi-style piggyback).
             for row in range(engine.batch):
                 if slots[row] is not None or not backlog:
                     continue
                 req = backlog.popleft()
                 try:
                     key = self._key_for_seed(req.seed) if req.seed is not None else None
-                    session.admit(
+                    session.begin_admit(
                         row, req.ids, temperature=req.temperature,
                         topp=req.topp, key_data=key,
                     )
+                    req.prefilling = True
                     slots[row] = req
-                    admitted = True
                 except Exception as e:
                     req.error = e
                     req.done.set()
 
             if all(s is None for s in slots):
                 continue
+            decode_rows = [
+                r for r, s in enumerate(slots) if s is not None and not s.prefilling
+            ]
+            # interleaved prefill: advance ONE staged admission per chunk
+            # boundary, in STAGING order (session.pending_rows) — finish the
+            # earliest prompt before starting a later one, so an in-flight
+            # admission's TTFT doesn't grow with later arrivals landing on
+            # lower-numbered rows. With live decode streams the advance is
+            # bounded by prefill_budget tokens; with none it runs to
+            # completion (nothing to starve).
+            prefill_rows = [
+                r
+                for r in session.pending_rows()
+                if slots[r] is not None and slots[r].prefilling
+            ]
+            armed = False
+            if prefill_rows:
+                row = prefill_rows[0]
+                req = slots[row]
+                if req.stopped:
+                    # the client died mid-admission (writer thread flagged
+                    # it): abandon the rest of its prompt instead of burning
+                    # one prefill chunk per boundary on a dead request and
+                    # head-of-line blocking every admission staged behind it
+                    self._finish(req, session, slots, row)
+                    continue
+                try:
+                    budget = self.prefill_budget if decode_rows else None
+                    remaining = session.prefill_pending(row, budget)
+                    if decode_rows:
+                        engine.stats.incr("interleaved_prefill_chunks")
+                except Exception as e:
+                    req.error = e
+                    self._finish(req, session, slots, row)
+                    continue
+                if remaining == 0:
+                    req.prefilling = False
+                    decode_rows.append(row)
+                    armed = True
+            if not decode_rows:
+                continue  # only prefilling rows: no decode chunk to run yet
             # a row at pos == seq_len-1 has zero decode headroom: finish it
             # (the request keeps what it generated) instead of flooring the
             # chunk clamp at 1 and letting session.step's overrun guard fail
             # every co-batched request — reachable for library users driving
             # the Batcher directly; the HTTP path's budget clamp never gets
-            # here
-            for row, req in enumerate(slots):
-                if req is not None and session.seq_len - 1 - int(session.pos[row]) <= 0:
+            # here. Prefilling rows are parked at seq_len by construction and
+            # must NOT be swept up by this check.
+            for row in list(decode_rows):
+                req = slots[row]
+                if session.seq_len - 1 - int(session.pos[row]) <= 0:
                     self._finish(req, session, slots, row)
-            if all(s is None for s in slots):
+                    decode_rows.remove(row)
+            if not decode_rows:
                 continue
-            # chunk size: ramp to 8 right after an admission (a fresh
-            # request's first tokens — and a tiny request's only tokens —
-            # reach the client after ~8 steps, not a full chunk). The ramp
-            # alternates: never two ramped chunks in a row, so sustained
-            # admission traffic costs at most half the chunks (the round-4
-            # loop re-ramped on EVERY admission and could run at chunk=8
-            # permanently). The clamp is only the HARD seq_len headroom —
-            # a row hitting its own max_new mid-chunk just has its surplus
-            # tokens discarded and its slot released (no more shrinking
-            # every co-tenant's chunks to the smallest remaining budget,
-            # which fragmented steady-state traffic into 1-2-token
+            # chunk size: ramp to 8 right after an admission finishes its
+            # prefill (a fresh request's first tokens — and a tiny request's
+            # only tokens — reach the client after ~8 steps, not a full
+            # chunk). The ramp alternates: never two ramped chunks in a row,
+            # so sustained admission traffic costs at most half the chunks
+            # (the round-4 loop re-ramped on EVERY admission and could run
+            # at chunk=8 permanently). The clamp is only the HARD seq_len
+            # headroom — a row hitting its own max_new mid-chunk just has
+            # its surplus tokens discarded and its slot released (no more
+            # shrinking every co-tenant's chunks to the smallest remaining
+            # budget, which fragmented steady-state traffic into 1-2-token
             # dispatches, each a ~75-100 ms tunnel round trip).
             headroom = min(
-                session.seq_len - 1 - int(session.pos[row])
-                for row in range(engine.batch)
-                if slots[row] is not None
+                session.seq_len - 1 - int(session.pos[row]) for row in decode_rows
             )
-            n = min(8, self.chunk) if admitted and not ramped_last else self.chunk
-            ramped_last = admitted and not ramped_last
+            n = min(8, self.chunk) if armed and not ramped_last else self.chunk
+            ramped_last = armed and not ramped_last
             while n > max(headroom, 1):
                 n //= 2
             n = max(n, 1)
@@ -376,7 +436,7 @@ class Batcher:
                 session = BatchSession(engine)
                 continue
             for row, req in enumerate(slots):
-                if req is None:
+                if req is None or req.prefilling:
                     continue
                 for j in range(toks.shape[1]):
                     t = int(toks[row, j])

@@ -32,6 +32,13 @@ def tiny_header(
     rope_theta: float = 10000.0,
     weight_type: int = FloatType.Q40,
     rope_scaling_factor: float = 1.0,
+    # llama-3.1 wavelength-dependent scaling knobs (only written to the
+    # header when rope_scaling_factor != 1.0, matching the converter; the
+    # .m header stores them as int32, so integral values only). Defaults
+    # are the llama-3.1 release values (factor 8 / low 1 / high 4 / 8192).
+    rope_scaling_low_freq_factor: float = 1.0,
+    rope_scaling_high_freq_factor: float = 4.0,
+    rope_scaling_orig_max_seq_len: int = 8192,
 ) -> ModelHeader:
     h = ModelHeader(
         version=1,
@@ -50,6 +57,9 @@ def tiny_header(
         rope_theta=rope_theta,
         rope_type=rope_type,
         rope_scaling_factor=rope_scaling_factor,
+        rope_scaling_low_freq_factor=rope_scaling_low_freq_factor,
+        rope_scaling_high_freq_factor=rope_scaling_high_freq_factor,
+        rope_scaling_orig_max_seq_len=rope_scaling_orig_max_seq_len,
         norm_epsilon=1e-5,
         weight_type=weight_type,
         head_dim=head_dim,

@@ -6,6 +6,11 @@ tunnel dispatch (one chunk = one dispatch); this isolates the COMPUTE:
   * matmul-only chain at t=512 (bf16-dequant kernel, multi-row)
   * flash attention at t=512 over the kv bucket
   * per-shape multi-row matmul bandwidth/MFU
+
+`--overlap` instead profiles the pipelined prefill's dispatch/compute
+overlap (per-chunk dispatch walls, sync wait, overlap %, pipelined vs the
+forced-serial path) — the observability twin of the engine's
+double-buffered chunk dispatch.
 """
 
 import os
@@ -20,6 +25,50 @@ import numpy as np
 from profile_decode import dev_ms  # differenced timing
 
 
+def overlap_report(path: str, prompt_tokens: int, reps: int = 3):
+    """Dispatch-vs-compute overlap of the pipelined prefill on the real
+    chip: per-chunk dispatch walls, the final sync wait, and the overlap
+    percentage (share of the wall spent inside dispatches — ~100% means the
+    sync found the device already done), pipelined vs the forced-serial
+    dispatch->block->dispatch path for the A/B."""
+    import time
+
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    for pipelined in (True, False):
+        eng = InferenceEngine(
+            path, compute_dtype="bfloat16", max_chunk=512,
+            prefill_pipelined=pipelined,
+        )
+        prompt = [(i % 1000) + 1 for i in range(prompt_tokens)]
+        eng.prefill(prompt)  # compile the ladder
+        eng.reset()
+        walls = []
+        for _ in range(reps):
+            eng.reset()
+            t0 = time.perf_counter()
+            eng.prefill(prompt)
+            walls.append((time.perf_counter() - t0) * 1e3)
+        t = eng.last_prefill_timing
+        label = "pipelined" if pipelined else "serial (DLT_PREFILL_PIPELINE=0)"
+        print(
+            f"{label}: {prompt_tokens} tokens / {t['n_chunks']} chunks, "
+            f"best wall {min(walls):.1f} ms "
+            f"({prompt_tokens / min(walls) * 1e3:.0f} tok/s)"
+        )
+        print(
+            f"    last rep: dispatch {t['dispatch_us'] / 1e3:.1f} ms, "
+            f"sync wait {t['sync_us'] / 1e3:.1f} ms, "
+            f"overlap {t['overlap_pct']:.1f}%"
+        )
+        for kind, s in sorted(eng.stats.series.items()):
+            if kind.startswith("prefill_dispatch"):
+                print(
+                    f"    {kind}: n={s.count} avg={s.total_us / s.count / 1e3:.1f} ms"
+                )
+        del eng
+
+
 def main():
     import argparse
 
@@ -32,8 +81,17 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["1b", "qwen3", "moe"], default="1b")
+    ap.add_argument(
+        "--overlap", action="store_true",
+        help="print prefill dispatch/compute overlap (pipelined vs serial) "
+        "instead of the kernel profile",
+    )
+    ap.add_argument("--prompt-tokens", type=int, default=1536)
     args = ap.parse_args()
     path = {"1b": ensure_model, "qwen3": ensure_qwen3, "moe": ensure_moe}[args.model]()
+    if args.overlap:
+        overlap_report(path, args.prompt_tokens)
+        return
     engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
     cfg, params, rope = engine.cfg, engine.params, engine.rope
     T = 512

@@ -190,6 +190,140 @@ def test_parked_rows_preserve_cache_tail(tmp_path):
     assert got == want
 
 
+def test_interleaved_admission_token_identical(tmp_path):
+    """The tentpole contract: while a newcomer's prompt prefills in bounded
+    chunks BETWEEN decode steps (begin_admit + prefill_pending), the
+    co-batched live stream's tokens are IDENTICAL to its solo run, and the
+    newcomer — once armed — matches ITS solo run. Non-interleaved admission
+    of the same traffic produces the same streams."""
+    path = _model(tmp_path)
+    pa = [5, 9, 17, 3]
+    pb = [(i % 120) + 1 for i in range(30)]  # multi-chunk prefill at max_chunk 8
+    want_a = _solo(path, pa, 32)
+    want_b = _solo(path, pb, 8)
+
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.admit(0, pa)
+    got_a, got_b = [], []
+    _collect(s.step(4), 0, got_a)  # A decodes alone for one chunk
+    s.begin_admit(1, pb)           # B arrives mid-stream: staged only
+    assert s.pending_rows() == [1]
+    assert 1 not in s.free_rows()
+    remaining = len(pb) - 1
+    while remaining:
+        remaining = s.prefill_pending(1, 8)  # one bounded chunk per boundary
+        _collect(s.step(4), 0, got_a)        # A keeps streaming throughout
+    assert s.active[1] and s.pending_rows() == []
+    for _ in range(2):
+        h = s.step(4)
+        _collect(h, 0, got_a)
+        _collect(h, 1, got_b)
+    assert got_a == want_a[: len(got_a)]
+    assert got_b == want_b
+
+    # the same traffic through plain (non-interleaved) admission at the same
+    # chunk boundaries yields the same streams — interleaving is pure
+    # scheduling
+    eng2 = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s2 = BatchSession(eng2)
+    s2.admit(0, pa)
+    ref_a, ref_b = [], []
+    _collect(s2.step(4), 0, ref_a)
+    s2.admit(1, pb)
+    for _ in range(len(got_a) // 4 - 1):
+        h = s2.step(4)
+        _collect(h, 0, ref_a)
+        if s2.active[1]:
+            _collect(h, 1, ref_b)
+    assert ref_a == got_a[: len(ref_a)]
+    assert ref_b[: len(want_b)] == want_b
+
+
+def test_interleaved_admission_with_eos_parked_rows(tmp_path):
+    """The PR-1 edge rows compose with interleaved admission: a co-batched
+    row RELEASES (parks) mid-way through the newcomer's chunked prefill, and
+    the newcomer still arms with the correct stream; the parked row's slot
+    stays re-admittable afterward."""
+    path = _model(tmp_path)
+    pa, pb, pc = [5, 9, 17, 3], [(i % 120) + 1 for i in range(22)], [44, 2, 60]
+    want_b = _solo(path, pb, 8)
+    want_c = _solo(path, pc, 4)
+
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.admit(0, pa)
+    s.step(4)
+    s.begin_admit(1, pb)
+    s.prefill_pending(1, 8)   # B's prefill partly done
+    s.release(0)              # A hits EOS and parks mid-B-prefill
+    s.step(4)                 # a chunk with ONLY parked + prefilling rows
+    remaining = 1
+    while remaining:
+        remaining = s.prefill_pending(1, 8)
+    got_b = []
+    for _ in range(2):
+        _collect(s.step(4), 1, got_b)
+    assert got_b == want_b
+    # A's freed slot is re-admittable while B keeps decoding
+    s.admit(0, pc)
+    got_c = []
+    _collect(s.step(4), 0, got_c)
+    assert got_c == want_c
+
+
+def test_release_mid_prefill_clears_pending(tmp_path):
+    """Releasing a row mid-chunked-prefill drops the staged admission (its
+    partial KV is junk past every live view) and frees the slot."""
+    path = _model(tmp_path)
+    pb = [(i % 120) + 1 for i in range(20)]
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.begin_admit(1, pb)
+    s.prefill_pending(1, 8)
+    s.release(1)
+    assert s.pending_rows() == []
+    assert 1 in s.free_rows()
+    # the slot admits fresh traffic and decodes correctly
+    want = _solo(path, [7, 1], 8)
+    s.admit(1, [7, 1])
+    got = []
+    for _ in range(2):
+        _collect(s.step(4), 1, got)
+    assert got == want
+
+
+def test_prefill_pending_budget_exact_and_odd_boundaries(tmp_path):
+    """prefill_pending honors max_tokens EXACTLY even below max_chunk (the
+    chunk is planned against the remaining budget, not just the ladder), and
+    odd incremental boundaries still produce the solo-identical stream."""
+    path = _model(tmp_path)
+    pb = [(i % 120) + 1 for i in range(20)]  # pre = 19 tokens
+    want = _solo(path, pb, 8)
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.begin_admit(1, pb)
+    assert s.prefill_pending(1, 5) == 14   # exactly 5, not a whole chunk
+    assert s.prefill_pending(1, 6) == 8
+    while s.prefill_pending(1, 6):
+        pass
+    got = []
+    for _ in range(2):
+        _collect(s.step(4), 1, got)
+    assert got == want
+
+
+def test_begin_admit_rejects_double_stage(tmp_path):
+    import pytest
+
+    path = _model(tmp_path)
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.begin_admit(0, [5, 9, 17])
+    with pytest.raises(ValueError, match="pending admission"):
+        s.begin_admit(0, [7, 1])
+
+
 def test_step_overrunning_seq_len_raises(tmp_path):
     """A direct caller stepping an active row past seq_len gets a loud
     ValueError, not silently-dropped cache writes + junk tokens (ADVICE r4:

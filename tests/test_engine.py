@@ -71,6 +71,32 @@ def test_greedy_generation_matches_numpy_golden(model_files):
     assert got.tokens == want
 
 
+def test_greedy_generation_llama31_rope_head_dim_128_matches_numpy(tmp_path):
+    """The llama-3.1 numeric conventions against the independent numpy
+    golden, runnable without the reference tree: wavelength-scaled RoPE
+    (factor 8 / low 1 / high 4 / orig 8192 — all three scaling branches at
+    theta 10000, head_dim 128) and head_dim=128 GQA geometry (head_dim
+    overriding dim/n_heads). The reference-BINARY twin of this leg lives in
+    tests/test_reference_parity.py (llama31_rope_hd128_q40_q80)."""
+    from distributed_llama_tpu.formats.mfile import RopeType
+
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=128, seq_len=64, vocab_size=288,
+        rope_type=RopeType.LLAMA3_1, rope_scaling_factor=8.0,
+        rope_scaling_low_freq_factor=1.0, rope_scaling_high_freq_factor=4.0,
+        rope_scaling_orig_max_seq_len=8192,
+    )
+    mp = str(tmp_path / "m31.m")
+    write_tiny_model(mp, h, seed=17)
+    prompt = [3, 17, 99]
+    golden = NumpyModel(MFileReader(mp))
+    want = golden.generate_greedy(prompt, 11)
+    eng = InferenceEngine(mp, compute_dtype="float32", decode_chunk_size=4)
+    got = eng.generate(prompt, len(prompt) + 10, sampler=None)
+    assert got.tokens == want
+
+
 def test_steps_not_exceeding_prompt_returns_no_decode(model_files):
     """steps <= prompt length: prefill only, zero generated tokens (the
     pre-overlap loop guard; regression for a dispatch-before-budget hang)."""

@@ -1042,7 +1042,9 @@ def test_gateway_429_past_queue_cap():
 
 def test_stats_endpoint(batched_api_server):
     """/stats surfaces live step latencies + Batcher occupancy (the
-    reference only prints its perf report at shutdown)."""
+    reference only prints its perf report at shutdown), including the
+    interleaved-admission view (slots_prefilling / prefill_budget) and the
+    prefill dispatch-vs-compute gauges."""
     port = batched_api_server
     _post(port, {"messages": [{"role": "user", "content": "warm"}], "max_tokens": 4}).read()
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=30) as r:
@@ -1050,5 +1052,62 @@ def test_stats_endpoint(batched_api_server):
     assert data["batcher"] is not None
     assert data["batcher"]["batch_slots"] >= 2
     assert data["batcher"]["slots_active"] == 0
+    assert data["batcher"]["slots_prefilling"] == 0
+    assert data["batcher"]["prefill_budget"] > 0
     assert isinstance(data["steps"], dict)
+    assert "gauges" in data["steps"]
     assert data["batch"] >= 2
+
+
+def test_interleaved_admission_long_prompt_mid_stream(batched_api_server):
+    """A LONG-prompt request admitted while another stream decodes: its
+    prompt prefills in bounded chunks between the live stream's decode
+    chunks (the Batcher's interleaved path — interleaved_prefill_chunks
+    counters tick), and BOTH completions still match their solo runs
+    token for token."""
+    port = batched_api_server
+
+    def ask(body, out, i):
+        with _post(port, body) as r:
+            out[i] = json.loads(r.read())
+
+    # a prompt long enough for several prefill chunks at the tiny engine's
+    # max_chunk (32 default) while fitting the 256-token window with the
+    # chat template around it
+    long_body = {
+        "messages": [{"role": "user", "content": "tell me everything " * 5}],
+        "max_tokens": 6,
+    }
+    # the live stream mirrors test_mid_round_admission's geometry: a big
+    # budget keeps it mid-generation well past the admission point
+    live_body = {
+        "messages": [{"role": "user", "content": "a very long request"}],
+        "max_tokens": 200,
+    }
+    solo = [None, None]
+    ask(live_body, solo, 0)
+    ask(long_body, solo, 1)
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=30) as r:
+        before = json.loads(r.read())["steps"]["counters"].get(
+            "interleaved_prefill_chunks", 0
+        )
+
+    out = [None, None]
+    t_live = threading.Thread(target=ask, args=(live_body, out, 0))
+    t_live.start()
+    time.sleep(0.35)  # the live stream is mid-generation
+    t_long = threading.Thread(target=ask, args=(long_body, out, 1))
+    t_long.start()
+    t_live.join(timeout=120)
+    t_long.join(timeout=120)
+    assert out[0] is not None and out[1] is not None
+    for i in (0, 1):
+        assert out[i]["choices"][0]["message"]["content"] == \
+            solo[i]["choices"][0]["message"]["content"], f"request {i}"
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=30) as r:
+        after = json.loads(r.read())["steps"]["counters"].get(
+            "interleaved_prefill_chunks", 0
+        )
+    assert after > before, "the long prompt never prefilled between decode chunks"
