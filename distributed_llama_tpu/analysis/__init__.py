@@ -1,0 +1,48 @@
+"""dlt-audit: trace-time graph auditing, runtime sanitizers, thread
+auditing, and the repo lint — the machine-enforced versions of the engine's
+invisible invariants.
+
+The JAX port replaced the reference engine's hand-rolled distributed
+discipline (head-split slices, pipe byte-sizes, thread fences) with
+*implicit* contracts: the (size, kv-bucket) warm-key compile ladder, donated
+KV buffers, per-stage sharding specs, and the lock discipline across the
+Batcher / gateway / prober / watchdog threads. Nothing used to check them —
+a stray f32 upcast, a surprise all-gather, a post-warmup recompile, or an
+unguarded counter mutation shipped silently and surfaced as a latency cliff
+or a flaky 503. This subpackage makes each contract checkable:
+
+* :mod:`.graph_audit`   — abstract-evals (``jax.make_jaxpr`` /
+  ``.lower()``) the decode step and every prefill-chunk entry on the warm
+  ladder and asserts dtypes, collective budgets, KV donation, and sharding
+  consistency per compiled key;
+* :mod:`.recompile_sentinel` — records compile events during warmup and
+  turns any post-warmup recompile into a counted (optionally fatal) event;
+* :mod:`.host_sync_guard`  — ``jax.transfer_guard`` scopes over the decode
+  hot loop so implicit device→host transfers outside the sanctioned
+  ``_fetch_pool`` sites raise, with counters in ``StepStats``;
+* :mod:`.thread_audit`  — lock-order graph recorder + long-hold detector +
+  guarded-mutation checks over the serving layer's locks;
+* :mod:`.lint`          — the AST repo lint (``scripts/dlt_lint.py``).
+
+The runtime sanitizers are opt-in via ``DLT_SANITIZERS=1`` (fatal variants
+via ``DLT_SANITIZERS_FATAL=1``); the trace-time passes run in CI and
+``pytest -m analysis``. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["sanitizers_enabled", "sanitizers_fatal"]
+
+
+def sanitizers_enabled() -> bool:
+    """True when the opt-in runtime sanitizers (recompile sentinel, host
+    sync guard) should be active for this process."""
+    return os.environ.get("DLT_SANITIZERS", "0") not in ("", "0")
+
+
+def sanitizers_fatal() -> bool:
+    """True when a sanitizer violation should raise instead of only
+    counting (``DLT_SANITIZERS_FATAL=1``)."""
+    return os.environ.get("DLT_SANITIZERS_FATAL", "0") not in ("", "0")

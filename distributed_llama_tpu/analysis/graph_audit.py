@@ -1,0 +1,650 @@
+"""Trace-time graph auditor: abstract-eval the engine's compiled step
+programs and assert the contracts nothing else checks.
+
+The engine's performance model rests on properties of the *traced graph*
+that are invisible at the Python layer and silently violable:
+
+* **dtype discipline** — no float64 anywhere (a stray literal promotes the
+  whole matmul chain), and in bfloat16 engines the quantized (Q40/int8)
+  projection matmuls must run in the compute dtype: the only sanctioned
+  f32×f32 matmul is the attention probs·V product (ops/attention.py keeps
+  it f32 for numerical stability). An accidental upcast of a projection
+  shows up here as an extra f32 dot and fails the budget;
+* **collective budget** — the explicit-collective pipeline path emits an
+  exactly predictable set of psum/all_gather/ppermute per step
+  (parallel/pipeline.py); a regression that inserts a surprise all-gather
+  (or drops a psum) changes the count and fails loudly. Non-mesh and GSPMD
+  programs must contain zero explicit collectives;
+* **KV donation** — every decode/prefill entry point donates the cache; a
+  lost `donate_argnames` doubles HBM traffic and peak memory without any
+  functional symptom. The lowered MLIR carries `tf.aliasing_output` markers
+  only when donation survived;
+* **sharding consistency** — on pipeline meshes every per-layer weight
+  stack must shard its layer axis over `pp` and the cache must match
+  `pp_cache_sharding`, or stage handoff silently computes on replicated
+  (wrong) slices.
+
+Everything here is `jax.make_jaxpr` / `.lower()` only: no compilation, no
+execution, no device transfers — cheap enough for CI on a tiny config and
+for a preflight check on a real model.
+
+Run standalone: ``python -m distributed_llama_tpu.analysis.graph_audit``
+(builds a tiny synthetic model and audits its full warm-key ladder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # jax >= 0.4.x keeps these importable from jax.core (newer: jax.extend)
+    from jax.extend.core import ClosedJaxpr, Jaxpr  # type: ignore
+except ImportError:
+    from jax.core import ClosedJaxpr, Jaxpr  # type: ignore
+
+
+class GraphAuditError(AssertionError):
+    """One or more audited programs violated a graph contract."""
+
+
+#: primitive names that are explicit cross-device collectives
+COLLECTIVE_PRIMS = frozenset(
+    {
+        "psum",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+        "ppermute",
+        "pshuffle",
+        "reduce_scatter",
+        "psum_scatter",
+    }
+)
+
+#: MLIR attributes jax emits on donated arguments: `tf.aliasing_output`
+#: when the input/output aliasing is resolved at lowering (single-device),
+#: `jax.buffer_donor` when it is deferred to compile (sharded programs)
+DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+# -- jaxpr walking ----------------------------------------------------------
+
+
+def _sub_jaxprs(eqn):
+    """Yield every jaxpr nested in an eqn's params (pjit/scan/while/cond/
+    custom_* / pallas_call bodies), each exactly once."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vals:
+            if isinstance(x, ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, Jaxpr):
+                yield x
+
+
+def iter_eqns(jaxpr):
+    """Depth-first walk over every equation, descending into sub-jaxprs.
+
+    Each sub-jaxpr is visited ONCE regardless of how many times it executes
+    (a `lax.scan` body counts once) — the resulting census is a *structural
+    fingerprint* of the program, which is exactly what a regression check
+    wants: inserting one collective into a scan body changes the count by
+    one, not by n_steps."""
+    if isinstance(jaxpr, ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def collective_counts(jaxpr) -> dict:
+    """Structural count of explicit collective primitives."""
+    c: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            c[name] += 1
+    return dict(c)
+
+
+def _dtype_name(dtype) -> str:
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)  # extended dtypes (PRNG keys) have no numpy twin
+
+
+def dtype_census(jaxpr) -> set:
+    """Set of dtypes appearing on any equation output."""
+    out = set()
+    for eqn in iter_eqns(jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                out.add(_dtype_name(aval.dtype))
+    return out
+
+
+def dot_input_census(jaxpr) -> Counter:
+    """Counter of (lhs_dtype, rhs_dtype) pairs over every dot_general."""
+    c: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+        c[(_dtype_name(lhs.dtype), _dtype_name(rhs.dtype))] += 1
+    return c
+
+
+# -- warm-key ladder --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LadderEntry:
+    """One compiled-program identity on the warm ladder.
+
+    kind: "prefill" (whole-batch chunk), "decode" (solo chunked decode),
+    "prefill_row" (BatchSession admission prefill), "batch_decode"
+    (BatchSession per-row decode chunk). `size` is the token-chunk size or
+    decode n_steps; `kv_len` the static KV read bucket."""
+
+    kind: str
+    size: int
+    kv_len: int
+
+
+def warm_key_ladder(engine) -> list:
+    """Every (kind, size, kv_bucket) program `InferenceEngine.warmup()`
+    compiles, derived by simulating the warmup schedule against the SAME
+    chunk arithmetic the engine uses (`chunk_plan`, `_kv_bucket`, the
+    decode dispatch shrink loop). If this list and the engine's actual
+    post-warmup `_warm` set ever disagree, the recompile sentinel fires in
+    production — the two are tested against each other."""
+    from ..runtime.engine import chunk_plan
+
+    cfg = engine.cfg
+    dcs = engine.decode_chunk_size
+    entries: list[LadderEntry] = []
+    seen = set()
+
+    def add(kind, size, kv):
+        e = LadderEntry(kind, size, kv)
+        if e not in seen:
+            seen.add(e)
+            entries.append(e)
+
+    # generate(prompt=[1]*n, steps) — the serving-critical solo ladder
+    n = max(1, min(engine.max_chunk, cfg.seq_len - dcs - 2))
+    steps = min(n + dcs + 8, cfg.seq_len)
+    if n > 1:
+        for i, size, _ in chunk_plan(n - 1, 0, engine.max_chunk, cfg.seq_len):
+            add("prefill", size, engine._kv_bucket(i + size))
+    # chunked decode from pos n-1 to steps, with the streaming TTFT ramp
+    # (warmup passes on_token), replicating _decode_device's shrink loop
+    pos = n - 1
+    max_pos = min(cfg.seq_len, steps)
+    first_chunk = min(8, dcs)
+    at = pos
+    chunk = first_chunk
+    while at < max_pos:
+        limit = max_pos - at
+        c = chunk if chunk is not None else dcs
+        while c > limit:
+            c //= 2
+        c = max(c, 1)
+        add("decode", c, engine._kv_bucket(at + c))
+        at += c
+        chunk = None
+
+    # warmup's BatchSession admit/step cycle (batch > 1 engines)
+    if engine.batch > 1 and engine.device_decode:
+        room = cfg.seq_len - dcs - 10
+        prompt_len = max(2, min(engine.max_chunk, room))
+        pre = prompt_len - 1
+        done = 0
+        while done < pre:
+            _, size, n_real = next(
+                iter(chunk_plan(pre - done, done, engine.max_chunk, cfg.seq_len))
+            )
+            add("prefill_row", size, engine._kv_bucket(done + size))
+            done += n_real
+        row_pos = prompt_len - 1
+        for c in (8, dcs):
+            if row_pos + 1 + c <= cfg.seq_len:
+                kvb = engine._kv_bucket(min(row_pos + 1 + c, cfg.seq_len))
+                add("batch_decode", c, kvb)
+                row_pos += c
+    return entries
+
+
+# -- tracing one ladder entry ----------------------------------------------
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def trace_entry(engine, entry: LadderEntry):
+    """`jax.make_jaxpr` of the program `entry` names, with abstract token /
+    position inputs and the engine's real params/cache closed over (tracing
+    reads shapes and shardings; nothing executes)."""
+    cfg, b = engine.cfg, engine.batch
+    if entry.kind == "prefill":
+        fn = lambda toks, pos: engine._forward(
+            toks, pos, logits_mode="last", kv_len=entry.kv_len
+        )
+        return jax.make_jaxpr(fn)(
+            _sds((b, entry.size), jnp.int32), _sds((), jnp.int32)
+        )
+    if entry.kind == "decode":
+        key = jax.random.PRNGKey(0)
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_decode_chunk
+
+            fn = lambda tok, pos: pipeline_decode_chunk(
+                cfg, engine.mesh, engine.params, engine.rope, engine.cache,
+                tok, pos, key, n_steps=entry.size, temperature=0.0,
+                topp=0.9, kv_len=entry.kv_len,
+            )
+        else:
+            from ..runtime.decode import decode_chunk
+
+            fn = lambda tok, pos: decode_chunk(
+                cfg, engine.params, engine.rope, engine.cache, tok, pos, key,
+                n_steps=entry.size, temperature=0.0, topp=0.9,
+                kv_len=entry.kv_len,
+            )
+        return jax.make_jaxpr(fn)(_sds((b,), jnp.int32), _sds((), jnp.int32))
+    if entry.kind == "prefill_row":
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_forward
+
+            fn = lambda toks, pos_vec: pipeline_forward(
+                cfg, engine.mesh, engine.params, engine.rope, engine.cache,
+                toks, pos_vec, logits_mode="last", kv_len=entry.kv_len,
+            )
+            return jax.make_jaxpr(fn)(
+                _sds((b, entry.size), jnp.int32), _sds((b,), jnp.int32)
+            )
+        from ..runtime.batch_session import prefill_row
+
+        fn = lambda toks, pos, row: prefill_row(
+            cfg, engine.params, engine.rope, engine.cache, toks, pos, row,
+            kv_len=entry.kv_len,
+        )
+        return jax.make_jaxpr(fn)(
+            _sds((1, entry.size), jnp.int32), _sds((), jnp.int32),
+            _sds((), jnp.int32),
+        )
+    if entry.kind == "batch_decode":
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_batch_decode_chunk as bdc
+
+            fn = lambda tok, pos, keys, temp, topp: bdc(
+                cfg, engine.mesh, engine.params, engine.rope, engine.cache,
+                tok, pos, keys, temp, topp, n_steps=entry.size,
+                kv_len=entry.kv_len,
+            )
+        else:
+            from ..runtime.batch_session import batch_decode_chunk
+
+            fn = lambda tok, pos, keys, temp, topp: batch_decode_chunk(
+                cfg, engine.params, engine.rope, engine.cache, tok, pos,
+                keys, temp, topp, n_steps=entry.size, kv_len=entry.kv_len,
+            )
+        return jax.make_jaxpr(fn)(
+            _sds((b,), jnp.int32), _sds((b,), jnp.int32),
+            _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
+            _sds((b,), jnp.float32),
+        )
+    raise ValueError(f"unknown ladder kind {entry.kind!r}")
+
+
+# -- expected manifests -----------------------------------------------------
+
+
+def expected_collectives(engine, entry: LadderEntry):
+    """The per-program collective budget for this engine's topology, or
+    None when the topology has no exact manifest (MoE / sp / ep meshes —
+    their collective structure is config-dependent; audit still enforces
+    dtypes and donation there).
+
+    Non-mesh and GSPMD programs contain ZERO explicit collectives (XLA
+    inserts GSPMD collectives after partitioning, below the jaxpr). The
+    shard_map pipeline path emits, per forward (parallel/pipeline.py):
+
+    * 2 psum("tp") per pipeline round (attention + FFN output reductions,
+      counted once per round's layer-scan body),
+    * 1 psum("pp") broadcasting the final stage's activations,
+    * 1 all_gather("tp") assembling the logits,
+    * 1 ppermute per round (stage handoff).
+
+    rounds = microbatches + pp - 1; decode runs 1 microbatch, prefill
+    chunks microbatch to pp when the chunk length divides (engine._forward).
+    """
+    if not engine.use_pipeline:
+        return {}
+    mesh = engine.mesh
+    if engine.cfg.is_moe or mesh.shape["sp"] > 1 or mesh.shape.get("ep", 1) > 1:
+        return None
+    rounds = pipeline_rounds(engine, entry)
+    return {"psum": 2 * rounds + 1, "all_gather": 1, "ppermute": rounds}
+
+
+def pipeline_rounds(engine, entry: LadderEntry) -> int:
+    """GPipe rounds this program's jaxpr contains: microbatches + pp - 1,
+    with the microbatch rule mirroring engine._forward (prefill chunks
+    microbatch to pp when the chunk length divides; decode runs 1;
+    prefill_row rides pipeline_forward's default of 1). The ONE owner of
+    this derivation — both the collective budget and the f32-dot budget
+    are per-round quantities and must move together."""
+    pp = engine.mesh.shape["pp"]
+    if entry.kind == "prefill":
+        micro = pp if entry.size % pp == 0 else 1
+    else:  # decode / batch_decode / prefill_row all run one microbatch
+        micro = 1
+    return micro + pp - 1
+
+
+def attention_sites(engine, entry: LadderEntry) -> int:
+    """Structural count of attention bodies in this program's jaxpr: one
+    per layer-scan body for non-mesh programs, one per pipeline round on
+    shard_map meshes (the rounds loop is a Python loop, each round builds
+    its own layer scan)."""
+    if not engine.use_pipeline:
+        return 1
+    return pipeline_rounds(engine, entry)
+
+
+def f32_dot_budget(engine, entry: LadderEntry) -> int:
+    """Max sanctioned f32-touching dot_generals for a bfloat16 engine.
+
+    The deliberate f32 matmuls live in attention — the softmax-side
+    products ops/attention.py keeps at f32 for numerics: measured, each
+    attention body contributes exactly 2 dots with an f32 input (scores
+    path + probs·V). Everything else — the quantized Q40/int8 projections,
+    logits — must keep bf16 inputs, so any EXTRA f32-touching dot is an
+    accidental upcast of a quantized matmul path."""
+    return 2 * attention_sites(engine, entry)
+
+
+# -- checks -----------------------------------------------------------------
+
+
+def dtype_problems(engine, entry: LadderEntry, jaxpr) -> list:
+    problems = []
+    dtypes = dtype_census(jaxpr)
+    if "float64" in dtypes:
+        problems.append("float64 appears in the traced program")
+    dots = dot_input_census(jaxpr)
+    for (l, r), cnt in dots.items():
+        if "float64" in (l, r):
+            problems.append(f"float64 dot_general inputs ({l} x {r}) x{cnt}")
+    if engine.cfg.dtype == jnp.bfloat16:
+        f32_dots = sum(
+            cnt for (l, r), cnt in dots.items() if "float32" in (l, r)
+        )
+        budget = f32_dot_budget(engine, entry)
+        if f32_dots > budget:
+            problems.append(
+                f"{f32_dots} f32-input dot_generals exceed the sanctioned "
+                f"budget of {budget} (attention softmax-side products) — an "
+                "accidental f32 upcast in a quantized matmul path"
+            )
+    return problems
+
+
+def collective_problems(engine, entry: LadderEntry, jaxpr) -> list:
+    expected = expected_collectives(engine, entry)
+    if expected is None:
+        return []
+    got = collective_counts(jaxpr)
+    problems = []
+    for name in sorted(set(expected) | set(got)):
+        e, g = expected.get(name, 0), got.get(name, 0)
+        if e != g:
+            problems.append(
+                f"collective budget violated: {name} x{g} traced, "
+                f"x{e} expected for this topology"
+            )
+    return problems
+
+
+def donation_problems(engine) -> list:
+    """Lower each decode/prefill jit entry point this engine uses and
+    assert the KV cache donation survived into the MLIR (buffer-alias
+    markers). One lowering per program CLASS — donation is declared on the
+    function, not per shape."""
+    cfg, b = engine.cfg, engine.batch
+    kvb = engine._kv_bucket(1)
+    key = jax.random.PRNGKey(0)
+    tok1 = jnp.zeros((b, 1), jnp.int32)
+    tokb = jnp.zeros((b,), jnp.int32)
+    pos = jnp.int32(0)
+    problems = []
+
+    def check(name, lowered):
+        txt = lowered.as_text()
+        if not any(m in txt for m in DONATION_MARKERS):
+            problems.append(
+                f"{name}: KV cache donation lost (no "
+                f"{'/'.join(DONATION_MARKERS)} marker in the lowered program)"
+            )
+
+    if engine.use_pipeline:
+        from ..parallel import pipeline as pl
+
+        fn = pl._cached_pipeline_fn(
+            cfg, engine.mesh, engine.params, engine.cache,
+            ("fwd", "last", 1, kvb, False),
+            lambda ps, cs: pl._build_pipeline_fn(
+                cfg, engine.mesh, ps, cs, "last", 1, kvb, per_row=False
+            ),
+        )
+        check(
+            "pipeline_forward",
+            fn.lower(engine.params, engine.rope, engine.cache, tok1, pos),
+        )
+        dfn = pl._cached_pipeline_fn(
+            cfg, engine.mesh, engine.params, engine.cache,
+            ("decode", 1, 0.0, 0.9, kvb, False),
+            lambda ps, cs: pl._build_pipeline_decode_fn(
+                cfg, engine.mesh, ps, cs, 1, 0.0, 0.9, kvb, per_row=False
+            ),
+        )
+        check(
+            "pipeline_decode_chunk",
+            dfn.lower(engine.params, engine.rope, engine.cache, tokb, pos, key),
+        )
+    else:
+        from ..models.transformer import forward
+        from ..runtime.decode import decode_chunk
+
+        check(
+            "forward",
+            forward.lower(
+                cfg, engine.params, engine.rope, engine.cache, tok1, pos,
+                logits_mode="last", kv_len=kvb,
+            ),
+        )
+        check(
+            "decode_chunk",
+            decode_chunk.lower(
+                cfg, engine.params, engine.rope, engine.cache, tokb, pos,
+                key, n_steps=1, temperature=0.0, topp=0.9, kv_len=kvb,
+            ),
+        )
+        if engine.batch > 1:
+            from ..runtime.batch_session import batch_decode_chunk, prefill_row
+
+            check(
+                "batch_decode_chunk",
+                batch_decode_chunk.lower(
+                    cfg, engine.params, engine.rope, engine.cache, tokb,
+                    jnp.zeros((b,), jnp.int32), jnp.zeros((b, 2), jnp.uint32),
+                    jnp.zeros((b,), jnp.float32), jnp.full((b,), 0.9, jnp.float32),
+                    n_steps=1, kv_len=kvb,
+                ),
+            )
+            check(
+                "prefill_row",
+                prefill_row.lower(
+                    cfg, engine.params, engine.rope, engine.cache,
+                    jnp.zeros((1, 1), jnp.int32), pos, jnp.int32(0), kv_len=kvb,
+                ),
+            )
+    return problems
+
+
+def sharding_problems(engine) -> list:
+    """Per-stage sharding consistency on pipeline meshes: every per-layer
+    weight stack shards its leading (layer) axis over `pp`, and the cache
+    matches `pp_cache_sharding` — the invariants the shard_map in_specs are
+    *derived from* (pipeline.py reads specs off the concrete arrays, so a
+    mis-sharded param silently reshapes the whole program)."""
+    if engine.mesh is None or not engine.use_pipeline:
+        return []
+    from jax.sharding import NamedSharding
+
+    from ..parallel.pipeline import pp_cache_sharding
+
+    problems = []
+    expected_cache = pp_cache_sharding(engine.mesh)
+    for name, arr in (("cache.k", engine.cache.k), ("cache.v", engine.cache.v)):
+        sh = getattr(arr, "sharding", None)
+        if not isinstance(sh, NamedSharding) or sh.spec != expected_cache.spec:
+            problems.append(
+                f"{name} sharding {getattr(sh, 'spec', None)} != pipeline "
+                f"cache spec {expected_cache.spec}"
+            )
+    for i, leaf in enumerate(jax.tree.leaves(engine.params.layers)):
+        sh = getattr(leaf, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            problems.append(f"layer param leaf {i} has no NamedSharding")
+            continue
+        if sh.mesh.shape != engine.mesh.shape:
+            problems.append(f"layer param leaf {i} lives on a different mesh")
+        spec = sh.spec
+        if len(spec) == 0 or spec[0] != "pp":
+            problems.append(
+                f"layer param leaf {i} layer-stack axis not sharded over pp "
+                f"(spec {spec}) — stages would compute on replicated layers"
+            )
+    return problems
+
+
+# -- driver -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AuditReport:
+    entry: LadderEntry
+    collectives: dict
+    dtypes: set
+    problems: list
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+
+def audit_engine(engine, ladder=None) -> list:
+    """Audit every warm-ladder program plus the engine-wide donation and
+    sharding contracts; returns one AuditReport per ladder entry (engine-
+    wide problems ride the first report)."""
+    ladder = warm_key_ladder(engine) if ladder is None else ladder
+    reports = []
+    for entry in ladder:
+        jaxpr = trace_entry(engine, entry)
+        problems = dtype_problems(engine, entry, jaxpr)
+        problems += collective_problems(engine, entry, jaxpr)
+        reports.append(
+            AuditReport(
+                entry=entry,
+                collectives=collective_counts(jaxpr),
+                dtypes=dtype_census(jaxpr),
+                problems=problems,
+            )
+        )
+    engine_wide = donation_problems(engine) + sharding_problems(engine)
+    if engine_wide:
+        if not reports:
+            reports.append(
+                AuditReport(LadderEntry("engine", 0, 0), {}, set(), [])
+            )
+        reports[0].problems.extend(engine_wide)
+    return reports
+
+
+def assert_clean(reports) -> None:
+    bad = [r for r in reports if not r.ok]
+    if bad:
+        lines = []
+        for r in bad:
+            for p in r.problems:
+                lines.append(f"{r.entry.kind}[{r.entry.size}|kv{r.entry.kv_len}]: {p}")
+        raise GraphAuditError(
+            "graph audit failed:\n  " + "\n  ".join(lines)
+        )
+
+
+def format_reports(reports) -> str:
+    lines = ["🔎 graph audit:"]
+    for r in reports:
+        status = "ok" if r.ok else "FAIL"
+        coll = (
+            " ".join(f"{k}x{v}" for k, v in sorted(r.collectives.items()))
+            or "none"
+        )
+        lines.append(
+            f"  [{status}] {r.entry.kind}[{r.entry.size}|kv{r.entry.kv_len}] "
+            f"collectives: {coll}"
+        )
+        for p in r.problems:
+            lines.append(f"         ! {p}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """CLI: audit a model file's engine, or (default) a tiny synthetic
+    model — the CI smoke path."""
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(prog="dlt-graph-audit")
+    p.add_argument("--model", default=None, help=".m file (default: tiny synthetic)")
+    p.add_argument("--compute-dtype", default="float32")
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--max-chunk", type=int, default=16)
+    p.add_argument("--decode-chunk-size", type=int, default=8)
+    args = p.parse_args(argv)
+
+    from ..runtime.engine import InferenceEngine
+
+    with tempfile.TemporaryDirectory() as d:
+        model = args.model
+        if model is None:
+            from ..testing import tiny_header, write_tiny_model
+
+            model = d + "/tiny.m"
+            write_tiny_model(model, tiny_header(seq_len=128), seed=0)
+        engine = InferenceEngine(
+            model, compute_dtype=args.compute_dtype, batch=args.batch,
+            max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
+        )
+        try:
+            reports = audit_engine(engine)
+        finally:
+            engine.close()
+    print(format_reports(reports))
+    return 0 if all(r.ok for r in reports) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,292 @@
+"""dlt-lint: the AST lint enforcing project rules the runtime can't.
+
+Rules (ids in parentheses; suppress a line with ``# dlt: allow(<rule>)``,
+comma-separate for several — the pragma documents WHY at the site):
+
+* **bare-except** — ``except:`` catches SystemExit/KeyboardInterrupt and
+  hides the watchdog's StallError; always name the exception;
+* **swallowed-exception** — ``except Exception:`` (or BaseException) whose
+  body is only ``pass``: a failure mode the operator can never see. Either
+  narrow the type, handle it, or pragma it with the reason it is safe;
+* **lock-with** — lock/condition ``.acquire()`` called explicitly: lock
+  discipline in this codebase is ``with`` only (a raised exception between
+  acquire and release leaks the lock and wedges the Batcher/gateway
+  forever). Applies to receivers whose name looks lock-ish
+  (lock/cond/mutex/sem);
+* **thread-daemon** — ``threading.Thread(...)`` without an explicit
+  ``daemon=``: a forgotten non-daemon thread turns every crash into a
+  hang at interpreter exit (the watchdog/prober/writer threads must never
+  outlive the process). Thread *subclasses* must pass ``daemon=`` in their
+  ``super().__init__`` call;
+* **float64** — ``float64`` dtype literals in device-side packages
+  (ops/models/parallel/runtime): one f64 constant silently promotes a
+  whole matmul chain (the graph auditor catches the traced result; this
+  catches the source). Host-side precomputation (rope tables) carries a
+  pragma;
+* **host-sync** — ``np.asarray`` / ``np.array`` / ``jax.device_get`` in
+  the hot packages (runtime/parallel): each is a potential blocking
+  device→host sync worth ~100 ms of tunnel round trip. The sanctioned
+  fetch sites carry pragmas — which doubles as the canonical list of
+  blessed host syncs the host_sync_guard sanitizer allows.
+
+The CLI lives at ``scripts/dlt_lint.py``; CI runs it over the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+ALL_RULES = (
+    "bare-except",
+    "swallowed-exception",
+    "lock-with",
+    "thread-daemon",
+    "float64",
+    "host-sync",
+)
+
+_PRAGMA_RE = re.compile(r"#\s*dlt:\s*allow\(([^)]*)\)")
+_LOCKISH_RE = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
+
+#: packages where a float64 literal is device-side poison
+FLOAT64_SCOPE = ("ops", "models", "parallel", "runtime", "formats")
+#: packages whose np.asarray/np.array sites are potential host syncs
+HOST_SYNC_SCOPE = ("runtime", "parallel")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def _pragmas(source: str) -> dict:
+    """line -> set of allowed rule ids (``*`` = all)."""
+    out: dict = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _receiver_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('threading.Thread')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # repo-relative path, for scope decisions
+        self.pragmas = _pragmas(source)
+        self.violations: list = []
+        self._thread_classes: list = []  # ClassDef stack: is-Thread-subclass
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _in_scope(self, packages) -> bool:
+        parts = Path(self.rel).parts
+        return any(p in parts for p in packages)
+
+    def _allowed(self, rule: str, node: ast.AST) -> bool:
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start) or start
+        for line in range(start, end + 1):
+            allowed = self.pragmas.get(line)
+            if allowed and (rule in allowed or "*" in allowed):
+                return True
+        # a pragma-only line directly above the statement also applies
+        allowed = self.pragmas.get(start - 1)
+        return bool(allowed and (rule in allowed or "*" in allowed))
+
+    def _flag(self, rule: str, node: ast.AST, msg: str):
+        if not self._allowed(rule, node):
+            self.violations.append(
+                Violation(self.path, getattr(node, "lineno", 0), rule, msg)
+            )
+
+    # -- rules --------------------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if node.type is None:
+            self._flag(
+                "bare-except", node,
+                "bare `except:` — name the exception (it catches "
+                "KeyboardInterrupt/SystemExit and hides StallError)",
+            )
+        else:
+            names = []
+            types = node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+            for t in types:
+                names.append(_receiver_name(t))
+            body_is_noop = all(
+                isinstance(s, ast.Pass)
+                or (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis
+                )
+                for s in node.body
+            )
+            if body_is_noop and any(n in ("Exception", "BaseException") for n in names):
+                self._flag(
+                    "swallowed-exception", node,
+                    "`except Exception: pass` swallows every failure mode — "
+                    "narrow it, handle it, or pragma it with the reason",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # lock-with: explicit .acquire() on lock-ish receivers
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+            and _LOCKISH_RE.search(_receiver_name(node.func.value))
+        ):
+            self._flag(
+                "lock-with", node,
+                f"explicit {_dotted(node.func)}() — locks are taken via "
+                "`with` only (exception safety)",
+            )
+        # thread-daemon: Thread(...) constructors
+        dotted = _dotted(node.func)
+        if dotted in ("threading.Thread", "Thread"):
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                self._flag(
+                    "thread-daemon", node,
+                    "Thread(...) without an explicit daemon= — an "
+                    "undeclared non-daemon thread hangs process exit",
+                )
+        # thread-daemon: Thread subclass super().__init__ without daemon=
+        if (
+            dotted.endswith("__init__")
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Call)
+            and _dotted(node.func.value.func) == "super"
+            and self._thread_classes
+            and self._thread_classes[-1]
+        ):
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                self._flag(
+                    "thread-daemon", node,
+                    "Thread subclass super().__init__ without daemon= — "
+                    "declare the thread's lifetime explicitly",
+                )
+        # float64 dtype literal in device-side scope
+        if self._in_scope(FLOAT64_SCOPE):
+            for kw in node.keywords:
+                if (
+                    kw.arg == "dtype"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value in ("float64", "f8", "double")
+                ):
+                    self._flag(
+                        "float64", kw.value,
+                        "float64 dtype literal in a device-side package",
+                    )
+        # host-sync: potential blocking fetches in hot packages
+        if self._in_scope(HOST_SYNC_SCOPE):
+            if dotted in ("np.asarray", "np.array", "numpy.asarray",
+                          "numpy.array", "jax.device_get"):
+                self._flag(
+                    "host-sync", node,
+                    f"{dotted}(...) in a hot package is a potential "
+                    "blocking device->host sync — pragma the sanctioned "
+                    "sites (see docs/ANALYSIS.md)",
+                )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if self._in_scope(FLOAT64_SCOPE) and node.attr == "float64":
+            self._flag(
+                "float64", node,
+                "float64 literal in a device-side package (one f64 "
+                "constant promotes the whole chain)",
+            )
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        is_thread = any(
+            _dotted(b) in ("threading.Thread", "Thread") for b in node.bases
+        )
+        self._thread_classes.append(is_thread)
+        self.generic_visit(node)
+        self._thread_classes.pop()
+
+
+def lint_source(source: str, path: str, rel: str | None = None) -> list:
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, rel if rel is not None else path, source)
+    linter.visit(tree)
+    return linter.violations
+
+
+def lint_file(path, root=None) -> list:
+    p = Path(path)
+    rel = str(p.relative_to(root)) if root else str(p)
+    return lint_source(p.read_text(), str(p), rel)
+
+
+def lint_paths(paths, root=None, exclude=("tests", "__pycache__")) -> list:
+    """Lint every .py under `paths` (files or directories)."""
+    out: list = []
+    for path in paths:
+        p = Path(path)
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            if any(part in exclude for part in f.parts):
+                continue
+            out.extend(lint_file(f, root=root))
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="dlt-lint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: the package + scripts)")
+    ap.add_argument("--root", default=None, help="repo root for scope-relative paths")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
+    paths = [Path(p) for p in args.paths] or [
+        root / "distributed_llama_tpu",
+        root / "scripts",
+        root / "bench.py",
+        root / "launch.py",
+    ]
+    violations = lint_paths([p for p in paths if p.exists()], root=root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"dlt-lint: {len(violations)} violation(s)")
+        return 1
+    print("dlt-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,154 @@
+"""Recompile sentinel: post-warmup XLA compiles are bugs, catch them live.
+
+The serving design compiles a closed ladder of programs up front
+(`InferenceEngine.warmup`: every prefill (size, kv-bucket) pair, the decode
+ramp + full chunks, the BatchSession admission/step cycle) precisely so no
+user request ever pays a compile. That contract is *invisible*: a shape
+regression — a mis-bucketed kv_len, a chunk planner change, a new dtype on a
+traced argument — silently re-introduces multi-second compiles inside user
+requests, and the only production symptom is a p99 cliff.
+
+The sentinel makes the contract observable: it subscribes to JAX's
+monitoring events (``/jax/core/compile/backend_compile_duration`` fires once
+per actual backend compile; cache hits are silent), counts compiles during
+the warmup window, and after ``seal()`` turns every further compile into
+
+* a ``sanitizer_recompiles`` counter bump in the engine's `StepStats`
+  (surfaces in ``/stats`` and ``/health``), and
+* optionally a raised :class:`RecompileError` (``DLT_SANITIZERS_FATAL=1``
+  or ``fatal=True``) — the exception propagates out of the jit call that
+  triggered the compile, so tests and canaries fail at the exact site.
+
+Scope: compile events are PROCESS-wide (JAX has no per-function hook).
+While any subscribed sentinel is still in its warm window, compiles are
+attributed to the warming engine(s) — a sealed co-resident engine neither
+counts them nor (fatal mode) aborts another engine's legitimate warmup.
+Once EVERY subscriber is sealed, any compile is a breach and is reported
+to all sentinels (it cannot be attributed further). That is the right
+semantics for a serving process — after warmup *nothing* should compile.
+Opt-in via ``DLT_SANITIZERS=1`` (the engine wires this automatically; see
+runtime/engine.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import sanitizers_fatal
+
+#: substrings identifying a compile event across jax versions
+_COMPILE_EVENT_MARKERS = ("backend_compile",)
+
+_install_lock = threading.Lock()
+_installed = False
+_subscribers: set = set()
+
+
+class RecompileError(RuntimeError):
+    """A post-warmup (sealed) compile happened — the warm-key ladder has a
+    hole or a caller dispatched an unwarmed shape."""
+
+
+def _dispatch(event: str, *args, **kwargs):
+    if not any(m in event for m in _COMPILE_EVENT_MARKERS):
+        return
+    # JAX's compile events carry no function identity, so attribution is a
+    # heuristic: while ANY subscriber is still in its warm window, compiles
+    # belong to the warming engine(s) — a sealed co-resident engine must
+    # neither count them nor (fatal mode) abort another engine's warmup.
+    # Only when every subscriber is sealed is a compile a genuine breach
+    # (and then it is reported to all, since it cannot be attributed).
+    subs = list(_subscribers)
+    unsealed = [s for s in subs if not s.sealed]
+    for s in (unsealed if unsealed else subs):
+        s._on_compile(event)
+
+
+def _install_once():
+    """Register the ONE process-wide monitoring listener (jax.monitoring has
+    no unregister, so sentinels subscribe/unsubscribe against our own
+    dispatcher instead of the jax registry)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_dispatch)
+        _installed = True
+
+
+class RecompileSentinel:
+    """Counts backend compiles; after `seal()` they are violations.
+
+    Usable standalone::
+
+        sentinel = RecompileSentinel(stats=engine.stats).start()
+        engine.warmup()
+        sentinel.seal()
+        ... serve ...
+        assert sentinel.post_seal_compiles == 0
+
+    or as a context manager (auto start/stop). Thread-safe: compile events
+    can arrive from any thread that triggers a jit compile.
+    """
+
+    def __init__(self, stats=None, fatal: bool | None = None, name: str = "engine"):
+        self.stats = stats  # StepStats: violations become counters
+        self.fatal = sanitizers_fatal() if fatal is None else fatal
+        self.name = name
+        self.sealed = False
+        self.warm_compiles = 0
+        self.post_seal_compiles = 0
+        self._lock = threading.Lock()
+        self._active = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "RecompileSentinel":
+        _install_once()
+        _subscribers.add(self)
+        self._active = True
+        return self
+
+    def stop(self):
+        _subscribers.discard(self)
+        self._active = False
+
+    def __enter__(self) -> "RecompileSentinel":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def seal(self):
+        """End the warmup window: every compile from here on is a breach."""
+        with self._lock:
+            self.sealed = True
+        if self.stats is not None:
+            self.stats.gauge("sanitizer_warm_compiles", self.warm_compiles)
+
+    def unseal(self):
+        """Re-open the warmup window (e.g. an intentional reconfiguration
+        that legitimately compiles new shapes)."""
+        with self._lock:
+            self.sealed = False
+
+    # -- event sink ---------------------------------------------------------
+
+    def _on_compile(self, event: str):
+        with self._lock:
+            if not self.sealed:
+                self.warm_compiles += 1
+                return
+            self.post_seal_compiles += 1
+        if self.stats is not None:
+            self.stats.incr("sanitizer_recompiles")
+        if self.fatal:
+            raise RecompileError(
+                f"post-warmup XLA compile detected ({self.name}): the "
+                "warm-key ladder does not cover a shape that just got "
+                "dispatched — find the mis-bucketed caller "
+                f"(event {event})"
+            )

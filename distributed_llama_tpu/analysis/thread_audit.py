@@ -1,0 +1,307 @@
+"""Thread auditor: lock-order recording, long-hold detection, and
+guarded-mutation checks for the serving layer's thread soup.
+
+The serving stack runs at least five thread families against shared state:
+request handler threads (emit-queue writers), the Batcher step loop, the
+gateway's per-connection proxies + `Balancer.cond` waiters, the health
+prober, the stall watchdog, and the chaos proxy's accept loop. Their
+discipline — a strict lock order, short hold times, counters only mutated
+under `_counter_lock` — is enforced by convention only; a violation
+deadlocks or corrupts silently and reproduces never.
+
+This module turns the convention into a recorded, checkable artifact:
+
+* :class:`AuditedLock` — a drop-in lock proxy recording every
+  acquire/release with owner, wait time, and hold time;
+* :class:`ThreadAuditor` — aggregates the proxies into a **lock-order
+  graph** (edge A→B = "B acquired while holding A"); `cycles()` finds
+  order inversions (potential deadlocks) even when the schedule never
+  actually deadlocked in the run; a hold longer than `long_hold_ms` is a
+  recorded violation (a lock held across a device call or socket write
+  starves every co-batched request);
+* :class:`GuardedDict` — a dict whose mutations must happen while the
+  owning lock is held by the mutating thread; anything else is recorded.
+  `instrument_stepstats` wires it under `StepStats.counters/gauges`, so a
+  counter bumped outside `_counter_lock` fails tests instead of dropping
+  increments under load.
+
+The auditor is a TEST/diagnosis harness (pure Python, no jax): tests wrap
+the real locks via the `instrument_*` helpers, drive real traffic, then
+`check()`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ThreadAuditError(AssertionError):
+    """Lock-order cycle, long hold, or unguarded mutation detected."""
+
+
+class AuditedLock:
+    """Proxy over a `threading.Lock`/`RLock` recording order + hold times.
+
+    Also usable as the lock of a `threading.Condition` (it exposes
+    `_is_owned`, which Condition prefers over its probe-acquire fallback).
+    Reentrant acquires are tracked with a depth count so RLock wrapping
+    works; a plain Lock simply never re-enters."""
+
+    def __init__(self, auditor: "ThreadAuditor", lock, name: str):
+        self._auditor = auditor
+        self._lock = lock
+        self.name = name
+        self.owner: int | None = None  # thread ident while held
+        self._depth = 0
+        self._acquired_at = 0.0
+
+    # -- lock protocol ------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.perf_counter()
+        if timeout is None or timeout < 0:
+            ok = self._lock.acquire(blocking)  # dlt: allow(lock-with) — the proxy IS the lock implementation
+        else:
+            ok = self._lock.acquire(blocking, timeout)  # dlt: allow(lock-with) — see above
+        if ok:
+            self._auditor._on_acquire(self, time.perf_counter() - t0)
+        return ok
+
+    def release(self):
+        self._auditor._on_release(self)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") else self.owner is not None
+
+    def _is_owned(self) -> bool:  # Condition protocol
+        return self.held_by_current_thread()
+
+    def held_by_current_thread(self) -> bool:
+        return self.owner == threading.get_ident()
+
+
+class GuardedDict(dict):
+    """A dict whose MUTATIONS require `lock.held_by_current_thread()`.
+
+    Reads stay unguarded (snapshot methods copy under the lock already;
+    racy reads are the documented contract). A mutation without the lock is
+    recorded as a violation — not raised inline, so the auditor reports
+    every offender instead of dying on the first."""
+
+    def __init__(self, auditor: "ThreadAuditor", lock: AuditedLock, name: str, init=()):
+        super().__init__(init)
+        self._auditor = auditor
+        self._lock = lock
+        self._name = name
+
+    def _check(self, op: str):
+        if not self._lock.held_by_current_thread():
+            self._auditor.record_violation(
+                "unguarded-mutation",
+                f"{self._name}.{op} without holding {self._lock.name} "
+                f"(thread {threading.current_thread().name})",
+            )
+
+    def __setitem__(self, k, v):
+        self._check(f"__setitem__[{k!r}]")
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._check(f"__delitem__[{k!r}]")
+        super().__delitem__(k)
+
+    def pop(self, *a, **kw):
+        self._check("pop")
+        return super().pop(*a, **kw)
+
+    def popitem(self):
+        self._check("popitem")
+        return super().popitem()
+
+    def clear(self):
+        self._check("clear")
+        super().clear()
+
+    def update(self, *a, **kw):
+        self._check("update")
+        super().update(*a, **kw)
+
+    def setdefault(self, *a, **kw):
+        self._check("setdefault")
+        return super().setdefault(*a, **kw)
+
+
+class ThreadAuditor:
+    """Aggregates AuditedLock events into order edges + violations."""
+
+    def __init__(self, long_hold_ms: float = 500.0):
+        self.long_hold_ms = long_hold_ms
+        self._mu = threading.Lock()  # guards edges/violations/hold stats
+        self._tls = threading.local()
+        self.edges: dict = {}  # (held_name, acquired_name) -> count
+        self.violations: list = []  # (kind, message)
+        self.hold_counts: dict = {}  # name -> n releases
+        self.max_hold_ms: dict = {}  # name -> worst hold
+
+    # -- wiring -------------------------------------------------------------
+
+    def wrap(self, lock, name: str) -> AuditedLock:
+        return AuditedLock(self, lock, name)
+
+    def record_violation(self, kind: str, msg: str):
+        with self._mu:
+            self.violations.append((kind, msg))
+
+    # -- lock event sinks ---------------------------------------------------
+
+    def _held_stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, lock: AuditedLock, wait_s: float):
+        ident = threading.get_ident()
+        stack = self._held_stack()
+        if lock.owner == ident:
+            lock._depth += 1  # reentrant (RLock under the proxy)
+            return
+        with self._mu:
+            for held in stack:
+                if held is not lock:
+                    key = (held.name, lock.name)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+        lock.owner = ident
+        lock._depth = 1
+        lock._acquired_at = time.perf_counter()
+        stack.append(lock)
+
+    def _on_release(self, lock: AuditedLock):
+        ident = threading.get_ident()
+        if lock.owner != ident:
+            self.record_violation(
+                "foreign-release",
+                f"{lock.name} released by thread "
+                f"{threading.current_thread().name} which does not own it",
+            )
+            return
+        lock._depth -= 1
+        if lock._depth > 0:
+            return
+        hold_ms = (time.perf_counter() - lock._acquired_at) * 1000.0
+        lock.owner = None
+        stack = self._held_stack()
+        if lock in stack:
+            stack.remove(lock)
+        with self._mu:
+            self.hold_counts[lock.name] = self.hold_counts.get(lock.name, 0) + 1
+            self.max_hold_ms[lock.name] = max(
+                self.max_hold_ms.get(lock.name, 0.0), hold_ms
+            )
+        if hold_ms > self.long_hold_ms:
+            self.record_violation(
+                "long-hold",
+                f"{lock.name} held {hold_ms:.1f} ms "
+                f"(> {self.long_hold_ms:.0f} ms) by "
+                f"{threading.current_thread().name}",
+            )
+
+    # -- analysis -----------------------------------------------------------
+
+    def cycles(self) -> list:
+        """Cycles in the recorded lock-order graph (each as a name list).
+        Any cycle is a potential deadlock: two threads interleaving those
+        acquire chains can block forever, whether or not this run did."""
+        with self._mu:
+            adj: dict = {}
+            for a, b in self.edges:
+                adj.setdefault(a, set()).add(b)
+        out, done = [], set()
+        for start in adj:
+            if start in done:
+                continue
+            path, on_path = [], set()
+
+            def dfs(node):
+                if node in on_path:
+                    out.append(path[path.index(node):] + [node])
+                    return
+                if node in done:
+                    return
+                done.add(node)
+                path.append(node)
+                on_path.add(node)
+                for nxt in adj.get(node, ()):
+                    dfs(nxt)
+                path.pop()
+                on_path.remove(node)
+
+            dfs(start)
+        return out
+
+    def check(self):
+        """Raise ThreadAuditError on any recorded violation or order cycle."""
+        problems = [f"{k}: {m}" for k, m in self.violations]
+        problems += [
+            "lock-order cycle: " + " -> ".join(c) for c in self.cycles()
+        ]
+        if problems:
+            raise ThreadAuditError(
+                "thread audit failed:\n  " + "\n  ".join(problems)
+            )
+
+    def report(self) -> str:
+        with self._mu:
+            lines = ["🔒 thread audit:"]
+            for (a, b), n in sorted(self.edges.items()):
+                lines.append(f"  order {a} -> {b} x{n}")
+            for name in sorted(self.hold_counts):
+                lines.append(
+                    f"  hold  {name}: n={self.hold_counts[name]} "
+                    f"max={self.max_hold_ms[name]:.2f} ms"
+                )
+            for k, m in self.violations:
+                lines.append(f"  ! {k}: {m}")
+        for c in self.cycles():
+            lines.append("  ! cycle: " + " -> ".join(c))
+        return "\n".join(lines)
+
+
+# -- instrumentation helpers -------------------------------------------------
+
+
+def instrument_stepstats(stats, auditor: ThreadAuditor, name: str = "stepstats"):
+    """Swap StepStats' counter lock for an audited one and guard its
+    counter/gauge dicts: a mutation outside `_counter_lock` is recorded."""
+    lock = auditor.wrap(stats._counter_lock, f"{name}._counter_lock")
+    stats._counter_lock = lock
+    stats.counters = GuardedDict(auditor, lock, f"{name}.counters", stats.counters)
+    stats.gauges = GuardedDict(auditor, lock, f"{name}.gauges", stats.gauges)
+    return lock
+
+
+def instrument_balancer(balancer, auditor: ThreadAuditor, name: str = "balancer"):
+    """Audit the gateway Balancer's lock/condition (they share one mutex:
+    `cond` is rebuilt around the audited proxy so both entry styles —
+    `with self.lock` and `with self.cond` — are recorded)."""
+    lock = auditor.wrap(balancer.lock, f"{name}.lock")
+    balancer.lock = lock
+    balancer.cond = threading.Condition(lock)
+    return lock
+
+
+def instrument_chaos(proxy, auditor: ThreadAuditor, name: str = "chaos"):
+    """Audit a ChaosProxy's accept-counter lock."""
+    lock = auditor.wrap(proxy._lock, f"{name}._lock")
+    proxy._lock = lock
+    return lock

@@ -70,7 +70,7 @@ def _scale_frequency_llama3(
 def build_rope_tables(h: ModelHeader) -> RopeTables:
     """Precompute per-position cos/sin for all pair indices of one head."""
     half = h.head_dim // 2
-    freqs = np.empty(half, dtype=np.float64)
+    freqs = np.empty(half, dtype=np.float64)  # dlt: allow(float64) — host-side precompute; cast to f32 before device
     # scaling is gated on the factor alone, matching the reference
     # (applyScaling = ropeScalingFactor != 1.0f, src/nn/nn-core.cpp:346) — a
     # LLAMA3_1-typed header without scaling keys must not apply scaling
@@ -86,7 +86,7 @@ def build_rope_tables(h: ModelHeader) -> RopeTables:
                 h.rope_scaling_orig_max_seq_len,
             )
         freqs[j] = f
-    pos = np.arange(h.seq_len, dtype=np.float64)[:, None]
+    pos = np.arange(h.seq_len, dtype=np.float64)[:, None]  # dlt: allow(float64) — host-side; angles cast to f32 below
     angles = (pos * freqs[None, :]).astype(np.float32)
     return RopeTables(cos=jnp.asarray(np.cos(angles)), sin=jnp.asarray(np.sin(angles)))
 

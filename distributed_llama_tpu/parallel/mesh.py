@@ -37,5 +37,5 @@ def make_mesh(
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, pp, ep, tp, sp)
+    arr = np.asarray(devices[:n]).reshape(dp, pp, ep, tp, sp)  # dlt: allow(host-sync) — array of device handles, no data transfer
     return Mesh(arr, AXES)

@@ -163,5 +163,5 @@ def make_multihost_mesh(
     need = dp * pp * ep * tp * sp
     if need != n:
         raise ValueError(f"mesh {dp}x{pp}x{ep}x{tp}x{sp} != {n} global devices")
-    arr = np.asarray(devices).reshape(dp, pp, ep, tp, sp)
+    arr = np.asarray(devices).reshape(dp, pp, ep, tp, sp)  # dlt: allow(host-sync) — array of device handles, no data transfer
     return Mesh(arr, AXES)

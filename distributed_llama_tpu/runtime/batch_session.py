@@ -229,50 +229,55 @@ class BatchSession:
         budget = len(pre) if max_tokens is None else max_tokens
         from .engine import chunk_plan
 
-        while st["done"] < len(pre) and budget > 0:
-            done = st["done"]
-            # plan against the REMAINING BUDGET too, so a budget below
-            # max_chunk is honored exactly (the chunk's bucket may pad past
-            # an odd budget, but its real tokens never exceed it) instead of
-            # overshooting by up to a whole max_chunk chunk
-            _, size, n_real = next(
-                iter(
-                    chunk_plan(
-                        min(len(pre) - done, budget), done, eng.max_chunk,
-                        self.seq_len,
+        # admission prefill is part of the Batcher's hot path too: the
+        # chunk loop is dispatch-only (completion is observed by the next
+        # step fetch), so under DLT_SANITIZERS=1 nothing in here may
+        # implicitly sync device->host
+        with eng._sanitizer_scope():
+            while st["done"] < len(pre) and budget > 0:
+                done = st["done"]
+                # plan against the REMAINING BUDGET too, so a budget below
+                # max_chunk is honored exactly (the chunk's bucket may pad
+                # past an odd budget, but its real tokens never exceed it)
+                # instead of overshooting by up to a whole max_chunk chunk
+                _, size, n_real = next(
+                    iter(
+                        chunk_plan(
+                            min(len(pre) - done, budget), done, eng.max_chunk,
+                            self.seq_len,
+                        )
                     )
                 )
-            )
-            chunk = pre[done : done + n_real] + [0] * (size - n_real)
-            kv_len = eng._kv_bucket(done + size)
-            if eng.use_pipeline:
-                # mesh path: whole-batch forward with every other row
-                # parked at seq_len (writes dropped)
-                from ..parallel.pipeline import pipeline_forward
+                chunk = pre[done : done + n_real] + [0] * (size - n_real)
+                kv_len = eng._kv_bucket(done + size)
+                if eng.use_pipeline:
+                    # mesh path: whole-batch forward with every other row
+                    # parked at seq_len (writes dropped)
+                    from ..parallel.pipeline import pipeline_forward
 
-                toks = np.zeros((eng.batch, size), np.int32)
-                toks[row, :] = chunk
-                pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
-                pos_vec[row] = done
-                toks_dev, pos_dev = jax.device_put((toks, pos_vec))
-                _, eng.cache = pipeline_forward(
-                    eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
-                    toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
-                )
-            else:
-                toks_dev, pos_dev, row_dev = jax.device_put(
-                    (
-                        np.asarray([chunk], np.int32),
-                        np.int32(done),
-                        np.int32(row),
+                    toks = np.zeros((eng.batch, size), np.int32)
+                    toks[row, :] = chunk
+                    pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
+                    pos_vec[row] = done
+                    toks_dev, pos_dev = jax.device_put((toks, pos_vec))
+                    _, eng.cache = pipeline_forward(
+                        eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
+                        toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
                     )
-                )
-                eng.cache = prefill_row(
-                    eng.cfg, eng.params, eng.rope, eng.cache,
-                    toks_dev, pos_dev, row_dev, kv_len=kv_len,
-                )
-            st["done"] = done + n_real
-            budget -= n_real
+                else:
+                    toks_dev, pos_dev, row_dev = jax.device_put(
+                        (
+                            np.asarray([chunk], np.int32),  # dlt: allow(host-sync) — host token list -> device operand prep
+                            np.int32(done),
+                            np.int32(row),
+                        )
+                    )
+                    eng.cache = prefill_row(
+                        eng.cfg, eng.params, eng.rope, eng.cache,
+                        toks_dev, pos_dev, row_dev, kv_len=kv_len,
+                    )
+                st["done"] = done + n_real
+                budget -= n_real
 
         remaining = len(pre) - st["done"]
         if remaining <= 0:
@@ -281,7 +286,7 @@ class BatchSession:
             self.token[row] = tokens[-1]
             self.temp[row] = st["temperature"]
             self.topp[row] = st["topp"]
-            self.keys[row] = np.asarray(st["key_data"], np.uint32)
+            self.keys[row] = np.asarray(st["key_data"], np.uint32)  # dlt: allow(host-sync) — host tuple, no device source
             self.active[row] = True
             del self._pending[row]
             return 0
@@ -312,34 +317,38 @@ class BatchSession:
                 f"max row end {max(ends)} (step n_steps={n_steps})"
             )
         kv_len = eng._kv_bucket(min(max(ends, default=1), self.seq_len))
-        token = jnp.asarray(self.token)
-        pos = jnp.asarray(self.pos)
-        keys = jnp.asarray(self.keys)
-        temp = jnp.asarray(self.temp)
-        topp = jnp.asarray(self.topp)
-        if eng.use_pipeline:
-            from ..parallel.pipeline import pipeline_batch_decode_chunk
+        # the sanitizer scope covers the Batcher's production decode path
+        # exactly like the solo loops: the ONLY device->host syncs allowed
+        # in here are the two _host_fetch calls below (DLT_SANITIZERS=1)
+        with eng._sanitizer_scope():
+            token = jnp.asarray(self.token)
+            pos = jnp.asarray(self.pos)
+            keys = jnp.asarray(self.keys)
+            temp = jnp.asarray(self.temp)
+            topp = jnp.asarray(self.topp)
+            if eng.use_pipeline:
+                from ..parallel.pipeline import pipeline_batch_decode_chunk
 
-            toks, eng.cache, keys = pipeline_batch_decode_chunk(
-                eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
-                token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
-            )
-        else:
-            toks, eng.cache, keys = batch_decode_chunk(
-                eng.cfg, eng.params, eng.rope, eng.cache,
-                token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
-            )
-        # the fetch is the batch path's one blocking device call — watchdog
-        # it like the solo decode path, so a wedged device raises StallError
-        # into the Batcher loop (reset + bounded client retry) instead of
-        # hanging every co-batched request forever
-        with eng._guard(
-            f"batch_decode[{n_steps}]", ("batch_decode", n_steps, kv_len)
-        ):
-            host = np.asarray(toks)
-        # np.array (copy): asarray of a device array is READ-ONLY, and admit
-        # writes rows into these between chunks
-        self.keys = np.array(keys)
+                toks, eng.cache, keys = pipeline_batch_decode_chunk(
+                    eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
+                    token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+                )
+            else:
+                toks, eng.cache, keys = batch_decode_chunk(
+                    eng.cfg, eng.params, eng.rope, eng.cache,
+                    token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+                )
+            # the fetch is the batch path's one blocking device call —
+            # watchdog it like the solo decode path, so a wedged device
+            # raises StallError into the Batcher loop (reset + bounded
+            # client retry) instead of hanging every co-batched request
+            with eng._guard(
+                f"batch_decode[{n_steps}]", ("batch_decode", n_steps, kv_len)
+            ):
+                host = eng._host_fetch(toks)
+            # .copy(): the fetched view of a device array is READ-ONLY, and
+            # admit writes rows into these between chunks
+            self.keys = eng._host_fetch(keys).copy()
         self.pos += n_steps
         # parked rows stay pinned at seq_len (a long-lived session must not
         # creep their positions toward int32 range)

@@ -21,6 +21,7 @@ TPU-specific design:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -93,8 +94,8 @@ def maybe_enable_compilation_cache():
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax without the knobs — cache is an optimization only
+    except Exception:  # dlt: allow(swallowed-exception) — older jax without the knobs; the cache is an optimization only
+        pass
 
 
 def _next_subkey(key, temperature: float):
@@ -270,15 +271,55 @@ class InferenceEngine:
         # with the (much wider) compile threshold and a "compile" label
         # instead of crying EXEC_STALL (the BENCH_r04 false alarm)
         self._warm: set = set()
+        # opt-in runtime sanitizers (DLT_SANITIZERS=1, docs/ANALYSIS.md):
+        # the recompile sentinel counts XLA compiles and, once warmup()
+        # seals it, flags any post-warmup recompile (a warm-key-ladder
+        # hole) through StepStats counters; the host-sync guard wraps the
+        # decode/prefill hot loops so implicit device->host transfers
+        # outside the sanctioned _fetch_pool/_host_fetch sites raise.
+        from ..analysis import sanitizers_enabled
+
+        self._sanitize = sanitizers_enabled()
+        self.sentinel = None
+        if self._sanitize:
+            from ..analysis.recompile_sentinel import RecompileSentinel
+
+            self.sentinel = RecompileSentinel(stats=self.stats).start()
 
     def close(self):
         self._fetch_pool.shutdown(wait=False)
+        if self.sentinel is not None:
+            self.sentinel.stop()
 
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # dlt: allow(swallowed-exception) — interpreter-teardown destructor; nothing to report to
             pass
+
+    def _sanitizer_scope(self):
+        """Transfer-guard scope for a hot loop (no-op unless
+        DLT_SANITIZERS=1): implicit device->host transfers on THIS thread
+        raise; the worker-thread fetches stay sanctioned by construction
+        (the guard is thread-local)."""
+        if self._sanitize:
+            from ..analysis.host_sync_guard import host_sync_guard
+
+            return host_sync_guard(self.stats)
+        return contextlib.nullcontext()
+
+    def _host_fetch(self, x) -> np.ndarray:
+        """THE sanctioned blocking device->host fetch: `np.asarray` under
+        the sanitizer's allow-scope, counted in /stats
+        (`sanitizer_d2h_sanctioned`). Every hot-loop token fetch routes
+        through here; any OTHER same-thread transfer inside a guarded loop
+        is a host-sync violation."""
+        if self._sanitize:
+            from ..analysis.host_sync_guard import sanctioned_fetch
+
+            with sanctioned_fetch(self.stats):
+                return np.asarray(x)  # dlt: allow(host-sync) — the one blessed fetch site
+        return np.asarray(x)  # dlt: allow(host-sync) — the one blessed fetch site
 
     # -- low-level steps ----------------------------------------------------
 
@@ -338,7 +379,7 @@ class InferenceEngine:
         batch row; returns host logits."""
         arr = jnp.asarray([tokens] * self.batch, dtype=jnp.int32)
         logits, self.cache = self._forward(arr, jnp.int32(pos_start), logits_mode)
-        return np.asarray(logits)
+        return np.asarray(logits)  # dlt: allow(host-sync) — deliberate blocking fetch; library entry, not the serving loop
 
     def warmup(self) -> None:
         """Compile the serving-critical chunk ladder before the first real
@@ -372,6 +413,11 @@ class InferenceEngine:
                     s.step(chunk)
             s.release(0)
             self.reset()
+        if self.sentinel is not None:
+            # the ladder is compiled: from here on, any XLA compile is a
+            # ladder hole — counted (sanitizer_recompiles) and optionally
+            # fatal (DLT_SANITIZERS_FATAL=1)
+            self.sentinel.seal()
 
     def _guard(self, label: str, key) -> watchdog:
         """Watchdog for a blocking device call; `key` identifies the
@@ -449,7 +495,7 @@ class InferenceEngine:
             so it overlaps the previous chunk's dispatch round trip."""
             i, size, n_real = plan[idx]
             chunk = tokens[i : i + n_real] + [0] * (size - n_real)
-            arr = np.asarray([chunk] * self.batch, dtype=np.int32)
+            arr = np.asarray([chunk] * self.batch, dtype=np.int32)  # dlt: allow(host-sync) — host token list -> device operand prep
             return jax.device_put((arr, np.int32(pos_start + i)))
 
         timing = {"dispatch_us": 0}
@@ -468,8 +514,11 @@ class InferenceEngine:
         # the guard now covers the dispatch loop too (not just the sync): a
         # first-shape chunk's dispatch can block on XLA compilation, and an
         # in-flight-but-uncompiled chunk must run under the compile-aware
-        # threshold, not the narrow stall one.
-        with self._guard(
+        # threshold, not the narrow stall one. The sanitizer scope
+        # (DLT_SANITIZERS=1) additionally forbids implicit device->host
+        # transfers on this thread for the whole chunk loop — the pipeline
+        # is only async end-to-end if nothing in here blocks on a fetch.
+        with self._sanitizer_scope(), self._guard(
             f"prefill[{len(tokens)}]",
             # the kv bucket matters to the compiled shape: a prefix-cache
             # continuation at a deeper position is a NEW compile even
@@ -543,7 +592,7 @@ class InferenceEngine:
         logits, self.cache = self._forward(
             arr, jnp.int32(pos), kv_len=self._kv_bucket(pos + 1)
         )
-        return np.asarray(logits)
+        return np.asarray(logits)  # dlt: allow(host-sync) — per-token host loop / library entry; the chunked path is the hot loop
 
     # -- generation driver --------------------------------------------------
 
@@ -577,7 +626,11 @@ class InferenceEngine:
         token = prompt_tokens[-1]
         max_pos = min(self.cfg.seq_len, steps)
         if self.device_decode:
-            self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
+            # sanitizer scope: the chunked decode loop must never block on
+            # an implicit device->host transfer on this thread (the token
+            # fetches ride the worker thread; DLT_SANITIZERS=1 enforces it)
+            with self._sanitizer_scope():
+                self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         else:
             self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         res.total_us = int((time.perf_counter() - wall0) * 1e6)
@@ -645,7 +698,7 @@ class InferenceEngine:
                 rows = [row[i : i + size] for row in padded]
                 rows = [r + [0] * (size - len(r)) for r in rows]
                 return jax.device_put(
-                    (np.asarray(rows, dtype=np.int32), np.int32(i))
+                    (np.asarray(rows, dtype=np.int32), np.int32(i))  # dlt: allow(host-sync) — host token rows -> device operand prep
                 )
 
             def dispatch(idx, operands):
@@ -715,35 +768,38 @@ class InferenceEngine:
             planned += n
             return toks, n, kvb
 
-        pending = dispatch_chunk()
-        while pending is not None:
-            toks, n, kvb = pending
-            fut = self._fetch_pool.submit(np.asarray, toks)
-            nxt = None
-            if planned < total_needed:
-                nxt = dispatch_chunk()
-            with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
-                host = fut.result()  # [b, n]
-            for j in range(n):
-                for r in range(self.batch):
-                    if done[r] or len(out[r]) >= budgets[r]:
-                        done[r] = True
-                        continue
-                    tkn = int(host[r, j])
-                    out[r].append(tkn)
-                    if on_token is not None:
-                        on_token(r, tkn)
-                    if stop_fn is not None and stop_fn(r, tkn):
-                        done[r] = True
-                    elif len(out[r]) >= budgets[r]:
-                        done[r] = True
-            if all(done):
-                # a dispatched lookahead chunk past this point is discarded:
-                # its cache writes sit beyond every returned sequence, junk
-                # the same way padded prefill tails are
-                pending = None
-            else:
-                pending = nxt
+        # same hot-loop sanitizer contract as _decode_device: fetches ride
+        # the worker thread, this thread must never implicitly sync
+        with self._sanitizer_scope():
+            pending = dispatch_chunk()
+            while pending is not None:
+                toks, n, kvb = pending
+                fut = self._fetch_pool.submit(self._host_fetch, toks)
+                nxt = None
+                if planned < total_needed:
+                    nxt = dispatch_chunk()
+                with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
+                    host = fut.result()  # [b, n]
+                for j in range(n):
+                    for r in range(self.batch):
+                        if done[r] or len(out[r]) >= budgets[r]:
+                            done[r] = True
+                            continue
+                        tkn = int(host[r, j])
+                        out[r].append(tkn)
+                        if on_token is not None:
+                            on_token(r, tkn)
+                        if stop_fn is not None and stop_fn(r, tkn):
+                            done[r] = True
+                        elif len(out[r]) >= budgets[r]:
+                            done[r] = True
+                if all(done):
+                    # a dispatched lookahead chunk past this point is
+                    # discarded: its cache writes sit beyond every returned
+                    # sequence, junk the same way padded prefill tails are
+                    pending = None
+                else:
+                    pending = nxt
         return out
 
     def _decode_host(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
@@ -836,7 +892,7 @@ class InferenceEngine:
             # device op (indexing toks[0] here would create a device slice
             # op ordered *behind* the in-flight chunk and serialize; `last`
             # comes back from the chunk program itself for the same reason).
-            fut = self._fetch_pool.submit(np.asarray, toks)
+            fut = self._fetch_pool.submit(self._host_fetch, toks)
             nxt = None
             if dispatched < max_pos:
                 nxt = dispatch(dispatched, last)

@@ -190,7 +190,7 @@ class StepStats:
         if not s or not s.recent:
             return {}
         # list() first: record() on another thread appends concurrently
-        arr = np.sort(np.asarray(list(s.recent)))
+        arr = np.sort(np.asarray(list(s.recent)))  # dlt: allow(host-sync) — host latency floats, no device source
         pick = lambda p: float(arr[min(len(arr) - 1, int(len(arr) * p))])
         return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
 

@@ -732,7 +732,11 @@ class ApiState:
         try:
             self.engine.reset()
         except Exception:
-            pass
+            # a reset that fails on an already-wedged engine must not mask
+            # the original failure, but it must be VISIBLE: the next
+            # request will hit the broken engine, and the operator needs
+            # the counter trail (/stats, /health) to see why
+            self.engine.stats.incr("recover_reset_failed")
 
 
 class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Local/CI gate — the same three stages .github/workflows/ci.yml runs,
+# for environments without Actions (and for preflight before pushing):
+#
+#   1. repo lint            (scripts/dlt_lint.py — AST rules, dlt pragmas)
+#   2. graph audit          (tiny config, full warm-key ladder: dtypes,
+#                            collective budgets, KV donation, shardings)
+#   3. analysis test suite  (pytest -m analysis: one suite per audit pass)
+#
+# Pass --full to also run the tier-1 fast subset (-m 'not slow').
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== dlt-lint =="
+python scripts/dlt_lint.py
+
+echo "== graph audit (tiny config) =="
+python -m distributed_llama_tpu.analysis.graph_audit
+
+echo "== analysis suite (pytest -m analysis) =="
+python -m pytest tests/ -q -m analysis -p no:cacheprovider
+
+if [[ "${1:-}" == "--full" ]]; then
+  echo "== tier-1 fast subset =="
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo "ci_check: all stages passed"

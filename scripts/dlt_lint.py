@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Repo lint CLI: `python scripts/dlt_lint.py [paths...]`.
+
+Thin wrapper over distributed_llama_tpu.analysis.lint so CI and operators
+run the same pass the analysis tests assert against. Exits non-zero on any
+violation; `# dlt: allow(<rule>)` pragmas suppress (and document) the
+intentional ones. Rules and pragma syntax: docs/ANALYSIS.md.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from distributed_llama_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,308 @@
+"""Host-sync guard + thread auditor tests, including the concurrency
+hammer (N threads pounding StepStats counters and the Batcher admit/park
+paths under the auditor)."""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.analysis import host_sync_guard as hsg
+from distributed_llama_tpu.analysis import thread_audit as ta
+from distributed_llama_tpu.runtime.telemetry import StepStats
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+pytestmark = pytest.mark.analysis
+
+
+# ---- host-sync guard -------------------------------------------------------
+
+
+def test_guard_scope_sets_and_restores_transfer_guard():
+    import jax
+
+    assert not hsg.guard_active()
+    with hsg.host_sync_guard(mode="disallow"):
+        assert hsg.guard_active()
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+        with hsg.sanctioned_fetch():
+            assert jax.config.jax_transfer_guard_device_to_host == "allow"
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+    assert not hsg.guard_active()
+
+
+def test_guard_mode_follows_the_sanitizer_tier(monkeypatch):
+    """DLT_SANITIZERS=1 alone must be SAFE on serving traffic: the default
+    guard level only logs; DLT_SANITIZERS_FATAL=1 upgrades to disallow
+    (raise at the transfer site)."""
+    import jax
+
+    monkeypatch.delenv("DLT_SANITIZERS_FATAL", raising=False)
+    assert hsg.default_mode() == "log"
+    with hsg.host_sync_guard():
+        assert jax.config.jax_transfer_guard_device_to_host == "log"
+    monkeypatch.setenv("DLT_SANITIZERS_FATAL", "1")
+    assert hsg.default_mode() == "disallow"
+    with hsg.host_sync_guard():
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+
+
+def test_guard_is_thread_local():
+    """The design hinges on this: the main thread guards itself while the
+    _fetch_pool worker transfers freely."""
+    seen = []
+
+    def worker():
+        seen.append(hsg.guard_active())
+
+    with hsg.host_sync_guard():
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        t.join()
+    assert seen == [False]
+
+
+def test_violation_is_counted_and_reraised():
+    stats = StepStats()
+    err = RuntimeError("Disallowed device-to-host transfer: 16 bytes")
+    assert hsg.is_transfer_guard_error(err)
+    with pytest.raises(RuntimeError):
+        with hsg.host_sync_guard(stats):
+            raise err
+    assert stats.counters_snapshot()["sanitizer_d2h_violations"] == 1
+    # unrelated failures must NOT be misattributed to the guard
+    with pytest.raises(ValueError):
+        with hsg.host_sync_guard(stats):
+            raise ValueError("not a transfer")
+    assert stats.counters_snapshot()["sanitizer_d2h_violations"] == 1
+
+
+def test_sanctioned_fetch_counts_into_stats():
+    stats = StepStats()
+    with hsg.sanctioned_fetch(stats):
+        pass
+    with hsg.sanctioned_fetch(stats):
+        pass
+    assert stats.counters_snapshot()["sanitizer_d2h_sanctioned"] == 2
+
+
+def test_engine_hot_loop_fetches_are_sanctioned(tmp_path, monkeypatch):
+    """DLT_SANITIZERS=1 end to end: a generate() run works under the guard
+    and every token fetch shows up as a sanctioned host sync in /stats'
+    counter source."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=64), seed=2)
+    eng = InferenceEngine(
+        path, compute_dtype="float32", decode_chunk_size=4, max_chunk=8
+    )
+    try:
+        res = eng.generate([1, 2, 3, 4, 5], 24, sampler=None)
+        assert res.n_pred_tokens > 0
+        counters = eng.stats.counters_snapshot()
+        assert counters.get("sanitizer_d2h_sanctioned", 0) >= len(res.pred_steps)
+        assert counters.get("sanitizer_d2h_violations", 0) == 0
+    finally:
+        eng.close()
+
+
+# ---- thread auditor: lock order, long holds, guarded mutation --------------
+
+
+def test_lock_order_cycle_detected():
+    aud = ta.ThreadAuditor()
+    a = aud.wrap(threading.Lock(), "A")
+    b = aud.wrap(threading.Lock(), "B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, daemon=True)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba, daemon=True)
+    t2.start()
+    t2.join()
+    assert aud.cycles()
+    with pytest.raises(ta.ThreadAuditError):
+        aud.check()
+
+
+def test_consistent_order_is_clean():
+    aud = ta.ThreadAuditor()
+    a = aud.wrap(threading.Lock(), "A")
+    b = aud.wrap(threading.Lock(), "B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert aud.cycles() == []
+    aud.check()
+
+
+def test_long_hold_detected():
+    aud = ta.ThreadAuditor(long_hold_ms=10)
+    lock = aud.wrap(threading.Lock(), "L")
+    with lock:
+        time.sleep(0.05)
+    assert any(k == "long-hold" for k, _ in aud.violations)
+
+
+def test_guarded_dict_flags_unguarded_mutation():
+    aud = ta.ThreadAuditor()
+    stats = StepStats()
+    ta.instrument_stepstats(stats, aud)
+    stats.incr("ok")  # goes through _counter_lock: clean
+    stats.gauge("g", 1.0)
+    aud.check()
+    stats.counters["sneaky"] = 1  # the regression: mutation outside the lock
+    assert any(k == "unguarded-mutation" for k, _ in aud.violations)
+    with pytest.raises(ta.ThreadAuditError):
+        aud.check()
+
+
+def test_audited_lock_works_as_condition_lock():
+    """instrument_balancer rebuilds Balancer.cond around the audited lock;
+    wait/notify must function (the gateway's queued-acquire path)."""
+    from distributed_llama_tpu.server.gateway import Backend, Balancer, GatewayConfig
+
+    aud = ta.ThreadAuditor()
+    bal = Balancer(GatewayConfig(backends=[Backend("h", 1)], probe_interval_s=0))
+    ta.instrument_balancer(bal, aud)
+    got = []
+
+    def waiter():
+        with bal.cond:
+            while not got:
+                bal.cond.wait(timeout=2.0)
+            got.append("woke")
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with bal.cond:
+        got.append("signal")
+        bal.cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive() and got[-1] == "woke"
+    bal.count("requests")  # exercises `with self.lock` on the same proxy
+    aud.check()
+
+
+def test_chaos_proxy_lock_audited():
+    from distributed_llama_tpu.server.chaos import ChaosProxy, Fault, FaultPlan, REFUSE
+
+    aud = ta.ThreadAuditor()
+    proxy = ChaosProxy("127.0.0.1", 1, FaultPlan(default=Fault(REFUSE)))
+    ta.instrument_chaos(proxy, aud)
+    proxy.start()
+    try:
+        import socket
+
+        for _ in range(3):
+            try:
+                s = socket.create_connection(("127.0.0.1", proxy.port), timeout=2)
+                s.close()
+            except OSError:
+                pass
+        deadline = time.time() + 5
+        while proxy.conn_count < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        proxy.stop()
+    assert aud.hold_counts.get("chaos._lock", 0) >= 3
+    aud.check()
+
+
+# ---- the concurrency hammer ------------------------------------------------
+
+
+def test_stepstats_counter_hammer():
+    """N threads pounding incr/gauge through the audited lock: totals must
+    be exact (no lost increments) and the auditor must record zero
+    unguarded mutations."""
+    aud = ta.ThreadAuditor(long_hold_ms=5000)
+    stats = StepStats()
+    ta.instrument_stepstats(stats, aud)
+    N, M = 8, 400
+
+    def pound(i):
+        for j in range(M):
+            stats.incr("hammer")
+            stats.incr(f"per_thread_{i}")
+            stats.gauge("last", float(j))
+
+    threads = [
+        threading.Thread(target=pound, args=(i,), daemon=True) for i in range(N)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = stats.counters_snapshot()
+    assert snap["hammer"] == N * M
+    for i in range(N):
+        assert snap[f"per_thread_{i}"] == M
+    aud.check()
+
+
+def test_batcher_admit_park_hammer(tmp_path_factory):
+    """Concurrent requests hammering the Batcher's admit/park paths while
+    StepStats is under the auditor: every request gets exactly its budget,
+    totals are stable, and no counter was mutated outside its lock."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.server import api as api_mod
+
+    d = tmp_path_factory.mktemp("hammer")
+    h = tiny_header(dim=64, n_layers=2, seq_len=256, vocab_size=128)
+    path = str(d / "m.m")
+    write_tiny_model(path, h, seed=21)
+    eng = InferenceEngine(path, compute_dtype="float32", batch=4, max_chunk=8)
+    try:
+        aud = ta.ThreadAuditor(long_hold_ms=5000)
+        ta.instrument_stepstats(eng.stats, aud)
+        state = types.SimpleNamespace(engine=eng, recover=lambda: None)
+        batcher = api_mod.Batcher(state, chunk_size=4)
+
+        outs: dict = {}
+        errors: list = []
+
+        def run(i):
+            toks = []
+            req = api_mod._BatchReq(
+                [3 + i % 5, 7, 1 + i % 3], 6, 0.0, 0.9, None, toks.append
+            )
+            try:
+                batcher.submit(req)
+                outs[i] = toks
+            except Exception as e:  # surface, don't deadlock the join
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(10)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors
+        assert len(outs) == 10
+        for i, toks in outs.items():
+            assert len(toks) == 6, f"request {i} got {len(toks)} tokens"
+        aud.check()
+        # park/re-admit actually cycled rows: 10 requests through 4 slots
+        assert all(s is None for s in batcher.slots)
+    finally:
+        eng.close()

@@ -1,0 +1,99 @@
+"""Repo lint tests: every rule fires on a synthetic offender, pragmas
+suppress, and — the dogfood criterion — the real tree lints clean."""
+
+from pathlib import Path
+
+import pytest
+
+from distributed_llama_tpu.analysis import lint
+
+pytestmark = pytest.mark.analysis
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _rules(src, rel="runtime/x.py"):
+    return sorted({v.rule for v in lint.lint_source(src, "x.py", rel)})
+
+
+def test_bare_except_flagged():
+    assert _rules("try:\n    x = 1\nexcept:\n    x = 2\n") == ["bare-except"]
+
+
+def test_swallowed_exception_flagged():
+    src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert _rules(src) == ["swallowed-exception"]
+    # a handler that DOES something is fine
+    src2 = "try:\n    x = 1\nexcept Exception:\n    x = 2\n"
+    assert _rules(src2) == []
+    # narrow types may pass-swallow (OSError cleanup idiom)
+    src3 = "try:\n    x = 1\nexcept OSError:\n    pass\n"
+    assert _rules(src3) == []
+
+
+def test_lock_with_flagged_only_for_lockish_receivers():
+    assert _rules("self._lock.acquire()\n") == ["lock-with"]
+    assert _rules("self.cond.acquire()\n") == ["lock-with"]
+    # Balancer.acquire() is an API method, not a lock acquire
+    assert _rules("idx = balancer.acquire(exclude=tried)\n") == []
+
+
+def test_thread_daemon_flagged():
+    src = "import threading\nt = threading.Thread(target=f)\n"
+    assert _rules(src) == ["thread-daemon"]
+    ok = "import threading\nt = threading.Thread(target=f, daemon=True)\n"
+    assert _rules(ok) == []
+    sub = (
+        "import threading\n"
+        "class W(threading.Thread):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+    )
+    assert _rules(sub) == ["thread-daemon"]
+    sub_ok = (
+        "import threading\n"
+        "class W(threading.Thread):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(daemon=True)\n"
+    )
+    assert _rules(sub_ok) == []
+
+
+def test_float64_scoped_to_device_packages():
+    src = "import numpy as np\nx = np.zeros(4, dtype=np.float64)\n"
+    assert _rules(src, "ops/x.py") == ["float64"]
+    assert _rules(src, "converter/x.py") == []  # host-side package: fine
+    lit = "x = np.zeros(4, dtype='float64')\n"
+    assert _rules(lit, "models/x.py") == ["float64"]
+
+
+def test_host_sync_scoped_to_hot_packages():
+    src = "import numpy as np\nh = np.asarray(toks)\n"
+    assert _rules(src, "runtime/x.py") == ["host-sync"]
+    assert _rules(src, "parallel/x.py") == ["host-sync"]
+    assert _rules(src, "server/x.py") == []  # server is not a hot package
+
+
+def test_pragma_suppresses_same_line_and_line_above():
+    same = "try:\n    x = 1\nexcept Exception:  # dlt: allow(swallowed-exception) — reason\n    pass\n"
+    assert _rules(same) == []
+    above = (
+        "import threading\n"
+        "# dlt: allow(thread-daemon)\n"
+        "t = threading.Thread(target=f)\n"
+    )
+    assert _rules(above) == []
+    wrong_rule = "try:\n    x = 1\nexcept Exception:  # dlt: allow(float64)\n    pass\n"
+    assert _rules(wrong_rule) == ["swallowed-exception"]
+
+
+def test_repo_tree_is_clean():
+    """The dogfood criterion: scripts/dlt_lint.py exits 0 on the tree."""
+    paths = [
+        ROOT / "distributed_llama_tpu",
+        ROOT / "scripts",
+        ROOT / "bench.py",
+        ROOT / "launch.py",
+    ]
+    violations = lint.lint_paths([p for p in paths if p.exists()], root=ROOT)
+    assert violations == [], "\n".join(str(v) for v in violations)

@@ -1,0 +1,124 @@
+"""Recompile sentinel regression tests: warm the ladder, decode, assert
+zero post-warmup compiles; a deliberately mis-bucketed shape must be
+flagged (and optionally fatal)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.analysis.recompile_sentinel import (
+    RecompileError,
+    RecompileSentinel,
+)
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("sentinel")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=128), seed=9)
+    return path
+
+
+def _engine(model_path, monkeypatch, **kw):
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("max_chunk", 16)
+    kw.setdefault("decode_chunk_size", 8)
+    return InferenceEngine(model_path, **kw)
+
+
+def test_zero_post_warmup_recompiles_on_warm_ladder(model_path, monkeypatch):
+    """The serving contract itself: warmup compiles the whole ladder, then
+    a full generate (prefill + >= 3 decode chunks, the same shapes warmup
+    drove) triggers ZERO further compiles."""
+    eng = _engine(model_path, monkeypatch)
+    try:
+        assert eng.sentinel is not None and not eng.sentinel.sealed
+        eng.warmup()
+        assert eng.sentinel.sealed
+        assert eng.sentinel.warm_compiles > 0
+        # replay the exact warmup-shaped request: same prompt ladder, same
+        # decode chunk progression (ramp 8 + full chunks + tail)
+        n = max(1, min(eng.max_chunk, eng.cfg.seq_len - eng.decode_chunk_size - 2))
+        steps = min(n + eng.decode_chunk_size + 8, eng.cfg.seq_len)
+        eng.reset()
+        res = eng.generate([1] * n, steps, sampler=None, on_token=lambda t: None)
+        assert len(res.pred_steps) >= 3, "want >= 3 decode chunks for the regression"
+        assert eng.sentinel.post_seal_compiles == 0
+        assert "sanitizer_recompiles" not in eng.stats.counters_snapshot()
+    finally:
+        eng.close()
+
+
+def test_mis_bucketed_shape_is_flagged(model_path, monkeypatch):
+    """A shape outside the warm ladder (the mis-bucketed caller class of
+    bugs) must be counted as a sanitizer_recompiles event."""
+    eng = _engine(model_path, monkeypatch)
+    try:
+        eng.warmup()
+        before = eng.sentinel.post_seal_compiles
+        eng.reset()
+        # a 3-token unpadded forward is deliberately NOT on the ladder
+        eng.forward_tokens([1, 2, 3], 0)
+        assert eng.sentinel.post_seal_compiles > before
+        assert eng.stats.counters_snapshot().get("sanitizer_recompiles", 0) > 0
+    finally:
+        eng.close()
+
+
+def test_fatal_sentinel_raises_at_the_compile_site():
+    sentinel = RecompileSentinel(fatal=True, name="test").start()
+    try:
+        sentinel.seal()
+        with pytest.raises(RecompileError):
+            jax.jit(lambda x: x * 3 + 1)(jnp.ones((17,)))  # unseen shape
+    finally:
+        sentinel.stop()
+
+
+def test_unseal_reopens_the_warm_window():
+    sentinel = RecompileSentinel(fatal=True, name="test").start()
+    try:
+        sentinel.seal()
+        sentinel.unseal()
+        jax.jit(lambda x: x * 5 - 2)(jnp.ones((19,)))  # compiles, no raise
+        assert sentinel.warm_compiles >= 1
+        assert sentinel.post_seal_compiles == 0
+    finally:
+        sentinel.stop()
+
+
+def test_sealed_sentinel_ignores_a_coresident_warmup():
+    """Two engines in one process: a sealed (even fatal) sentinel must not
+    claim — or abort — a co-resident engine's legitimate warm-window
+    compiles; only when every subscriber is sealed is a compile a breach."""
+    a = RecompileSentinel(fatal=True, name="A").start()
+    b = RecompileSentinel(fatal=False, name="B").start()
+    try:
+        a.seal()
+        # B is still warming: its compile must land on B alone, no raise
+        jax.jit(lambda x: x * 7 + 3)(jnp.ones((23,)))
+        assert b.warm_compiles >= 1
+        assert a.post_seal_compiles == 0
+        b.seal()
+        # now everyone is sealed: the breach reports to all (A raises)
+        with pytest.raises(RecompileError):
+            jax.jit(lambda x: x * 11 - 5)(jnp.ones((29,)))
+        assert b.post_seal_compiles >= 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_sentinel_off_by_default(model_path, monkeypatch):
+    monkeypatch.delenv("DLT_SANITIZERS", raising=False)
+    eng = InferenceEngine(model_path, compute_dtype="float32")
+    try:
+        assert eng.sentinel is None
+    finally:
+        eng.close()
