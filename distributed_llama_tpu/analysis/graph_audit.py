@@ -151,8 +151,10 @@ class LadderEntry:
 
     kind: "prefill" (whole-batch chunk), "decode" (solo chunked decode),
     "prefill_row" (BatchSession admission prefill), "batch_decode"
-    (BatchSession per-row decode chunk). `size` is the token-chunk size or
-    decode n_steps; `kv_len` the static KV read bucket."""
+    (BatchSession per-row decode chunk), "prefix_extract" /"prefix_copy" /
+    "prefix_copy_row" (the prefix cache's publish/splice copy programs).
+    `size` is the token-chunk size, decode n_steps, or prefix bucket;
+    `kv_len` the static KV read bucket (== size for prefix programs)."""
 
     kind: str
     size: int
@@ -161,66 +163,13 @@ class LadderEntry:
 
 def warm_key_ladder(engine) -> list:
     """Every (kind, size, kv_bucket) program `InferenceEngine.warmup()`
-    compiles, derived by simulating the warmup schedule against the SAME
-    chunk arithmetic the engine uses (`chunk_plan`, `_kv_bucket`, the
-    decode dispatch shrink loop). If this list and the engine's actual
-    post-warmup `_warm` set ever disagree, the recompile sentinel fires in
+    compiles. The enumeration itself lives on the engine
+    (`InferenceEngine.warm_plan` — the full reachable cross product of
+    chunk/decode sizes with kv buckets, plus the prefix-cache copy ladder);
+    warmup executes from the same plan, so the auditor and the compiled set
+    cannot drift. If they ever did, the recompile sentinel would fire in
     production — the two are tested against each other."""
-    from ..runtime.engine import chunk_plan
-
-    cfg = engine.cfg
-    dcs = engine.decode_chunk_size
-    entries: list[LadderEntry] = []
-    seen = set()
-
-    def add(kind, size, kv):
-        e = LadderEntry(kind, size, kv)
-        if e not in seen:
-            seen.add(e)
-            entries.append(e)
-
-    # generate(prompt=[1]*n, steps) — the serving-critical solo ladder
-    n = max(1, min(engine.max_chunk, cfg.seq_len - dcs - 2))
-    steps = min(n + dcs + 8, cfg.seq_len)
-    if n > 1:
-        for i, size, _ in chunk_plan(n - 1, 0, engine.max_chunk, cfg.seq_len):
-            add("prefill", size, engine._kv_bucket(i + size))
-    # chunked decode from pos n-1 to steps, with the streaming TTFT ramp
-    # (warmup passes on_token), replicating _decode_device's shrink loop
-    pos = n - 1
-    max_pos = min(cfg.seq_len, steps)
-    first_chunk = min(8, dcs)
-    at = pos
-    chunk = first_chunk
-    while at < max_pos:
-        limit = max_pos - at
-        c = chunk if chunk is not None else dcs
-        while c > limit:
-            c //= 2
-        c = max(c, 1)
-        add("decode", c, engine._kv_bucket(at + c))
-        at += c
-        chunk = None
-
-    # warmup's BatchSession admit/step cycle (batch > 1 engines)
-    if engine.batch > 1 and engine.device_decode:
-        room = cfg.seq_len - dcs - 10
-        prompt_len = max(2, min(engine.max_chunk, room))
-        pre = prompt_len - 1
-        done = 0
-        while done < pre:
-            _, size, n_real = next(
-                iter(chunk_plan(pre - done, done, engine.max_chunk, cfg.seq_len))
-            )
-            add("prefill_row", size, engine._kv_bucket(done + size))
-            done += n_real
-        row_pos = prompt_len - 1
-        for c in (8, dcs):
-            if row_pos + 1 + c <= cfg.seq_len:
-                kvb = engine._kv_bucket(min(row_pos + 1 + c, cfg.seq_len))
-                add("batch_decode", c, kvb)
-                row_pos += c
-    return entries
+    return [LadderEntry(kind, size, kv) for kind, size, kv in engine.warm_plan()]
 
 
 # -- tracing one ladder entry ----------------------------------------------
@@ -303,6 +252,31 @@ def trace_entry(engine, entry: LadderEntry):
             _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
             _sds((b,), jnp.float32),
         )
+    if entry.kind in ("prefix_extract", "prefix_copy", "prefix_copy_row"):
+        from ..runtime.prefix_cache import (
+            copy_prefix_into_row,
+            copy_prefix_into_rows,
+            extract_prefix_from_row,
+        )
+
+        pc = engine.prefix_cache
+        L, _, _, h, d = engine.cache.k.shape
+        seg = _sds((L, entry.size, h, d), engine.cache.k.dtype)
+        if entry.kind == "prefix_extract":
+            fn = lambda row: extract_prefix_from_row(
+                engine.cache, row, length=entry.size,
+                out_sharding=pc.seg_sharding,
+            )
+            return jax.make_jaxpr(fn)(_sds((), jnp.int32))
+        if entry.kind == "prefix_copy":
+            fn = lambda k, v: copy_prefix_into_rows(
+                engine.cache, k, v, out_sharding=pc.cache_sharding
+            )
+            return jax.make_jaxpr(fn)(seg, seg)
+        fn = lambda k, v, row: copy_prefix_into_row(
+            engine.cache, k, v, row, out_sharding=pc.cache_sharding
+        )
+        return jax.make_jaxpr(fn)(seg, seg, _sds((), jnp.int32))
     raise ValueError(f"unknown ladder kind {entry.kind!r}")
 
 
@@ -327,7 +301,14 @@ def expected_collectives(engine, entry: LadderEntry):
 
     rounds = microbatches + pp - 1; decode runs 1 microbatch, prefill
     chunks microbatch to pp when the chunk length divides (engine._forward).
+
+    Prefix-cache copy/extract programs are plain GSPMD slice/update
+    programs on EVERY topology — zero explicit collectives always: a
+    surprise collective there would mean a splice is reshuffling cached KV
+    across stages on every hit.
     """
+    if entry.kind.startswith("prefix_"):
+        return {}
     if not engine.use_pipeline:
         return {}
     mesh = engine.mesh
@@ -499,6 +480,31 @@ def donation_problems(engine) -> list:
                     jnp.zeros((1, 1), jnp.int32), pos, jnp.int32(0), kv_len=kvb,
                 ),
             )
+    if engine.prefix_cache is not None and engine.prefix_cache.buckets:
+        # the prefix-cache splice programs donate the live cache too: a
+        # lost donation would double the cache's HBM footprint on every hit
+        from ..runtime.prefix_cache import (
+            copy_prefix_into_row,
+            copy_prefix_into_rows,
+        )
+
+        pc = engine.prefix_cache
+        P = pc.buckets[0]
+        L, _, _, h, d = engine.cache.k.shape
+        seg = jnp.zeros((L, P, h, d), engine.cache.k.dtype)
+        check(
+            "copy_prefix_into_rows",
+            copy_prefix_into_rows.lower(
+                engine.cache, seg, seg, out_sharding=pc.cache_sharding
+            ),
+        )
+        check(
+            "copy_prefix_into_row",
+            copy_prefix_into_row.lower(
+                engine.cache, seg, seg, jnp.int32(0),
+                out_sharding=pc.cache_sharding,
+            ),
+        )
     return problems
 
 
@@ -623,6 +629,10 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--max-chunk", type=int, default=16)
     p.add_argument("--decode-chunk-size", type=int, default=8)
+    p.add_argument(
+        "--prefix-cache-mb", type=int, default=64,
+        help="prefix-cache budget: audits the copy/extract ladder too (0 = off)",
+    )
     args = p.parse_args(argv)
 
     from ..runtime.engine import InferenceEngine
@@ -637,6 +647,7 @@ def main(argv=None) -> int:
         engine = InferenceEngine(
             model, compute_dtype=args.compute_dtype, batch=args.batch,
             max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
+            prefix_cache_mb=args.prefix_cache_mb,
         )
         try:
             reports = audit_engine(engine)

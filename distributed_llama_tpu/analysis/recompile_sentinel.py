@@ -60,8 +60,18 @@ def _dispatch(event: str, *args, **kwargs):
     # (and then it is reported to all, since it cannot be attributed).
     subs = list(_subscribers)
     unsealed = [s for s in subs if not s.sealed]
+    # a FATAL sentinel raises out of _on_compile — deliver the event to
+    # every subscriber first (a breach must be counted by all of them, not
+    # just the ones that happened to iterate before the raiser), then let
+    # the first error propagate to the compiling call site
+    err = None
     for s in (unsealed if unsealed else subs):
-        s._on_compile(event)
+        try:
+            s._on_compile(event)
+        except RecompileError as e:
+            err = err if err is not None else e
+    if err is not None:
+        raise err
 
 
 def _install_once():

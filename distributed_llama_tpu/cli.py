@@ -83,11 +83,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch-size", "--nbatches", dest="max_chunk", type=int, default=32)
     p.add_argument("--prefill-chunk-size", type=int, default=0)
     p.add_argument("--prefill-chunk-threshold", type=int, default=128)
+    p.add_argument(
+        "--prefix-cache-mb", type=int, default=-1,
+        help="HBM budget for the radix prefix cache (cross-request KV reuse "
+        "over shared prompts; runtime/prefix_cache.py). -1 = "
+        "DLT_PREFIX_CACHE_MB env, defaulting to 512; 0 disables",
+    )
     return p
 
 
 def make_engine(args) -> InferenceEngine:
+    from .runtime.prefix_cache import resolve_budget_mb
+
     max_chunk = args.prefill_chunk_size if args.prefill_chunk_size > 0 else args.max_chunk
+    # radix prefix cache: ON by default for the CLI/server entry points
+    # (serving workloads are where shared prefixes live); library engines
+    # constructed directly keep the env-or-off default. One shared resolver
+    # owns the env parsing — only the intended default differs.
+    flag = getattr(args, "prefix_cache_mb", -1)
+    prefix_mb = resolve_budget_mb(
+        None if flag is None or flag < 0 else flag, default_mb=512
+    )
     batch = getattr(args, "batch", 1) or 1
     dp_axis = getattr(args, "dp", 1)
     # an explicit batch must be compatible with the dp mesh, not silently
@@ -121,7 +137,7 @@ def make_engine(args) -> InferenceEngine:
         from .parallel import make_mesh
 
         mesh = make_mesh(tp=args.tp, pp=args.pp, sp=sp, ep=ep, dp=dp)
-    return InferenceEngine(
+    engine = InferenceEngine(
         args.model,
         compute_dtype=args.compute_dtype,
         cache_dtype=args.cache_dtype,
@@ -131,7 +147,19 @@ def make_engine(args) -> InferenceEngine:
         batch=batch,
         device_decode=not getattr(args, "host_decode", False),
         verbose=True,
+        prefix_cache_mb=prefix_mb,
     )
+    if prefix_mb > 0 and engine.prefix_cache is None:
+        # a requested prefix cache that cannot be built (sp>1 shards the
+        # cache's seq axis; or the context is too small to publish) means
+        # ZERO KV reuse across requests — every chat turn re-prefills its
+        # whole history. Say so at startup instead of degrading silently.
+        print(
+            "⚠️  prefix cache unavailable on this topology (sp>1 mesh or "
+            "tiny context): cross-request KV reuse is OFF; multi-turn "
+            "chats re-prefill their full history each turn"
+        )
+    return engine
 
 
 def make_sampler(args, vocab_size: int) -> Sampler:

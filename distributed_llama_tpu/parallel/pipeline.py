@@ -107,6 +107,17 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
 
 
+def pp_prefix_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of a prefix-cache KV slice [L, P, heads, head_dim]
+    (runtime/prefix_cache.py): the live cache's own per-stage layout minus
+    the batch axis — layer stack over pp, kv heads over tp, the (short)
+    cached seq axis replicated. A cached slice spliced into a row must land
+    stage-for-stage where `pp_cache_sharding` keeps that row's KV, or the
+    splice pays a cross-stage reshuffle on every hit (and the graph audit's
+    sharding check fails)."""
+    return NamedSharding(mesh, P("pp", None, "tp", None))
+
+
 def _local_stage(
     cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx,
     ep_axis=None, kv_len=None, stacked_cache=False,

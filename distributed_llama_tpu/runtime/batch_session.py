@@ -23,6 +23,12 @@ decision instead:
   time, so a long prompt's prefill slots between decode chunks instead of
   stalling every co-batched stream for the whole prompt (Sarathi-Serve's
   chunked-prefill piggyback; the server's Batcher drives this);
+* admission consults the engine's radix PREFIX CACHE
+  (runtime/prefix_cache.py): `begin_admit` longest-prefix-matches the
+  staged prompt and pins the entry; the first `prefill_pending` splices the
+  cached KV into the row with one donate-safe copy and resumes chunked
+  prefill from the bucket boundary; arming (and row retirement, via
+  `publish_row`) publishes the row's KV back for the next request;
 * `step(n)` decodes n tokens for ALL slots in one on-device chunk with
   per-row positions, per-row threefry key chains, and per-row
   temperature/top-p vectors (ops/sampling.py sample_logits_per_row) — so
@@ -208,12 +214,23 @@ class BatchSession:
                 np.uint32(0x9E3779B9),
                 np.uint32((self._admits * 2654435761) & 0xFFFFFFFF),
             )
+        # prefix-cache lookup at STAGING time (host-only): the matched entry
+        # is PINNED (refcounted) so LRU eviction cannot drop it before the
+        # splice dispatches — prefill_pending runs the copy at the first
+        # chunk boundary this row gets (device work stays out of
+        # begin_admit, per the class contract).
+        resume, entry = 0, None
+        eng = self.engine
+        if eng.prefix_cache is not None and not eng._in_warmup:
+            resume, entry = eng.prefix_cache.match_for_splice(prompt_tokens[:-1])
         self._pending[row] = {
             "tokens": list(prompt_tokens),
             "done": 0,  # prefilled prefix length within tokens[:-1]
             "temperature": temperature,
             "topp": topp,
             "key_data": key_data,
+            "resume": resume,  # chunk-bucket-aligned prefix-cache boundary
+            "entry": entry,  # pinned PrefixEntry to splice, or None
         }
 
     def prefill_pending(self, row: int, max_tokens: int | None = None) -> int:
@@ -234,6 +251,27 @@ class BatchSession:
         # step fetch), so under DLT_SANITIZERS=1 nothing in here may
         # implicitly sync device->host
         with eng._sanitizer_scope():
+            entry = st.pop("entry", None)
+            if entry is not None:
+                # prefix-cache splice: ONE donate-safe copy writes the
+                # cached KV into this row at positions [0, entry.length);
+                # chunked prefill then resumes from the bucket boundary.
+                # Positions in [resume, entry.length) may belong to a
+                # diverged sibling prompt — the chunks below rewrite every
+                # position >= resume before any query reads it (the parked-
+                # row write-before-read invariant).
+                try:
+                    with eng._guard(
+                        f"prefix_copy_row[{entry.length}]",
+                        ("prefix_copy_row", entry.length, entry.length),
+                    ):
+                        eng.cache = eng.prefix_cache.splice_row(eng, entry, row)
+                finally:
+                    # ALWAYS unpin — a watchdog StallError out of the guard
+                    # must not leave the entry pinned (unevictable) forever
+                    eng.prefix_cache.entry_release(entry)
+                eng.prefix_cache.record_hit(st["resume"])
+                st["done"] = min(st["resume"], len(pre))
             while st["done"] < len(pre) and budget > 0:
                 done = st["done"]
                 # plan against the REMAINING BUDGET too, so a budget below
@@ -289,6 +327,12 @@ class BatchSession:
             self.keys[row] = np.asarray(st["key_data"], np.uint32)  # dlt: allow(host-sync) — host tuple, no device source
             self.active[row] = True
             del self._pending[row]
+            if eng.prefix_cache is not None and not eng._in_warmup:
+                # publish this prompt's KV at arming (one extract copy): a
+                # burst of shared-prefix admissions then hits from the
+                # SECOND request on, without waiting for the first to finish
+                with eng._sanitizer_scope():
+                    eng.prefix_cache.publish_from_row(eng, row, pre)
             return 0
         return remaining
 
@@ -296,11 +340,29 @@ class BatchSession:
         """Park the row: its cache writes drop from the next chunk on, so
         the slot can be re-admitted later without disturbing anyone. Also
         drops any staged admission mid-prefill (its partial KV is junk past
-        every live row's view, same as any parked interval)."""
+        every live row's view, same as any parked interval) — unpinning the
+        prefix-cache entry a never-spliced admission still holds."""
         self.active[row] = False
         self.pos[row] = self.seq_len
         self.temp[row] = 0.0  # greedy is the cheap sampling path for junk
-        self._pending.pop(row, None)
+        st = self._pending.pop(row, None)
+        if st is not None and st.get("entry") is not None:
+            self.engine.prefix_cache.entry_release(st["entry"])
+
+    def publish_row(self, row: int, tokens: list) -> None:
+        """Publish the first `len(tokens) - 1` tokens' KV of `row` into the
+        engine's prefix cache (no-op when disabled). The Batcher calls this
+        at row retirement with prompt + delivered tokens: every position
+        below the cap was FED during a decode chunk, so its KV is final.
+        The -1 cap drops the last token, whose slot is unwritten when it
+        was the final sample of the row's final chunk."""
+        eng = self.engine
+        if eng.prefix_cache is None or eng._in_warmup or len(tokens) < 2:
+            return
+        with eng._sanitizer_scope():
+            eng.prefix_cache.publish_from_row(
+                eng, row, list(tokens), max_len=len(tokens) - 1
+            )
 
     def step(self, n_steps: int) -> np.ndarray:
         """One decode chunk for every slot; returns host tokens [b, n_steps]

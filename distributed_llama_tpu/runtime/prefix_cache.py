@@ -1,0 +1,549 @@
+"""Radix prefix cache: device-resident cross-request KV reuse.
+
+Real serving fleets are dominated by *shared prefixes* — system prompts,
+few-shot templates, multi-turn chat histories — yet every admission used to
+re-prefill from token 0; the only reuse was the serialized path's
+`NaiveCache`, which remembered exactly one conversation and thrashed the
+moment two users interleaved. This module is the engine-wide replacement:
+a radix tree (RadixAttention, SGLang / Zheng et al. 2023) over *token
+chains* whose published nodes own **device-resident KV slices** — per-layer
+k/v copied out of the live cache at bucket-aligned lengths — refcounted and
+LRU-evicted under an HBM byte budget (PagedAttention's refcounted-sharing
+memory discipline at slice granularity rather than per-block).
+
+A new request longest-prefix-matches the trie; the match is rounded *down*
+to a chunk-bucket boundary B; one jitted donate-safe copy program splices
+the cached slice into the request's row(s); chunked prefill resumes from B.
+Completed prefills publish their prompt KV back into the trie (one extract
+copy), and completed generations publish the whole conversation, so the
+next turn of a chat hits near-zero-TTFT regardless of which other users
+interleaved in between.
+
+Correctness invariants (the reasons this is bit-identical to a cold run):
+
+* a published slice of length P holds, at position p < P, exactly the KV a
+  cold prefill writes for that position — it was *extracted from* a
+  completed prefill/decode, never recomputed;
+* splicing writes the WHOLE stored slice [0, P); positions in [B, P) may
+  belong to a diverged sibling request, but the resumed prefill (and then
+  decode) rewrites every position >= B before any query at position >= B
+  reads it — the same write-before-read invariant padded prefill tails and
+  parked rows already rely on (models/transformer.py OOB-scatter notes);
+* the copy/extract programs are plain jitted slice/update programs on the
+  engine's warm-key ladder: one `(bucket, bucket)` entry per prefix bucket,
+  warmed by `InferenceEngine.warmup()`, ZERO collectives (the graph
+  auditor enforces this), cache donated so the splice is in-place in HBM.
+
+Sharding: on shard_map pipeline meshes a cached slice carries
+`parallel.pipeline.pp_prefix_sharding` — the live cache's per-stage layout
+minus the batch axis — enforced with an in-program sharding constraint so
+extraction and splice never reshuffle KV across stages. Sequence-parallel
+(`sp > 1`) meshes shard the seq axis itself and are not supported; the
+cache disables itself there.
+
+Thread-safety: all trie/LRU/refcount state is guarded by one lock. The
+device programs are dispatched by whichever thread owns the engine (the
+Batcher worker, or the caller of `generate`); `/stats` readers only take
+snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.params import KVCache
+
+#: prefixes shorter than this are not worth a splice dispatch (~a tunnel
+#: round trip); also the smallest published bucket
+PREFIX_MIN_TOKENS = 16
+
+
+def prefix_buckets(seq_len: int) -> list:
+    """Power-of-two published-slice lengths: PREFIX_MIN_TOKENS up to
+    seq_len // 2 (a prefix past half the context leaves no room to decode,
+    and the cap keeps the copy-program ladder O(log seq_len))."""
+    out = []
+    b = PREFIX_MIN_TOKENS
+    while b <= seq_len // 2:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def bucket_down(n: int, seq_len: int) -> int:
+    """Largest prefix bucket <= n (0 = below the publishable floor)."""
+    best = 0
+    for b in prefix_buckets(seq_len):
+        if b <= n:
+            best = b
+    return best
+
+
+def resolve_budget_mb(explicit, default_mb: int) -> int:
+    """THE one resolver of the prefix-cache budget: an explicit value wins;
+    otherwise DLT_PREFIX_CACHE_MB; an unset OR unparsable env value means
+    `default_mb` (library engines pass 0 = off, the CLI/server entry points
+    pass their serving default — same parsing everywhere, only the intended
+    default differs)."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get("DLT_PREFIX_CACHE_MB")
+    if raw is None or raw == "":
+        return default_mb
+    try:
+        return int(raw)
+    except ValueError:
+        return default_mb
+
+
+# -- the jitted device programs ---------------------------------------------
+#
+# One compiled program per (prefix bucket, cache shape) — the new entries on
+# the warm-key ladder. All three are pure slice/update programs: no matmuls,
+# no collectives (GSPMD may partition them, but the traced jaxpr is
+# collective-free — analysis/graph_audit.py asserts it). `out_sharding` is a
+# STATIC NamedSharding (hashable) so pipeline engines pin the per-stage
+# layout inside the program instead of hoping XLA propagates it.
+
+
+@partial(
+    jax.jit,
+    static_argnames=("out_sharding",),
+    donate_argnames=("cache",),
+)
+def copy_prefix_into_rows(cache, k_seg, v_seg, out_sharding=None):
+    """Splice a cached slice [L, P, h, d] into positions [0, P) of EVERY
+    batch row (the solo `generate`/`generate_batch` paths treat rows as one
+    aligned front). Donated cache: in-place in HBM."""
+    L, b = cache.k.shape[0], cache.k.shape[1]
+    P = k_seg.shape[1]
+    kb = jnp.broadcast_to(k_seg[:, None], (L, b, P) + k_seg.shape[2:])
+    vb = jnp.broadcast_to(v_seg[:, None], (L, b, P) + v_seg.shape[2:])
+    k = jax.lax.dynamic_update_slice(cache.k, kb.astype(cache.k.dtype), (0, 0, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, vb.astype(cache.v.dtype), (0, 0, 0, 0, 0))
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
+    return KVCache(k=k, v=v)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("out_sharding",),
+    donate_argnames=("cache",),
+)
+def copy_prefix_into_row(cache, k_seg, v_seg, row, out_sharding=None):
+    """Splice a cached slice [L, P, h, d] into positions [0, P) of ONE batch
+    row (the BatchSession admission path; `row` is traced so every row
+    shares one compiled program per bucket). Donated cache."""
+    k = jax.lax.dynamic_update_slice(
+        cache.k, k_seg[:, None].astype(cache.k.dtype), (0, row, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, v_seg[:, None].astype(cache.v.dtype), (0, row, 0, 0, 0)
+    )
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
+    return KVCache(k=k, v=v)
+
+
+@partial(jax.jit, static_argnames=("length", "out_sharding"))
+def extract_prefix_from_row(cache, row, length, out_sharding=None):
+    """Copy positions [0, length) of one row OUT of the live cache into a
+    standalone [L, length, h, d] pair (the publish path). NOT donated — the
+    live cache must survive; the result is the published entry's storage."""
+    L, h, d = cache.k.shape[0], cache.k.shape[3], cache.k.shape[4]
+    k = jax.lax.dynamic_slice(cache.k, (0, row, 0, 0, 0), (L, 1, length, h, d))[:, 0]
+    v = jax.lax.dynamic_slice(cache.v, (0, row, 0, 0, 0), (L, 1, length, h, d))[:, 0]
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
+    return k, v
+
+
+# -- host-side structure ----------------------------------------------------
+
+
+@dataclass
+class PrefixEntry:
+    """One published slice: `tokens` (a bucket-length tuple) is the trie
+    key; k/v are the device arrays; `refs` pins the entry against eviction
+    while an admission is between match and splice-dispatch."""
+
+    tokens: tuple
+    k: object
+    v: object
+    nbytes: int
+    refs: int = 0
+    last_used: int = 0
+
+    @property
+    def length(self) -> int:
+        return len(self.tokens)
+
+
+class _Node:
+    """Radix node: `edge` is the token run from the parent (path
+    compression), children keyed by first token, `entry` set when a
+    published slice ends exactly at this node."""
+
+    __slots__ = ("edge", "children", "entry")
+
+    def __init__(self, edge=()):
+        self.edge = tuple(edge)
+        self.children: dict = {}
+        self.entry = None
+
+
+class PrefixCache:
+    """The engine-wide radix prefix cache (see module docstring)."""
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        seq_len: int,
+        max_chunk: int,
+        stats=None,
+        seg_sharding=None,
+        cache_sharding=None,
+    ):
+        self.budget_bytes = int(budget_bytes)
+        self.seq_len = seq_len
+        self.max_chunk = max_chunk
+        self.stats = stats  # StepStats: counters surface in /stats, /health
+        self.seg_sharding = seg_sharding  # published-slice layout (pipeline)
+        self.cache_sharding = cache_sharding  # live-cache layout to preserve
+        self.buckets = prefix_buckets(seq_len)
+        self._root = _Node()
+        self._entries: dict = {}  # token tuple -> PrefixEntry
+        self._bytes = 0
+        self._clock = 0
+        self._lock = threading.Lock()
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, engine, prefix_cache_mb=None):
+        """The engine's factory: resolves the budget (constructor arg >
+        DLT_PREFIX_CACHE_MB env > 0/off) and the topology gates. Returns
+        None when the cache is disabled — `sp > 1` meshes shard the cache's
+        seq axis itself, which a replicated slice cannot splice into."""
+        prefix_cache_mb = resolve_budget_mb(prefix_cache_mb, default_mb=0)
+        if prefix_cache_mb <= 0:
+            return None
+        if engine.mesh is not None and engine.mesh.shape.get("sp", 1) > 1:
+            return None
+        if not prefix_buckets(engine.cfg.seq_len):
+            return None  # context too small for a publishable prefix
+        seg_sh = None
+        cache_sh = engine._cache_sharding
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pp_prefix_sharding
+
+            seg_sh = pp_prefix_sharding(engine.mesh)
+        return cls(
+            prefix_cache_mb * 1024 * 1024,
+            seq_len=engine.cfg.seq_len,
+            max_chunk=engine.max_chunk,
+            stats=engine.stats,
+            seg_sharding=seg_sh,
+            cache_sharding=cache_sh,
+        )
+
+    # -- observability ------------------------------------------------------
+
+    def _incr(self, name, n=1):
+        if self.stats is not None:
+            self.stats.incr(name, n)
+
+    def _gauges(self):
+        if self.stats is not None:
+            self.stats.gauge("prefix_cache_bytes", self._bytes)
+            self.stats.gauge("prefix_cache_entries", len(self._entries))
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "buckets": list(self.buckets),
+                "pinned": sum(1 for e in self._entries.values() if e.refs > 0),
+            }
+
+    # -- matching -----------------------------------------------------------
+
+    def resume_boundary(self, m: int) -> int:
+        """Round a matched length DOWN to a chunk-bucket boundary: a
+        multiple of max_chunk, or (below one chunk) the largest power-of-two
+        chunk bucket — so the resumed prefill's chunk plan stays on the same
+        (size, kv-bucket) warm ladder a cold prefill walks."""
+        if m >= self.max_chunk:
+            return (m // self.max_chunk) * self.max_chunk
+        b = 0
+        p = 1
+        while p <= m:
+            b = p
+            p *= 2
+        return b
+
+    def _walk(self, tokens):
+        """(m, subtree_node, best_on_path): m = longest shared prefix with
+        any published chain; subtree_node roots the entries sharing exactly
+        m tokens; best_on_path = deepest entry whose WHOLE chain matched."""
+        node = self._root
+        t = tuple(tokens)
+        m = 0
+        best = None
+        while True:
+            if node.entry is not None:
+                best = node.entry
+            if m == len(t):
+                return m, node, best
+            child = node.children.get(t[m])
+            if child is None:
+                return m, None, best
+            e = child.edge
+            lim = min(len(e), len(t) - m)
+            lcp = 0
+            while lcp < lim and e[lcp] == t[m + lcp]:
+                lcp += 1
+            m += lcp
+            if lcp == len(e):
+                node = child
+                continue
+            # diverged (or ran out of tokens) mid-edge: everything below
+            # `child` still shares exactly the first m tokens
+            return m, child, best
+
+    @staticmethod
+    def _first_entry(node):
+        if node is None:
+            return None
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    def match(self, tokens):
+        """Longest-prefix match: (covered, entry). `covered` is the number
+        of leading tokens of `tokens` the entry's slice holds CORRECT KV
+        for; entry None on a miss. An entry deeper than the divergence point
+        is still usable — its positions past `covered` get rewritten by the
+        resumed prefill before any query reads them (module docstring)."""
+        with self._lock:
+            m, subtree, best = self._walk(tokens)
+            entry = self._first_entry(subtree)
+            if entry is not None:
+                return m, entry
+            if best is not None:
+                return min(m, best.length), best
+            return 0, None
+
+    def match_for_splice(self, tokens):
+        """The admission-path lookup: returns (resume_boundary, entry) with
+        the entry PINNED (refs+1) so eviction cannot drop it between match
+        and splice dispatch — the caller must `entry_release` it after the
+        copy is dispatched (or abandoned). A miss (including a match whose
+        boundary rounds below the publishable floor) is counted here; a HIT
+        is counted by `record_hit` at splice-dispatch time, so an admission
+        abandoned before its splice never inflates prefix_hit_tokens (the
+        metric is "prefill compute actually skipped")."""
+        covered, entry = self.match(tokens)
+        B = self.resume_boundary(min(covered, len(tokens)))
+        if entry is None or B < PREFIX_MIN_TOKENS:
+            self._incr("prefix_misses")
+            return 0, None
+        with self._lock:
+            entry.refs += 1
+            self._clock += 1
+            entry.last_used = self._clock
+        return B, entry
+
+    def record_hit(self, resume: int) -> None:
+        """Count one splice that actually dispatched (`resume` = the
+        bucket-aligned prefill tokens it skipped)."""
+        self._incr("prefix_hits")
+        self._incr("prefix_hit_tokens", resume)
+
+    def entry_release(self, entry) -> None:
+        with self._lock:
+            entry.refs = max(0, entry.refs - 1)
+
+    # -- splicing -----------------------------------------------------------
+
+    def splice_rows(self, engine, entry):
+        """Dispatch the all-rows copy program; returns the new (donated)
+        cache. Dispatch-only: nothing here blocks on the device."""
+        return copy_prefix_into_rows(
+            engine.cache, entry.k, entry.v, out_sharding=self.cache_sharding
+        )
+
+    def splice_row(self, engine, entry, row: int):
+        """Dispatch the one-row copy program (BatchSession admissions)."""
+        return copy_prefix_into_row(
+            engine.cache, entry.k, entry.v, jnp.asarray(row, jnp.int32),
+            out_sharding=self.cache_sharding,
+        )
+
+    # -- publishing ---------------------------------------------------------
+
+    def publish_from_row(self, engine, row: int, tokens, max_len=None) -> bool:
+        """Publish the first `bucket_down(max_len)` tokens' KV of `row` into
+        the trie: one extract copy out of the live cache, then a host-side
+        radix insert. Every position < max_len must already hold final KV
+        (callers cap at the last *fed* token). Dedupes by token key; evicts
+        LRU unpinned entries to fit the budget; skips (with a counter) when
+        pinned entries leave no room. Returns True when an entry was
+        inserted or refreshed."""
+        n = len(tokens) if max_len is None else min(max_len, len(tokens))
+        P = bucket_down(n, self.seq_len)
+        if P < PREFIX_MIN_TOKENS:
+            return False
+        key = tuple(int(t) for t in tokens[:P])
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                self._clock += 1
+                existing.last_used = self._clock
+                return True
+            need = self._slice_nbytes(engine, P)
+            if need > self.budget_bytes:
+                self._incr("prefix_publish_skipped")
+                return False
+            if not self._evict_until(self.budget_bytes - need):
+                self._incr("prefix_publish_skipped")
+                return False
+        # dispatch OUTSIDE the lock: /stats readers must not wait on a
+        # device dispatch. The extract is async; the arrays become the
+        # entry's storage and are only consumed by later splice dispatches,
+        # which XLA orders after the producing program.
+        with engine._guard(f"prefix_extract[{P}]", ("prefix_extract", P, P)):
+            k, v = extract_prefix_from_row(
+                engine.cache, jnp.asarray(row, jnp.int32), length=P,
+                out_sharding=self.seg_sharding,
+            )
+        with self._lock:
+            if key in self._entries:  # raced with another publisher
+                return True
+            self._clock += 1
+            entry = PrefixEntry(
+                tokens=key, k=k, v=v, nbytes=k.nbytes + v.nbytes,
+                last_used=self._clock,
+            )
+            self._insert(entry)
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self._gauges()
+        self._incr("prefix_inserts")
+        return True
+
+    def _slice_nbytes(self, engine, P: int) -> int:
+        L, _, _, h, d = engine.cache.k.shape
+        return 2 * L * P * h * d * engine.cache.k.dtype.itemsize
+
+    # -- trie maintenance (callers hold the lock) ---------------------------
+
+    def _insert(self, entry) -> None:
+        t = entry.tokens
+        node = self._root
+        i = 0
+        while True:
+            if i == len(t):
+                node.entry = entry
+                return
+            child = node.children.get(t[i])
+            if child is None:
+                leaf = _Node(t[i:])
+                leaf.entry = entry
+                node.children[t[i]] = leaf
+                return
+            e = child.edge
+            lim = min(len(e), len(t) - i)
+            lcp = 0
+            while lcp < lim and e[lcp] == t[i + lcp]:
+                lcp += 1
+            if lcp == len(e):
+                node = child
+                i += lcp
+                continue
+            # split the edge at the divergence point
+            mid = _Node(e[:lcp])
+            child.edge = e[lcp:]
+            mid.children[child.edge[0]] = child
+            node.children[t[i]] = mid
+            i += lcp
+            if i == len(t):
+                mid.entry = entry
+            else:
+                leaf = _Node(t[i:])
+                leaf.entry = entry
+                mid.children[t[i]] = leaf
+            return
+
+    def _detach(self, entry) -> None:
+        """Remove `entry` from the trie, pruning now-empty nodes."""
+        t = entry.tokens
+        path = []  # (parent, first_token, node)
+        node = self._root
+        i = 0
+        while i < len(t):
+            child = node.children.get(t[i])
+            if child is None:
+                return  # not present (already detached)
+            path.append((node, t[i], child))
+            i += len(child.edge)
+            node = child
+        if node.entry is not entry:
+            return
+        node.entry = None
+        for parent, first, n in reversed(path):
+            if n.entry is None and not n.children:
+                del parent.children[first]
+            else:
+                break
+
+    def _evict_until(self, target_bytes: int) -> bool:
+        """Evict LRU UNPINNED entries until total <= target; False when
+        pinned entries make the target unreachable."""
+        while self._bytes > target_bytes:
+            victims = [e for e in self._entries.values() if e.refs == 0]
+            if not victims:
+                return False
+            victim = min(victims, key=lambda e: e.last_used)
+            self._remove(victim)
+            self._incr("prefix_evictions")
+        return True
+
+    def _remove(self, entry) -> None:
+        self._detach(entry)
+        self._entries.pop(entry.tokens, None)
+        self._bytes -= entry.nbytes
+        self._gauges()
+
+    def clear(self) -> None:
+        """Drop every entry (engine recovery: after an engine failure the
+        in-flight extracts may descend from the failed computation)."""
+        with self._lock:
+            self._root = _Node()
+            self._entries.clear()
+            self._bytes = 0
+            self._gauges()

@@ -7,11 +7,18 @@ Wire-compatible with the reference server (reference: src/dllama-api.cpp):
   `temperature`, `top_p`, `seed`, `max_tokens`, `stream`
   (reference: parseRequest, dllama-api.cpp:501-530);
 * ``GET /v1/models`` — single-model list;
-* **naive prefix cache**: successive chat turns whose message prefix matches
-  the cached conversation resume decoding from the cached KV position
-  instead of re-prefilling (reference: NaiveCache, dllama-api.cpp:296-341).
+* **radix prefix cache** (runtime/prefix_cache.py): every request
+  longest-prefix-matches a trie of published KV slices, so successive chat
+  turns — and UNRELATED requests sharing a system prompt — resume from
+  cached KV instead of re-prefilling. Unlike the retired ``NaiveCache``
+  (one remembered conversation, thrashed by two interleaved users), the
+  radix cache is multi-conversation and applies on BOTH the serialized and
+  the batched (Batcher) paths. On by default (``--prefix-cache-mb``,
+  ``DLT_PREFIX_CACHE_MB``; 0 disables); observable via ``/stats``
+  (``prefix_hits``/``prefix_hit_tokens``/``prefix_cache_bytes``/
+  ``prefix_evictions`` and the ``prefix_cache`` section).
 
-Requests are served sequentially (one engine, one KV cache) exactly like the
+batch == 1 serves sequentially (one engine, one KV cache) exactly like the
 reference's accept loop; horizontal scale comes from the gateway
 (server/gateway.py) across replicas.
 """
@@ -70,7 +77,15 @@ class CacheItem:
 
 
 class NaiveCache:
-    """KV-prefix reuse across chat turns (reference: dllama-api.cpp:296-341)."""
+    """DEPRECATED: KV-prefix reuse across chat turns (reference:
+    dllama-api.cpp:296-341). Retired in favor of the engine's radix prefix
+    cache (runtime/prefix_cache.py), which is multi-conversation correct —
+    NaiveCache remembered exactly ONE conversation, so two interleaved
+    users evicted each other's prefix on every turn (the "interleaved-user
+    thrash"). The class is kept for API compatibility and as the reference
+    baseline; the server no longer constructs it. The old per-request miss
+    signal survives as the ``cache_miss`` StepStats counter (a chat request
+    that reused zero prefix tokens)."""
 
     def __init__(self):
         self.items: list[CacheItem] = []
@@ -147,6 +162,9 @@ class _BatchReq:
         self.stopped = False
         self.prefilling = False  # admitted, prompt still prefilling in
         # bounded chunks between decode steps (interleaved admission)
+        self.out_ids: list = []  # raw token ids delivered to the emit
+        # queue, in order — the retirement-time prefix-cache publish needs
+        # the row's actual token chain (ids + generated)
         self.n = 0  # tokens decoded into this row (budget accounting)
         self.n_out = 0  # tokens actually delivered to on_token (usage
         # accounting: excludes post-stop overrun the writer drains away)
@@ -174,10 +192,13 @@ class Batcher:
       temperature/top-p traffic — and explicitly seeded requests — co-batch
       freely. A seeded request's stream depends only on its seed and step
       count (per-row threefry chains), so it reproduces regardless of what
-      it shares chunks with.
-
-    The naive prefix cache does not apply in batch mode (rows are
-    independent fresh sequences).
+      it shares chunks with;
+    * admissions ride the engine's radix PREFIX CACHE
+      (runtime/prefix_cache.py): a staged prompt longest-prefix-matches the
+      trie at `begin_admit`, splices the cached KV at its first prefill
+      chunk, and every retired row publishes its conversation KV back —
+      shared system prompts and multi-turn histories reuse device KV
+      across co-batched users.
     """
 
     def __init__(self, state: "ApiState", chunk_size: int | None = None,
@@ -291,6 +312,15 @@ class Batcher:
     def _finish(self, req: _BatchReq, session, slots, row):
         import queue
 
+        if req.error is None and not req.prefilling and req.out_ids:
+            # publish the retired row's conversation KV (prompt + generated)
+            # into the prefix cache BEFORE parking it, so this user's next
+            # turn — on any row — splices instead of re-prefilling. Best
+            # effort: a publish failure must never fail the request.
+            try:
+                session.publish_row(row, list(req.ids) + req.out_ids)
+            except Exception:
+                self.state.engine.stats.incr("prefix_publish_failed")
         session.release(row)
         slots[row] = None
         req.done.set()
@@ -441,6 +471,7 @@ class Batcher:
                 for j in range(toks.shape[1]):
                     t = int(toks[row, j])
                     req.n += 1
+                    req.out_ids.append(t)
                     try:
                         req.emit.put_nowait(t)
                     except queue.Full:
@@ -472,7 +503,6 @@ class ApiState:
         self.tokenizer = tokenizer
         self.args = args
         self.lock = threading.Lock()
-        self.naive_cache = NaiveCache()
         self.sampler = Sampler(
             engine.cfg.vocab_size,
             args.temperature,
@@ -604,6 +634,7 @@ class ApiState:
         # n_out counts tokens the writer actually delivered (the EOS token
         # included) — req.n also counts post-stop overrun decoded before the
         # step loop noticed, which must not inflate usage accounting
+        self.engine.stats.incr("requests_completed")
         return "".join(base + deltas_box[0]), len(ids), req.n_out
 
     def complete(self, params: dict, emit, client_visible: bool = True):
@@ -640,23 +671,26 @@ class ApiState:
     def _complete_once(self, params: dict, emit):
         engine, tok = self.engine, self.tokenizer
         messages = params["messages"]
-        delta_prompt, start_pos = self.naive_cache.resolve_delta_prompt(messages)
-        if start_pos == 0:
-            engine.reset()
+        # full-prompt serving over the radix prefix cache: every request
+        # encodes its WHOLE templated conversation and resets the live
+        # cache; the engine's prefix cache splices whatever prefix any
+        # earlier request (this conversation's prior turn, or an unrelated
+        # user sharing a system prompt) already published — multi-
+        # conversation correct where NaiveCache thrashed on interleaving
+        engine.reset()
 
-        items = [ChatItem(m["role"], m["content"]) for m in delta_prompt]
+        items = [ChatItem(m["role"], m["content"]) for m in messages]
         prompt = self.template.generate(items, True)
-        ids = tok.encode(prompt.content, is_start=(start_pos == 0))
+        ids = tok.encode(prompt.content, is_start=True)
         seq_len = engine.cfg.seq_len
-        if start_pos + len(ids) - 1 >= seq_len:
+        if len(ids) - 1 >= seq_len:
             # the reference clamps silently and returns an empty completion
             # (dllama-api.cpp:390-391); surface it as a client error instead
             raise PromptTooLong(
-                f"prompt ({start_pos + len(ids)} tokens with cached prefix) "
-                f"exceeds the context window ({seq_len})"
+                f"prompt ({len(ids)} tokens) exceeds the context window ({seq_len})"
             )
 
-        prompt_end = start_pos + len(ids) - 1
+        prompt_end = len(ids) - 1
         max_tokens = params.get("max_tokens", -1)
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
 
@@ -698,37 +732,38 @@ class ApiState:
 
         try:
             res = engine.generate(
-                ids, max_pred, sampler=self.sampler, pos_start=start_pos,
+                ids, max_pred, sampler=self.sampler, pos_start=0,
                 on_token=on_token, stop_fn=lambda t: state["stop"],
             )
         except ClientDisconnected:
             # the CLIENT dropped mid-stream (emit raised) — the engine and
-            # the cached prefix are fine; this turn simply was never pushed
+            # the published prefixes are fine; this turn was never pushed
             raise
         except Exception:
             # an ENGINE failure leaves the KV cache holding a prefix that
-            # was never fully written — drop both caches so the next request
-            # starts clean instead of silently resuming from a corrupt prefix
+            # was never fully written — drop the live cache AND the prefix
+            # cache (an in-flight publish may descend from the failed
+            # computation) so the next request starts clean
             self.recover()
             raise
-        # cache entries record only successfully-prefilled KV (pushing them
-        # before generate would let a mid-stream failure poison later turns)
-        for m in delta_prompt:
-            self.naive_cache.push(prompt_end, m["role"], m["content"])
-        pos = prompt_end + res.n_pred_tokens
-
+        # the engine published this conversation's KV into the prefix trie
+        # itself (generate's post-decode publish); keep the NaiveCache-era
+        # miss signal as a counter for dashboards that tracked it
+        if engine.prefix_cache is not None and engine.last_prefix_hit_tokens == 0:
+            engine.stats.incr("cache_miss")
+        engine.stats.incr("requests_completed")
         text = "".join(buffer)
-        if pos >= seq_len:
-            self.naive_cache.clear()
-        else:
-            self.naive_cache.push(pos, "assistant", text)
         return text, len(ids), res.n_pred_tokens
 
     def recover(self):
         """Reset engine + prefix cache after a failed generation (the
         reference instead restarts the whole server loop,
-        dllama-api.cpp:624-636; one engine reset is the cheaper analogue)."""
-        self.naive_cache.clear()
+        dllama-api.cpp:624-636; one engine reset is the cheaper analogue).
+        The prefix cache is cleared too: entries extracted near the failure
+        may hold poisoned/unfinished KV, and a silent splice of one would
+        corrupt a future request."""
+        if self.engine.prefix_cache is not None:
+            self.engine.prefix_cache.clear()
         try:
             self.engine.reset()
         except Exception:
@@ -773,9 +808,14 @@ class Handler(BaseHTTPRequestHandler):
             # network perf report only at shutdown, nn-network.cpp:883-1053;
             # this surfaces the same numbers live, plus Batcher occupancy)
             st = self.state
+            pc = st.engine.prefix_cache
             payload = {
                 "steps": st.engine.stats.snapshot(),
                 "batcher": st.batcher.stats() if st.batcher is not None else None,
+                # prefix-cache occupancy; the hit/eviction counters
+                # (prefix_hits, prefix_hit_tokens, prefix_evictions, ...)
+                # ride steps.counters like every other engine event
+                "prefix_cache": pc.stats_snapshot() if pc is not None else None,
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,

@@ -3,9 +3,12 @@
 # for environments without Actions (and for preflight before pushing):
 #
 #   1. repo lint            (scripts/dlt_lint.py — AST rules, dlt pragmas)
-#   2. graph audit          (tiny config, full warm-key ladder: dtypes,
+#   2. graph audit          (tiny config, full warm-key ladder incl. the
+#                            prefix-cache copy/extract programs: dtypes,
 #                            collective budgets, KV donation, shardings)
 #   3. analysis test suite  (pytest -m analysis: one suite per audit pass)
+#   4. prefix-cache suite   (radix trie, token identity, eviction/pinning,
+#                            sanitizer acceptance — fast subset member)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -21,6 +24,9 @@ python -m distributed_llama_tpu.analysis.graph_audit
 
 echo "== analysis suite (pytest -m analysis) =="
 python -m pytest tests/ -q -m analysis -p no:cacheprovider
+
+echo "== prefix-cache suite =="
+python -m pytest tests/test_prefix_cache.py -q -p no:cacheprovider
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

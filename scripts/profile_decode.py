@@ -60,7 +60,9 @@ def main():
                     "the round-4 per-token-floor hunt)")
     args = ap.parse_args()
     path = {"1b": ensure_model, "qwen3": ensure_qwen3, "moe": ensure_moe}[args.model]()
-    engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=64)
+    engine = InferenceEngine(
+        path, compute_dtype="bfloat16", max_chunk=64, prefix_cache_mb=0
+    )
     cfg, params, rope = engine.cfg, engine.params, engine.rope
     print(f"cfg: dim={cfg.dim} layers={cfg.n_layers} heads={cfg.n_heads}/{cfg.n_kv_heads} "
           f"hd={cfg.head_dim} hidden={cfg.hidden_dim} vocab={cfg.vocab_size} seq={cfg.seq_len} "

@@ -39,6 +39,8 @@ def overlap_report(path: str, prompt_tokens: int, reps: int = 3):
         eng = InferenceEngine(
             path, compute_dtype="bfloat16", max_chunk=512,
             prefill_pipelined=pipelined,
+            prefix_cache_mb=0,  # repeated-prompt probe: a splice would
+            # replace the prefill being measured
         )
         prompt = [(i % 1000) + 1 for i in range(prompt_tokens)]
         eng.prefill(prompt)  # compile the ladder
@@ -92,7 +94,9 @@ def main():
     if args.overlap:
         overlap_report(path, args.prompt_tokens)
         return
-    engine = InferenceEngine(path, compute_dtype="bfloat16", max_chunk=512)
+    engine = InferenceEngine(
+        path, compute_dtype="bfloat16", max_chunk=512, prefix_cache_mb=0
+    )
     cfg, params, rope = engine.cfg, engine.params, engine.rope
     T = 512
     N = 8

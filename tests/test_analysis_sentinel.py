@@ -115,6 +115,41 @@ def test_sealed_sentinel_ignores_a_coresident_warmup():
         b.stop()
 
 
+def test_52_token_prompt_and_deep_buckets_zero_recompiles(tmp_path, monkeypatch):
+    """The ROADMAP warm-ladder open item, closed: the recorded repro was a
+    52-token prompt on the default max_chunk=32 config — its prefill plan
+    contains a FULL max_chunk chunk (32+16+2+1), which the canonical
+    warmup prompt (n-1 = 31 tokens) never produced, so the first real
+    odd-shaped request compiled inside the request. warmup()'s ladder fill
+    now covers every (size, kv-bucket) combination — including prefill
+    tail buckets below max_chunk and decode chunks in DEEP kv buckets — so
+    the repro (and a deep-context request crossing the 256-bucket
+    boundary) serves with sanitizer_recompiles == 0."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=512), seed=9)
+    eng = InferenceEngine(
+        path, compute_dtype="float32", max_chunk=32, decode_chunk_size=8
+    )
+    try:
+        eng.warmup()
+        assert eng.sentinel.sealed
+        # the recorded repro: 52-token prompt (prefill plan 32+16+2+1)
+        eng.reset()
+        eng.generate([1 + (i % 99) for i in range(52)], 52 + 12, sampler=None,
+                     on_token=lambda t: None)
+        assert eng.sentinel.post_seal_compiles == 0
+        # deep-kv-bucket leg: a 300-token prompt decodes across the 512
+        # bucket — chunks the canonical schedule never reached
+        eng.reset()
+        eng.generate([1 + (i % 97) for i in range(300)], 300 + 12, sampler=None,
+                     on_token=lambda t: None)
+        assert eng.sentinel.post_seal_compiles == 0
+        assert "sanitizer_recompiles" not in eng.stats.counters_snapshot()
+    finally:
+        eng.close()
+
+
 def test_sentinel_off_by_default(model_path, monkeypatch):
     monkeypatch.delenv("DLT_SANITIZERS", raising=False)
     eng = InferenceEngine(model_path, compute_dtype="float32")

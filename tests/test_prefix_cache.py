@@ -1,0 +1,521 @@
+"""Radix prefix cache tests: trie semantics, bit-identical hit paths at
+engine / BatchSession / HTTP level, LRU eviction under the byte budget,
+refcount pinning, mesh sharding, and the sanitizer acceptance contract
+(warmed engine serves cold + full-hit + partial-hit with zero recompiles).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.runtime.batch_session import BatchSession
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.runtime.prefix_cache import (
+    PREFIX_MIN_TOKENS,
+    PrefixCache,
+    PrefixEntry,
+    bucket_down,
+    prefix_buckets,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pfx")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=256), seed=11)
+    return path
+
+
+def _engine(path, **kw):
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("max_chunk", 16)
+    kw.setdefault("decode_chunk_size", 8)
+    return InferenceEngine(path, **kw)
+
+
+def _gen(eng, prompt, n_new):
+    eng.reset()
+    res = eng.generate(prompt, len(prompt) + n_new, sampler=None, on_token=lambda t: None)
+    return res
+
+
+# -- host-side structure ----------------------------------------------------
+
+
+def test_buckets_and_rounding():
+    assert prefix_buckets(256) == [16, 32, 64, 128]
+    assert prefix_buckets(24) == []  # context too small to publish
+    assert bucket_down(100, 256) == 64
+    assert bucket_down(15, 256) == 0
+    pc = PrefixCache(1 << 20, seq_len=256, max_chunk=16)
+    assert pc.resume_boundary(50) == 48  # multiple of max_chunk
+    assert pc.resume_boundary(16) == 16
+    assert pc.resume_boundary(13) == 8  # below one chunk: power of two
+    assert pc.resume_boundary(0) == 0
+
+
+def _fake_entry(tokens, nbytes=100):
+    return PrefixEntry(tokens=tuple(tokens), k=None, v=None, nbytes=nbytes)
+
+
+def test_radix_match_semantics():
+    """Longest-prefix match over a real radix structure: full-chain hits,
+    mid-edge divergence (subtree entries still cover the shared prefix),
+    and ancestor fallbacks."""
+    pc = PrefixCache(1 << 20, seq_len=4096, max_chunk=16)
+    a = _fake_entry([1, 2, 3, 4] * 8)          # 32 tokens
+    b = _fake_entry([1, 2, 3, 4] * 8 + [9] * 32)  # 64, extends a
+    c = _fake_entry([7] * 16)
+    for e in (a, b, c):
+        pc._insert(e)
+        pc._entries[e.tokens] = e
+    # exact full-chain match
+    covered, hit = pc.match(list(a.tokens))
+    assert covered == 32 and hit in (a, b)
+    # prompt extends past a toward b: b's chain keeps matching
+    covered, hit = pc.match(list(b.tokens) + [5, 5])
+    assert covered == 64 and hit is b
+    # diverges inside b's tail: any subtree entry covers the shared part
+    covered, hit = pc.match(list(a.tokens) + [9] * 4 + [1] * 8)
+    assert covered == 36 and hit is b
+    # unrelated prompt: miss
+    covered, hit = pc.match([5, 5, 5, 5])
+    assert covered == 0 and hit is None
+    # ancestor fallback: prompt shares only c's chain prefix
+    covered, hit = pc.match([7] * 10)
+    assert covered == 10 and hit is c
+
+
+def test_lru_eviction_respects_pins_and_budget():
+    """LRU eviction under the byte budget skips PINNED entries; unpinned
+    least-recently-used go first; an unreachable target skips the publish
+    instead of evicting a pinned slice out from under an admission."""
+    pc = PrefixCache(250, seq_len=4096, max_chunk=16)
+    a, b, c = _fake_entry([1] * 16), _fake_entry([2] * 16), _fake_entry([3] * 16)
+    for e in (a, b, c):
+        pc._insert(e)
+        pc._entries[e.tokens] = e
+        pc._bytes += e.nbytes
+        pc._clock += 1
+        e.last_used = pc._clock
+    a.refs = 1  # pinned (admission between match and splice)
+    assert pc._evict_until(250)  # b (oldest unpinned) goes
+    assert b.tokens not in pc._entries and a.tokens in pc._entries
+    assert not pc._evict_until(50)  # pinned a makes 50 unreachable
+    assert a.tokens in pc._entries and c.tokens not in pc._entries
+    a.refs = 0
+    assert pc._evict_until(0)
+    assert pc.n_entries == 0 and pc.total_bytes == 0
+
+
+# -- engine-level token identity --------------------------------------------
+
+
+def test_engine_hit_paths_bit_identical(model_path):
+    """Cold, full-prefix hit, and partial-prefix hit produce identical
+    tokens AND identical next-token logits; hit accounting is bucket-
+    aligned."""
+    cold_eng = _engine(model_path, prefix_cache_mb=0)
+    prompt = [(i % 100) + 1 for i in range(48)]
+    want = _gen(cold_eng, prompt, 16).tokens
+
+    eng = _engine(model_path, prefix_cache_mb=64)
+    assert eng.prefix_cache is not None
+    got_cold = _gen(eng, prompt, 16).tokens
+    assert eng.last_prefix_hit_tokens == 0
+    assert got_cold == want
+
+    # full-prefix hit: the conversation entry published above matches
+    got_hit = _gen(eng, prompt, 16).tokens
+    assert eng.last_prefix_hit_tokens >= PREFIX_MIN_TOKENS
+    assert eng.last_prefix_hit_tokens % 8 == 0  # chunk-bucket aligned
+    assert got_hit == want
+
+    # partial hit: shared head, diverging tail
+    p2 = prompt[:32] + [(i % 90) + 7 for i in range(16)]
+    want2 = _gen(cold_eng, p2, 16).tokens
+    got2 = _gen(eng, p2, 16).tokens
+    assert eng.last_prefix_hit_tokens >= PREFIX_MIN_TOKENS
+    assert got2 == want2
+
+    # fetched logits after a hit-splice prefill match the cold path's
+    eng.reset()
+    eng.prefill(prompt[:-1], publish=False)
+    assert eng.last_prefix_hit_tokens > 0
+    lg_hit = eng.decode_one(prompt[-1], len(prompt) - 1)
+    cold_eng.reset()
+    cold_eng.prefill(prompt[:-1])
+    lg_cold = cold_eng.decode_one(prompt[-1], len(prompt) - 1)
+    np.testing.assert_array_equal(lg_hit, lg_cold)
+
+    counters = eng.stats.counters_snapshot()
+    assert counters["prefix_hits"] >= 3
+    assert counters["prefix_hit_tokens"] >= 3 * PREFIX_MIN_TOKENS
+    eng.close()
+    cold_eng.close()
+
+
+def test_hit_then_evict_then_miss(model_path):
+    """After LRU eviction squeezes an entry out, the SAME prompt goes back
+    to the cold path (counted as a miss) and still produces identical
+    tokens — eviction is purely a performance event."""
+    cold_eng = _engine(model_path, prefix_cache_mb=0)
+    pa = [(i % 100) + 1 for i in range(40)]
+    pb = [(i % 95) + 3 for i in range(40)]
+    want_a = _gen(cold_eng, pa, 8).tokens
+    cold_eng.close()
+
+    eng = _engine(model_path, prefix_cache_mb=64)
+    _gen(eng, pa, 8)
+    # shrink the budget to one entry's worth: publishing B must evict A
+    one_entry = next(iter(eng.prefix_cache._entries.values())).nbytes
+    eng.prefix_cache.budget_bytes = one_entry
+    _gen(eng, pb, 8)
+    assert eng.stats.counters_snapshot().get("prefix_evictions", 0) >= 1
+    misses_before = eng.stats.counters_snapshot().get("prefix_misses", 0)
+    got_a = _gen(eng, pa, 8).tokens  # A was evicted: miss, cold re-prefill
+    assert got_a == want_a
+    assert eng.stats.counters_snapshot()["prefix_misses"] > misses_before
+    eng.close()
+
+
+# -- BatchSession level ------------------------------------------------------
+
+
+def test_batch_session_hit_identical_and_pin_released(model_path):
+    """An admission matching the trie splices and still decodes the exact
+    solo stream; the matched entry's pin is dropped after the splice (and
+    on release() for an abandoned staged admission)."""
+    solo = _engine(model_path, prefix_cache_mb=0)
+    prompt = [(i % 100) + 1 for i in range(40)]
+    want = solo.generate(prompt, len(prompt) + 13, sampler=None).tokens[len(prompt):][:12]
+    solo.close()
+
+    eng = _engine(model_path, batch=2, prefix_cache_mb=64)
+    s = BatchSession(eng)
+    s.admit(0, prompt)  # cold: publishes at arming
+    got = []
+    for _ in range(3):
+        got.extend(int(t) for t in s.step(4)[0])
+    assert got == want
+    assert eng.prefix_cache.n_entries >= 1
+
+    s.admit(1, prompt)  # hit: splices
+    assert eng.stats.counters_snapshot().get("prefix_hits", 0) >= 1
+    got_b = []
+    for _ in range(3):
+        got_b.extend(int(t) for t in s.step(4)[1])
+    assert got_b == want
+    assert all(e.refs == 0 for e in eng.prefix_cache._entries.values())
+
+    # interleaved staging: begin_admit pins; release() before any
+    # prefill_pending must unpin
+    s.release(0)
+    s.begin_admit(0, prompt)
+    assert any(e.refs == 1 for e in eng.prefix_cache._entries.values())
+    s.release(0)
+    assert all(e.refs == 0 for e in eng.prefix_cache._entries.values())
+    eng.close()
+
+
+def test_batch_session_partial_hit_interleaved(model_path):
+    """A partial-prefix hit through the interleaved admission path
+    (begin_admit + bounded prefill_pending) matches the solo stream."""
+    solo = _engine(model_path, prefix_cache_mb=0)
+    pa = [(i % 100) + 1 for i in range(40)]
+    p2 = pa[:24] + [(i % 70) + 3 for i in range(16)]
+    want = solo.generate(p2, len(p2) + 9, sampler=None).tokens[len(p2):][:8]
+    solo.close()
+
+    eng = _engine(model_path, batch=2, prefix_cache_mb=64)
+    s = BatchSession(eng)
+    s.admit(0, pa)
+    for _ in range(2):
+        s.step(4)
+    s.release(0)
+    s.begin_admit(1, p2)  # matches pa's published prefix partially
+    while s.prefill_pending(1, 8):
+        pass
+    got = []
+    for _ in range(2):
+        got.extend(int(t) for t in s.step(4)[1])
+    assert got == want
+    assert eng.stats.counters_snapshot().get("prefix_hit_tokens", 0) >= PREFIX_MIN_TOKENS
+    eng.close()
+
+
+def test_generate_batch_shared_prefix_hit(model_path):
+    """generate_batch splices the rows' COMMON prefix: outputs identical to
+    the cold batch, hit tokens counted, and the first batch's publish feeds
+    the second batch's splice."""
+    prefix = [(i % 100) + 1 for i in range(32)]
+    prompts = [prefix + [(i * (r + 2) % 80) + 5 for i in range(8)] for r in range(2)]
+
+    cold = _engine(model_path, batch=2, prefix_cache_mb=0)
+    want = cold.generate_batch(prompts, 8, sampler=None)
+    cold.close()
+
+    eng = _engine(model_path, batch=2, prefix_cache_mb=64)
+    first = eng.generate_batch(prompts, 8, sampler=None)  # cold + publish
+    assert first == want
+    assert eng.last_prefix_hit_tokens == 0
+    eng.reset()
+    second = eng.generate_batch(prompts, 8, sampler=None)  # splice
+    assert second == want
+    assert eng.last_prefix_hit_tokens >= PREFIX_MIN_TOKENS
+    eng.close()
+
+
+# -- sanitizer acceptance ----------------------------------------------------
+
+
+@pytest.mark.analysis
+def test_warmed_engine_hits_with_zero_recompiles(model_path, monkeypatch):
+    """The acceptance contract: with DLT_SANITIZERS=1 a warmed engine
+    serves a cold request, a full-prefix hit, and a partial-prefix hit with
+    sanitizer_recompiles == 0, the hit path skips >= the bucket-aligned
+    matched length, and outputs are bit-identical to the cold path."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    # the cold-twin engine boots FIRST: engine construction compiles shape-
+    # setup programs, and a co-resident boot after the serving engine seals
+    # would be (correctly) attributed as a breach by the process-wide sentinel
+    cold_eng = _engine(model_path, prefix_cache_mb=0)
+    prompt = [(i % 100) + 1 for i in range(48)]
+    p2 = prompt[:32] + [(i % 90) + 5 for i in range(16)]
+    want = _gen(cold_eng, prompt, 16).tokens
+    want2 = _gen(cold_eng, p2, 16).tokens
+    cold_eng.close()
+
+    eng = _engine(model_path, prefix_cache_mb=64)
+    try:
+        eng.warmup()
+        assert eng.sentinel is not None and eng.sentinel.sealed
+        got_cold = _gen(eng, prompt, 16).tokens  # cold
+        assert eng.last_prefix_hit_tokens == 0
+        got_hit = _gen(eng, prompt, 16).tokens  # full-prefix hit
+        hit_full = eng.last_prefix_hit_tokens
+        got_part = _gen(eng, p2, 16).tokens  # partial-prefix hit
+        hit_part = eng.last_prefix_hit_tokens
+        assert got_cold == want and got_hit == want and got_part == want2
+        assert hit_full >= 32 and hit_part >= 32  # bucket-aligned skip
+        assert eng.sentinel.post_seal_compiles == 0
+        assert "sanitizer_recompiles" not in eng.stats.counters_snapshot()
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+def test_warm_plan_matches_warmup_prefix_keys(model_path, monkeypatch):
+    """The prefix-cache programs land on the engine's warm-key set exactly
+    as warm_plan enumerates them (the graph auditor audits this plan)."""
+    monkeypatch.delenv("DLT_SANITIZERS", raising=False)
+    eng = _engine(model_path, batch=2, prefix_cache_mb=64)
+    try:
+        eng.warmup()
+        want = {
+            (k, s, kv)
+            for (k, s, kv) in eng.warm_plan()
+            if k.startswith("prefix_")
+        }
+        got = {k for k in eng._warm if k[0].startswith("prefix_")}
+        assert got == want
+        assert any(k[0] == "prefix_copy_row" for k in got)  # batch engine
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+def test_graph_audit_covers_prefix_programs(model_path):
+    """The auditor traces the prefix copy/extract ladder: zero collectives,
+    donation intact, clean on the tiny config."""
+    from distributed_llama_tpu.analysis import graph_audit as ga
+
+    eng = _engine(model_path, batch=2, prefix_cache_mb=64)
+    try:
+        ladder = ga.warm_key_ladder(eng)
+        kinds = {e.kind for e in ladder}
+        assert {"prefix_extract", "prefix_copy", "prefix_copy_row"} <= kinds
+        prefix_entries = [e for e in ladder if e.kind.startswith("prefix_")]
+        reports = ga.audit_engine(eng, prefix_entries)
+        ga.assert_clean(reports)
+        for r in reports:
+            assert r.collectives == {}
+    finally:
+        eng.close()
+
+
+# -- mesh sharding -----------------------------------------------------------
+
+
+def test_pipeline_mesh_slice_sharding_and_identity(tmp_path):
+    """On a pp mesh: published slices carry pp_prefix_sharding (per-stage
+    layout equal to the cache's), the live cache keeps pp_cache_sharding
+    across a splice, and hit outputs stay identical to solo."""
+    from jax.sharding import NamedSharding
+
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.parallel.pipeline import (
+        pp_cache_sharding,
+        pp_prefix_sharding,
+    )
+
+    h = tiny_header(
+        dim=128, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=4, seq_len=128
+    )
+    path = str(tmp_path / "mesh.m")
+    write_tiny_model(path, h, seed=32)
+    prompt = [(i % 100) + 3 for i in range(40)]
+    solo = InferenceEngine(path, compute_dtype="float32", max_chunk=16)
+    want = solo.generate(prompt, len(prompt) + 9, sampler=None).tokens[len(prompt):][:8]
+    solo.close()
+
+    mesh = make_mesh(pp=2)
+    eng = InferenceEngine(
+        path, compute_dtype="float32", max_chunk=16, mesh=mesh,
+        prefix_cache_mb=64,
+    )
+    try:
+        assert eng.use_pipeline
+        got_cold = eng.generate(prompt, len(prompt) + 9, sampler=None).tokens[len(prompt):][:8]
+        assert got_cold == want
+        entry = next(iter(eng.prefix_cache._entries.values()))
+        want_sh = pp_prefix_sharding(mesh)
+        for arr in (entry.k, entry.v):
+            sh = arr.sharding
+            assert isinstance(sh, NamedSharding)
+            assert sh.is_equivalent_to(want_sh, arr.ndim)
+        eng.reset()
+        got_hit = eng.generate(prompt, len(prompt) + 9, sampler=None).tokens[len(prompt):][:8]
+        assert eng.last_prefix_hit_tokens > 0
+        assert got_hit == want
+        cache_sh = pp_cache_sharding(mesh)
+        for arr in (eng.cache.k, eng.cache.v):
+            # splice preserved the live cache's per-stage layout
+            assert arr.sharding.is_equivalent_to(cache_sh, arr.ndim)
+    finally:
+        eng.close()
+
+
+def test_sp_mesh_disables_prefix_cache(tmp_path):
+    """sp > 1 shards the cache's seq axis — the prefix cache must disable
+    itself rather than splice a mis-sharded slice."""
+    from distributed_llama_tpu.parallel import make_mesh
+
+    h = tiny_header(
+        dim=128, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=4, seq_len=128
+    )
+    path = str(tmp_path / "sp.m")
+    write_tiny_model(path, h, seed=33)
+    eng = InferenceEngine(
+        path, compute_dtype="float32", max_chunk=16, mesh=make_mesh(sp=2),
+        prefix_cache_mb=64,
+    )
+    try:
+        assert eng.prefix_cache is None
+    finally:
+        eng.close()
+
+
+# -- HTTP level --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def prefix_server(tmp_path_factory):
+    """Serialized (batch=1) API server with the prefix cache ON — the
+    NaiveCache-replacement path."""
+    import socket
+
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    d = tmp_path_factory.mktemp("pfxsrv")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=256,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(
+        tp, pad_to=288,
+        chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+    from distributed_llama_tpu.cli import build_arg_parser
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    servers = []
+    ports = []
+    for _ in range(2):  # [0] = prefix-enabled, [1] = cache-off twin
+        p = build_arg_parser()
+        p.add_argument("--port", type=int, default=0)
+        port = free_port()
+        mb = "64" if not servers else "0"
+        args = p.parse_args(
+            [
+                "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+                "--compute-dtype", "float32", "--temperature", "0.0",
+                "--port", str(port), "--prefix-cache-mb", mb,
+            ]
+        )
+        httpd = api_mod.serve(args)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        ports.append(port)
+    yield ports
+    for s in servers:
+        s.shutdown()
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_http_interleaved_conversations_bit_identical(prefix_server):
+    """Two conversations interleaving over HTTP: every reply from the
+    prefix-enabled server matches the cache-off twin byte for byte, and the
+    hit counters tick from turn 2 on (the NaiveCache thrash scenario made
+    correct AND fast)."""
+    on_port, off_port = prefix_server
+
+    def drive(port):
+        replies = []
+        conv_a = [{"role": "user", "content": "alpha opening statement here"}]
+        conv_b = [{"role": "user", "content": "beta subject entirely different"}]
+        for conv, nxt in (
+            (conv_a, "alpha follow up"),
+            (conv_b, "beta follow up"),
+            (conv_a, "alpha third turn"),
+            (conv_b, "beta third turn"),
+        ):
+            out = _post(port, {"messages": conv, "max_tokens": 6})
+            reply = out["choices"][0]["message"]["content"]
+            replies.append(reply)
+            conv += [
+                {"role": "assistant", "content": reply},
+                {"role": "user", "content": nxt},
+            ]
+        return replies
+
+    assert drive(on_port) == drive(off_port)
+    with urllib.request.urlopen(f"http://127.0.0.1:{on_port}/stats", timeout=30) as r:
+        snap = json.loads(r.read())
+    counters = snap["steps"]["counters"]
+    assert counters.get("prefix_hits", 0) >= 2  # both conversations re-hit
+    assert snap["prefix_cache"]["entries"] >= 2
