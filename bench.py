@@ -471,6 +471,63 @@ def leg_prefix_cache():
     }
 
 
+def leg_speculative():
+    """Speculative decoding (ngram/k=4, runtime/speculative.py) vs plain
+    chunked decode on the 1B, greedy. Two arms: a REPETITIVE prompt (the
+    prompt-lookup draft source's target traffic — templated/quoting
+    workloads; high acceptance, each verify dispatch lands up to k+1
+    tokens) and a RANDOM prompt (no n-gram recurs — every round is a
+    failed host-side lookup plus the ordinary fallback chunk; the
+    acceptance bar is <= 1.1x slowdown vs speculation off). Reported:
+    decode tok/s and p95 per-token step latency per arm and mode, plus the
+    measured acceptance rates."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+
+    path = ensure_model()
+    pattern = [((i * 37) % 911) + 1 for i in range(48)]
+    rep_prompt = (pattern * 12)[:512]
+    # i*613 mod 997 is a permutation: 512 distinct tokens, no n-gram recurs
+    rand_prompt = [(i * 613) % 997 + 1 for i in range(512)]
+    decode_tokens = 256
+
+    def run(mode, prompt):
+        eng = InferenceEngine(
+            path, compute_dtype="bfloat16", max_chunk=256,
+            decode_chunk_size=64, prefix_cache_mb=0, speculative=mode,
+            draft_k=4,
+        )
+        steps = len(prompt) + decode_tokens - 1
+        eng.generate(prompt, steps, sampler=None)  # warmup: compiles
+        eng.reset()
+        res = eng.generate(prompt, steps, sampler=None)
+        per_tok = sorted(s.eval_us / s.n_tokens for s in res.pred_steps)
+        p95 = per_tok[min(len(per_tok) - 1, int(len(per_tok) * 0.95))] / 1000
+        rate = res.n_pred_tokens * 1e6 / max(res.decode_us, 1)
+        acc = (eng.last_spec_timing or {}).get("acceptance_rate")
+        del eng
+        return rate, p95, acc
+
+    rep_on, rep_p95_on, rep_acc = run("ngram", rep_prompt)
+    rep_off, rep_p95_off, _ = run("off", rep_prompt)
+    rand_on, rand_p95_on, rand_acc = run("ngram", rand_prompt)
+    rand_off, rand_p95_off, _ = run("off", rand_prompt)
+    return {
+        "config": "llama-1B q40 1chip speculative ngram/k4",
+        "decode_tok_s_repetitive_on": round(rep_on, 2),
+        "decode_tok_s_repetitive_off": round(rep_off, 2),
+        "speedup_repetitive_x": round(rep_on / max(rep_off, 1e-9), 2),
+        "p95_step_ms_repetitive_on": round(rep_p95_on, 3),
+        "p95_step_ms_repetitive_off": round(rep_p95_off, 3),
+        "spec_acceptance_rate_repetitive": rep_acc,
+        "decode_tok_s_random_on": round(rand_on, 2),
+        "decode_tok_s_random_off": round(rand_off, 2),
+        "slowdown_random_x": round(rand_off / max(rand_on, 1e-9), 2),
+        "p95_step_ms_random_on": round(rand_p95_on, 3),
+        "p95_step_ms_random_off": round(rand_p95_off, 3),
+        "spec_acceptance_rate_random": rand_acc,
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -612,6 +669,13 @@ def main():
         print(f"# shared-prefix: {pfx}", file=sys.stderr)
     except Exception as e:
         print(f"# shared-prefix leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        sp = leg_speculative()
+        configs.append(sp)
+        print(f"# speculative: {sp}", file=sys.stderr)
+    except Exception as e:
+        print(f"# speculative leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

@@ -151,10 +151,13 @@ class LadderEntry:
 
     kind: "prefill" (whole-batch chunk), "decode" (solo chunked decode),
     "prefill_row" (BatchSession admission prefill), "batch_decode"
-    (BatchSession per-row decode chunk), "prefix_extract" /"prefix_copy" /
-    "prefix_copy_row" (the prefix cache's publish/splice copy programs).
-    `size` is the token-chunk size, decode n_steps, or prefix bucket;
-    `kv_len` the static KV read bucket (== size for prefix programs)."""
+    (BatchSession per-row decode chunk), "verify" / "verify_row" (the
+    speculative-decoding verify forwards — logits at every drafted
+    position, scalar vs per-row positions; runtime/speculative.py),
+    "prefix_extract" /"prefix_copy" / "prefix_copy_row" (the prefix
+    cache's publish/splice copy programs). `size` is the token-chunk size,
+    decode n_steps, draft bucket + 1, or prefix bucket; `kv_len` the
+    static KV read bucket (== size for prefix programs)."""
 
     kind: str
     size: int
@@ -252,6 +255,32 @@ def trace_entry(engine, entry: LadderEntry):
             _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
             _sds((b,), jnp.float32),
         )
+    if entry.kind in ("verify", "verify_row"):
+        # the speculative verify program: a prefill-shaped logits_mode="all"
+        # forward (+ in-graph argmax on the fused non-mesh path). Mirrors
+        # engine._dispatch_verify exactly: scalar-pos "verify" rides
+        # engine._forward's microbatch rule, per-row "verify_row" rides the
+        # admission-prefill shape (one microbatch).
+        per_row = entry.kind == "verify_row"
+        pos_sds = _sds((b,), jnp.int32) if per_row else _sds((), jnp.int32)
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_forward
+
+            pp = engine.mesh.shape["pp"]
+            micro = 1 if per_row else (pp if entry.size % pp == 0 else 1)
+            fn = lambda toks, pos: pipeline_forward(
+                cfg, engine.mesh, engine.params, engine.rope, engine.cache,
+                toks, pos, logits_mode="all", microbatches=micro,
+                kv_len=entry.kv_len,
+            )
+        else:
+            from ..runtime.speculative import verify_chunk
+
+            fn = lambda toks, pos: verify_chunk(
+                cfg, engine.params, engine.rope, engine.cache, toks, pos,
+                kv_len=entry.kv_len,
+            )
+        return jax.make_jaxpr(fn)(_sds((b, entry.size), jnp.int32), pos_sds)
     if entry.kind in ("prefix_extract", "prefix_copy", "prefix_copy_row"):
         from ..runtime.prefix_cache import (
             copy_prefix_into_row,
@@ -326,9 +355,12 @@ def pipeline_rounds(engine, entry: LadderEntry) -> int:
     this derivation — both the collective budget and the f32-dot budget
     are per-round quantities and must move together."""
     pp = engine.mesh.shape["pp"]
-    if entry.kind == "prefill":
+    if entry.kind in ("prefill", "verify"):
+        # verify rides engine._forward like a whole-batch prefill chunk:
+        # same microbatch rule, hence the ISSUE contract "collective budget
+        # identical to prefill of the same size"
         micro = pp if entry.size % pp == 0 else 1
-    else:  # decode / batch_decode / prefill_row all run one microbatch
+    else:  # decode / batch_decode / prefill_row / verify_row: one microbatch
         micro = 1
     return micro + pp - 1
 
@@ -480,6 +512,19 @@ def donation_problems(engine) -> list:
                     jnp.zeros((1, 1), jnp.int32), pos, jnp.int32(0), kv_len=kvb,
                 ),
             )
+    if engine.spec_mode is not None and not engine.use_pipeline:
+        # the fused verify program donates the cache exactly like a prefill
+        # chunk; a lost donation would copy the whole KV stack every round
+        from ..runtime.speculative import verify_chunk
+
+        k0 = engine.spec_buckets[0]
+        check(
+            "verify_chunk",
+            verify_chunk.lower(
+                cfg, engine.params, engine.rope, engine.cache,
+                jnp.zeros((b, k0 + 1), jnp.int32), pos, kv_len=kvb,
+            ),
+        )
     if engine.prefix_cache is not None and engine.prefix_cache.buckets:
         # the prefix-cache splice programs donate the live cache too: a
         # lost donation would double the cache's HBM footprint on every hit
@@ -633,6 +678,16 @@ def main(argv=None) -> int:
         "--prefix-cache-mb", type=int, default=64,
         help="prefix-cache budget: audits the copy/extract ladder too (0 = off)",
     )
+    p.add_argument(
+        "--speculative", choices=["off", "ngram"], default="ngram",
+        help="audit the speculative verify programs too (default on; the "
+        "model draft source adds no programs of its own — its engine "
+        "audits separately)",
+    )
+    p.add_argument(
+        "--draft-k", type=int, default=8,
+        help="draft budget for the audited verify ladder (8 = both buckets)",
+    )
     args = p.parse_args(argv)
 
     from ..runtime.engine import InferenceEngine
@@ -648,6 +703,7 @@ def main(argv=None) -> int:
             model, compute_dtype=args.compute_dtype, batch=args.batch,
             max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
             prefix_cache_mb=args.prefix_cache_mb,
+            speculative=args.speculative, draft_k=args.draft_k,
         )
         try:
             reports = audit_engine(engine)

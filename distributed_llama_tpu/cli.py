@@ -89,11 +89,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "over shared prompts; runtime/prefix_cache.py). -1 = "
         "DLT_PREFIX_CACHE_MB env, defaulting to 512; 0 disables",
     )
+    p.add_argument(
+        "--speculative", choices=["off", "ngram", "model"], default=None,
+        help="speculative decoding draft source for greedy requests "
+        "(runtime/speculative.py): ngram = prompt-lookup over the live "
+        "context (model-free), model = a second engine from --draft-model. "
+        "Default: DLT_SPECULATIVE env, else ngram for the CLI/server",
+    )
+    p.add_argument(
+        "--draft-k", type=int, default=0,
+        help="max drafted tokens per verify round, bucketed at {4, 8} "
+        "(default: DLT_DRAFT_K env, else 4)",
+    )
+    p.add_argument(
+        "--draft-model", default=None,
+        help=".m file for the --speculative model draft engine (a smaller "
+        "model drafting autoregressively)",
+    )
     return p
 
 
 def make_engine(args) -> InferenceEngine:
     from .runtime.prefix_cache import resolve_budget_mb
+    from .runtime.speculative import ModelDraft, resolve_draft_k, resolve_spec_mode
 
     max_chunk = args.prefill_chunk_size if args.prefill_chunk_size > 0 else args.max_chunk
     # radix prefix cache: ON by default for the CLI/server entry points
@@ -104,6 +122,14 @@ def make_engine(args) -> InferenceEngine:
     prefix_mb = resolve_budget_mb(
         None if flag is None or flag < 0 else flag, default_mb=512
     )
+    # speculative decoding: ngram (prompt-lookup) by default for the
+    # CLI/server entry points — greedy requests only, zero extra FLOPs,
+    # bit-identical output; library engines keep the env-or-off default
+    spec_mode = resolve_spec_mode(getattr(args, "speculative", None), default="ngram")
+    draft_k = resolve_draft_k(getattr(args, "draft_k", 0) or None)
+    draft_source = None
+    if spec_mode == "model" and not getattr(args, "draft_model", None):
+        raise ValueError("--speculative model requires --draft-model")
     batch = getattr(args, "batch", 1) or 1
     dp_axis = getattr(args, "dp", 1)
     # an explicit batch must be compatible with the dp mesh, not silently
@@ -137,18 +163,42 @@ def make_engine(args) -> InferenceEngine:
         from .parallel import make_mesh
 
         mesh = make_mesh(tp=args.tp, pp=args.pp, sp=sp, ep=ep, dp=dp)
-    engine = InferenceEngine(
-        args.model,
-        compute_dtype=args.compute_dtype,
-        cache_dtype=args.cache_dtype,
-        max_seq_len=args.max_seq_len,
-        max_chunk=max_chunk,
-        mesh=mesh,
-        batch=batch,
-        device_decode=not getattr(args, "host_decode", False),
-        verbose=True,
-        prefix_cache_mb=prefix_mb,
-    )
+    if spec_mode == "model":
+        # the draft engine: batch=1 greedy chain, its own warm ladder
+        # (warmed from the main engine's warmup()); speculation and the
+        # prefix cache are pinned OFF on it — explicit args, so an ambient
+        # DLT_SPECULATIVE=model cannot recurse into draft-of-draft engines.
+        # Built AFTER the arg validation above so a bad --batch/--dp combo
+        # never loads draft weights; torn down if the main engine fails.
+        draft_source = ModelDraft(
+            InferenceEngine(
+                args.draft_model, compute_dtype=args.compute_dtype, batch=1,
+                device_decode=True, prefix_cache_mb=0, speculative="off",
+            ),
+            owns=True,
+        )
+    try:
+        engine = InferenceEngine(
+            args.model,
+            compute_dtype=args.compute_dtype,
+            cache_dtype=args.cache_dtype,
+            max_seq_len=args.max_seq_len,
+            max_chunk=max_chunk,
+            mesh=mesh,
+            batch=batch,
+            device_decode=not getattr(args, "host_decode", False),
+            verbose=True,
+            prefix_cache_mb=prefix_mb,
+            speculative=spec_mode or "off",
+            draft_k=draft_k,
+            draft_source=draft_source,
+        )
+    except BaseException:
+        # the main engine failed to build: release the draft engine's
+        # fetch-pool thread + weights instead of leaking them
+        if draft_source is not None:
+            draft_source.close()
+        raise
     if prefix_mb > 0 and engine.prefix_cache is None:
         # a requested prefix cache that cannot be built (sp>1 shards the
         # cache's seq axis; or the context is too small to publish) means

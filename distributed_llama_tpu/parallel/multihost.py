@@ -99,7 +99,11 @@ def initialize_distributed(
         except RuntimeError as e:
             if "already initialized" in str(e).lower():
                 return
-            if "before any jax calls" in str(e).lower():
+            # jax has reworded this error across versions ("... before any
+            # JAX calls" vs "... before any JAX computations are executed");
+            # match the stable prefix so the documented single-process
+            # fallback keeps engaging on a live backend
+            if "before any jax" in str(e).lower():
                 # Something touched the backend before us. On a REAL cluster
                 # (coordinator env vars present) falling back would run
                 # every host as an independent single-process job — the

@@ -7,5 +7,13 @@ engine here only orchestrates prefill chunking, sampling, and timing.
 """
 
 from .engine import GenerationResult, InferenceEngine, StepTiming
+from .speculative import DraftSource, ModelDraft, NGramDraft
 
-__all__ = ["InferenceEngine", "GenerationResult", "StepTiming"]
+__all__ = [
+    "InferenceEngine",
+    "GenerationResult",
+    "StepTiming",
+    "DraftSource",
+    "NGramDraft",
+    "ModelDraft",
+]

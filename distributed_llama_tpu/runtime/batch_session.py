@@ -364,6 +364,53 @@ class BatchSession:
                 eng, row, list(tokens), max_len=len(tokens) - 1
             )
 
+    def spec_step(self, drafts: dict) -> dict:
+        """One speculative verify round (runtime/speculative.py) for the
+        rows named in `drafts` (row -> proposed tokens; an EMPTY list is
+        valid — the row still advances by its one greedy bonus token).
+        Rows absent from `drafts` — parked, prefilling, or sampled — are
+        parked for the round: fed at pos seq_len, writes dropped, no
+        progress. All named rows must be active and GREEDY (speculation
+        never advances a sampled row: accepting drafts would change its
+        stream, and this round does not consume the per-row key chains —
+        greedy rows never draw from them).
+
+        One verify dispatch + one [b, k+1] int fetch serves every row:
+        per-row acceptance keeps each row's longest draft prefix matching
+        its own argmax chain plus the bonus token, so rows advance
+        UNEVENLY (1..k+1 positions). Returns {row: emitted tokens}.
+        Rejected drafts' KV needs no rollback — positions past a row's
+        accepted boundary are rewritten before any query reads them (the
+        parked-row write-before-read invariant)."""
+        eng = self.engine
+        if eng.spec_mode is None or not eng.device_decode:
+            raise ValueError("speculative decoding is not enabled on this engine")
+        rows = sorted(drafts)
+        if not rows:
+            return {}
+        for r in rows:
+            if not self.active[r]:
+                raise ValueError(f"row {r} is not active")
+            if self.temp[r] > 0.0:
+                raise ValueError(f"row {r} is sampled; speculation is greedy-only")
+        from .speculative import choose_bucket, verify_row_round
+
+        K = choose_bucket(eng.spec_buckets, max(len(drafts[r]) for r in rows))
+        ends = [int(self.pos[r]) + K + 1 for r in rows]
+        if max(ends) > self.seq_len:
+            # mirror step()'s overrun guard: silently-dropped writes would
+            # hand back junk tokens instead of an error. The Batcher only
+            # takes the spec path when every decode row has K+1 headroom.
+            raise ValueError(
+                f"verify round would overrun seq_len={self.seq_len}: "
+                f"max row end {max(ends)} (draft bucket {K})"
+            )
+        out = verify_row_round(eng, drafts, self.token, self.pos, self.seq_len)
+        for r, emitted in out.items():
+            self.pos[r] += len(emitted)
+            self.token[r] = emitted[-1]
+        return out
+
     def step(self, n_steps: int) -> np.ndarray:
         """One decode chunk for every slot; returns host tokens [b, n_steps]
         (junk in parked rows). Advances every row's position by n_steps."""

@@ -184,6 +184,14 @@ class InferenceEngine:
         # prefix cache (runtime/prefix_cache.py): cross-request KV reuse for
         # shared prompts. None = DLT_PREFIX_CACHE_MB env (default 0 = off
         # for library engines; the API server defaults it on — server/api.py)
+        speculative: str | None = None,  # "off" | "ngram" | "model" draft
+        # source for greedy speculative decode (runtime/speculative.py).
+        # None = DLT_SPECULATIVE env (default off for library engines; the
+        # CLI/server entry points default ngram — cli.make_engine)
+        draft_k: int | None = None,  # max drafted tokens per verify round
+        # (bucketed at {4, 8}). None = DLT_DRAFT_K env, default 4
+        draft_source=None,  # DraftSource override; REQUIRED for "model"
+        # (a speculative.ModelDraft wrapping the smaller draft engine)
     ):
         maybe_enable_compilation_cache()
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
@@ -287,6 +295,23 @@ class InferenceEngine:
         self.prefix_cache = PrefixCache.build(self, prefix_cache_mb)
         self.last_prefix_hit_tokens = 0  # tokens the most recent prefill
         # skipped via a prefix-cache splice (0 = cold; /stats gauge twin)
+        # speculative decoding (runtime/speculative.py): greedy requests
+        # draft k tokens and verify them in ONE prefill-shaped forward; the
+        # verify programs ride the warm ladder at (k+1, kv-bucket) keys
+        from .speculative import (
+            build_draft_source,
+            resolve_draft_k,
+            resolve_spec_mode,
+            spec_buckets,
+        )
+
+        self.spec_mode = resolve_spec_mode(speculative, default="off")
+        self.draft_k = resolve_draft_k(draft_k)
+        self.spec_buckets = spec_buckets(self.draft_k) if self.spec_mode else ()
+        self.draft_source = build_draft_source(self.spec_mode, draft_source)
+        # draft/verify/acceptance summary of the most recent speculative
+        # generate (bench.py reads it; mirrors last_prefill_timing)
+        self.last_spec_timing: dict | None = None
         self._in_warmup = False
         # opt-in runtime sanitizers (DLT_SANITIZERS=1, docs/ANALYSIS.md):
         # the recompile sentinel counts XLA compiles and, once warmup()
@@ -305,6 +330,8 @@ class InferenceEngine:
 
     def close(self):
         self._fetch_pool.shutdown(wait=False)
+        if self.draft_source is not None:
+            self.draft_source.close()
         if self.sentinel is not None:
             self.sentinel.stop()
 
@@ -412,6 +439,18 @@ class InferenceEngine:
                 for n in decode_sizes:
                     if n <= kvb:
                         plan.append(("batch_decode", n, kvb))
+        if self.spec_mode is not None and self.device_decode:
+            # speculative verify programs: one prefill-shaped logits-at-
+            # every-position forward per (draft bucket + 1, kv bucket) —
+            # "verify" at scalar pos (solo generate: rows aligned),
+            # "verify_row" at per-row positions (generate_batch /
+            # BatchSession.spec_step), gated like the other per-row kinds
+            for kvb in kvbs:
+                for k in self.spec_buckets:
+                    if k + 1 <= kvb:
+                        plan.append(("verify", k + 1, kvb))
+                        if self.batch > 1:
+                            plan.append(("verify_row", k + 1, kvb))
         if self.prefix_cache is not None:
             for P in self.prefix_cache.buckets:
                 # extract first: its (correctly sharded) outputs are the
@@ -515,6 +554,11 @@ class InferenceEngine:
                 s.release(0)
                 self.reset()
             self._warmup_fill()
+            if self.draft_source is not None:
+                # a model-backed draft source compiles its own ladder; it
+                # must finish before THIS engine's sentinel seals, or its
+                # first serving-time draft would count as a recompile
+                self.draft_source.warmup()
             if self.prefix_cache is not None:
                 self.prefix_cache.clear()
             self.reset()
@@ -565,6 +609,21 @@ class InferenceEngine:
                     f"batch_decode[{size}]", ("batch_decode", size, kvb)
                 ):
                     self._dispatch_batch_decode_warm(size, kvb, pos)
+            elif kind in ("verify", "verify_row"):
+                if (kind, size, kvb) in self._warm:
+                    continue
+                toks = np.zeros((self.batch, size), np.int32)
+                if kind == "verify":
+                    vpos = pos
+                else:
+                    # per-row shape: one live row, the rest parked at
+                    # seq_len (writes dropped) — exactly the serving shape
+                    vpos = np.full((self.batch,), self.cfg.seq_len, np.int32)
+                    vpos[0] = pos
+                with self._sanitizer_scope(), self._guard(
+                    f"{kind}[{size - 1}]", (kind, size, kvb)
+                ):
+                    self._dispatch_verify(toks, vpos, kvb)
             elif kind == "prefix_extract":
                 from .prefix_cache import extract_prefix_from_row
 
@@ -883,6 +942,48 @@ class InferenceEngine:
             n_steps=n_steps, temperature=temperature, topp=topp, kv_len=kv_len,
         )
 
+    def _dispatch_verify(self, tokens_np, pos, kv_len: int):
+        """Dispatch one speculative verify forward (runtime/speculative.py):
+        a prefill-shaped pass over [last_token, drafts...] returning logits
+        at EVERY position plus their greedy argmax. `pos` is a host scalar
+        (solo: rows aligned — the ("verify", size, kvb) program) or a [b]
+        vector (per-row positions, parked rows at seq_len — the
+        ("verify_row", ...) program). Dispatch-only: the caller fetches the
+        ids. Returns (ids_dev [b, t], logits_dev [b, t, vocab])."""
+        per_row = np.ndim(pos) != 0
+        toks_dev, pos_dev = jax.device_put(
+            (
+                np.asarray(tokens_np, np.int32),  # dlt: allow(host-sync) — host token rows -> device operand prep
+                np.asarray(pos, np.int32) if per_row else np.int32(pos),
+            )
+        )
+        if self.use_pipeline:
+            if per_row:
+                # mirror the admission-prefill mesh path: per-row positions
+                # run one microbatch (prefill_row's collective budget)
+                from ..parallel.pipeline import pipeline_forward
+
+                logits, self.cache = pipeline_forward(
+                    self.cfg, self.mesh, self.params, self.rope, self.cache,
+                    toks_dev, pos_dev, logits_mode="all", kv_len=kv_len,
+                )
+            else:
+                # _forward applies the same microbatch rule a prefill chunk
+                # of this size gets — identical collective budget by
+                # construction (graph_audit mirrors the rule)
+                logits, self.cache = self._forward(
+                    toks_dev, pos_dev, logits_mode="all", kv_len=kv_len
+                )
+            ids = self._argmax_step(logits)
+            return ids, logits
+        from .speculative import verify_chunk
+
+        ids, logits, self.cache = verify_chunk(
+            self.cfg, self.params, self.rope, self.cache, toks_dev, pos_dev,
+            kv_len=kv_len,
+        )
+        return ids, logits
+
     def decode_one(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns host logits [batch, vocab]."""
         arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
@@ -928,11 +1029,24 @@ class InferenceEngine:
         token = prompt_tokens[-1]
         max_pos = min(self.cfg.seq_len, steps)
         if self.device_decode:
+            # speculative decode applies to GREEDY generations only: under a
+            # sampler, accepting drafts would change the RNG stream (and the
+            # acceptance test itself needs the deterministic argmax chain)
+            use_spec = (
+                self.spec_mode is not None
+                and not self._in_warmup
+                and (sampler is None or sampler.temperature == 0.0)
+            )
             # sanitizer scope: the chunked decode loop must never block on
             # an implicit device->host transfer on this thread (the token
             # fetches ride the worker thread; DLT_SANITIZERS=1 enforces it)
             with self._sanitizer_scope():
-                self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
+                if use_spec:
+                    self._decode_speculative(
+                        res, token, pos, max_pos, on_token, stop_fn, wall0
+                    )
+                else:
+                    self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         else:
             self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         res.total_us = int((time.perf_counter() - wall0) * 1e6)
@@ -1067,25 +1181,62 @@ class InferenceEngine:
         topp = sampler.topp if sampler is not None else 0.9
         key = _sampler_prng_key(sampler)
 
-        pos = jnp.asarray([l - 1 for l in lens], jnp.int32)  # [b]
-        token = jnp.asarray([p[-1] for p in prompts], jnp.int32)
-        done = [False] * self.batch
         out: list[list[int]] = [[] for _ in range(self.batch)]
-
-        # One-chunk lookahead + worker-thread fetch, exactly like
-        # _decode_device: chunk i+1's dispatch (device-resident inputs)
-        # overlaps chunk i's ~100 ms tunnel fetch. Without this the round-4
-        # batched loop paid a full synchronous round trip per chunk — the
-        # dominant share of the batched-serving per-stream tax (measured:
-        # the batched chunk program computes ~1.9 ms/step with the batch
-        # axis nearly free, but e2e ran at ~3.5 ms/step). Chunks are
-        # PLANNED against the max per-row budget (tokens aren't visible at
-        # dispatch time); rows cap at their own budgets at consume time,
-        # and a stop_fn early-exit wastes at most the lookahead chunk
-        # (same overrun tradeoff the solo path accepts).
         total_needed = max(budgets)
         if total_needed <= 0:
             return out
+        if (
+            self.spec_mode is not None
+            and self.device_decode
+            and not self._in_warmup
+            and temperature == 0.0
+        ):
+            # greedy batches take the speculative path: per-row drafts, one
+            # per-row-position verify dispatch per round
+            # (runtime/speculative.py). Sampled batches keep the chunked
+            # lookahead loop below — accepting drafts under a sampler would
+            # change the RNG stream — and host-decode engines always do:
+            # their warm plan (and the sentinel's sealed ladder) carries no
+            # verify programs, the same gate every other spec entry has.
+            self._decode_batch_speculative(
+                prompts, lens, budgets, out, on_token, stop_fn
+            )
+        else:
+            self._decode_batch_chunked(
+                prompts, lens, budgets, out, on_token, stop_fn, key,
+                temperature, topp,
+            )
+        if pc is not None and not self._in_warmup and pre_t > 0 and resume == 0:
+            # publish the rows' common prefix (row 0's copy, capped at its
+            # prefilled extent) so the NEXT shared-prefix batch splices it.
+            # After the decode loop on purpose: a failed batch must not
+            # leave a half-written slice in the trie. A hit this call
+            # (resume > 0) means the prefix is already published.
+            with self._sanitizer_scope():
+                pc.publish_from_row(
+                    self, 0, list(prompts[0]), max_len=min(common_len, lens[0] - 1)
+                )
+        return out
+
+    def _decode_batch_chunked(
+        self, prompts, lens, budgets, out, on_token, stop_fn, key,
+        temperature, topp,
+    ):
+        """generate_batch's chunked decode loop: one-chunk lookahead +
+        worker-thread fetch, exactly like _decode_device — chunk i+1's
+        dispatch (device-resident inputs) overlaps chunk i's ~100 ms tunnel
+        fetch. Without this the round-4 batched loop paid a full synchronous
+        round trip per chunk — the dominant share of the batched-serving
+        per-stream tax (measured: the batched chunk program computes
+        ~1.9 ms/step with the batch axis nearly free, but e2e ran at
+        ~3.5 ms/step). Chunks are PLANNED against the max per-row budget
+        (tokens aren't visible at dispatch time); rows cap at their own
+        budgets at consume time, and a stop_fn early-exit wastes at most the
+        lookahead chunk (same overrun tradeoff the solo path accepts)."""
+        pos = jnp.asarray([l - 1 for l in lens], jnp.int32)  # [b]
+        token = jnp.asarray([p[-1] for p in prompts], jnp.int32)
+        done = [False] * self.batch
+        total_needed = max(budgets)
         planned = 0
         key_box = [key]
         state = {"token": token, "pos": pos}
@@ -1152,17 +1303,95 @@ class InferenceEngine:
                     pending = None
                 else:
                     pending = nxt
-        if pc is not None and not self._in_warmup and pre_t > 0 and resume == 0:
-            # publish the rows' common prefix (row 0's copy, capped at its
-            # prefilled extent) so the NEXT shared-prefix batch splices it.
-            # After the decode loop on purpose: a failed batch must not
-            # leave a half-written slice in the trie. A hit this call
-            # (resume > 0) means the prefix is already published.
-            with self._sanitizer_scope():
-                pc.publish_from_row(
-                    self, 0, list(prompts[0]), max_len=min(common_len, lens[0] - 1)
-                )
-        return out
+
+    def _decode_batch_speculative(self, prompts, lens, budgets, out, on_token, stop_fn):
+        """generate_batch's speculative decode loop (greedy batches): every
+        round drafts per row from the row's OWN context, then either one
+        per-row-position verify dispatch (any row drafted; rows with no
+        draft still advance by their one bonus token) or one plain batched
+        decode chunk (nobody drafted — the draft-hostile fallback that keeps
+        worst-case throughput at the chunked loop's rate). Per-row
+        acceptance: each row keeps its longest draft prefix matching its own
+        argmax chain. Finished rows park at seq_len — their writes drop via
+        the per-row scatter and they skip drafting. Rows advance unevenly
+        (speculation is per-row), so positions/tokens are host lists rather
+        than the aligned device vectors of the chunked loop."""
+        from .speculative import verify_row_round
+
+        b = self.batch
+        seq_len = self.cfg.seq_len
+        ds = self.draft_source
+        key = jax.random.PRNGKey(0)  # greedy chunks never draw
+        pos = [l - 1 for l in lens]
+        token = [int(p[-1]) for p in prompts]
+        done = [budgets[r] <= 0 for r in range(b)]
+        with self._sanitizer_scope():
+            while not all(done):
+                live = [r for r in range(b) if not done[r]]
+                drafts = {}
+                for r in live:
+                    # cap: emitted <= drafts+1 <= remaining budget, which
+                    # also bounds writes to pos + cap <= seq_len - 2 (the
+                    # lens+budgets <= seq_len constructor check)
+                    cap = min(self.spec_buckets[-1], budgets[r] - len(out[r]) - 1)
+                    d = ds.draft(list(prompts[r]) + out[r], cap) if cap > 0 else []
+                    drafts[r] = [int(t) for t in d[:max(cap, 0)]]
+                if any(drafts.values()):
+                    # the shared per-row verify round (speculative.py):
+                    # one dispatch, per-row acceptance, rows advance by
+                    # their own 1..K+1 emitted tokens
+                    rounds = verify_row_round(self, drafts, token, pos, seq_len)
+                    for r, emitted in rounds.items():
+                        pos[r] += len(emitted)
+                        token[r] = emitted[-1]
+                        for t in emitted:
+                            out[r].append(t)
+                            if on_token is not None:
+                                on_token(r, t)
+                            if stop_fn is not None and stop_fn(r, t):
+                                done[r] = True
+                                break
+                            if len(out[r]) >= budgets[r]:
+                                done[r] = True
+                                break
+                else:
+                    # nobody drafted: one plain chunk at per-row positions
+                    # (the generate_batch decode program) — surplus tokens
+                    # past a row's budget/stop are discarded at consume time
+                    needed = max(budgets[r] - len(out[r]) for r in live)
+                    n = self.decode_chunk_size
+                    while n > needed:
+                        n //= 2
+                    n = max(n, 1)
+                    pv = np.full((b,), seq_len, np.int32)
+                    tv = np.zeros((b,), np.int32)
+                    for r in live:
+                        pv[r] = pos[r]
+                        tv[r] = token[r]
+                    kvb = self._kv_bucket(
+                        min(max(pos[r] for r in live) + 1 + n, seq_len)
+                    )
+                    tok_dev, pos_dev = jax.device_put((tv, pv))
+                    with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
+                        toks, _, self.cache = self._decode_chunk_any(
+                            tok_dev, pos_dev, key, n_steps=n, temperature=0.0,
+                            topp=0.9, kv_len=kvb,
+                        )
+                        host = self._host_fetch(toks)
+                    for r in live:
+                        for j in range(n):
+                            t = int(host[r, j])
+                            out[r].append(t)
+                            if on_token is not None:
+                                on_token(r, t)
+                            if stop_fn is not None and stop_fn(r, t):
+                                done[r] = True
+                                break
+                            if len(out[r]) >= budgets[r]:
+                                done[r] = True
+                                break
+                        pos[r] += n
+                        token[r] = int(host[r, n - 1])
 
     def _decode_host(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
         """Per-token host loop: one device round trip per token. Bit-parity
@@ -1283,3 +1512,120 @@ class InferenceEngine:
                     # continuation re-writes those slots before reading them
                     return
             pending = nxt
+
+    def _decode_speculative(self, res, token, pos, max_pos, on_token, stop_fn, wall0):
+        """Greedy speculative decode (runtime/speculative.py): per round,
+        the draft source proposes up to k tokens from the live context, ONE
+        verify dispatch scores [token, drafts...] at every position, and
+        the longest draft prefix matching the model's own argmax chain is
+        accepted plus the bonus token at the first mismatch — 1..k+1 tokens
+        of the exact plain-decode chain per dispatch. Rounds with no draft
+        fall back to one ordinary decode chunk (the plain program off the
+        same warm ladder), so draft-hostile traffic pays only the failed
+        lookup, not per-token dispatches. Rejected drafts need no KV
+        rollback: positions past the accepted boundary are rewritten by a
+        later round's feed before any query reads them (write-before-read).
+        Unlike the chunked loop there is no lookahead dispatch — each
+        round's draft depends on the previous round's outcome."""
+        from .speculative import accept_greedy, note_round
+
+        ds = self.draft_source
+        seq_len = self.cfg.seq_len
+        key = jax.random.PRNGKey(0)  # greedy chunks never draw
+        t0 = time.perf_counter()
+        rounds = fallback_chunks = drafted = accepted = emitted_total = 0
+        draft_us = verify_us = 0
+        first = True
+        while pos < max_pos:
+            # the verify feed writes positions pos..pos+k; at scalar pos the
+            # cache update is a dynamic_update_slice whose start CLAMPS at
+            # seq_len - size (silently corrupting earlier KV), so a bucket
+            # only qualifies when it fits entirely
+            kmax = 0
+            for b in self.spec_buckets:
+                if pos + b + 1 <= seq_len:
+                    kmax = b
+            td = time.perf_counter()
+            drafts = ds.draft(list(res.tokens), kmax) if kmax else []
+            draft_us += int((time.perf_counter() - td) * 1e6)
+            tv = time.perf_counter()
+            if drafts:
+                drafts = [int(t) for t in drafts[:kmax]]
+                K = next(b for b in self.spec_buckets if b >= len(drafts))
+                size = K + 1
+                feed = [int(token)] + drafts + [0] * (K - len(drafts))
+                kvb = self._kv_bucket(pos + size)
+                with self._guard(f"verify[{K}]", ("verify", size, kvb)):
+                    ids_dev, _ = self._dispatch_verify(
+                        np.asarray([feed] * self.batch, np.int32), pos, kvb  # dlt: allow(host-sync) — host token list -> device operand prep
+                    )
+                    ids = self._host_fetch(ids_dev)[0]
+                a = accept_greedy(drafts, ids)
+                emitted = drafts[:a] + [int(ids[a])]
+                dt = int((time.perf_counter() - tv) * 1e6)
+                verify_us += dt
+                rounds += 1
+                drafted += len(drafts)
+                accepted += a
+                note_round(self.stats, len(drafts), a)
+                self.stats.record(f"spec_verify[{K}]", dt)
+            else:
+                # no draft: one plain decode chunk (largest power-of-two
+                # that fits the remaining budget — the ordinary ladder).
+                # First-chunk TTFT ramp exactly like _decode_device: a
+                # streaming consumer gets tokens after ~8 steps, not a
+                # full chunk
+                limit = min(max_pos, seq_len) - pos
+                n = (
+                    min(8, self.decode_chunk_size)
+                    if first and on_token is not None
+                    else self.decode_chunk_size
+                )
+                while n > limit:
+                    n //= 2
+                n = max(n, 1)
+                kvb = self._kv_bucket(pos + n)
+                with self._guard(f"decode[{n}]", ("decode", n, kvb)):
+                    toks, _, self.cache = self._decode_chunk_any(
+                        jnp.full((self.batch,), int(token), jnp.int32),
+                        jnp.int32(pos), key, n_steps=n, temperature=0.0,
+                        topp=0.9, kv_len=kvb,
+                    )
+                    emitted = [int(t) for t in self._host_fetch(toks)[0]]
+                dt = int((time.perf_counter() - tv) * 1e6)
+                fallback_chunks += 1
+                self.stats.record(f"decode[{n}]", dt)
+            if first:
+                res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
+                first = False
+            res.pred_steps.append(
+                StepTiming(eval_us=dt, n_tokens=min(len(emitted), max_pos - pos))
+            )
+            stopped = False
+            for t in emitted:
+                if pos >= max_pos:
+                    break  # a round may overshoot the budget; surplus
+                    # tokens are discarded like a chunk's post-stop tail
+                res.tokens.append(t)
+                pos += 1
+                emitted_total += 1
+                if on_token is not None:
+                    on_token(t)
+                if stop_fn is not None and stop_fn(t):
+                    stopped = True
+                    break
+            token = res.tokens[-1]
+            if stopped:
+                break
+        total_us = int((time.perf_counter() - t0) * 1e6)
+        self.last_spec_timing = {
+            "rounds": rounds,
+            "fallback_chunks": fallback_chunks,
+            "draft_tokens": drafted,
+            "accepted_tokens": accepted,
+            "emitted_tokens": emitted_total,
+            "acceptance_rate": round(accepted / drafted, 4) if drafted else None,
+            "draft_us": draft_us,
+            "verify_us": verify_us,
+            "total_us": total_us,
+        }

@@ -448,13 +448,54 @@ class Batcher:
             headroom = min(
                 session.seq_len - 1 - int(session.pos[row]) for row in decode_rows
             )
-            n = min(8, self.chunk) if armed and not ramped_last else self.chunk
-            ramped_last = armed and not ramped_last
-            while n > max(headroom, 1):
-                n //= 2
-            n = max(n, 1)
+            # speculative round (runtime/speculative.py): when every decode
+            # row is greedy with a full verify bucket of headroom, draft per
+            # row from its delivered context (prompt ids + streamed tokens)
+            # and verify all rows in ONE dispatch — rows whose draft came up
+            # empty still advance by their one greedy bonus token. A sampled
+            # co-tenant, tight headroom, or an all-empty draft round falls
+            # back to the plain chunk, so draft-hostile traffic keeps the
+            # chunked loop's throughput.
             try:
-                toks = session.step(n)
+                # drafting runs INSIDE the failure scope: a model-backed
+                # draft source dispatches device work, and a wedged draft
+                # engine must take the same fail-requests-and-recover path
+                # as a main-engine failure — not kill the batcher thread
+                spec_drafts = None
+                if engine.spec_mode is not None and engine.device_decode:
+                    K = engine.spec_buckets[-1]
+                    if all(
+                        slots[r].temperature == 0.0
+                        and session.seq_len - int(session.pos[r]) >= K + 1
+                        for r in decode_rows
+                    ):
+                        drafts = {}
+                        for r in decode_rows:
+                            req = slots[r]
+                            cap = min(K, req.max_new - req.n - 1)
+                            drafts[r] = (
+                                engine.draft_source.draft(
+                                    list(req.ids) + req.out_ids, cap
+                                )
+                                if cap > 0
+                                else []
+                            )
+                        if any(drafts.values()):
+                            spec_drafts = drafts
+                if spec_drafts is not None:
+                    per_row = session.spec_step(spec_drafts)
+                else:
+                    n = min(8, self.chunk) if armed and not ramped_last else self.chunk
+                    ramped_last = armed and not ramped_last
+                    while n > max(headroom, 1):
+                        n //= 2
+                    n = max(n, 1)
+                    toks = session.step(n)
+                    per_row = {
+                        r: [int(t) for t in toks[r]]
+                        for r, s in enumerate(slots)
+                        if s is not None and not s.prefilling
+                    }
             except Exception as e:
                 # engine failure: fail every in-flight request, rebuild the
                 # session on a recovered engine
@@ -466,10 +507,9 @@ class Batcher:
                 session = BatchSession(engine)
                 continue
             for row, req in enumerate(slots):
-                if req is None or req.prefilling:
+                if req is None or req.prefilling or row not in per_row:
                     continue
-                for j in range(toks.shape[1]):
-                    t = int(toks[row, j])
+                for t in per_row[row]:
                     req.n += 1
                     req.out_ids.append(t)
                     try:
@@ -809,6 +849,8 @@ class Handler(BaseHTTPRequestHandler):
             # this surfaces the same numbers live, plus Batcher occupancy)
             st = self.state
             pc = st.engine.prefix_cache
+            from ..runtime.speculative import spec_snapshot
+
             payload = {
                 "steps": st.engine.stats.snapshot(),
                 "batcher": st.batcher.stats() if st.batcher is not None else None,
@@ -816,6 +858,10 @@ class Handler(BaseHTTPRequestHandler):
                 # (prefix_hits, prefix_hit_tokens, prefix_evictions, ...)
                 # ride steps.counters like every other engine event
                 "prefix_cache": pc.stats_snapshot() if pc is not None else None,
+                # speculative decoding config + acceptance counters (the
+                # spec_* counters ride steps.counters and /health too; this
+                # section is the one-stop operator view)
+                "speculative": spec_snapshot(st.engine),
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,

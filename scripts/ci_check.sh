@@ -9,6 +9,9 @@
 #   3. analysis test suite  (pytest -m analysis: one suite per audit pass)
 #   4. prefix-cache suite   (radix trie, token identity, eviction/pinning,
 #                            sanitizer acceptance — fast subset member)
+#   5. speculative suite    (draft sources, greedy verify identity at
+#                            engine/batch/session/HTTP levels, verify
+#                            buckets on the warm ladder)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -27,6 +30,9 @@ python -m pytest tests/ -q -m analysis -p no:cacheprovider
 
 echo "== prefix-cache suite =="
 python -m pytest tests/test_prefix_cache.py -q -p no:cacheprovider
+
+echo "== speculative suite =="
+python -m pytest tests/test_speculative.py -q -p no:cacheprovider
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

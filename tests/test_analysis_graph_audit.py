@@ -230,6 +230,60 @@ def test_sharding_audit_catches_unsharded_cache(mesh_model_path):
         eng.close()
 
 
+def test_speculative_verify_ladder_covered_and_clean(model_path):
+    """A speculative engine's warm ladder grows the verify programs (both
+    draft buckets, scalar AND per-row variants), they audit clean (no f64,
+    zero single-chip collectives, donation on the fused verify program),
+    and the ladder equals the set warmup() really compiles — the recompile
+    sentinel's zero-post-warmup contract for speculation."""
+    eng = _engine(model_path, speculative="ngram", draft_k=8)
+    try:
+        ladder = ga.warm_key_ladder(eng)
+        kinds = {e.kind for e in ladder}
+        assert {"verify", "verify_row"} <= kinds
+        assert {e.size for e in ladder if e.kind == "verify"} == {5, 9}
+        reports = ga.audit_engine(eng, ladder)
+        ga.assert_clean(reports)
+        for r in reports:
+            assert r.collectives == {}, "single-chip program emitted a collective"
+        eng.warmup()
+        warm = set(eng._warm)
+        for kind in ("verify", "verify_row"):
+            got = {(e.size, e.kv_len) for e in ladder if e.kind == kind}
+            want = {(k[1], k[2]) for k in warm if k[0] == kind}
+            assert got == want, f"{kind} ladder drifted from warmup's compiles"
+    finally:
+        eng.close()
+
+
+def test_mesh_verify_budget_equals_prefill_of_same_size(mesh_model_path):
+    """The ISSUE contract pinned: on the shard_map pipeline path a verify
+    program's collective budget is IDENTICAL to a prefill chunk of the same
+    size (verify_row to the admission-prefill shape), and the traced
+    programs hit those budgets exactly."""
+    eng = _engine(
+        mesh_model_path, mesh=make_mesh(tp=2, pp=2), speculative="ngram",
+        draft_k=8,
+    )
+    try:
+        ladder = [e for e in ga.warm_key_ladder(eng) if e.kind.startswith("verify")]
+        assert ladder
+        reports = ga.audit_engine(eng, ladder)
+        ga.assert_clean(reports)
+        for r in reports:
+            twin_kind = "prefill" if r.entry.kind == "verify" else "prefill_row"
+            twin = ga.LadderEntry(twin_kind, r.entry.size, r.entry.kv_len)
+            assert ga.expected_collectives(eng, r.entry) == ga.expected_collectives(
+                eng, twin
+            )
+            assert r.collectives == {
+                k: v for k, v in ga.expected_collectives(eng, r.entry).items() if v
+            }
+    finally:
+        eng.close()
+
+
 def test_cli_tiny_config_exit_code():
-    """The CI entry point: audits a synthetic tiny model end to end."""
+    """The CI entry point: audits a synthetic tiny model end to end
+    (speculative verify ladder included by default)."""
     assert ga.main([]) == 0

@@ -1,0 +1,530 @@
+"""Speculative decoding (runtime/speculative.py): draft sources, greedy
+verify identity at the engine / generate_batch / BatchSession / HTTP
+levels, warm-ladder sentinel coverage, and acceptance telemetry.
+
+The load-bearing claim under test everywhere: with temperature 0,
+speculation is an EXECUTION strategy, not a model change — tokens AND
+fetched logits are bit-identical to plain decode, only the dispatch count
+differs."""
+
+import json
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.runtime.batch_session import BatchSession
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.runtime.speculative import (
+    ModelDraft,
+    NGramDraft,
+    accept_greedy,
+    resolve_spec_mode,
+    spec_buckets,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model, write_tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("spec")
+    path = str(d / "m.m")
+    write_tiny_model(
+        path,
+        tiny_header(dim=64, hidden_dim=128, n_layers=2, seq_len=128, vocab_size=288),
+        seed=3,
+    )
+    return path
+
+
+@pytest.fixture(scope="module")
+def deep_model_path(tmp_path_factory):
+    """seq_len 512: TWO kv buckets (256, 512), so a verify round can cross
+    the bucket boundary."""
+    d = tmp_path_factory.mktemp("spec_deep")
+    path = str(d / "m.m")
+    write_tiny_model(
+        path,
+        tiny_header(dim=64, hidden_dim=128, n_layers=2, seq_len=512, vocab_size=288),
+        seed=3,
+    )
+    return path
+
+
+def _engine(path, **kw):
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("decode_chunk_size", 8)
+    return InferenceEngine(path, **kw)
+
+
+# -- NGramDraft unit tests ---------------------------------------------------
+
+
+def test_ngram_no_match_returns_empty():
+    ds = NGramDraft()
+    assert ds.draft([1, 2, 3, 4, 5, 6, 7], 4) == []
+    assert ds.draft([], 4) == []
+    assert ds.draft([1], 4) == []
+    assert ds.draft([1, 2, 3], 0) == []
+
+
+def test_ngram_proposes_continuation_of_most_recent_match():
+    # suffix (2, 3) occurs twice earlier; the MOST RECENT match's
+    # continuation wins (..., 2, 3, 9, ...) over the older (2, 3, 4, ...)
+    ctx = [1, 2, 3, 4, 5, 2, 3, 9, 8, 2, 3]
+    assert NGramDraft().draft(ctx, 2) == [9, 8]
+
+
+def test_ngram_longest_n_wins():
+    # both (3,) and (2, 3) recur; the longer gram's continuation is the
+    # draft even though a 1-gram match sits closer to the end
+    ctx = [2, 3, 7, 7, 3, 5, 2, 3]
+    assert NGramDraft().draft(ctx, 1) == [7]
+
+
+def test_ngram_match_at_context_edge_returns_short_draft():
+    # the match's continuation runs into the context edge: fewer than k
+    # tokens come back (the verify bucket pads; acceptance caps at the
+    # real draft length)
+    ctx = [5, 6, 7, 8, 5, 6]
+    assert NGramDraft().draft(ctx, 4) == [7, 8, 5, 6][: len(ctx) - 2]
+    ctx2 = [9, 1, 2, 3, 9, 1]
+    assert NGramDraft().draft(ctx2, 8) == [2, 3, 9, 1]
+
+
+def test_ngram_respects_k():
+    ctx = [1, 2, 3, 4, 5, 1, 2]
+    assert NGramDraft().draft(ctx, 2) == [3, 4]
+
+
+# -- config resolution -------------------------------------------------------
+
+
+def test_mode_and_bucket_resolution(monkeypatch):
+    assert resolve_spec_mode(None, default="off") is None
+    assert resolve_spec_mode(None, default="ngram") == "ngram"
+    assert resolve_spec_mode("off", default="ngram") is None
+    monkeypatch.setenv("DLT_SPECULATIVE", "ngram")
+    assert resolve_spec_mode(None, default="off") == "ngram"
+    monkeypatch.setenv("DLT_SPECULATIVE", "bogus")
+    assert resolve_spec_mode(None, default="off") is None
+    with pytest.raises(ValueError):
+        resolve_spec_mode("bogus")
+    assert spec_buckets(4) == (4,)
+    assert spec_buckets(8) == (4, 8)
+    assert spec_buckets(1) == (4,)  # never below the smallest bucket
+
+
+def test_model_mode_requires_draft_source(model_path):
+    with pytest.raises(ValueError, match="draft_source"):
+        _engine(model_path, speculative="model")
+
+
+# -- engine-level identity ---------------------------------------------------
+
+
+def test_engine_greedy_identity_ngram(model_path):
+    """Tokens bit-identical to plain decode on a mixed workload: verify
+    rounds with accepts AND rejects, plus draftless fallback chunks."""
+    prompt = [3, 17, 99, 4]
+    want = _engine(model_path).generate(prompt, 60, sampler=None).tokens
+    eng = _engine(model_path, speculative="ngram")
+    got = eng.generate(prompt, 60, sampler=None).tokens
+    assert got == want
+    t = eng.last_spec_timing
+    assert t["rounds"] > 0 and t["fallback_chunks"] > 0
+    assert 0 < t["accepted_tokens"] < t["draft_tokens"]
+    c = eng.stats.counters_snapshot()
+    assert c["spec_draft_tokens"] == c["spec_accepted_tokens"] + c["spec_rejected_tokens"]
+    assert eng.stats.gauges_snapshot()["spec_acceptance_rate"] == pytest.approx(
+        t["accepted_tokens"] / t["draft_tokens"], abs=1e-3
+    )
+
+
+def test_verify_logits_bit_identical_to_stepwise(model_path):
+    """The verify forward's FETCHED LOGITS at every drafted position equal
+    the per-step decode logits bit for bit — the property greedy acceptance
+    rests on (argmax of equal arrays is equal)."""
+    prompt = [3, 17, 99, 4]
+    pos = len(prompt) - 1
+
+    step = _engine(model_path)
+    step.prefill(prompt[:-1])
+    tok, p, chain_logits = prompt[-1], pos, []
+    for _ in range(5):
+        lg = step.decode_one(tok, p)
+        chain_logits.append(lg[0].copy())
+        tok, p = int(np.argmax(lg[0])), p + 1
+    drafts = [int(np.argmax(l)) for l in chain_logits[:4]]
+
+    spec = _engine(model_path, speculative="ngram")
+    spec.prefill(prompt[:-1])
+    feed = np.asarray([[prompt[-1]] + drafts], np.int32)
+    ids_dev, logits_dev = spec._dispatch_verify(
+        feed, pos, spec._kv_bucket(pos + len(drafts) + 1)
+    )
+    ids = np.asarray(ids_dev)[0]
+    logits = np.asarray(logits_dev)[0]
+    for i in range(5):
+        assert np.array_equal(logits[i], chain_logits[i]), f"position {i} drifted"
+    assert accept_greedy(drafts, ids) == 4  # the chain is its own draft
+
+
+def test_engine_stop_fn_and_streaming_identity(model_path):
+    """on_token streaming order and stop_fn early exit match plain decode
+    (a verify round's surplus past the stop is discarded like a chunk
+    tail)."""
+    prompt = [3, 17, 99, 4]
+
+    def run(spec):
+        eng = _engine(model_path, speculative="ngram" if spec else "off")
+        seen = []
+        state = {"n": 0}
+
+        def stop(t):
+            state["n"] += 1
+            return state["n"] >= 17
+        res = eng.generate(prompt, 80, sampler=None, on_token=seen.append, stop_fn=stop)
+        return res.tokens, seen
+
+    (tok_a, seen_a), (tok_b, seen_b) = run(True), run(False)
+    assert tok_a == tok_b
+    assert seen_a == seen_b and len(seen_a) == 17
+
+
+def test_sampled_generation_bypasses_speculation(model_path):
+    """temperature > 0 must take the plain chunked path (same RNG stream as
+    a spec-off engine) and record zero verify rounds."""
+    from distributed_llama_tpu.tokenizer import Sampler
+
+    prompt = [3, 17, 99, 4]
+    a = _engine(model_path, speculative="ngram")
+    b = _engine(model_path)
+    sa = Sampler(288, 0.8, 0.9, 42)
+    sb = Sampler(288, 0.8, 0.9, 42)
+    assert a.generate(prompt, 40, sampler=sa).tokens == b.generate(prompt, 40, sampler=sb).tokens
+    assert "spec_rounds" not in a.stats.counters_snapshot()
+
+
+def test_draft_crossing_kv_bucket_boundary(deep_model_path):
+    """A verify round spanning the 256 kv-bucket boundary (positions below,
+    drafts above) stays bit-identical — the round's bucket covers its own
+    end, exactly like a prefill tail chunk's."""
+    # repetitive prompt ending just under the boundary so the first verify
+    # rounds write across it
+    prompt = ([7, 9, 11, 13] * 64)[:250]
+    want = _engine(deep_model_path, max_chunk=32).generate(
+        prompt, len(prompt) + 24, sampler=None
+    ).tokens
+    eng = _engine(deep_model_path, max_chunk=32, speculative="ngram")
+    got = eng.generate(prompt, len(prompt) + 24, sampler=None).tokens
+    assert got == want
+    verify_kvbs = {k[2] for k in eng._warm if k[0] == "verify"}
+    assert 512 in verify_kvbs, "no verify round crossed into the deep bucket"
+    assert eng.stats.counters_snapshot()["spec_rounds"] > 0
+
+
+def test_model_draft_same_model_accepts_everything(model_path):
+    """ModelDraft with the SAME model as drafter: every draft IS the greedy
+    chain, so acceptance is 100% and output identity is trivial — the
+    end-to-end proof of the two-engine plumbing (resync prefill + chunked
+    draft decode)."""
+    prompt = [3, 17, 99, 4]
+    want = _engine(model_path).generate(prompt, 40, sampler=None).tokens
+    draft_eng = _engine(model_path, batch=1, prefix_cache_mb=0)
+    eng = _engine(
+        model_path, speculative="model", draft_source=ModelDraft(draft_eng)
+    )
+    got = eng.generate(prompt, 40, sampler=None).tokens
+    assert got == want
+    t = eng.last_spec_timing
+    assert t["rounds"] > 0 and t["acceptance_rate"] == 1.0
+    eng.close()  # closes the draft engine through the source
+
+
+def test_model_draft_refuses_batched_draft_engine(model_path):
+    with pytest.raises(ValueError, match="batch=1"):
+        ModelDraft(_engine(model_path, batch=2))
+
+
+def test_model_draft_snaps_odd_k_to_decode_ladder(model_path):
+    """Batched callers cap k at odd budget remainders (3, 5, ...); the
+    draft chunk must still dispatch a warm-ladder power-of-two n_steps —
+    an off-ladder n would be a post-warmup recompile mid-serving."""
+    draft_eng = _engine(model_path, batch=1)
+    ds = ModelDraft(draft_eng)
+    out = ds.draft([3, 17, 99, 4], 3)
+    assert len(out) == 3
+    decode_sizes = {k[1] for k in draft_eng._warm if k[0] == "decode"}
+    assert decode_sizes <= {1, 2, 4, 8, 16, 32, 64}, decode_sizes
+    assert 4 in decode_sizes and 3 not in decode_sizes
+    ds.close()
+
+
+# -- generate_batch ----------------------------------------------------------
+
+
+def test_generate_batch_identity_mixed_rows(model_path):
+    """Per-row speculation on a mixed batch (repetitive row, short row,
+    ordinary row) with PER-ROW budgets: outputs and streaming order match
+    the plain chunked loop row for row."""
+    prompts = [[3, 17, 99, 4], [5, 5, 5, 5, 5, 5], [7, 1]]
+    budgets = [40, 25, 10]
+
+    def run(spec):
+        eng = _engine(
+            model_path, batch=3,
+            speculative="ngram" if spec else "off", draft_k=8,
+        )
+        streamed = [[] for _ in prompts]
+        outs = eng.generate_batch(
+            prompts, budgets, sampler=None,
+            on_token=lambda r, t: streamed[r].append(t),
+        )
+        return eng, outs, streamed
+
+    eng_on, on, stream_on = run(True)
+    _, off, stream_off = run(False)
+    assert on == off
+    for r in range(3):
+        assert stream_on[r] == on[r] == stream_off[r]
+        assert len(on[r]) == budgets[r]
+    assert eng_on.stats.counters_snapshot()["spec_rounds"] > 0
+
+
+def test_host_decode_engine_bypasses_speculation(model_path):
+    """device_decode=False engines carry NO verify programs on their warm
+    plan, so generate_batch must take the chunked path (the regression:
+    a silent mid-serving compile of an unwarmed verify_row program)."""
+    prompts = [[3, 17, 99, 4], [5, 5, 5, 5]]
+    eng = _engine(model_path, batch=2, device_decode=False, speculative="ngram")
+    assert not any(k[0].startswith("verify") for k in eng.warm_plan())
+    outs = eng.generate_batch(prompts, 12, sampler=None)
+    assert "spec_rounds" not in eng.stats.counters_snapshot()
+    assert not any(k[0].startswith("verify") for k in eng._warm)
+    off = _engine(model_path, batch=2, device_decode=False)
+    assert outs == off.generate_batch(prompts, 12, sampler=None)
+
+
+def test_generate_batch_stop_fn_identity(model_path):
+    prompts = [[3, 17, 99, 4], [5, 5, 5, 5]]
+
+    def run(spec):
+        eng = _engine(model_path, batch=2, speculative="ngram" if spec else "off")
+        return eng.generate_batch(
+            prompts, 30, sampler=None,
+            stop_fn=lambda r, t: t == 220,  # appears early in row 0's chain
+        )
+
+    assert run(True) == run(False)
+
+
+# -- BatchSession ------------------------------------------------------------
+
+
+def test_session_spec_step_mixed_accept_reject(model_path):
+    """One verify round with a fully-accepted row and a fully-rejected row:
+    per-row acceptance advances them UNEVENLY, each along its own plain-
+    decode chain (the plain twin session is the oracle)."""
+    def boot(spec):
+        eng = _engine(model_path, batch=2, speculative="ngram" if spec else "off")
+        s = BatchSession(eng)
+        s.admit(0, [3, 17, 99, 4])
+        s.admit(1, [5, 5, 5, 5])
+        return eng, s
+
+    _, oracle = boot(False)
+    plain = oracle.step(5)  # the true greedy chains, 5 tokens each
+    eng, sess = boot(True)
+    good = [int(t) for t in plain[0, :4]]  # row 0: the real chain
+    bad = [280, 281, 282, 283]  # row 1: nonsense — rejected at position 0
+    out = sess.spec_step({0: good, 1: bad})
+    assert out[0] == [int(t) for t in plain[0, :5]]  # 4 accepted + bonus
+    assert out[1] == [int(plain[1, 0])]  # bonus only
+    assert int(sess.pos[0]) - int(sess.pos[1]) == 4  # uneven advance
+    c = eng.stats.counters_snapshot()
+    assert c["spec_accepted_tokens"] == 4 and c["spec_rejected_tokens"] == 4
+
+    # the next round continues each row's chain from its own position:
+    # row 0 (ahead, no draft) gets one bonus token; row 1 re-offers its
+    # true next token and lands it plus the bonus
+    out2 = sess.spec_step({0: [], 1: [int(plain[1, 1])]})
+    assert len(out2[0]) == 1
+    assert out2[1] == [int(plain[1, 1]), int(plain[1, 2])]
+
+
+def test_session_spec_step_guards(model_path):
+    eng = _engine(model_path, batch=2, speculative="ngram")
+    s = BatchSession(eng)
+    s.admit(0, [3, 17, 99, 4], temperature=0.7)
+    with pytest.raises(ValueError, match="greedy-only"):
+        s.spec_step({0: [1, 2]})
+    with pytest.raises(ValueError, match="not active"):
+        s.spec_step({1: [1, 2]})
+    s.release(0)
+    s.admit(0, [1] * 126)  # pos 125 of seq_len 128: no K+1 headroom
+    with pytest.raises(ValueError, match="overrun"):
+        s.spec_step({0: [1, 2, 3, 4]})
+    off = _engine(model_path, batch=2)
+    with pytest.raises(ValueError, match="not enabled"):
+        BatchSession(off).spec_step({0: []})
+
+
+# -- sanitizers: the warm-ladder contract ------------------------------------
+
+
+@pytest.mark.analysis
+def test_zero_post_warmup_recompiles_with_speculation(model_path, monkeypatch):
+    """DLT_SANITIZERS=1 regression: with speculation enabled, warmup
+    compiles the verify buckets too, and a post-warmup serving mix —
+    solo verify rounds, draftless fallback chunks, AND a BatchSession
+    spec round — triggers ZERO recompiles."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    eng = _engine(
+        model_path, batch=2, max_chunk=16, speculative="ngram", draft_k=8
+    )
+    try:
+        eng.warmup()
+        assert eng.sentinel is not None and eng.sentinel.sealed
+        # verify + verify_row buckets are ON the sealed ladder
+        warm_kinds = {k[0] for k in eng._warm if isinstance(k[0], str)}
+        assert {"verify", "verify_row"} <= warm_kinds
+        # solo: repetitive prompt (verify rounds) then distinct-token
+        # prompt (draftless fallback chunks)
+        eng.reset()
+        res = eng.generate([9, 2, 9, 2, 9, 2, 9], 40, sampler=None)
+        assert eng.stats.counters_snapshot().get("spec_rounds", 0) > 0
+        eng.reset()
+        eng.generate([31, 7, 200, 11, 83], 20, sampler=None)
+        # batched: one admission + one spec round + one plain chunk
+        eng.reset()
+        s = BatchSession(eng)
+        s.admit(0, [3, 17, 99, 4])
+        s.admit(1, [5, 5, 5, 5])
+        s.spec_step({0: [1, 2, 3], 1: []})
+        s.step(8)
+        assert eng.sentinel.post_seal_compiles == 0
+        assert "sanitizer_recompiles" not in eng.stats.counters_snapshot()
+        assert res.tokens  # the run actually generated
+    finally:
+        eng.close()
+
+
+# -- HTTP level --------------------------------------------------------------
+
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def http_twins(tmp_path_factory):
+    """Two batched API servers over the same model: --speculative ngram vs
+    off (warmup skipped — identity, not latency, is under test here)."""
+    import os
+
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.cli import build_arg_parser
+
+    d = tmp_path_factory.mktemp("spec_srv")
+    h = tiny_header(dim=64, hidden_dim=128, n_layers=2, seq_len=256, vocab_size=288)
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+
+    os.environ["DLT_NO_WARMUP"] = "1"
+    servers = {}
+    try:
+        for mode in ("ngram", "off"):
+            p = build_arg_parser()
+            p.add_argument("--port", type=int, default=0)
+            port = _free_port()
+            args = p.parse_args(
+                ["inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+                 "--compute-dtype", "float32", "--temperature", "0.0",
+                 "--speculative", mode, "--batch", "3", "--port", str(port)]
+            )
+            httpd = api_mod.serve(args)
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            servers[mode] = (port, httpd)
+        yield {m: p for m, (p, _) in servers.items()}
+    finally:
+        os.environ.pop("DLT_NO_WARMUP", None)
+        for _, httpd in servers.values():
+            httpd.shutdown()
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_http_greedy_identity_and_stats(http_twins):
+    """Non-stream completions bit-match between the speculative and plain
+    servers (the Batcher's spec rounds included), and /stats grows the
+    speculative section with live acceptance counters."""
+    msgs = [
+        {"messages": [{"role": "user", "content": "hello world hello world hello"}],
+         "max_tokens": 40},
+        {"messages": [{"role": "user", "content": "abc"}], "max_tokens": 12},
+    ]
+    for payload in msgs:
+        with _post(http_twins["ngram"], payload) as r:
+            a = json.loads(r.read())
+        with _post(http_twins["off"], payload) as r:
+            b = json.loads(r.read())
+        assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
+        assert a["usage"] == b["usage"]
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_twins['ngram']}/stats", timeout=30
+    ) as r:
+        stats = json.loads(r.read())
+    spec = stats["speculative"]
+    assert spec["mode"] == "ngram" and spec["buckets"] == [4]
+    assert spec["rounds"] > 0
+    assert spec["draft_tokens"] == spec["accepted_tokens"] + spec["rejected_tokens"]
+    # the plain server's section reads None (off)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_twins['off']}/stats", timeout=30
+    ) as r:
+        assert json.loads(r.read())["speculative"] is None
+    # counters ride /health too
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_twins['ngram']}/health", timeout=30
+    ) as r:
+        health = json.loads(r.read())
+    assert health["counters"]["spec_rounds"] == spec["rounds"]
+
+
+def test_http_stream_identity(http_twins):
+    payload = {
+        "messages": [{"role": "user", "content": "hello world hello world"}],
+        "max_tokens": 24, "stream": True,
+    }
+    raws = {}
+    for mode in ("ngram", "off"):
+        with _post(http_twins[mode], payload) as r:
+            raws[mode] = r.read().decode()
+    text = {}
+    for mode, raw in raws.items():
+        deltas = []
+        for line in raw.split("\r\n\r\n"):
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunk = json.loads(line[len("data: "):])
+                delta = chunk["choices"][0].get("delta", {})
+                deltas.append(delta.get("content", ""))
+        text[mode] = "".join(deltas)
+    assert text["ngram"] == text["off"]
