@@ -528,6 +528,60 @@ def leg_speculative():
     }
 
 
+def leg_tracing_overhead():
+    """Tracing-overhead leg (runtime/tracing.py): greedy decode on the 1B
+    with a fully-sampled request trace attached to the engine (the
+    DLT_TRACE_SAMPLE=1 serving configuration — every chunk emits a span
+    through a pre-bound emitter) vs tracing compiled out (engine.trace is
+    None — every emission site short-circuits on the guard). The span emit
+    is one host-side tuple append per CHUNK, so the acceptance bar is a
+    <=2% decode-throughput delta; both arms and the delta land in the
+    BENCH json so a regression is visible round to round."""
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.runtime.tracing import Tracer
+
+    path = ensure_model()
+    prompt = [(i % 1000) + 1 for i in range(256)]
+    decode_tokens = 512
+    tracer = Tracer(capacity=1 << 15)
+
+    def run(traced: bool):
+        eng = InferenceEngine(
+            path, compute_dtype="bfloat16", max_chunk=256,
+            decode_chunk_size=64, prefix_cache_mb=0, speculative="off",
+        )
+        steps = len(prompt) + decode_tokens - 1
+        eng.generate(prompt, steps, sampler=None)  # warmup: compiles
+        eng.reset()
+        if traced:
+            # force the sampled bit: the leg must measure full emission
+            # even if the host environment carries DLT_TRACE_SAMPLE!=1
+            eng.trace = tracer.start(sampled=True)
+        res = eng.generate(prompt, steps, sampler=None)
+        n_events = len(tracer.for_trace(eng.trace.id)) if traced else 0
+        eng.trace = None
+        per_tok = sorted(s.eval_us / s.n_tokens for s in res.pred_steps)
+        p95 = per_tok[min(len(per_tok) - 1, int(len(per_tok) * 0.95))] / 1000
+        rate = res.n_pred_tokens * 1e6 / max(res.decode_us, 1)
+        del eng
+        return rate, p95, n_events
+
+    rate_on, p95_on, n_events = run(True)
+    assert n_events > 0, "traced arm emitted no spans — the leg measured nothing"
+    rate_off, p95_off, _ = run(False)
+    overhead_pct = 100.0 * (rate_off - rate_on) / max(rate_off, 1e-9)
+    return {
+        "config": "llama-1B q40 1chip tracing-overhead",
+        "decode_tok_s_traced": round(rate_on, 2),
+        "decode_tok_s_untraced": round(rate_off, 2),
+        "throughput_overhead_pct": round(overhead_pct, 2),
+        "overhead_bar_pct": 2.0,
+        "p95_step_ms_traced": round(p95_on, 3),
+        "p95_step_ms_untraced": round(p95_off, 3),
+        "trace_events_emitted": n_events,
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -676,6 +730,13 @@ def main():
         print(f"# speculative: {sp}", file=sys.stderr)
     except Exception as e:
         print(f"# speculative leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        tro = leg_tracing_overhead()
+        configs.append(tro)
+        print(f"# tracing-overhead: {tro}", file=sys.stderr)
+    except Exception as e:
+        print(f"# tracing-overhead leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

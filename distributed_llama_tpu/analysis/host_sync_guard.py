@@ -71,8 +71,23 @@ def host_sync_guard(stats=None, mode: str | None = None):
         with jax.transfer_guard_device_to_host(mode):
             yield
     except Exception as e:
-        if stats is not None and is_transfer_guard_error(e):
-            stats.incr("sanitizer_d2h_violations")
+        # guard scopes nest (BatchSession.step around verify_row_round's
+        # engine scope, generate around its dispatch scopes): one breach
+        # unwinds through every level, so count and flight-record only at
+        # the OUTERMOST scope — depth == 1 here because every inner
+        # scope's finally already ran
+        if is_transfer_guard_error(e) and getattr(_tls, "depth", 0) == 1:
+            if stats is not None:
+                stats.incr("sanitizer_d2h_violations")
+            # fatal sanitizer breach: snapshot the trace ring before the
+            # error unwinds the serving loop — the violating request's
+            # spans are the post-mortem
+            from ..runtime.tracing import flight_record
+
+            flight_record(
+                "sanitizer:d2h-violation",
+                counters=stats.counters_snapshot() if stats else None,
+            )
         raise
     finally:
         _tls.depth -= 1

@@ -27,7 +27,14 @@ comma-separate for several — the pragma documents WHY at the site):
   the hot packages (runtime/parallel): each is a potential blocking
   device→host sync worth ~100 ms of tunnel round trip. The sanctioned
   fetch sites carry pragmas — which doubles as the canonical list of
-  blessed host syncs the host_sync_guard sanitizer allows.
+  blessed host syncs the host_sync_guard sanitizer allows;
+* **trace-hot-emit** — ``trace.event(...)`` / ``TRACER.event(...)`` inside
+  a ``for``/``while`` loop body in the hot packages (runtime/parallel), or
+  an emit call constructing a dict literal anywhere in them: per-iteration
+  span emission must go through a pre-bound ``Trace.bind(...)`` emitter
+  (one tuple append per event — no name/keys re-tupling, no dict
+  allocation in the decode/spec_step inner loops; runtime/tracing.py
+  Emitter).
 
 The CLI lives at ``scripts/dlt_lint.py``; CI runs it over the tree.
 """
@@ -46,10 +53,12 @@ ALL_RULES = (
     "thread-daemon",
     "float64",
     "host-sync",
+    "trace-hot-emit",
 )
 
 _PRAGMA_RE = re.compile(r"#\s*dlt:\s*allow\(([^)]*)\)")
 _LOCKISH_RE = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
+_TRACEISH_RE = re.compile(r"^(tr|trace|tracer|TRACER)$")
 
 #: packages where a float64 literal is device-side poison
 FLOAT64_SCOPE = ("ops", "models", "parallel", "runtime", "formats")
@@ -104,6 +113,7 @@ class _Linter(ast.NodeVisitor):
         self.pragmas = _pragmas(source)
         self.violations: list = []
         self._thread_classes: list = []  # ClassDef stack: is-Thread-subclass
+        self._loop_depth = 0  # for/while nesting (trace-hot-emit)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -217,7 +227,44 @@ class _Linter(ast.NodeVisitor):
                     "blocking device->host sync — pragma the sanctioned "
                     "sites (see docs/ANALYSIS.md)",
                 )
+        # trace-hot-emit: span emission discipline in hot packages —
+        # per-iteration .event() calls re-tuple name/keys every time and
+        # invite dict construction; loops must use a pre-bound
+        # Trace.bind(...) emitter (one tuple append per event)
+        if (
+            self._in_scope(HOST_SYNC_SCOPE)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "event"
+            and _TRACEISH_RE.match(_receiver_name(node.func.value) or "")
+        ):
+            if self._loop_depth > 0:
+                self._flag(
+                    "trace-hot-emit", node,
+                    ".event(...) inside a loop in a hot package — bind a "
+                    "pre-bound emitter outside the loop (Trace.bind) and "
+                    "call it per iteration",
+                )
+            has_dict = any(
+                isinstance(a, (ast.Dict, ast.DictComp)) for a in node.args
+            ) or any(
+                isinstance(kw.value, (ast.Dict, ast.DictComp))
+                for kw in node.keywords
+            )
+            if has_dict:
+                self._flag(
+                    "trace-hot-emit", node,
+                    "dict construction in a span emit call — pass scalar "
+                    "vals against pre-bound keys instead",
+                )
         self.generic_visit(node)
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
 
     def visit_Attribute(self, node: ast.Attribute):
         if self._in_scope(FLOAT64_SCOPE) and node.attr == "float64":

@@ -156,6 +156,14 @@ class RecompileSentinel:
         if self.stats is not None:
             self.stats.incr("sanitizer_recompiles")
         if self.fatal:
+            # post-mortem BEFORE the raise: the trace ring holds the spans
+            # of whatever request dispatched the mis-bucketed shape
+            from ..runtime.tracing import flight_record
+
+            flight_record(
+                f"sanitizer:recompile:{self.name}",
+                counters=self.stats.counters_snapshot() if self.stats else None,
+            )
             raise RecompileError(
                 f"post-warmup XLA compile detected ({self.name}): the "
                 "warm-key ladder does not cover a shape that just got "

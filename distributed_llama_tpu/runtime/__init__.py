@@ -4,16 +4,31 @@ Replaces the reference's executor/thread-pool/socket runtime (reference:
 src/nn/nn-executor.cpp, src/app.cpp): XLA replaces the step list and thread
 pool, buffer donation replaces pipe memory management, and the host-side
 engine here only orchestrates prefill chunking, sampling, and timing.
+
+The engine exports are LAZY (module ``__getattr__``): importing a jax-free
+sibling — ``runtime.tracing``, which the gateway shares for trace IDs and
+Prometheus exposition — must not drag jax into a process that never
+dispatches device work.
 """
 
-from .engine import GenerationResult, InferenceEngine, StepTiming
-from .speculative import DraftSource, ModelDraft, NGramDraft
+from __future__ import annotations
 
-__all__ = [
-    "InferenceEngine",
-    "GenerationResult",
-    "StepTiming",
-    "DraftSource",
-    "NGramDraft",
-    "ModelDraft",
-]
+import importlib
+
+_EXPORTS = {
+    "InferenceEngine": ".engine",
+    "GenerationResult": ".engine",
+    "StepTiming": ".engine",
+    "DraftSource": ".speculative",
+    "NGramDraft": ".speculative",
+    "ModelDraft": ".speculative",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod, __name__), name)

@@ -41,6 +41,7 @@ decision instead:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -50,6 +51,7 @@ import numpy as np
 from ..models.params import KVCache
 from ..models.transformer import forward_uncompiled
 from ..ops.sampling import sample_logits_per_row, split_row_keys
+from .tracing import to_us
 
 
 @partial(
@@ -167,12 +169,13 @@ class BatchSession:
         temperature: float = 0.0,
         topp: float = 0.9,
         key_data=None,  # (hi, lo) uint32 pair; None derives from the row+pos
+        trace=None,
     ) -> None:
         """Prefill `prompt_tokens[:-1]` into `row` and arm the slot in one
         call (begin_admit + an unbounded prefill_pending). The row starts
         decoding on the next `step` call — admission latency is one prefill
         plus at most one in-flight chunk boundary."""
-        self.begin_admit(row, prompt_tokens, temperature, topp, key_data)
+        self.begin_admit(row, prompt_tokens, temperature, topp, key_data, trace)
         self.prefill_pending(row)
 
     def begin_admit(
@@ -182,6 +185,8 @@ class BatchSession:
         temperature: float = 0.0,
         topp: float = 0.9,
         key_data=None,
+        trace=None,  # runtime/tracing.py Trace for this request (None = untraced):
+        # admission-prefill chunks and the splice emit span events into it
     ) -> None:
         """Stage an admission without running its prefill: the prompt then
         advances in bounded chunks via `prefill_pending`, scheduled by the
@@ -222,7 +227,14 @@ class BatchSession:
         resume, entry = 0, None
         eng = self.engine
         if eng.prefix_cache is not None and not eng._in_warmup:
+            t_match = time.perf_counter()
             resume, entry = eng.prefix_cache.match_for_splice(prompt_tokens[:-1])
+            if trace is not None:
+                trace.event(
+                    "prefix_match", to_us(t_match),
+                    int((time.perf_counter() - t_match) * 1e6),
+                    ("resume_tokens", "row"), (resume, row),
+                )
         self._pending[row] = {
             "tokens": list(prompt_tokens),
             "done": 0,  # prefilled prefix length within tokens[:-1]
@@ -231,6 +243,12 @@ class BatchSession:
             "key_data": key_data,
             "resume": resume,  # chunk-bucket-aligned prefix-cache boundary
             "entry": entry,  # pinned PrefixEntry to splice, or None
+            "trace": trace,
+            # pre-bound per-chunk emitter: admission prefill advances one
+            # chunk per call below — a tuple append each, nothing more
+            "em_chunk": None if trace is None else trace.bind(
+                "prefill_chunk", ("size", "row")
+            ),
         }
 
     def prefill_pending(self, row: int, max_tokens: int | None = None) -> int:
@@ -260,6 +278,7 @@ class BatchSession:
                 # diverged sibling prompt — the chunks below rewrite every
                 # position >= resume before any query reads it (the parked-
                 # row write-before-read invariant).
+                t_splice = time.perf_counter()
                 try:
                     with eng._guard(
                         f"prefix_copy_row[{entry.length}]",
@@ -271,9 +290,17 @@ class BatchSession:
                     # must not leave the entry pinned (unevictable) forever
                     eng.prefix_cache.entry_release(entry)
                 eng.prefix_cache.record_hit(st["resume"])
+                if st["trace"] is not None:
+                    st["trace"].event(
+                        "prefix_splice", to_us(t_splice),
+                        int((time.perf_counter() - t_splice) * 1e6),
+                        ("tokens", "row"), (st["resume"], row),
+                    )
                 st["done"] = min(st["resume"], len(pre))
+            em_chunk = st["em_chunk"]
             while st["done"] < len(pre) and budget > 0:
                 done = st["done"]
+                t_chunk = time.perf_counter()
                 # plan against the REMAINING BUDGET too, so a budget below
                 # max_chunk is honored exactly (the chunk's bucket may pad
                 # past an odd budget, but its real tokens never exceed it)
@@ -313,6 +340,14 @@ class BatchSession:
                     eng.cache = prefill_row(
                         eng.cfg, eng.params, eng.rope, eng.cache,
                         toks_dev, pos_dev, row_dev, kv_len=kv_len,
+                    )
+                if em_chunk is not None:
+                    # dispatch wall of this admission-prefill chunk (the
+                    # dispatch is async; completion is observed by the next
+                    # step fetch, same semantics as the solo prefill spans)
+                    em_chunk(
+                        to_us(t_chunk),
+                        int((time.perf_counter() - t_chunk) * 1e6), n_real, row,
                     )
                 st["done"] = done + n_real
                 budget -= n_real

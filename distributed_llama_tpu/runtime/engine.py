@@ -39,6 +39,7 @@ from ..models import KVCache, config_from_header, forward, init_kv_cache, load_p
 from ..ops import build_rope_tables
 from ..tokenizer import Sampler
 from .telemetry import StepStats, memory_report, watchdog
+from .tracing import to_us
 
 
 @dataclass
@@ -281,6 +282,12 @@ class InferenceEngine:
         # dispatch-vs-compute overlap summary of the most recent prefill
         # (bench.py reads it; /stats exports the gauge twin)
         self.last_prefill_timing: dict | None = None
+        # per-request tracing context (runtime/tracing.py Trace), set by the
+        # serving layer around a request (the serialized API path; the
+        # Batcher threads per-row traces through BatchSession instead).
+        # None = untraced: every emission site guards on it, so library and
+        # bench callers pay nothing.
+        self.trace = None
         # shape keys this engine has executed at least once: a first-shape
         # call legitimately blocks on XLA compilation, so its watchdog runs
         # with the (much wider) compile threshold and a "compile" label
@@ -795,10 +802,19 @@ class InferenceEngine:
         # from the boundary. Only fresh sequences (pos_start == 0) can hit:
         # a continuation's absolute positions don't start at the trie root.
         pc = self.prefix_cache
+        tr = self.trace
         resume = 0
         if pc is not None and pos_start == 0 and not self._in_warmup:
+            t_match = time.perf_counter()
             resume, entry = pc.match_for_splice(tokens)
+            if tr is not None:
+                tr.event(
+                    "prefix_match", to_us(t_match),
+                    int((time.perf_counter() - t_match) * 1e6),
+                    ("resume_tokens",), (resume,),
+                )
             if entry is not None:
+                t_splice = time.perf_counter()
                 try:
                     with self._sanitizer_scope(), self._guard(
                         f"prefix_copy[{entry.length}]",
@@ -810,6 +826,12 @@ class InferenceEngine:
                     # must not leave the entry unevictable forever
                     pc.entry_release(entry)
                 pc.record_hit(resume)
+                if tr is not None:
+                    tr.event(
+                        "prefix_splice", to_us(t_splice),
+                        int((time.perf_counter() - t_splice) * 1e6),
+                        ("tokens",), (resume,),
+                    )
         self.last_prefix_hit_tokens = resume
         rem = tokens[resume:]
         base = pos_start + resume
@@ -833,6 +855,8 @@ class InferenceEngine:
 
         timing = {"dispatch_us": 0}
         sync_us = 0
+        sync_t0 = 0.0
+        chunk_log: list = []  # (t_dispatch_perf, dispatch_us, size) per chunk
 
         def dispatch(idx, operands):
             arr, pos_dev = operands
@@ -842,6 +866,7 @@ class InferenceEngine:
             dus = int((time.perf_counter() - td) * 1e6)
             timing["dispatch_us"] += dus
             self.stats.record(f"prefill_dispatch[{size}]", dus)
+            chunk_log.append((td, dus, size))
             return out
 
         # the guard now covers the dispatch loop too (not just the sync): a
@@ -866,7 +891,7 @@ class InferenceEngine:
             ):
                 out = self._pipelined_chunks(len(plan), prep, dispatch)
                 if sync:
-                    ts = time.perf_counter()
+                    ts = sync_t0 = time.perf_counter()
                     # block on the last chunk's logits — the ONE host round trip
                     # of a pipelined prefill: a ready-wait, no extra device op
                     # enqueued (jnp.sum was a dispatch round trip) and no buffer
@@ -878,7 +903,7 @@ class InferenceEngine:
             # full-prefix hit: no chunks to run — the only in-flight device
             # work is the splice; wait for it so the caller's timing (and
             # error surfacing) semantics match the cold path
-            ts = time.perf_counter()
+            ts = sync_t0 = time.perf_counter()
             jax.block_until_ready(self.cache.k)
             sync_us = int((time.perf_counter() - ts) * 1e6)
             self.stats.record("prefill_sync", sync_us)
@@ -901,6 +926,18 @@ class InferenceEngine:
         self.stats.gauge(
             "prefill_dispatch_overlap_pct", self.last_prefill_timing["overlap_pct"]
         )
+        if tr is not None:
+            # span per chunk from the dispatch walls recorded above (the
+            # emitter is pre-bound; None when this trace is unsampled).
+            # Each span is the chunk's DISPATCH wall — compute overlaps the
+            # next dispatch, which is exactly what last_prefill_timing's
+            # overlap_pct summarizes.
+            em = tr.bind("prefill_chunk", ("size",))
+            if em is not None:
+                for td, dus, size in chunk_log:
+                    em(to_us(td), dus, size)
+            if sync_us:
+                tr.event("prefill_sync", to_us(sync_t0), sync_us)
         for _, size, n_real in plan:
             dt = total_us * n_real // max(len(rem), 1)
             self.stats.record(f"prefill[{size}]", dt)
@@ -1024,6 +1061,12 @@ class InferenceEngine:
             publish=False,
         )
         res.prefill_us = int((time.perf_counter() - wall0) * 1e6)
+        if self.trace is not None:
+            self.trace.event(
+                "prefill", to_us(wall0), res.prefill_us,
+                ("n_tokens", "prefix_hit_tokens"),
+                (len(prompt_tokens) - 1, self.last_prefix_hit_tokens),
+            )
 
         pos = pos_start + len(prompt_tokens) - 1
         token = prompt_tokens[-1]
@@ -1459,6 +1502,11 @@ class InferenceEngine:
         # the DEVICE tokens array, not the host copy) — serializing them put
         # a ~150 ms/chunk host floor under small-model decode (the round-3
         # per-token floor's other half, beside the cache re-stack).
+        # pre-bound span emitter (one tuple append per CHUNK, not per token;
+        # None = untraced or unsampled — the same guard covers both)
+        em_chunk = (
+            self.trace.bind("decode_chunk", ("n",)) if self.trace is not None else None
+        )
         first = True
         t_prev = time.perf_counter()
         # TTFT ramp — only when a consumer is streaming (on_token): the first
@@ -1492,6 +1540,8 @@ class InferenceEngine:
                 host_toks = fut.result()[0].tolist()
             now = time.perf_counter()
             dt = int((now - t_prev) * 1e6)
+            if em_chunk is not None:
+                em_chunk(to_us(t_prev), dt, n)
             t_prev = now
             self.stats.record(f"decode[{n}]", dt)
             if first:
@@ -1536,6 +1586,11 @@ class InferenceEngine:
         rounds = fallback_chunks = drafted = accepted = emitted_total = 0
         draft_us = verify_us = 0
         first = True
+        # pre-bound per-round emitters (one tuple append per verify round /
+        # fallback chunk; None = untraced or unsampled)
+        tr = self.trace
+        em_round = tr.bind("spec_round", ("drafted", "accepted")) if tr else None
+        em_chunk = tr.bind("decode_chunk", ("n",)) if tr else None
         while pos < max_pos:
             # the verify feed writes positions pos..pos+k; at scalar pos the
             # cache update is a dynamic_update_slice whose start CLAMPS at
@@ -1569,6 +1624,8 @@ class InferenceEngine:
                 accepted += a
                 note_round(self.stats, len(drafts), a)
                 self.stats.record(f"spec_verify[{K}]", dt)
+                if em_round is not None:
+                    em_round(to_us(tv), dt, len(drafts), a)
             else:
                 # no draft: one plain decode chunk (largest power-of-two
                 # that fits the remaining budget — the ordinary ladder).
@@ -1595,6 +1652,8 @@ class InferenceEngine:
                 dt = int((time.perf_counter() - tv) * 1e6)
                 fallback_chunks += 1
                 self.stats.record(f"decode[{n}]", dt)
+                if em_chunk is not None:
+                    em_chunk(to_us(tv), dt, n)
             if first:
                 res.ttft_us = int((time.perf_counter() - wall0) * 1e6)
                 first = False

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.params import KVCache
+from .tracing import global_event
 
 #: prefixes shorter than this are not worth a splice dispatch (~a tunnel
 #: round trip); also the smallest published bucket
@@ -383,6 +384,9 @@ class PrefixCache:
         bucket-aligned prefill tokens it skipped)."""
         self._incr("prefix_hits")
         self._incr("prefix_hit_tokens", resume)
+        # engine-level trace event (flight-recorder context; the request's
+        # own prefix_match/prefix_splice spans carry the per-request view)
+        global_event("prefix_hit", keys=("tokens",), vals=(resume,))
 
     def entry_release(self, entry) -> None:
         with self._lock:
@@ -454,6 +458,7 @@ class PrefixCache:
             self._bytes += entry.nbytes
             self._gauges()
         self._incr("prefix_inserts")
+        global_event("prefix_publish", keys=("tokens", "row"), vals=(P, int(row)))
         return True
 
     def _slice_nbytes(self, engine, P: int) -> int:

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import forward_uncompiled
+from .tracing import TRACER, to_us
 
 SPEC_MODES = ("off", "ngram", "model")
 
@@ -211,6 +212,12 @@ def verify_row_round(engine, drafts: dict, token, pos, seq_len: int) -> dict:
             ids_dev, _ = engine._dispatch_verify(toks, pv, kvb)
             ids = engine._host_fetch(ids_dev)
     engine.stats.record(f"spec_verify[{K}]", (time.perf_counter() - t0) * 1e6)
+    # one engine-level event per verify round (per-row acceptance spans are
+    # emitted by the caller, which owns the row -> request mapping)
+    TRACER.event(
+        "verify_row", to_us(t0), int((time.perf_counter() - t0) * 1e6),
+        ("rows", "bucket"), (len(rows), K),
+    )
     out = {}
     for r in rows:
         a = accept_greedy(clean[r], ids[r])

@@ -31,6 +31,8 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from .tracing import Hist, global_event
+
 
 class StallError(RuntimeError):
     pass
@@ -104,6 +106,8 @@ class watchdog:
                 )
                 if self.stats is not None:
                     self.stats.incr("exec_stall_logged")
+                # fires at most once per stall — a cold path, not a hot loop
+                global_event("exec_stall_logged", keys=("what",), vals=(self.what,))  # dlt: allow(trace-hot-emit)
                 logged = True
             if elapsed_ms >= self.timeout_ms:
                 self._timed_out = True
@@ -113,6 +117,8 @@ class watchdog:
                 )
                 if self.stats is not None:
                     self.stats.incr("exec_stall_timeout")
+                # ditto: one event per hard timeout, then the thread exits
+                global_event("watchdog_stall", keys=("what",), vals=(self.what,))  # dlt: allow(trace-hot-emit)
                 return
 
     def __enter__(self):
@@ -126,6 +132,16 @@ class watchdog:
         self._done.set()
         self._thread.join(timeout=1)
         if self._timed_out and exc_type is None:
+            # post-mortem BEFORE the raise: the ring still holds the stalled
+            # request's spans (prefill chunks, decode chunks) and the
+            # watchdog event the thread just emitted — exactly the context
+            # an operator needs to reconstruct what wedged
+            from .tracing import flight_record
+
+            flight_record(
+                f"stall:{self.what}",
+                counters=self.stats.counters_snapshot() if self.stats else None,
+            )
             raise StallError(f"{self.what} exceeded {self.timeout_ms:.0f} ms")
         return False
 
@@ -151,6 +167,11 @@ class StepStats:
         self.series: dict[str, _Series] = defaultdict(lambda: _Series(window=window))
         self.counters: dict[str, int] = {}
         self.gauges: dict[str, float] = {}
+        # fixed log-bucket histograms (runtime/tracing.py Hist): unlike the
+        # recent-window percentiles above, their cumulative counts are
+        # monotone across scrapes — the Prometheus `_bucket` series /metrics
+        # exports (TTFT, time-per-output-token)
+        self.hists: dict[str, Hist] = {}
         self._counter_lock = threading.Lock()
 
     def incr(self, name: str, n: int = 1):
@@ -166,6 +187,22 @@ class StepStats:
         surfaces derived quantities the series alone can't express."""
         with self._counter_lock:
             self.gauges[name] = float(value)
+
+    def observe(self, name: str, value_ms: float, bounds=None):
+        """Record one observation into the named cumulative histogram
+        (created on first use; fixed log-scale ms buckets). Thread-safe;
+        exported under ``snapshot()["histograms"]`` and as Prometheus
+        ``_bucket``/``_sum``/``_count`` series on `/metrics`."""
+        with self._counter_lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = Hist(bounds) if bounds else Hist()
+        h.observe(value_ms)
+
+    def hists_snapshot(self) -> dict:
+        with self._counter_lock:
+            hists = dict(self.hists)
+        return {k: h.snapshot() for k, h in hists.items()}
 
     def counters_snapshot(self) -> dict:
         with self._counter_lock:
@@ -200,7 +237,13 @@ class StepStats:
         ``"counters"`` and ``"gauges"`` keys, the event counters and
         last-value gauges — the one source `/health` and the gateway's
         `/gateway/stats` both agree with."""
-        out = {"counters": self.counters_snapshot(), "gauges": self.gauges_snapshot()}
+        out = {
+            "counters": self.counters_snapshot(),
+            "gauges": self.gauges_snapshot(),
+            # reserved key like counters/gauges: existing /stats readers
+            # (and their tests) key into what they know and keep working
+            "histograms": self.hists_snapshot(),
+        }
         # materialize the items: engine threads insert new kinds while the
         # /stats handler iterates
         for kind, s in sorted(list(self.series.items())):

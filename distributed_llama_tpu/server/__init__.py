@@ -6,3 +6,10 @@ behavior: `/v1/chat/completions` (stream + non-stream), `/v1/models`, the
 naive KV-prefix cache across chat turns, and least-inflight backend
 selection with failure cooldown.
 """
+
+
+def parse_query(query: str) -> dict:
+    """Parse an already-split query string (``a=1&b=2``) into a dict — the
+    one copy both servers' control endpoints share. No URL-decoding: the
+    only consumers are our own hex trace ids and backend keys."""
+    return dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)

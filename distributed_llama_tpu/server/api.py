@@ -28,12 +28,27 @@ from __future__ import annotations
 import contextlib
 import json
 import threading
+import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import numpy as np
 
 from ..runtime.engine import InferenceEngine
+from ..runtime.tracing import (
+    PROM_CONTENT_TYPE,
+    SAMPLED_HEADER,
+    TRACE_HEADER,
+    TRACER,
+    flight_record,
+    last_flight_record,
+    now_us,
+    parse_sampled,
+    render_step_stats,
+    to_us,
+    trace_payload,
+)
+from . import parse_query
 from ..tokenizer import (
     ChatItem,
     ChatTemplateGenerator,
@@ -146,7 +161,7 @@ class _BatchReq:
     EMIT_DEPTH = 8192
 
     def __init__(self, ids, max_new, temperature, topp, seed, on_token,
-                 eos_ids=frozenset()):
+                 eos_ids=frozenset(), trace=None):
         import queue
 
         self.ids = ids
@@ -155,6 +170,16 @@ class _BatchReq:
         self.topp = topp
         self.seed = seed
         self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        # request-lifecycle tracing (runtime/tracing.py): the Batcher loop
+        # emits this request's queue-wait/decode/spec spans through the
+        # pre-bound emitters (one tuple append per chunk; None = untraced
+        # or unsampled, and every emission site guards on it)
+        self.trace = trace
+        self.t_enqueue_us = 0  # set by submit(); queue_wait span base
+        self._em_decode = trace.bind("decode_chunk", ("n",)) if trace else None
+        self._em_spec = (
+            trace.bind("spec_round", ("drafted", "accepted")) if trace else None
+        )
         # token ids that END the row — checked IN the step loop, so a row
         # stops decoding at its EOS token instead of running up to a full
         # extra chunk before the writer thread's `stopped` flag is seen
@@ -259,6 +284,7 @@ class Batcher:
         retires it at the next chunk boundary."""
         import queue
 
+        req.t_enqueue_us = now_us()
         self.q.put(req)
         while True:
             try:
@@ -312,6 +338,14 @@ class Batcher:
     def _finish(self, req: _BatchReq, session, slots, row):
         import queue
 
+        if req.trace is not None:
+            # terminal event: errors land even for unsampled traces, so a
+            # failed request is always reconstructable from /debug/trace
+            req.trace.event(
+                "finish", now_us(), 0, ("tokens", "error"),
+                (req.n_out, 1 if req.error is not None else 0),
+                always=req.error is not None,
+            )
         if req.error is None and not req.prefilling and req.out_ids:
             # publish the retired row's conversation KV (prompt + generated)
             # into the prefix cache BEFORE parking it, so this user's next
@@ -365,10 +399,16 @@ class Batcher:
                     continue
                 req = backlog.popleft()
                 try:
+                    if req.trace is not None:
+                        nowu = now_us()
+                        t0 = req.t_enqueue_us or nowu
+                        req.trace.event(
+                            "queue_wait", t0, max(nowu - t0, 0), ("row",), (row,)
+                        )
                     key = self._key_for_seed(req.seed) if req.seed is not None else None
                     session.begin_admit(
                         row, req.ids, temperature=req.temperature,
-                        topp=req.topp, key_data=key,
+                        topp=req.topp, key_data=key, trace=req.trace,
                     )
                     req.prefilling = True
                     slots[row] = req
@@ -456,6 +496,7 @@ class Batcher:
             # co-tenant, tight headroom, or an all-empty draft round falls
             # back to the plain chunk, so draft-hostile traffic keeps the
             # chunked loop's throughput.
+            t_chunk = time.perf_counter()  # spans: draft + dispatch + fetch
             try:
                 # drafting runs INSIDE the failure scope: a model-backed
                 # draft source dispatches device work, and a wedged draft
@@ -506,9 +547,23 @@ class Batcher:
                 self.state.recover()
                 session = BatchSession(engine)
                 continue
+            chunk_dur_us = int((time.perf_counter() - t_chunk) * 1e6)
+            t_chunk_us = to_us(t_chunk)
             for row, req in enumerate(slots):
                 if req is None or req.prefilling or row not in per_row:
                     continue
+                # one span per row per chunk through the pre-bound emitters
+                # (a tuple append each; the chunk wall is shared — per-row
+                # attribution is the row's token count / acceptance)
+                if spec_drafts is not None:
+                    if req._em_spec is not None:
+                        req._em_spec(
+                            t_chunk_us, chunk_dur_us,
+                            len(spec_drafts.get(row) or ()),
+                            max(len(per_row[row]) - 1, 0),
+                        )
+                elif req._em_decode is not None:
+                    req._em_decode(t_chunk_us, chunk_dur_us, len(per_row[row]))
                 for t in per_row[row]:
                     req.n += 1
                     req.out_ids.append(t)
@@ -577,12 +632,15 @@ class ApiState:
                 "samples on-device); concurrent requests will queue"
             )
 
-    def complete_batched(self, params: dict, emit, client_visible: bool = True):
+    def complete_batched(self, params: dict, emit, client_visible: bool = True,
+                         trace=None):
         """One request's slice of a batched generation: encode, submit to the
         Batcher, stream deltas from this row's tokens as they arrive.
         Returns (full_text, n_prompt_tokens, n_completion_tokens).
         `client_visible=False` widens stall-retry eligibility exactly like
-        `complete` (see there)."""
+        `complete` (see there). `trace` (runtime/tracing.py Trace) threads
+        the request's span context through the Batcher and the session."""
+        t_req0 = now_us()
         tok = self.tokenizer
         items = [ChatItem(m["role"], m["content"]) for m in params["messages"]]
         prompt = self.template.generate(items, True)
@@ -613,6 +671,7 @@ class ApiState:
 
         req_box = []
         deltas_box = []
+        times_box = [[None, None]]  # [first_token_perf, last_token_perf]
 
         def make_req():
             """Fresh request + decode state + delta buffer (a stall retry
@@ -627,8 +686,14 @@ class ApiState:
             )
             deltas = []
             deltas_box[:] = [deltas]
+            times = [None, None]
+            times_box[:] = [times]
 
             def on_token(t):
+                nowp = time.perf_counter()  # TTFT/per-token histograms
+                if times[0] is None:
+                    times[0] = nowp
+                times[1] = nowp
                 piece = dec.decode(t)
                 eos_type = detector.append(t, piece)
                 if eos_type != EOS_MAYBE:
@@ -647,6 +712,7 @@ class ApiState:
                 params.get("seed"),
                 on_token,
                 eos_ids=frozenset(tok.eos_token_ids),
+                trace=trace,
             )
             req_box[:] = [req]
             return req
@@ -675,9 +741,22 @@ class ApiState:
         # included) — req.n also counts post-stop overrun decoded before the
         # step loop noticed, which must not inflate usage accounting
         self.engine.stats.incr("requests_completed")
+        times = times_box[0]
+        if times[0] is not None:
+            # per-request latency histograms: TTFT from request arrival to
+            # the first delivered token (queue wait included — the client's
+            # view), per-output-token from the delivery span
+            self.engine.stats.observe(
+                "ttft_ms", max((to_us(times[0]) - t_req0) / 1e3, 0.0)
+            )
+            if req.n_out > 1:
+                self.engine.stats.observe(
+                    "tpot_ms", (times[1] - times[0]) * 1e3 / (req.n_out - 1)
+                )
         return "".join(base + deltas_box[0]), len(ids), req.n_out
 
-    def complete(self, params: dict, emit, client_visible: bool = True):
+    def complete(self, params: dict, emit, client_visible: bool = True,
+                 trace=None):
         """Run one completion; calls emit(delta_text) per safe-to-send chunk.
         Returns (full_text, n_prompt_tokens, n_completion_tokens).
 
@@ -696,7 +775,7 @@ class ApiState:
             emit(delta)
 
         try:
-            return self._complete_once(params, traced_emit)
+            return self._complete_once(params, traced_emit, trace=trace)
         except StallError:
             # _complete_once's failure path already ran recover() (engine
             # reset + prefix cache dropped), so the retry starts clean and
@@ -706,9 +785,9 @@ class ApiState:
             if emitted[0] and client_visible:
                 raise
             self.engine.stats.incr("stall_retries")
-            return self._complete_once(params, traced_emit)
+            return self._complete_once(params, traced_emit, trace=trace)
 
-    def _complete_once(self, params: dict, emit):
+    def _complete_once(self, params: dict, emit, trace=None):
         engine, tok = self.engine, self.tokenizer
         messages = params["messages"]
         # full-prompt serving over the radix prefix cache: every request
@@ -771,6 +850,9 @@ class ApiState:
                 state["stop"] = True
 
         try:
+            # the engine emits this request's prefill/decode/spec spans
+            # through its trace context for the duration of the generate
+            engine.trace = trace
             res = engine.generate(
                 ids, max_pred, sampler=self.sampler, pos_start=0,
                 on_token=on_token, stop_fn=lambda t: state["stop"],
@@ -786,12 +868,22 @@ class ApiState:
             # computation) so the next request starts clean
             self.recover()
             raise
+        finally:
+            engine.trace = None
         # the engine published this conversation's KV into the prefix trie
         # itself (generate's post-decode publish); keep the NaiveCache-era
         # miss signal as a counter for dashboards that tracked it
         if engine.prefix_cache is not None and engine.last_prefix_hit_tokens == 0:
             engine.stats.incr("cache_miss")
         engine.stats.incr("requests_completed")
+        # per-request latency histograms (the serialized path's twin of the
+        # Batcher observes: GenerationResult already carries the walls)
+        engine.stats.observe("ttft_ms", res.ttft_us / 1e3)
+        if res.n_pred_tokens > 1:
+            engine.stats.observe(
+                "tpot_ms",
+                (res.total_us - res.ttft_us) / (res.n_pred_tokens - 1) / 1e3,
+            )
         text = "".join(buffer)
         return text, len(ids), res.n_pred_tokens
 
@@ -802,6 +894,11 @@ class ApiState:
         The prefix cache is cleared too: entries extracted near the failure
         may hold poisoned/unfinished KV, and a silent splice of one would
         corrupt a future request."""
+        # post-mortem FIRST: the trace ring still holds the failed
+        # request's spans and whatever engine events led up to the failure
+        flight_record(
+            "api.recover", counters=self.engine.stats.counters_snapshot()
+        )
         if self.engine.prefix_cache is not None:
             self.engine.prefix_cache.clear()
         try:
@@ -817,11 +914,49 @@ class ApiState:
 class Handler(BaseHTTPRequestHandler):
     state: ApiState = None  # set by serve()
     protocol_version = "HTTP/1.1"
+    _trace = None  # per-request Trace (do_POST); _json echoes its id
 
     def log_message(self, fmt, *args):
         pass
 
+    def _query_params(self) -> dict:
+        return parse_query(self.path.partition("?")[2])
+
     def do_GET(self):
+        route = self.path.partition("?")[0]
+        if route == "/metrics":
+            # Prometheus text exposition: every StepStats counter/gauge/
+            # percentile series plus the TTFT / per-output-token histograms,
+            # with Batcher occupancy and prefix-cache occupancy as gauges
+            st = self.state
+            extra = {}
+            if st.batcher is not None:
+                for k, v in st.batcher.stats().items():
+                    extra[f"batcher_{k}"] = v
+            pc = st.engine.prefix_cache
+            if pc is not None:
+                snap = pc.stats_snapshot()
+                for k in ("entries", "bytes", "budget_bytes", "pinned"):
+                    if k in snap:
+                        extra[f"prefix_cache_{k}"] = snap[k]
+            body = render_step_stats(st.engine.stats, extra_gauges=extra)
+            self._respond(200, body.encode(), ctype=PROM_CONTENT_TYPE)
+            return
+        if route == "/debug/trace":
+            tid = self._query_params().get("id", "")
+            events = TRACER.for_trace(tid) if tid else []
+            if not events:
+                self._json(404, b'{"error":"unknown or expired trace id"}')
+                return
+            self._json(200, json.dumps(trace_payload(tid, events)).encode())
+            return
+        if route == "/debug/flightrecord":
+            rec = last_flight_record()
+            if rec is None:
+                self._json(404, b'{"error":"no flight record yet"}')
+                return
+            self._json(200, json.dumps(rec).encode())
+            return
         if self.path == "/v1/models":
             body = json.dumps(
                 {
@@ -884,8 +1019,32 @@ class Handler(BaseHTTPRequestHandler):
             self._json(400, b'{"error":"messages required"}')
             return
 
+        # request-lifecycle trace: adopt the gateway's X-DLT-Trace-Id (one
+        # joinable identity across gateway -> retry -> backend) — and its
+        # X-DLT-Trace-Sampled decision, so the 1-in-N trace the gateway
+        # chose to keep gets its backend detail spans too — or mint one
+        # for direct traffic; every response echoes it (_json/start_stream)
+        tr = TRACER.start(
+            self.headers.get(TRACE_HEADER),
+            sampled=parse_sampled(self.headers.get(SAMPLED_HEADER)),
+        )
+        self._trace = tr
+        t_req0 = now_us()
+
         stream = bool(params.get("stream", False))
+        try:
+            self._serve_chat(params, stream)
+        finally:
+            # terminal request span: always recorded (one event/request) so
+            # /debug/trace reconstructs even unsampled or failed requests
+            tr.event(
+                "request", t_req0, now_us() - t_req0, ("path", "status"),
+                (self.path, getattr(self, "_last_status", 200)), always=True,
+            )
+
+    def _serve_chat(self, params, stream):
         st = self.state
+        tr = self._trace
         # batch mode: the Batcher serializes engine access and groups
         # concurrent requests into one generation — no global lock, so
         # handler threads can actually arrive concurrently
@@ -907,6 +1066,8 @@ class Handler(BaseHTTPRequestHandler):
                         self.send_response(200)
                         self.send_header("Content-Type", "text/event-stream")
                         self.send_header("Connection", "close")
+                        if tr is not None:
+                            self.send_header(TRACE_HEADER, tr.id)
                         self.end_headers()
                         started[0] = True
 
@@ -922,7 +1083,9 @@ class Handler(BaseHTTPRequestHandler):
                         raise ClientDisconnected(str(e)) from e
 
                 try:
-                    text, n_prompt, n_completion = complete_fn(params, emit)
+                    text, n_prompt, n_completion = complete_fn(
+                        params, emit, trace=tr
+                    )
                 except PromptTooLong as e:
                     if not started[0]:
                         self._json(400, json.dumps({"error": str(e)}).encode())
@@ -961,7 +1124,7 @@ class Handler(BaseHTTPRequestHandler):
                     # from the return value only — a stall retry can never
                     # duplicate client-visible bytes
                     text, n_prompt, n_completion = complete_fn(
-                        params, lambda d: None, client_visible=False
+                        params, lambda d: None, client_visible=False, trace=tr
                     )
                 except PromptTooLong as e:
                     self._json(400, json.dumps({"error": str(e)}).encode())
@@ -998,10 +1161,22 @@ class Handler(BaseHTTPRequestHandler):
                 ).encode()
                 self._json(200, body)
 
-    def _json(self, code: int, body: bytes, headers: dict | None = None):
+    def send_response(self, code, message=None):
+        self._last_status = code  # the terminal request span reads this
+        super().send_response(code, message)
+
+    def _respond(
+        self, code: int, body: bytes,
+        ctype: str = "application/json; charset=utf-8",
+        headers: dict | None = None,
+    ):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self._trace is not None:
+            # echo the request's trace id on every response, so a client
+            # (or the gateway in front) can join its logs to /debug/trace
+            self.send_header(TRACE_HEADER, self._trace.id)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         # close after every response (reference: dllama-api.cpp:202-235):
@@ -1011,6 +1186,9 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
         self.close_connection = True
+
+    def _json(self, code: int, body: bytes, headers: dict | None = None):
+        self._respond(code, body, headers=headers)
 
 
 def serve(args) -> HTTPServer:

@@ -48,6 +48,26 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+# stdlib-only import: runtime/__init__ lazies its engine exports, so the
+# gateway stays runnable on a box with no jax installed
+from ..runtime.tracing import (
+    Hist,
+    PROM_CONTENT_TYPE,
+    SAMPLED_HEADER,
+    TRACE_HEADER,
+    TRACER,
+    last_flight_record,
+    now_us,
+    parse_sampled,
+    prom_line,
+    render_counters,
+    render_gauges,
+    render_hist,
+    to_us,
+    trace_payload,
+)
+from . import parse_query
+
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half_open"
@@ -138,6 +158,9 @@ class Balancer:
         # requests into 429 timeouts while latecomers sail through)
         self._queue: list[int] = []
         self._next_ticket = 0
+        # per-request gateway wall-time histogram (cumulative log buckets;
+        # the /metrics twin of the backend's TTFT/per-token histograms)
+        self.request_ms = Hist()
         # gateway-level counters (under the lock)
         self.counters = {
             "requests": 0,
@@ -525,14 +548,42 @@ def _request_line(request: bytes) -> tuple[str, str]:
         return "", ""
 
 
+def _header_value(request: bytes, name: bytes) -> str | None:
+    """Case-insensitive header lookup in raw request bytes."""
+    head = request.split(b"\r\n\r\n", 1)[0]
+    needle = name.lower() + b":"
+    for line in head.split(b"\r\n")[1:]:
+        if line.lower().startswith(needle):
+            return line.split(b":", 1)[1].strip().decode("latin-1")
+    return None
+
+
+def _with_trace_header(request: bytes, trace_id: str, sampled: bool) -> bytes:
+    """Inject (or replace) the X-DLT-Trace-Id and X-DLT-Trace-Sampled
+    headers in raw request bytes, so the backend sees the SAME id — and the
+    SAME sampling decision — across the gateway's transparent retries: one
+    coherently-sampled trace stitches gateway -> retry -> backend
+    together (the two processes' 1-in-N counters are never in phase)."""
+    head, _, rest = request.partition(b"\r\n\r\n")
+    lines = [
+        l for l in head.split(b"\r\n")
+        if not l.lower().startswith((b"x-dlt-trace-id:", b"x-dlt-trace-sampled:"))
+    ]
+    lines.insert(1, f"{SAMPLED_HEADER}: {int(sampled)}".encode())
+    lines.insert(1, f"{TRACE_HEADER}: {trace_id}".encode())
+    return b"\r\n".join(lines) + b"\r\n\r\n" + rest
+
+
 def _plain_response(
-    sock: socket.socket, code: int, text: str, body: str, headers: dict | None = None
+    sock: socket.socket, code: int, text: str, body: str,
+    headers: dict | None = None,
+    ctype: str = "application/json; charset=utf-8",
 ):
     payload = body.encode()
     extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
     resp = (
         f"HTTP/1.1 {code} {text}\r\n"
-        "Content-Type: application/json; charset=utf-8\r\n"
+        f"Content-Type: {ctype}\r\n"
         "Connection: close\r\n"
         f"{extra}"
         f"Content-Length: {len(payload)}\r\n\r\n"
@@ -543,17 +594,77 @@ def _plain_response(
         pass
 
 
+def render_gateway_metrics(balancer: Balancer) -> str:
+    """The gateway's ``GET /metrics`` body: Prometheus text exposition of
+    the balancer counters, queue depth, per-backend breaker/inflight state,
+    and the per-request wall-time histogram."""
+    s = balancer.stats()
+    lines: list = []
+    render_counters(lines, s["counters"], prefix="dlt_gateway")
+    render_gauges(lines, {"queue_depth": s["queue_depth"]}, prefix="dlt_gateway")
+    gauge_cols = (("inflight", "inflight"), ("draining", "draining"))
+    for metric, col in gauge_cols:
+        m = f"dlt_gateway_backend_{metric}"
+        lines.append(f"# TYPE {m} gauge")
+        for b in s["backends"]:
+            lines.append(prom_line(m, {"backend": b["backend"]}, int(b[col])))
+    m = "dlt_gateway_backend_breaker_open"
+    lines.append(f"# TYPE {m} gauge")
+    for b in s["backends"]:
+        lines.append(
+            prom_line(
+                m, {"backend": b["backend"]},
+                int(b["breaker"] == BREAKER_OPEN),
+            )
+        )
+    counter_cols = (
+        "served", "failures", "retries_away", "breaker_opens",
+        "probes_ok", "probes_failed",
+    )
+    for col in counter_cols:
+        m = f"dlt_gateway_backend_{col}_total"
+        lines.append(f"# TYPE {m} counter")
+        for b in s["backends"]:
+            lines.append(prom_line(m, {"backend": b["backend"]}, b[col]))
+    render_hist(lines, "dlt_gateway_request_ms", balancer.request_ms.snapshot())
+    return "\n".join(lines) + "\n"
+
+
 def _handle_control(client: socket.socket, balancer: Balancer, method: str, path: str):
-    """The gateway's own control endpoints (never proxied)."""
+    """The gateway's own control + observability endpoints (never proxied;
+    scrape backends' /metrics directly for engine-side numbers)."""
     route, _, query = path.partition("?")
     if route == "/gateway/stats" and method == "GET":
         _plain_response(client, 200, "OK", json.dumps(balancer.stats()))
         return
-    if route in ("/gateway/drain", "/gateway/undrain") and method == "POST":
-        params = dict(
-            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+    if route == "/metrics" and method == "GET":
+        _plain_response(
+            client, 200, "OK", render_gateway_metrics(balancer),
+            ctype=PROM_CONTENT_TYPE,
         )
-        key = params.get("backend", "")
+        return
+    if route == "/debug/trace" and method == "GET":
+        tid = parse_query(query).get("id", "")
+        events = TRACER.for_trace(tid) if tid else []
+        if not events:
+            _plain_response(
+                client, 404, "Not Found",
+                '{"error":"unknown or expired trace id"}',
+            )
+            return
+        _plain_response(client, 200, "OK", json.dumps(trace_payload(tid, events)))
+        return
+    if route == "/debug/flightrecord" and method == "GET":
+        rec = last_flight_record()
+        if rec is None:
+            _plain_response(
+                client, 404, "Not Found", '{"error":"no flight record yet"}'
+            )
+            return
+        _plain_response(client, 200, "OK", json.dumps(rec))
+        return
+    if route in ("/gateway/drain", "/gateway/undrain") and method == "POST":
+        key = parse_query(query).get("backend", "")
         draining = route == "/gateway/drain"
         if balancer.set_draining(key, draining):
             _plain_response(
@@ -603,18 +714,38 @@ def _proxy_once(client, request, b: Backend, config) -> tuple[bool, bool, bool]:
 def handle_client(client: socket.socket, balancer: Balancer):
     config = balancer.config
     held = -1  # acquired-but-unreleased backend (crash safety net)
+    tr = None
+    t_req0 = 0
+    path = ""
+    outcome = "client_gone"  # overwritten on every terminal path below
     try:
         request = _read_http_request(client)
         if not request:
             return
         method, path = _request_line(request)
-        if path.startswith("/gateway/"):
+        route = path.partition("?")[0]
+        if route.startswith("/gateway/") or route.startswith("/debug/") or route == "/metrics":
             _handle_control(client, balancer, method, path)
             return
+        # request-lifecycle trace: adopt the client's X-DLT-Trace-Id or
+        # mint one; the SAME id rides every retried attempt (injected into
+        # the forwarded bytes), so one trace stitches gateway -> retry ->
+        # backend. The backend echoes the header to the client through the
+        # transparent stream.
+        tr = TRACER.start(
+            _header_value(request, b"x-dlt-trace-id"),
+            sampled=parse_sampled(_header_value(request, b"x-dlt-trace-sampled")),
+        )
+        request = _with_trace_header(request, tr.id, tr.sampled)
+        hdrs = {TRACE_HEADER: tr.id}
+        t_req0 = now_us()
         balancer.count("requests")
         tried: set[int] = set()
+        attempt = 0
         while True:
+            t_acq = time.perf_counter()
             idx = balancer.acquire(exclude=tried)
+            acq_us = int((time.perf_counter() - t_acq) * 1e6)
             held = idx if idx >= 0 else -1
             if idx < 0 and tried:
                 # this request already failed zero-byte on some backend and
@@ -622,59 +753,95 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 # open, or full): the original failure is the honest signal
                 # — 502, not a shed/busy code that would misattribute it
                 balancer.count("bad_gateway_502")
+                outcome = "502"
                 _plain_response(
-                    client, 502, "Bad Gateway", '{"error":"backend failure"}'
+                    client, 502, "Bad Gateway", '{"error":"backend failure"}',
+                    headers=hdrs,
                 )
                 return
             if idx == Balancer.SHED:
                 balancer.count("shed_503")
+                outcome = "503"
                 retry_after = max(1, math.ceil(balancer.retry_after_hint_s()))
                 _plain_response(
                     client, 503, "Service Unavailable",
                     '{"error":"no healthy backend"}',
-                    headers={"Retry-After": str(retry_after)},
+                    headers={"Retry-After": str(retry_after), **hdrs},
                 )
                 return
             if idx < 0:
                 balancer.count("rejected_429")
+                outcome = "429"
                 _plain_response(
                     client, 429, "Too Many Requests",
                     '{"error":"all backends busy"}',
+                    headers=hdrs,
                 )
                 return
             b = config.backends[idx]
+            attempt += 1
+            tr.event(
+                "gw_acquire", to_us(t_acq), acq_us,
+                ("backend", "attempt"), (b.key, attempt),
+            )
+            t_att = time.perf_counter()
             failed, forwarded, client_gone = _proxy_once(client, request, b, config)
+            tr.event(
+                "gw_attempt", to_us(t_att),
+                int((time.perf_counter() - t_att) * 1e6),
+                ("backend", "attempt", "failed", "forwarded"),
+                (b.key, attempt, int(failed), int(forwarded)),
+                always=failed,  # failed attempts land even when unsampled
+            )
             balancer.release(idx, mark_unhealthy=failed)
             held = -1
             if client_gone:
+                outcome = "client_gone"
                 return
             if not failed:
                 balancer.count("proxied_ok")
+                outcome = "ok"
                 return
             if forwarded:
                 # mid-stream failure: appending a second status line to a
                 # partially streamed response would corrupt the client's
                 # stream; EOF is the only honest signal left — no retry
                 balancer.count("midstream_failures")
+                outcome = "midstream_eof"
                 return
             # zero bytes reached the client: transparently retry on a
             # DIFFERENT backend (bounded; the failed one is excluded)
             tried.add(idx)
             if len(tried) > config.retry_attempts:
                 balancer.count("bad_gateway_502")
+                outcome = "502"
                 _plain_response(
-                    client, 502, "Bad Gateway", '{"error":"backend failure"}'
+                    client, 502, "Bad Gateway", '{"error":"backend failure"}',
+                    headers=hdrs,
                 )
                 return
             with balancer.lock:
                 b.n_retries_away += 1
             balancer.count("zero_byte_retries")
+            tr.event(
+                "gw_retry", now_us(), 0,
+                ("attempt", "from_backend"), (attempt, b.key),
+                always=True,
+            )
     finally:
         if held >= 0:
             # an unexpected exception escaped between acquire and release:
             # give the slot back (a leak here would silently and permanently
             # remove the backend from rotation once it eats the inflight cap)
             balancer.release(held, mark_unhealthy=False)
+        if tr is not None:
+            dur_us = now_us() - t_req0
+            # terminal span: non-ok outcomes land even when unsampled
+            tr.event(
+                "gw_request", t_req0, dur_us, ("path", "outcome"),
+                (path, outcome), always=outcome not in ("ok", "client_gone"),
+            )
+            balancer.request_ms.observe(dur_us / 1e3)
         try:
             client.close()
         except OSError:

@@ -12,6 +12,10 @@
 #   5. speculative suite    (draft sources, greedy verify identity at
 #                            engine/batch/session/HTTP levels, verify
 #                            buckets on the warm ladder)
+#   6. tracing suite        (trace ring/sampling, span trees, Prometheus
+#                            exposition format, /debug/trace + /metrics on
+#                            a live server, flight recorder, zero-host-sync
+#                            contract with tracing on)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -33,6 +37,9 @@ python -m pytest tests/test_prefix_cache.py -q -p no:cacheprovider
 
 echo "== speculative suite =="
 python -m pytest tests/test_speculative.py -q -p no:cacheprovider
+
+echo "== tracing suite =="
+python -m pytest tests/test_tracing.py -q -p no:cacheprovider
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

@@ -79,6 +79,25 @@ def test_violation_is_counted_and_reraised():
     assert stats.counters_snapshot()["sanitizer_d2h_violations"] == 1
 
 
+def test_nested_scopes_count_and_flight_record_one_violation(monkeypatch):
+    """Guard scopes nest (session step around verify's engine scope): one
+    breach unwinding N levels must produce ONE violation count and ONE
+    flight-recorder snapshot, not N."""
+    from distributed_llama_tpu.runtime import tracing
+
+    monkeypatch.setenv("DLT_FLIGHTREC_DIR", "")  # memory-only for the test
+    stats = StepStats()
+    err = RuntimeError("Disallowed device-to-host transfer: 16 bytes")
+    n_before = tracing.FLIGHT._n
+    with pytest.raises(RuntimeError):
+        with hsg.host_sync_guard(stats):
+            with hsg.host_sync_guard(stats):
+                with hsg.host_sync_guard(stats):
+                    raise err
+    assert stats.counters_snapshot()["sanitizer_d2h_violations"] == 1
+    assert tracing.FLIGHT._n == n_before + 1
+
+
 def test_sanctioned_fetch_counts_into_stats():
     stats = StepStats()
     with hsg.sanctioned_fetch(stats):

@@ -74,6 +74,31 @@ def test_host_sync_scoped_to_hot_packages():
     assert _rules(src, "server/x.py") == []  # server is not a hot package
 
 
+def test_trace_hot_emit_scoped_to_hot_packages():
+    """Per-iteration span emission in runtime loops must ride a pre-bound
+    emitter (runtime/tracing.py Emitter): `.event(...)` in a loop body —
+    or a dict literal in any emit call — is flagged; the bound-emitter
+    idiom and cold-path `.event(...)` calls pass."""
+    in_loop = "for i in range(8):\n    tr.event('decode', 1, 2)\n"
+    assert _rules(in_loop) == ["trace-hot-emit"]
+    while_loop = "while go:\n    TRACER.event('x', 1)\n"
+    assert _rules(while_loop) == ["trace-hot-emit"]
+    # the sanctioned idiom: bind outside, tuple-append inside
+    bound = "em = tr.bind('decode', ('n',))\nfor i in range(8):\n    em(1, 2, i)\n"
+    assert _rules(bound) == []
+    # cold-path (non-loop) events are fine
+    cold = "tr.event('request', 1, 2)\n"
+    assert _rules(cold) == []
+    # dict construction in an emit call is flagged even outside loops
+    dict_arg = "tr.event('x', 1, 2, {'a': 1})\n"
+    assert _rules(dict_arg) == ["trace-hot-emit"]
+    # server is not a hot package — the Batcher's cold-path loop emits pass
+    assert _rules(in_loop, "server/x.py") == []
+    # non-trace receivers named `event` are not span emits
+    other = "for i in range(8):\n    bus.event('x')\n"
+    assert _rules(other) == []
+
+
 def test_pragma_suppresses_same_line_and_line_above():
     same = "try:\n    x = 1\nexcept Exception:  # dlt: allow(swallowed-exception) — reason\n    pass\n"
     assert _rules(same) == []

@@ -47,8 +47,9 @@ def free_port():
 
 def _mk_stub(tag: str):
     """A canned-completion backend: /health + /v1/chat/completions, counting
-    requests per path so tests can see which backend served."""
-    counts = {"health": 0, "chat": 0}
+    requests per path so tests can see which backend served. Echoes (and
+    records) the gateway-injected X-DLT-Trace-Id, like the real API server."""
+    counts = {"health": 0, "chat": 0, "traces": []}
 
     class Stub(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -58,6 +59,12 @@ def _mk_stub(tag: str):
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            tid = self.headers.get("X-DLT-Trace-Id")
+            if tid:
+                counts["traces"].append(
+                    (tid, self.headers.get("X-DLT-Trace-Sampled"))
+                )
+                self.send_header("X-DLT-Trace-Id", tid)
             self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(body)
@@ -666,3 +673,131 @@ def test_headroom_exhausted_row_finishes_cleanly(tmp_path_factory):
     assert 1 <= len(edge_toks) <= 2  # got its one fitting token, then parked
     assert cobatched.error is None, "co-batched request must be unaffected"
     assert len(long_toks) == 20
+
+
+# ---- request-lifecycle tracing satellites --------------------------------
+
+
+def test_one_trace_stitches_gateway_retry_backend(stack_factory):
+    """Trace-ID propagation across the transparent retry: the retried
+    attempt carries the SAME X-DLT-Trace-Id (attempt=2 span on the same
+    trace), the backend that finally served saw that id on the wire, and
+    the client's response echoes it — one trace stitches
+    gateway -> retry -> backend together."""
+    from distributed_llama_tpu.server.chaos import Fault, FaultPlan, REFUSE
+
+    st = stack_factory(plans={0: FaultPlan(default=Fault(REFUSE))})
+    tid = "feedbeefcafe0001"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{st.gw}/v1/chat/completions",
+        data=json.dumps(PAYLOAD).encode(),
+        headers={"Content-Type": "application/json", "X-DLT-Trace-Id": tid},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        data = json.loads(r.read())
+        echoed = r.headers.get("X-DLT-Trace-Id")
+    assert data["choices"][0]["message"]["content"] == "reply-from-1"
+    # the surviving backend echoed the id through the transparent stream
+    assert echoed == tid
+    # the backend that served saw the SAME id on the wire (retry included),
+    # with the gateway's sampling decision riding alongside it
+    assert (tid, "1") in st.counts[1]["traces"]
+    assert st.counts[0]["chat"] == 0  # the faulty one never served
+    # the gateway's trace reconstructs the retry: attempt=1 failed on one
+    # backend, attempt=2 (or a later retry) succeeded on the other
+    with _get(st.gw, f"/debug/trace?id={tid}") as r:
+        payload = json.loads(r.read())
+    attempts = [
+        e["args"] for e in payload["events"] if e["name"] == "gw_attempt"
+    ]
+    assert len(attempts) >= 2, attempts
+    assert attempts[0]["failed"] == 1 and attempts[0]["attempt"] == 1
+    ok = [a for a in attempts if a["failed"] == 0]
+    assert ok and ok[-1]["attempt"] >= 2
+    assert any(e["name"] == "gw_retry" for e in payload["events"])
+    # the terminal span closed the trace with the ok outcome
+    req_span = next(e for e in payload["events"] if e["name"] == "gw_request")
+    assert req_span["args"]["outcome"] == "ok"
+
+
+def test_gateway_metrics_endpoint(stack_factory):
+    """The gateway's GET /metrics is valid Prometheus text exposition with
+    per-backend breaker/inflight series and the request-wall histogram."""
+    from test_tracing import assert_valid_prometheus
+
+    st = stack_factory()
+    with _post(st.gw) as r:
+        json.loads(r.read())
+    with _get(st.gw, "/metrics") as r:
+        assert r.headers.get("Content-Type", "").startswith("text/plain")
+        body = r.read().decode()
+    assert_valid_prometheus(body)
+    assert "dlt_gateway_requests_total" in body
+    assert "dlt_gateway_backend_breaker_open" in body
+    assert "dlt_gateway_request_ms_bucket" in body
+
+
+def test_stall_produces_flight_record_with_request_spans(
+    batched_server, monkeypatch
+):
+    """The flight-recorder acceptance: a watchdog stall mid-request through
+    a live server produces a post-mortem dump (served by
+    /debug/flightrecord) containing the stalled request's admission
+    prefill-chunk spans and the watchdog event."""
+    from distributed_llama_tpu.runtime import tracing
+    from distributed_llama_tpu.runtime.batch_session import BatchSession
+    from distributed_llama_tpu.runtime.telemetry import watchdog
+
+    httpd, port = batched_server
+    # warm the server's program ladder FIRST (one untimed request): the
+    # stall envs below apply process-wide, so a cold first-shape compile
+    # on the shared server would trip the 60 ms hard timeout for real and
+    # make this test order-dependent on whoever compiled those shapes
+    warm = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(PAYLOAD).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(warm, timeout=120) as r:
+        r.read()
+    # a real watchdog timeout: the guarded "device call" sleeps past the
+    # hard deadline, so the genuine StallError path runs — the watchdog
+    # event, the flight-record snapshot, then the raise into the Batcher
+    monkeypatch.setenv("DLT_STALL_LOG_MS", "20")
+    monkeypatch.setenv("DLT_STALL_TIMEOUT_MS", "60")
+    monkeypatch.setenv("DLT_FLIGHTREC_DIR", "")  # memory-only for the test
+    boom = {"armed": True}
+    orig_step = BatchSession.step
+    logs = []
+
+    def stalling_step(self, n):
+        if boom["armed"]:
+            boom["armed"] = False
+            with watchdog("decode chunk (chaos)", log_fn=logs.append):
+                time.sleep(0.2)
+        return orig_step(self, n)
+
+    monkeypatch.setattr(BatchSession, "step", stalling_step)
+    tid = "feedbeefcafe0002"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(PAYLOAD).encode(),
+        headers={"Content-Type": "application/json", "X-DLT-Trace-Id": tid},
+    )
+    # the request still SUCCEEDS: StallError fails the first attempt, the
+    # Batcher recovers, and complete_batched retries in place
+    with urllib.request.urlopen(req, timeout=120) as r:
+        data = json.loads(r.read())
+    assert data["usage"]["completion_tokens"] > 0
+    with _get(port, "/debug/flightrecord") as r:
+        rec = json.loads(r.read())
+    assert rec["reason"].startswith(("stall:", "api.recover"))
+    names = [e["name"] for e in rec["events"]]
+    assert "watchdog_stall" in names, names
+    # the stalled request's own spans are in the dump: its admission
+    # prefill chunks carry its trace id
+    mine = [e for e in rec["events"] if e["trace_id"] == tid]
+    assert any(e["name"] == "prefill_chunk" for e in mine), [
+        e["name"] for e in mine
+    ]
+    assert any(e["name"] == "queue_wait" for e in mine)

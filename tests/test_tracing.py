@@ -1,0 +1,440 @@
+"""Request-lifecycle tracing tests: ring-buffer bounds, sampling, span
+trees, Chrome export, histograms, Prometheus text exposition (format
+asserted by a validator), the live-server trace/metrics endpoints, the
+flight recorder, and the sanitizer contract (tracing adds zero device→host
+syncs)."""
+
+import json
+import re
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_llama_tpu.runtime import tracing
+from distributed_llama_tpu.runtime.tracing import (
+    Hist,
+    TRACE_HEADER,
+    TRACER,
+    TraceRing,
+    Tracer,
+    chrome_trace,
+    flight_record,
+    render_step_stats,
+    trace_tree,
+)
+from distributed_llama_tpu.runtime.telemetry import StepStats
+
+
+# ---- ring buffer -----------------------------------------------------------
+
+
+def test_ring_buffer_bounds_memory_under_100k_events():
+    """The tentpole memory contract: a bounded ring never grows past its
+    capacity no matter how many events flow through it."""
+    ring = TraceRing(capacity=4096)
+    for i in range(100_000):
+        ring.append(("t", "e", i, 1, (), ()))
+    assert len(ring) == 4096
+    snap = ring.snapshot()
+    assert len(snap) == 4096
+    # and it kept the MOST RECENT events (post-mortem semantics)
+    assert snap[-1][2] == 99_999
+    assert snap[0][2] == 100_000 - 4096
+
+
+def test_ring_capacity_env_knob(monkeypatch):
+    monkeypatch.setenv("DLT_TRACE_RING", "64")
+    ring = TraceRing()
+    for i in range(1000):
+        ring.append((str(i),))
+    assert len(ring) == 64
+
+
+# ---- sampling --------------------------------------------------------------
+
+
+def test_sampling_knob_one_in_n(monkeypatch):
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "3")
+    t = Tracer(capacity=1024)
+    sampled = [t.start().sampled for _ in range(9)]
+    assert sum(sampled) == 3
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "0")
+    assert not any(t.start().sampled for _ in range(5))
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "1")
+    assert all(t.start().sampled for _ in range(5))
+
+
+def test_sampled_override_propagates_upstream_decision(monkeypatch):
+    """The X-DLT-Trace-Sampled hop contract: an explicit `sampled=` on
+    Tracer.start overrides the local 1-in-N decision, so the backend keeps
+    detail spans for exactly the traces the gateway chose to sample (the
+    two processes' counters are never in phase)."""
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "1000")
+    t = Tracer(capacity=64)
+    assert t.start(sampled=True).sampled is True
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "1")
+    assert t.start(sampled=False).sampled is False
+    # header wire format: absent = decide locally, "0" = the only falsy
+    assert tracing.parse_sampled(None) is None
+    assert tracing.parse_sampled("0") is False
+    assert tracing.parse_sampled("1") is True
+
+
+def test_unsampled_trace_records_always_events_only(monkeypatch):
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "0")
+    t = Tracer(capacity=1024)
+    tr = t.start("tid0")
+    assert tr.bind("hot") is None  # hot-loop guard covers sampling
+    tr.event("detail", tracing.now_us(), 1)
+    tr.event("error", tracing.now_us(), 1, always=True)
+    names = [e[1] for e in t.for_trace("tid0")]
+    assert names == ["error"]
+
+
+# ---- span tree + chrome export ---------------------------------------------
+
+
+def test_trace_tree_nests_by_interval_containment():
+    evs = [
+        ("t", "request", 100, 1000, ("path",), ("/x",)),
+        ("t", "prefill", 150, 300, (), ()),
+        ("t", "prefill_chunk", 160, 50, ("size",), (32,)),
+        ("t", "decode_chunk", 500, 100, ("n",), (8,)),
+    ]
+    tree = trace_tree(evs)
+    assert len(tree) == 1
+    root = tree[0]
+    assert root["name"] == "request"
+    kids = [c["name"] for c in root["children"]]
+    assert kids == ["prefill", "decode_chunk"]
+    assert root["children"][0]["children"][0]["name"] == "prefill_chunk"
+    assert root["children"][0]["children"][0]["args"] == {"size": 32}
+
+
+def test_chrome_trace_export_shape():
+    evs = [("t", "decode_chunk", 10, 20, ("n",), (8,))]
+    out = chrome_trace(evs)
+    assert out[0]["ph"] == "X"
+    assert out[0]["ts"] == 10 and out[0]["dur"] == 20
+    assert out[0]["args"] == {"n": 8}
+    json.dumps(out)  # chrome://tracing needs plain JSON
+
+
+# ---- histograms ------------------------------------------------------------
+
+
+def test_hist_cumulative_le_semantics():
+    h = Hist(bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5.0, 50.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # le semantics: a bucket counts observations <= its bound
+    assert snap["buckets"] == [[1.0, 2], [10.0, 3], [100.0, 4], ["+Inf", 5]]
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(556.5)
+    # cumulative counts are monotone — the scrape-to-scrape contract
+    cums = [c for _, c in snap["buckets"]]
+    assert cums == sorted(cums)
+
+
+def test_stepstats_observe_and_snapshot_backward_compat():
+    s = StepStats()
+    s.incr("requests_completed")
+    s.gauge("overlap_pct", 92.5)
+    s.record("decode[8]", 1500.0)
+    s.observe("ttft_ms", 12.0)
+    s.observe("ttft_ms", 900.0)
+    snap = s.snapshot()
+    # the pre-existing readers' keys are intact
+    assert snap["counters"]["requests_completed"] == 1
+    assert snap["gauges"]["overlap_pct"] == 92.5
+    assert snap["decode[8]"]["count"] == 1
+    # and the new reserved key carries the cumulative histograms
+    hist = snap["histograms"]["ttft_ms"]
+    assert hist["count"] == 2
+    assert hist["buckets"][-1] == ["+Inf", 2]
+
+
+# ---- Prometheus exposition -------------------------------------------------
+
+# one metric line: name{labels} value (labels optional)
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' [-+]?[0-9.eE+]+$'
+)
+
+
+def assert_valid_prometheus(body: str):
+    """Every non-comment line must parse as `name{labels} value`, and every
+    histogram's cumulative bucket counts must be monotone."""
+    hist_buckets: dict = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        if "_bucket{" in line:
+            name = line.split("{", 1)[0]
+            hist_buckets.setdefault(name, []).append(float(line.rsplit(" ", 1)[1]))
+    for name, cums in hist_buckets.items():
+        assert cums == sorted(cums), f"non-monotone histogram {name}: {cums}"
+
+
+def test_render_step_stats_is_valid_prometheus():
+    s = StepStats()
+    s.incr("requests_completed", 3)
+    s.incr("shed_503")
+    s.gauge("spec_acceptance_rate", 0.75)
+    for us in (900.0, 1500.0, 80_000.0):
+        s.record("decode[64]", us)
+    s.observe("ttft_ms", 45.0)
+    s.observe("tpot_ms", 2.5)
+    body = render_step_stats(s, extra_gauges={"batcher_queue_depth": 2})
+    assert_valid_prometheus(body)
+    assert "dlt_requests_completed_total 3" in body
+    assert "dlt_batcher_queue_depth 2" in body
+    assert 'dlt_step_latency_ms{kind="decode[64]",quantile="p95"}' in body
+    assert "dlt_ttft_ms_bucket" in body and "dlt_tpot_ms_sum" in body
+    assert 'dlt_ttft_ms_bucket{le="+Inf"} 1' in body
+
+
+def test_render_gateway_metrics_is_valid_prometheus():
+    from distributed_llama_tpu.server.gateway import (
+        Backend, Balancer, GatewayConfig, render_gateway_metrics,
+    )
+
+    b = Balancer(GatewayConfig(backends=[Backend("127.0.0.1", 9990)]))
+    b.count("requests", 2)
+    b.request_ms.observe(120.0)
+    body = render_gateway_metrics(b)
+    assert_valid_prometheus(body)
+    assert "dlt_gateway_requests_total 2" in body
+    assert 'dlt_gateway_backend_inflight{backend="127.0.0.1:9990"} 0' in body
+    assert "dlt_gateway_request_ms_bucket" in body
+
+
+# ---- flight recorder -------------------------------------------------------
+
+
+def test_flight_record_memory_and_disk(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLT_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setenv("DLT_FLIGHTREC_EVENTS", "100")
+    tracing.global_event("pre_crash_marker", keys=("k",), vals=("v",))
+    rec = flight_record("test-reason", counters={"stall_resets": 1})
+    assert rec["reason"] == "test-reason"
+    assert rec["counters"]["stall_resets"] == 1
+    names = [e["name"] for e in rec["events"]]
+    assert "pre_crash_marker" in names
+    assert len(rec["events"]) <= 100
+    # in memory for /debug/flightrecord
+    assert tracing.last_flight_record()["reason"] == "test-reason"
+    # and on disk for post-mortem after a process death
+    dumps = list(tmp_path.glob("flightrecord-*.json"))
+    assert len(dumps) == 1
+    on_disk = json.loads(dumps[0].read_text())
+    assert on_disk["reason"] == "test-reason"
+
+
+def test_flight_record_disk_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLT_FLIGHTREC_DIR", "")
+    rec = flight_record("no-disk")
+    assert "path" not in rec
+
+
+# ---- live server: trace endpoints + /metrics -------------------------------
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def traced_server(tmp_path_factory):
+    """A batched (batch=2) API server — the Batcher path exercises queue
+    wait, admission prefill chunks, and decode/spec rounds."""
+    import os
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import (
+        tiny_header, write_tiny_model, write_tiny_tokenizer,
+    )
+
+    os.environ["DLT_NO_WARMUP"] = "1"
+    d = tmp_path_factory.mktemp("tracing_srv")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=256,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    port = free_port()
+    args = p.parse_args(
+        [
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.0",
+            "--batch", "2", "--port", str(port),
+        ]
+    )
+    httpd = api_mod.serve(args)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    os.environ.pop("DLT_NO_WARMUP", None)
+    yield httpd, port
+    httpd.shutdown()
+
+
+def _post(port, payload, path="/v1/chat/completions", headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def _get(port, path, timeout=30):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout)
+
+
+PAYLOAD = {"messages": [{"role": "user", "content": "trace me please"}], "max_tokens": 8}
+
+
+def test_response_carries_trace_id_and_debug_trace_reconstructs(traced_server):
+    """The acceptance headline: a request returns an X-DLT-Trace-Id, and
+    /debug/trace?id=... reconstructs its span tree — queue wait, prefix
+    match, prefill chunks, decode/spec rounds — with monotonic timestamps
+    inside the request span."""
+    _, port = traced_server
+    with _post(port, PAYLOAD) as r:
+        tid = r.headers.get(TRACE_HEADER)
+        json.loads(r.read())
+    assert tid and re.fullmatch(r"[0-9a-f]{16}", tid), tid
+    with _get(port, f"/debug/trace?id={tid}") as r:
+        payload = json.loads(r.read())
+    assert payload["trace_id"] == tid
+    names = {e["name"] for e in payload["events"]}
+    assert "request" in names
+    assert "queue_wait" in names
+    assert "prefix_match" in names  # the server runs the prefix cache by default
+    assert "prefill_chunk" in names
+    assert names & {"decode_chunk", "spec_round"}, names
+    assert "finish" in names
+    # timestamps are monotonic & contained: every span starts within the
+    # request span and never ends after a later-starting sibling's world
+    req = next(e for e in payload["events"] if e["name"] == "request")
+    t0, t1 = req["t_us"], req["t_us"] + req["dur_us"]
+    for e in payload["events"]:
+        assert e["dur_us"] >= 0
+        assert t0 <= e["t_us"] <= t1 + 1000, (e, t0, t1)
+    # the TREE is the contract: the request span is a root enclosing the
+    # lifecycle spans (trace_tree sorts by start time, so the rendered
+    # tree's sibling order is the monotonic timeline)
+    roots = {n["name"] for n in payload["tree"]}
+    assert "request" in roots
+    # chrome://tracing export rides along
+    assert payload["chrome_trace"][0]["ph"] == "X"
+
+
+def test_client_supplied_trace_id_is_adopted_and_echoed(traced_server):
+    _, port = traced_server
+    tid = "cafe0123beef4567"
+    with _post(port, PAYLOAD, headers={TRACE_HEADER: tid}) as r:
+        assert r.headers.get(TRACE_HEADER) == tid
+        json.loads(r.read())
+    with _get(port, f"/debug/trace?id={tid}") as r:
+        payload = json.loads(r.read())
+    assert {e["name"] for e in payload["events"]} >= {"request", "finish"}
+
+
+def test_upstream_sampled_header_wins_over_local_sampling(
+    traced_server, monkeypatch
+):
+    """A gateway-sampled 1-in-N trace must keep its backend detail spans
+    even when the backend's own counter would skip it: the
+    X-DLT-Trace-Sampled header carries the first hop's decision."""
+    _, port = traced_server
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "1000")  # local draw ~never hits
+    tid = "cafe0123beef9999"
+    hdr = {TRACE_HEADER: tid, tracing.SAMPLED_HEADER: "1"}
+    with _post(port, PAYLOAD, headers=hdr) as r:
+        assert r.headers.get(TRACE_HEADER) == tid
+        json.loads(r.read())
+    with _get(port, f"/debug/trace?id={tid}") as r:
+        payload = json.loads(r.read())
+    names = {e["name"] for e in payload["events"]}
+    assert "prefill_chunk" in names, names  # detail spans, not just always-on
+    # and "0" suppresses detail even at full local sampling
+    monkeypatch.setenv("DLT_TRACE_SAMPLE", "1")
+    tid2 = "cafe0123beef0000"
+    with _post(port, PAYLOAD, headers={TRACE_HEADER: tid2, tracing.SAMPLED_HEADER: "0"}):
+        pass
+    with _get(port, f"/debug/trace?id={tid2}") as r:
+        payload = json.loads(r.read())
+    names2 = {e["name"] for e in payload["events"]}
+    assert "prefill_chunk" not in names2, names2
+    assert "request" in names2  # terminal events always land
+
+
+def test_debug_trace_unknown_id_is_404(traced_server):
+    _, port = traced_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/debug/trace?id=ffffffffffffffff")
+    assert ei.value.code == 404
+
+
+def test_metrics_endpoint_valid_prometheus_with_ttft_histogram(traced_server):
+    _, port = traced_server
+    with _post(port, PAYLOAD) as r:
+        json.loads(r.read())
+    with _get(port, "/metrics") as r:
+        assert r.headers.get("Content-Type", "").startswith("text/plain")
+        body = r.read().decode()
+    assert_valid_prometheus(body)
+    assert "dlt_ttft_ms_bucket" in body
+    assert "dlt_tpot_ms_bucket" in body
+    assert "dlt_requests_completed_total" in body
+    assert "dlt_batcher_queue_depth" in body
+
+
+# ---- sanitizer contract: tracing adds zero device->host syncs ---------------
+
+
+def test_tracing_is_clean_under_fatal_host_sync_guard(tmp_path, monkeypatch):
+    """Tracing must add ZERO host syncs to the hot loops: run a traced
+    generate under DLT_SANITIZERS_FATAL=1 (implicit device→host transfers
+    raise at the site) and assert spans were emitted with no violations."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    monkeypatch.setenv("DLT_SANITIZERS_FATAL", "1")
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+    h = tiny_header(dim=64, hidden_dim=128, n_layers=2, seq_len=128)
+    path = str(tmp_path / "m.m")
+    write_tiny_model(path, h, seed=5)
+    eng = InferenceEngine(
+        path, compute_dtype="float32", decode_chunk_size=8, prefix_cache_mb=8
+    )
+    t = Tracer(capacity=4096)
+    eng.trace = t.start()
+    tid = eng.trace.id
+    res = eng.generate(list(range(1, 20)), 48, sampler=None, on_token=lambda x: None)
+    eng.trace = None
+    assert res.n_pred_tokens > 0
+    names = {e[1] for e in t.for_trace(tid)}
+    assert "prefill_chunk" in names and "decode_chunk" in names
+    counters = eng.stats.counters_snapshot()
+    assert counters.get("sanitizer_d2h_violations", 0) == 0
