@@ -688,6 +688,12 @@ def main(argv=None) -> int:
         "--draft-k", type=int, default=8,
         help="draft budget for the audited verify ladder (8 = both buckets)",
     )
+    p.add_argument(
+        "--costs", action="store_true",
+        help="also build the warm-ladder cost/memory table "
+        "(runtime/profiling.py) and FAIL if any warm_plan() program is "
+        "missing an entry — the /debug/costs coverage contract",
+    )
     args = p.parse_args(argv)
 
     from ..runtime.engine import InferenceEngine
@@ -707,10 +713,27 @@ def main(argv=None) -> int:
         )
         try:
             reports = audit_engine(engine)
+            cost_issues: list = []
+            if args.costs:
+                # cost coverage is part of the audit when asked: a program
+                # kind that lands on the warm ladder without a cost-model
+                # entry (profiling.lower_entry can't build it) fails here,
+                # so /debug/costs can never silently drift from warm_plan()
+                from ..runtime.profiling import (
+                    build_cost_table,
+                    cost_problems,
+                    format_cost_table,
+                )
+
+                table = build_cost_table(engine)
+                print(format_cost_table(table))
+                cost_issues = cost_problems(engine, table)
+                for p_ in cost_issues:
+                    print(f"  ! cost coverage: {p_}")
         finally:
             engine.close()
     print(format_reports(reports))
-    return 0 if all(r.ok for r in reports) else 1
+    return 0 if all(r.ok for r in reports) and not cost_issues else 1
 
 
 if __name__ == "__main__":

@@ -23,11 +23,13 @@ comma-separate for several — the pragma documents WHY at the site):
   whole matmul chain (the graph auditor catches the traced result; this
   catches the source). Host-side precomputation (rope tables) carries a
   pragma;
-* **host-sync** — ``np.asarray`` / ``np.array`` / ``jax.device_get`` in
-  the hot packages (runtime/parallel): each is a potential blocking
-  device→host sync worth ~100 ms of tunnel round trip. The sanctioned
-  fetch sites carry pragmas — which doubles as the canonical list of
-  blessed host syncs the host_sync_guard sanitizer allows;
+* **host-sync** — ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+  ``<device>.memory_stats()`` in the hot packages (runtime/parallel): each
+  is a potential blocking device→host sync (or a runtime round trip) worth
+  ~100 ms of tunnel latency. The sanctioned fetch sites carry pragmas —
+  which doubles as the canonical list of blessed host syncs the
+  host_sync_guard sanitizer allows (``memory_stats`` is blessed only at
+  the cold-path HBM-ledger site, runtime/profiling.py);
 * **trace-hot-emit** — ``trace.event(...)`` / ``TRACER.event(...)`` inside
   a ``for``/``while`` loop body in the hot packages (runtime/parallel), or
   an emit call constructing a dict literal anywhere in them: per-iteration
@@ -226,6 +228,16 @@ class _Linter(ast.NodeVisitor):
                     f"{dotted}(...) in a hot package is a potential "
                     "blocking device->host sync — pragma the sanctioned "
                     "sites (see docs/ANALYSIS.md)",
+                )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "memory_stats"
+            ):
+                self._flag(
+                    "host-sync", node,
+                    ".memory_stats() in a hot package is a device-runtime "
+                    "round trip — only the cold-path HBM-ledger site "
+                    "(runtime/profiling.py) is sanctioned; pragma it",
                 )
         # trace-hot-emit: span emission discipline in hot packages —
         # per-iteration .event() calls re-tuple name/keys every time and

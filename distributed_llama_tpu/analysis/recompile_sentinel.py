@@ -32,6 +32,7 @@ runtime/engine.py).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from . import sanitizers_fatal
@@ -59,13 +60,17 @@ def _dispatch(event: str, *args, **kwargs):
     # Only when every subscriber is sealed is a compile a genuine breach
     # (and then it is reported to all, since it cannot be attributed).
     subs = list(_subscribers)
-    unsealed = [s for s in subs if not s.sealed]
+    # compile events fire on the thread that triggered the compile, so a
+    # sealed sentinel whose exempt() window covers THIS thread claims the
+    # event exactly like an unsealed (warming) one — co-resident sealed
+    # sentinels must not treat another engine's sanctioned build as a breach
+    claimants = [s for s in subs if not s.sealed or s.exempts_current_thread()]
     # a FATAL sentinel raises out of _on_compile — deliver the event to
     # every subscriber first (a breach must be counted by all of them, not
     # just the ones that happened to iterate before the raiser), then let
     # the first error propagate to the compiling call site
     err = None
-    for s in (unsealed if unsealed else subs):
+    for s in (claimants if claimants else subs):
         try:
             s._on_compile(event)
         except RecompileError as e:
@@ -112,6 +117,7 @@ class RecompileSentinel:
         self.post_seal_compiles = 0
         self._lock = threading.Lock()
         self._active = False
+        self._exempt_threads: set = set()  # thread ids inside exempt()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -145,11 +151,36 @@ class RecompileSentinel:
         with self._lock:
             self.sealed = False
 
+    @contextlib.contextmanager
+    def exempt(self):
+        """Thread-scoped sanctioned-compile window: compiles triggered by
+        the CURRENT thread count as warm (an intentional reconfiguration —
+        e.g. the lazy cost-table build's AOT compiles, runtime/profiling)
+        while the sentinel stays sealed and every OTHER thread keeps full
+        breach detection. Compile events fire on the compiling thread, so
+        attribution is exact — unlike unseal(), which forgives the whole
+        process for the window."""
+        tid = threading.get_ident()
+        with self._lock:
+            self._exempt_threads.add(tid)
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._exempt_threads.discard(tid)
+
+    def exempts_current_thread(self) -> bool:
+        with self._lock:
+            return threading.get_ident() in self._exempt_threads
+
     # -- event sink ---------------------------------------------------------
 
     def _on_compile(self, event: str):
         with self._lock:
-            if not self.sealed:
+            if (
+                not self.sealed
+                or threading.get_ident() in self._exempt_threads
+            ):
                 self.warm_compiles += 1
                 return
             self.post_seal_compiles += 1

@@ -461,6 +461,7 @@ class BatchSession:
                 f"max row end {max(ends)} (step n_steps={n_steps})"
             )
         kv_len = eng._kv_bucket(min(max(ends, default=1), self.seq_len))
+        t_chunk = time.perf_counter()
         # the sanitizer scope covers the Batcher's production decode path
         # exactly like the solo loops: the ONLY device->host syncs allowed
         # in here are the two _host_fetch calls below (DLT_SANITIZERS=1)
@@ -493,6 +494,12 @@ class BatchSession:
             # .copy(): the fetched view of a device array is READ-ONLY, and
             # admit writes rows into these between chunks
             self.keys = eng._host_fetch(keys).copy()
+        # whole-chunk wall (dispatch + fetch): the batched serving path's
+        # per-program series — /stats latency numbers and the roofline join
+        # (profiling.roofline_view) read it exactly like solo decode[n]
+        eng.stats.record(
+            f"batch_decode[{n_steps}]", (time.perf_counter() - t_chunk) * 1e6
+        )
         self.pos += n_steps
         # parked rows stay pinned at seq_len (a long-lived session must not
         # creep their positions toward int32 range)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -320,6 +321,17 @@ class InferenceEngine:
         # generate (bench.py reads it; mirrors last_prefill_timing)
         self.last_spec_timing: dict | None = None
         self._in_warmup = False
+        # engine lifetime anchor: the device-duty-cycle gauge (profiling
+        # .roofline_view) reports busy-time as a fraction of this span
+        self._t_start = time.perf_counter()
+        # warm-ladder cost table (runtime/profiling.py): per-program
+        # FLOP/byte analysis built from the SAME warm_plan() — None until
+        # warmup builds it (DLT_COST_TABLE=1), the server's post-warmup
+        # build runs, or a cold endpoint (/debug/costs) asks for it
+        self._cost_table = None
+        # serializes the lazy cost-table build: concurrent /debug/costs
+        # handler threads must not both pay the full-ladder AOT compile
+        self._cost_table_lock = threading.Lock()
         # opt-in runtime sanitizers (DLT_SANITIZERS=1, docs/ANALYSIS.md):
         # the recompile sentinel counts XLA compiles and, once warmup()
         # seals it, flags any post-warmup recompile (a warm-key-ladder
@@ -469,6 +481,33 @@ class InferenceEngine:
                     plan.append(("prefix_copy_row", P, P))
         return plan
 
+    def cost_table(self, build: bool = True):
+        """The warm-ladder cost table (runtime/profiling.py CostTable), or
+        None. ``build=True`` constructs the FULL-ladder table on first use
+        (AOT lower+compile of every warm_plan program — compile work, no
+        execution; a bench-built partial table is upgraded). The build runs
+        inside the sentinel's THREAD-scoped `exempt()` window: this
+        thread's compiles are sanctioned reconfiguration, never
+        post-warmup-recompile breaches, while concurrent serving threads
+        keep full breach detection — so a DLT_SANITIZERS_FATAL=1 server
+        can serve /debug/costs lazily without a process-wide blind spot."""
+        if build and (self._cost_table is None or self._cost_table.partial):
+            import contextlib
+
+            from .profiling import build_cost_table
+
+            with self._cost_table_lock:
+                if self._cost_table is None or self._cost_table.partial:
+                    ctx = (
+                        self.sentinel.exempt()
+                        if self.sentinel is not None
+                        else contextlib.nullcontext()
+                    )
+                    with ctx:
+                        table = build_cost_table(self)
+                    self._cost_table = table
+        return self._cost_table
+
     def _forward(self, tokens_arr, pos_start, logits_mode="last", kv_len=None):
         """Dispatch one forward step to the GSPMD jit or the shard_map
         pipeline depending on the mesh shape."""
@@ -569,6 +608,14 @@ class InferenceEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.clear()
             self.reset()
+            if os.environ.get("DLT_COST_TABLE") == "1":
+                # opt-in at-warmup cost-table build: the compiles land in
+                # the sentinel's warm window (it seals below) and dedupe
+                # against the ladder's own via DLT_COMPILE_CACHE. Default
+                # off — the table builds lazily on first /debug/costs (or
+                # the server's post-warmup build), keeping library warmups
+                # at their current cost.
+                self.cost_table()
         finally:
             self._in_warmup = False
         if self.sentinel is not None:

@@ -275,6 +275,8 @@ class PrefixCache:
 
     @property
     def total_bytes(self) -> int:
+        """Device bytes the published entries hold — also the HBM ledger's
+        ``prefix_cache`` component (runtime/profiling.py hbm_ledger)."""
         return self._bytes
 
     def stats_snapshot(self) -> dict:

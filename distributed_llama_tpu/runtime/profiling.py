@@ -1,0 +1,972 @@
+"""Device-performance observability: warm-ladder cost model, HBM ledger,
+live roofline/MFU gauges, and on-demand profiler capture.
+
+PR 6 made the *request* path observable (trace IDs, flight recorder,
+Prometheus); this module makes the *device* observable. Four pieces:
+
+* **Warm-ladder cost model** — every program `engine.warm_plan()` names is
+  traced AND lowered+compiled AOT (abstract params/cache, so nothing is
+  baked or duplicated): ``memory_analysis()`` supplies the per-dispatch
+  argument/output/temp/alias bytes, XLA's ``cost_analysis()`` rides along
+  raw, and the headline per-dispatch FLOPs / HBM bytes come from a
+  trip-count-aware census of the traced jaxpr (XLA counts every scan body
+  exactly once — measured — which would undercount a 64-step decode chunk
+  64x; see the census block below). One per-(kind, size, kv-bucket) table,
+  served at ``GET /debug/costs``, printed by ``graph_audit --costs``, and
+  audited for 100% ladder coverage — a new program kind that lands on the
+  warm ladder without a cost entry fails the audit, so the table can never
+  silently drift from the ladder.
+* **HBM ledger** — modeled per-component device-memory accounting (Q40
+  weights, rope tables, KV cache, prefix-cache entries, draft engine),
+  reconciled against ``device.memory_stats()`` where the backend provides
+  it (TPU/GPU; XLA:CPU returns None and the measured side is skipped).
+  Exported as ``dlt_hbm_bytes{component=...}`` gauges plus a headroom
+  gauge; growth of the measured-minus-modeled residual beyond
+  ``DLT_HBM_DRIFT_MB`` bumps the ``hbm_drift_events`` counter — a leak
+  detector for anything the model doesn't know about.
+* **Live roofline / MFU** — the cost table joined with the per-program
+  chunk walls StepStats already records (``decode[n]``,
+  ``batch_decode[n]``, ``spec_verify[k]``) yields achieved GB/s and
+  FLOP/s per program and the aggregate ``dlt_mfu`` /
+  ``dlt_bw_utilization`` / ``dlt_device_duty_cycle`` gauges on
+  ``/metrics`` — the bench's roofline arithmetic as a first-class live
+  metric. SLO attainment (``dlt_slo_ttft_attainment`` /
+  ``dlt_slo_tpot_attainment``) is derived from the PR 6 cumulative
+  TTFT/TPOT histograms against ``DLT_SLO_TTFT_MS`` / ``DLT_SLO_TPOT_MS``.
+* **On-demand capture** — ``GET /debug/profile?ms=...`` wraps
+  ``jax.profiler.trace`` around live serving for a bounded window
+  (single-flight; concurrent captures get 409) and returns the trace
+  directory + the perfetto ``.trace.json.gz`` path.
+
+Measurement honesty notes:
+
+* The joined walls are HOST chunk-boundary walls — the same numbers the
+  bench's roofline headline uses. In steady state a decode chunk's wall is
+  its device compute (the lookahead hides dispatch/fetch); when the tunnel
+  round trip dominates (tiny models), achieved GB/s is honestly *lower*
+  than the kernel rate, exactly as the bench reports it. Prefill
+  *dispatch* walls are asynchronous (the device runs behind them) and are
+  deliberately NOT joined.
+* Per-series joins use the **p50 of the recent window**, so warmup's
+  compile walls (which land in the same series) age out instead of
+  poisoning a mean, and the **shallowest kv-bucket** cost variant, a
+  conservative floor; the full per-bucket table is at ``/debug/costs``.
+* Everything here is cold-path: table building compiles (at warmup, or
+  lazily inside the sentinel's thread-scoped ``exempt()`` window), but scrapes
+  (`metrics_view`) read host-side metadata only — no device dispatch, no
+  device→host array transfer, so the sanitizer contract is untouched.
+
+Peak knobs: ``DLT_PEAK_TFLOPS`` (default 197, the bench chip's bf16 MXU
+peak) and ``DLT_PEAK_HBM_GBS`` (default 819) — set them to your part's
+datasheet numbers for honest MFU/roofline percentages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import re
+import tempfile
+import threading
+import time
+
+import jax
+
+from .telemetry import _tree_bytes
+
+
+def peak_flops() -> float:
+    """Device peak FLOP/s for MFU (``DLT_PEAK_TFLOPS``, bf16 MXU peak)."""
+    try:
+        return float(os.environ.get("DLT_PEAK_TFLOPS", 197.0)) * 1e12
+    except ValueError:
+        return 197.0e12
+
+
+def peak_hbm_bytes_s() -> float:
+    """Device peak HBM bandwidth for roofline (``DLT_PEAK_HBM_GBS``)."""
+    try:
+        return float(os.environ.get("DLT_PEAK_HBM_GBS", 819.0)) * 1e9
+    except ValueError:
+        return 819.0e9
+
+
+# -- cost table --------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CostEntry:
+    """Cost/memory analysis of ONE warm-ladder program.
+
+    ``flops`` / ``bytes_accessed`` are PER DISPATCH, from a trip-count-aware
+    census of the traced jaxpr (scan lengths applied — XLA's own
+    ``cost_analysis()`` counts every loop body exactly once, which would
+    undercount a 64-step decode chunk 64x; those raw body-once numbers ride
+    along as ``xla_body_*``). The byte census models HBM-RESIDENT traffic:
+    reads of program inputs (packed weights at their STORED width, rope,
+    the KV cache at its sliced kv-bucket read bound) and in-place cache
+    update writes — intermediates are assumed on-chip, the same optimism a
+    roofline model wants. ``arg/out/temp/alias`` come from XLA's
+    ``memory_analysis()`` (loop-independent, so per-dispatch correct)."""
+
+    kind: str
+    size: int
+    kv_len: int
+    flops: float  # per dispatch (trip-count-aware jaxpr census)
+    bytes_accessed: float  # per dispatch HBM-resident traffic (see above)
+    xla_body_flops: float  # XLA cost_analysis raw (loop bodies once)
+    xla_body_bytes: float
+    arg_bytes: int
+    out_bytes: int
+    temp_bytes: int
+    alias_bytes: int  # donated (in-place) bytes
+    tokens: int  # token positions processed per dispatch (batch included)
+
+    @property
+    def flops_per_token(self) -> float:
+        return self.flops / max(self.tokens, 1)
+
+    @property
+    def bytes_per_token(self) -> float:
+        return self.bytes_accessed / max(self.tokens, 1)
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["flops_per_token"] = round(self.flops_per_token, 1)
+        d["bytes_per_token"] = round(self.bytes_per_token, 1)
+        return d
+
+
+class CostTable:
+    """Per-(kind, size, kv-bucket) cost entries over a warm plan, plus the
+    per-entry build failures (a failure IS information: a new warm-plan
+    kind the cost model can't lower fails the coverage audit loudly)."""
+
+    def __init__(self, entries: dict, failures: dict, partial: bool = False):
+        self.entries = entries  # (kind, size, kv_len) -> CostEntry
+        self.failures = failures  # (kind, size, kv_len) -> error string
+        self.partial = partial  # built over a sub-plan (bench), not the ladder
+
+    def lookup(self, kind: str, size: int):
+        """The (kind, size) entry at the SHALLOWEST kv bucket — the
+        conservative per-program floor the roofline join uses."""
+        best = None
+        for (k, s, kv), e in self.entries.items():
+            if k == kind and s == size and (best is None or kv < best.kv_len):
+                best = e
+        return best
+
+    def coverage_problems(self, plan) -> list:
+        """One message per warm-plan program missing from the table."""
+        problems = []
+        for key in plan:
+            key = tuple(key)
+            if key in self.entries:
+                continue
+            why = self.failures.get(key, "no cost entry built")
+            problems.append(
+                f"{key[0]}[{key[1]}|kv{key[2]}]: missing cost/memory entry "
+                f"({why})"
+            )
+        return problems
+
+    def snapshot(self, plan=None) -> dict:
+        """The ``/debug/costs`` payload."""
+        out = {
+            "partial": self.partial,
+            "n_entries": len(self.entries),
+            "peak_tflops": peak_flops() / 1e12,
+            "peak_hbm_gb_s": peak_hbm_bytes_s() / 1e9,
+            "entries": [
+                self.entries[k].as_dict() for k in sorted(self.entries)
+            ],
+        }
+        if self.failures:
+            out["failures"] = {
+                f"{k[0]}[{k[1]}|kv{k[2]}]": v for k, v in self.failures.items()
+            }
+        if plan is not None:
+            missing = self.coverage_problems(plan)
+            out["coverage"] = {
+                "plan_size": len(list(plan)),
+                "complete": not missing,
+                "missing": missing,
+            }
+        return out
+
+
+def _abstract(tree):
+    """ShapeDtypeStruct twin of a concrete pytree (shardings preserved) —
+    lowering against it compiles the production program without baking the
+    real weights in as constants (or duplicating them on device)."""
+
+    def one(a):
+        sh = getattr(a, "sharding", None)
+        try:
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+        except TypeError:  # older jax without the sharding kwarg
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    return jax.tree.map(one, tree)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def entry_tokens(engine, kind: str, size: int) -> int:
+    """Token positions one dispatch of this program processes (the
+    per-token normalization for ``/debug/costs``): whole-batch programs
+    advance `batch * size` positions, the per-row admission prefill one
+    row's `size`, prefix copies move `size` cached positions."""
+    b = engine.batch
+    if kind in ("prefill", "decode", "batch_decode", "verify", "verify_row"):
+        return b * size
+    return size  # prefill_row / prefix_extract / prefix_copy(_row)
+
+
+def lower_entry(engine, key):
+    """AOT-lower the program a warm-plan key names — the SAME jit entry
+    points serving dispatches (`graph_audit.trace_entry`'s abstract-eval
+    twin, but through `.lower()` so the result can `.compile()` for
+    cost/memory analysis). Params/rope/cache ride as abstract trees."""
+    import jax.numpy as jnp
+
+    kind, size, kvb = key
+    cfg, b = engine.cfg, engine.batch
+    a_params = _abstract(engine.params)
+    a_rope = _abstract(engine.rope)
+    a_cache = _abstract(engine.cache)
+    key0 = jax.random.PRNGKey(0)
+
+    if kind in ("prefill", "verify", "verify_row"):
+        mode = "last" if kind == "prefill" else "all"
+        per_row = kind == "verify_row"
+        pos_sds = _sds((b,), jnp.int32) if per_row else _sds((), jnp.int32)
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_forward
+
+            pp = engine.mesh.shape["pp"]
+            micro = 1 if per_row else (pp if size % pp == 0 else 1)
+            fn = lambda params, rope, cache, toks, pos: pipeline_forward(
+                cfg, engine.mesh, params, rope, cache, toks, pos,
+                logits_mode=mode, microbatches=micro, kv_len=kvb,
+            )
+            return jax.jit(fn).lower(
+                a_params, a_rope, a_cache, _sds((b, size), jnp.int32), pos_sds
+            )
+        if kind == "prefill":
+            from ..models.transformer import forward
+
+            return forward.lower(
+                cfg, a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
+                pos_sds, logits_mode="last", kv_len=kvb,
+            )
+        from .speculative import verify_chunk
+
+        return verify_chunk.lower(
+            cfg, a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
+            pos_sds, kv_len=kvb,
+        )
+    if kind == "decode":
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_decode_chunk
+
+            fn = lambda params, rope, cache, tok, pos: pipeline_decode_chunk(
+                cfg, engine.mesh, params, rope, cache, tok, pos, key0,
+                n_steps=size, temperature=0.0, topp=0.9, kv_len=kvb,
+            )
+            return jax.jit(fn).lower(
+                a_params, a_rope, a_cache, _sds((b,), jnp.int32),
+                _sds((), jnp.int32),
+            )
+        from .decode import decode_chunk
+
+        return decode_chunk.lower(
+            cfg, a_params, a_rope, a_cache, _sds((b,), jnp.int32),
+            _sds((), jnp.int32), key0, n_steps=size, temperature=0.0,
+            topp=0.9, kv_len=kvb,
+        )
+    if kind == "batch_decode":
+        args = (
+            _sds((b,), jnp.int32), _sds((b,), jnp.int32),
+            _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
+            _sds((b,), jnp.float32),
+        )
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_batch_decode_chunk as bdc
+
+            fn = lambda params, rope, cache, tok, pos, keys, temp, topp: bdc(
+                cfg, engine.mesh, params, rope, cache, tok, pos, keys, temp,
+                topp, n_steps=size, kv_len=kvb,
+            )
+            return jax.jit(fn).lower(a_params, a_rope, a_cache, *args)
+        from .batch_session import batch_decode_chunk
+
+        return batch_decode_chunk.lower(
+            cfg, a_params, a_rope, a_cache, *args, n_steps=size, kv_len=kvb
+        )
+    if kind == "prefill_row":
+        if engine.use_pipeline:
+            from ..parallel.pipeline import pipeline_forward
+
+            fn = lambda params, rope, cache, toks, pos_vec: pipeline_forward(
+                cfg, engine.mesh, params, rope, cache, toks, pos_vec,
+                logits_mode="last", kv_len=kvb,
+            )
+            return jax.jit(fn).lower(
+                a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
+                _sds((b,), jnp.int32),
+            )
+        from .batch_session import prefill_row
+
+        return prefill_row.lower(
+            cfg, a_params, a_rope, a_cache, _sds((1, size), jnp.int32),
+            _sds((), jnp.int32), _sds((), jnp.int32), kv_len=kvb,
+        )
+    if kind in ("prefix_extract", "prefix_copy", "prefix_copy_row"):
+        from .prefix_cache import (
+            copy_prefix_into_row,
+            copy_prefix_into_rows,
+            extract_prefix_from_row,
+        )
+
+        pc = engine.prefix_cache
+        L, _, _, h, d = engine.cache.k.shape
+        seg = _sds((L, size, h, d), engine.cache.k.dtype)
+        if kind == "prefix_extract":
+            return extract_prefix_from_row.lower(
+                a_cache, _sds((), jnp.int32), length=size,
+                out_sharding=pc.seg_sharding,
+            )
+        if kind == "prefix_copy":
+            return copy_prefix_into_rows.lower(
+                a_cache, seg, seg, out_sharding=pc.cache_sharding
+            )
+        return copy_prefix_into_row.lower(
+            a_cache, seg, seg, _sds((), jnp.int32),
+            out_sharding=pc.cache_sharding,
+        )
+    raise ValueError(f"unknown warm-plan kind {kind!r}")
+
+
+def _cost_from_compiled(compiled) -> tuple:
+    """(flops, bytes_accessed, memory dict) from a compiled executable —
+    normalizing across backends (XLA:CPU returns a one-element list from
+    ``cost_analysis()``, TPU a dict)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+    mem = {"arg": 0, "out": 0, "temp": 0, "alias": 0}
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        mem = {
+            "arg": int(getattr(ma, "argument_size_in_bytes", 0) or 0),
+            "out": int(getattr(ma, "output_size_in_bytes", 0) or 0),
+            "temp": int(getattr(ma, "temp_size_in_bytes", 0) or 0),
+            "alias": int(getattr(ma, "alias_size_in_bytes", 0) or 0),
+        }
+    return flops, bytes_accessed, mem
+
+
+# -- trip-count-aware jaxpr census -------------------------------------------
+#
+# XLA's HloCostAnalysis counts every loop body exactly ONCE (measured: a
+# lax.scan of length 1, 2, and 8 over the same matmul reports identical
+# flops), so its aggregates describe one decode STEP, not the n-step chunk a
+# dispatch runs. The census below walks the traced jaxpr with the scan
+# lengths applied — exact for dot flops — and models HBM traffic by tagging
+# which values are device-RESIDENT (the program's inputs: weights at their
+# stored/packed width, rope, cache) and counting only their reads, at the
+# sliced width where a slice is what's read (the kv-bucket bound), plus
+# in-place cache-update writes. Intermediates are assumed on-chip — the
+# optimistic-cache assumption a roofline denominator wants.
+
+#: layout-only ops: an HBM-resident array stays resident through them, and
+#: the op itself moves no bytes the consumer won't pay for
+_LAYOUT_PRIMS = frozenset({"reshape", "transpose", "broadcast_in_dim", "squeeze"})
+#: slice-like ops: reading FROM a resident array costs the slice taken,
+#: not the whole allocation (this is exactly what kv_len bucketing buys)
+_SLICE_PRIMS = frozenset({"slice", "dynamic_slice", "gather", "take"})
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        return int(aval.size) * aval.dtype.itemsize
+    except Exception:  # tokens / extended dtypes (PRNG keys)
+        return 0
+
+
+def _aval_elems(aval) -> int:
+    try:
+        return int(aval.size)
+    except Exception:
+        return 0
+
+
+def _dot_flops(eqn, mult: float) -> float:
+    (lc, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    out = eqn.outvars[0].aval
+    k = 1
+    for i in lc:
+        k *= lhs.shape[i]
+    return 2.0 * k * _aval_elems(out) * mult
+
+
+def _census_walk(jaxpr, mult: float, hbm: dict, acc: dict) -> None:
+    from ..analysis.graph_audit import _sub_jaxprs
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+            length = int(eqn.params.get("length") or 1)
+            inner = {}
+            # scan body invars align 1:1 with [consts..., carry..., xs...];
+            # an xs slice inherits its stacked source's residency, so a
+            # layer scan's per-iteration weight slice counts per iteration
+            # — length iterations read the whole stack, as the device does
+            for bv, ov in zip(body.invars, eqn.invars):
+                inner[id(bv)] = hbm.get(id(ov), False)
+            _census_walk(body, mult * length, inner, acc)
+            continue
+        subs = list(_sub_jaxprs(eqn))
+        if subs:
+            # pjit / cond / while / custom_* bodies: trip count unknown or 1
+            # — count once, mapping residency through where arities align
+            for sub in subs:
+                sub_j = sub
+                inner = {}
+                if len(sub_j.invars) == len(eqn.invars):
+                    for bv, ov in zip(sub_j.invars, eqn.invars):
+                        inner[id(bv)] = hbm.get(id(ov), False)
+                _census_walk(sub_j, mult, inner, acc)
+            continue
+        in_hbm = [hbm.get(id(v), False) for v in eqn.invars]
+        # -- flops: dots exact, everything else one op per output element
+        # (layout/slice ops move data, they don't compute)
+        if name == "dot_general":
+            acc["flops"] += _dot_flops(eqn, mult)
+        elif (
+            name not in _LAYOUT_PRIMS
+            and name not in _SLICE_PRIMS
+            and name != "dynamic_update_slice"
+            and eqn.outvars
+            and hasattr(eqn.outvars[0].aval, "dtype")
+        ):
+            try:
+                is_float = eqn.outvars[0].aval.dtype.kind == "f"
+            except Exception:
+                is_float = False
+            if is_float:
+                acc["flops"] += _aval_elems(eqn.outvars[0].aval) * mult
+        # -- bytes: reads of resident arrays + in-place update writes
+        if name in _LAYOUT_PRIMS:
+            # residency flows through; the consumer pays the bytes
+            if any(in_hbm):
+                for ov in eqn.outvars:
+                    hbm[id(ov)] = True
+            continue
+        if name == "dynamic_update_slice":
+            if in_hbm[0]:
+                # in-place write of the update region (donated cache)
+                acc["bytes"] += _aval_bytes(eqn.invars[1].aval) * mult
+                hbm[id(eqn.outvars[0])] = True  # still the resident cache
+            continue
+        if name in _SLICE_PRIMS:
+            if any(in_hbm):
+                acc["bytes"] += _aval_bytes(eqn.outvars[0].aval) * mult
+            continue
+        for v, resident in zip(eqn.invars, in_hbm):
+            if resident:
+                acc["bytes"] += _aval_bytes(v.aval) * mult
+
+
+def jaxpr_census(closed_jaxpr) -> dict:
+    """{"flops", "bytes"} per dispatch of a traced program (see the block
+    comment above for the counting model)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc = {"flops": 0.0, "bytes": 0.0}
+    # resident set = the program's inputs, whether traced as arguments or
+    # closed over (make_jaxpr puts the engine's params/cache in constvars)
+    hbm = {id(v): True for v in list(jaxpr.invars) + list(jaxpr.constvars)}
+    _census_walk(jaxpr, 1.0, hbm, acc)
+    return acc
+
+
+def build_cost_table(engine, plan=None) -> CostTable:
+    """Lower + compile every program in `plan` (default: the engine's full
+    ``warm_plan()``) and collect XLA's cost/memory analyses. Compilation is
+    AOT — nothing executes, no device arrays move — but it IS compile work:
+    call it at warmup, lazily from a cold endpoint, or over a partial plan
+    (the bench's per-leg tables). With ``DLT_COMPILE_CACHE`` set the
+    persistent cache dedupes these against warmup's own compiles."""
+    from ..analysis.graph_audit import LadderEntry, trace_entry
+
+    entries: dict = {}
+    failures: dict = {}
+    partial = plan is not None
+    plan = engine.warm_plan() if plan is None else list(plan)
+    for key in plan:
+        key = tuple(key)
+        if key in entries or key in failures:
+            continue
+        kind, size, kvb = key
+        try:
+            census = jaxpr_census(
+                trace_entry(engine, LadderEntry(kind, size, kvb))
+            )
+            xla_flops, xla_bytes, mem = _cost_from_compiled(
+                lower_entry(engine, key).compile()
+            )
+            entries[key] = CostEntry(
+                kind=kind, size=size, kv_len=kvb,
+                flops=census["flops"], bytes_accessed=census["bytes"],
+                xla_body_flops=xla_flops, xla_body_bytes=xla_bytes,
+                arg_bytes=mem["arg"], out_bytes=mem["out"],
+                temp_bytes=mem["temp"], alias_bytes=mem["alias"],
+                tokens=entry_tokens(engine, kind, size),
+            )
+        except Exception as e:  # recorded, surfaced by the coverage audit
+            failures[key] = f"{type(e).__name__}: {e}"
+    return CostTable(entries, failures, partial=partial)
+
+
+def cost_problems(engine, table=None) -> list:
+    """The ``graph_audit --costs`` check: every warm-plan program must have
+    a cost/memory entry (build failures count as missing). Returns problem
+    strings; empty means the table fully covers the ladder. (There is no
+    disabled state here: ``DLT_COST_TABLE=0`` only defers the serve-time
+    build — ``engine.cost_table()`` always constructs on demand.)"""
+    table = engine.cost_table() if table is None else table
+    return table.coverage_problems(engine.warm_plan())
+
+
+def format_cost_table(table: CostTable) -> str:
+    lines = ["💰 warm-ladder cost table:"]
+    for key in sorted(table.entries):
+        e = table.entries[key]
+        lines.append(
+            f"  {e.kind}[{e.size}|kv{e.kv_len}]: "
+            f"{e.flops / 1e6:.1f} MFLOP, {e.bytes_accessed / 1e6:.1f} MB "
+            f"accessed, temp {e.temp_bytes / 1e6:.1f} MB "
+            f"({e.bytes_per_token:.0f} B/token)"
+        )
+    for key, why in sorted(table.failures.items()):
+        lines.append(f"  ! {key[0]}[{key[1]}|kv{key[2]}]: FAILED — {why}")
+    return "\n".join(lines)
+
+
+# -- HBM ledger --------------------------------------------------------------
+
+
+def _device_memory_stats(engine) -> dict | None:
+    """Aggregate ``memory_stats()`` over the devices holding this engine's
+    cache; None when the backend doesn't report (XLA:CPU)."""
+    try:
+        devices = list(engine.cache.k.devices())
+    except Exception:
+        devices = jax.devices()[:1]
+    in_use = limit = 0
+    seen = False
+    for d in devices:
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None  # dlt: allow(host-sync) — cold-path runtime query, no array transfer
+        if not stats:
+            continue
+        seen = True
+        in_use += int(stats.get("bytes_in_use", 0) or 0)
+        limit += int(stats.get("bytes_limit", 0) or 0)
+    if not seen:
+        return None
+    return {"bytes_in_use": in_use, "bytes_limit": limit or None}
+
+
+def hbm_ledger(engine) -> dict:
+    """Modeled per-component device-byte accounting, reconciled against the
+    backend's measured numbers where available. Reads only host-side array
+    metadata (`.nbytes`) — no device work, safe on any scrape."""
+    components = {
+        "weights": _tree_bytes(engine.params),
+        "rope": _tree_bytes(engine.rope),
+        "kv_cache": _tree_bytes(engine.cache),
+    }
+    pc = engine.prefix_cache
+    if pc is not None:
+        components["prefix_cache"] = pc.total_bytes
+    draft_eng = getattr(engine.draft_source, "engine", None)
+    if draft_eng is not None:
+        components["draft_engine"] = (
+            _tree_bytes(draft_eng.params)
+            + _tree_bytes(draft_eng.cache)
+            + _tree_bytes(draft_eng.rope)
+        )
+    modeled = sum(components.values())
+    out = {
+        "components": components,
+        "modeled_bytes": modeled,
+        "measured_bytes": None,
+        "limit_bytes": None,
+        "headroom_bytes": None,
+        "unattributed_bytes": None,
+    }
+    measured = _device_memory_stats(engine)
+    if measured is not None:
+        out["measured_bytes"] = measured["bytes_in_use"]
+        out["unattributed_bytes"] = measured["bytes_in_use"] - modeled
+        if measured["bytes_limit"]:
+            out["limit_bytes"] = measured["bytes_limit"]
+            out["headroom_bytes"] = (
+                measured["bytes_limit"] - measured["bytes_in_use"]
+            )
+    return out
+
+
+def _drift_threshold_bytes() -> int:
+    try:
+        return int(float(os.environ.get("DLT_HBM_DRIFT_MB", 64))) * 1024 * 1024
+    except ValueError:
+        return 64 * 1024 * 1024
+
+
+#: serializes the read-modify-write of engine._hbm_drift_base: concurrent
+#: /metrics scrapes (threaded server, bench scraper thread) must count one
+#: residual excursion exactly once
+_DRIFT_LOCK = threading.Lock()
+
+
+def reconcile_hbm(engine, ledger: dict | None = None) -> dict:
+    """The leak detector: the first reconcile baselines the measured-minus-
+    modeled residual (compiled executables, runtime scratch — legitimate
+    bytes the model doesn't itemize); later reconciles count residual
+    GROWTH beyond ``DLT_HBM_DRIFT_MB`` as a drift event
+    (``hbm_drift_events`` counter + ``dlt_hbm_drift_bytes`` gauge).
+    Shrinkage re-baselines — freed scratch must not bank headroom that
+    masks a later leak. No-op (drift 0) where nothing is measured."""
+    ledger = hbm_ledger(engine) if ledger is None else ledger
+    un = ledger.get("unattributed_bytes")
+    if un is None:
+        return {"drift_bytes": 0, "tripped": False}
+    with _DRIFT_LOCK:
+        base = getattr(engine, "_hbm_drift_base", None)
+        if base is None or un < base:
+            engine._hbm_drift_base = base = un
+        drift = un - base
+        tripped = drift > _drift_threshold_bytes()
+        if tripped:
+            engine.stats.incr("hbm_drift_events")
+            engine._hbm_drift_base = un  # re-arm: count each excursion once
+    return {"drift_bytes": drift, "tripped": tripped}
+
+
+# -- live roofline / MFU / SLO -----------------------------------------------
+
+_SERIES_RE = re.compile(r"^([a-z_]+)\[(\d+)\]$")
+
+#: StepStats series that are honest whole-chunk device walls, mapped to
+#: their cost-table kind(s) and the size offset from the series' bracket
+#: number (spec_verify[k] walls belong to the (k+1)-token verify program).
+#: Prefill *dispatch* series are asynchronous walls and deliberately absent.
+_SERIES_KINDS = {
+    "decode": (("decode", 0),),
+    "batch_decode": (("batch_decode", 0),),
+    "spec_verify": (("verify", 1), ("verify_row", 1)),
+}
+
+#: series whose all-time totals count toward the duty-cycle gauge — device
+#: time regardless of whether a cost entry joins: the decode-side chunk
+#: walls above plus the prefill loop (dispatch walls + the final sync wait
+#: together span the prefill wall, and the phases are disjoint)
+_BUSY_RE = re.compile(
+    r"^(?:decode|batch_decode|spec_verify|prefill_dispatch)\[\d+\]$"
+    r"|^prefill_sync$"
+)
+
+
+def roofline_view(engine, table: CostTable):
+    """(gauges, labeled_series) joining the cost table with the recorded
+    per-program walls. Per-series numbers use the recent-window p50 wall
+    (warmup's compile walls age out) and the shallowest-kv cost variant
+    (a conservative floor)."""
+    gauges: dict = {}
+    series: dict = {}
+    prog_gbs: list = []
+    prog_tflops: list = []
+    w_flops = w_bytes = w_us = 0.0
+    busy_us = 0.0
+    for name, s in sorted(list(engine.stats.series.items())):
+        if s.count and _BUSY_RE.match(name):
+            # duty cycle counts EVERY device wall, joined or not — a
+            # prefill-heavy server must not read as idle just because
+            # prefill walls have no cost entry
+            busy_us += s.total_us
+        m = _SERIES_RE.match(name)
+        if not m or m.group(1) not in _SERIES_KINDS or s.count == 0:
+            continue
+        entry = None
+        for kind, off in _SERIES_KINDS[m.group(1)]:
+            entry = table.lookup(kind, int(m.group(2)) + off)
+            if entry is not None:
+                break
+        if entry is None:
+            continue
+        p = engine.stats.percentiles(name)
+        p50_us = p.get("p50", 0.0)
+        if p50_us <= 0:
+            continue
+        sec = p50_us / 1e6
+        prog_gbs.append(({"program": name}, round(entry.bytes_accessed / sec / 1e9, 2)))
+        prog_tflops.append(({"program": name}, round(entry.flops / sec / 1e12, 4)))
+        n = len(s.recent)
+        w_flops += n * entry.flops
+        w_bytes += n * entry.bytes_accessed
+        w_us += n * p50_us
+    if prog_gbs:
+        series["program_gb_s"] = prog_gbs
+        series["program_tflop_s"] = prog_tflops
+    if w_us > 0:
+        gauges["mfu"] = round((w_flops / (w_us / 1e6)) / peak_flops(), 4)
+        gauges["bw_utilization"] = round(
+            (w_bytes / (w_us / 1e6)) / peak_hbm_bytes_s(), 4
+        )
+    elapsed_us = (time.perf_counter() - engine._t_start) * 1e6
+    if elapsed_us > 0 and busy_us > 0:
+        # busy fraction over the engine's lifetime, from the all-time series
+        # totals — warmup (compiles included) counts as busy, honestly so
+        gauges["device_duty_cycle"] = round(min(busy_us / elapsed_us, 1.0), 4)
+    return gauges, series
+
+
+def _slo_ms(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def slo_gauges(stats) -> dict:
+    """SLO attainment from the cumulative TTFT/TPOT histograms: the
+    fraction of observations at or under the target, read at the largest
+    histogram bound <= the target (a conservative floor — log buckets, so
+    within one 2x bucket of exact)."""
+    out: dict = {}
+    hists = stats.hists_snapshot()
+    for hname, env, default, gauge in (
+        ("ttft_ms", "DLT_SLO_TTFT_MS", 1000.0, "slo_ttft_attainment"),
+        ("tpot_ms", "DLT_SLO_TPOT_MS", 100.0, "slo_tpot_attainment"),
+    ):
+        snap = hists.get(hname)
+        if not snap or not snap["count"]:
+            continue
+        slo = _slo_ms(env, default)
+        cum = 0
+        for bound, c in snap["buckets"]:
+            if isinstance(bound, str) or bound > slo:
+                break
+            cum = c
+        out[gauge] = round(cum / snap["count"], 4)
+        out[gauge.replace("attainment", "target_ms")] = slo
+    return out
+
+
+def metrics_view(engine):
+    """Everything `/metrics` adds on top of StepStats: (flat_gauges,
+    labeled_series). One cold-path call per scrape — host metadata reads
+    only; the roofline section appears once a cost table exists
+    (``/debug/costs``, warmup with ``DLT_COST_TABLE=1``, or the server's
+    post-warmup build)."""
+    ledger = hbm_ledger(engine)
+    rec = reconcile_hbm(engine, ledger)
+    gauges = {"hbm_modeled_bytes": ledger["modeled_bytes"]}
+    series = {
+        "hbm_bytes": [
+            ({"component": k}, v) for k, v in sorted(ledger["components"].items())
+        ]
+    }
+    if ledger["unattributed_bytes"] is not None:
+        series["hbm_bytes"].append(
+            ({"component": "unattributed"}, ledger["unattributed_bytes"])
+        )
+        gauges["hbm_drift_bytes"] = rec["drift_bytes"]
+    if ledger["headroom_bytes"] is not None:
+        gauges["hbm_headroom_bytes"] = ledger["headroom_bytes"]
+    table = engine.cost_table(build=False)
+    if table is not None:
+        rg, rs = roofline_view(engine, table)
+        gauges.update(rg)
+        series.update(rs)
+    gauges.update(slo_gauges(engine.stats))
+    return gauges, series
+
+
+# -- bench integration -------------------------------------------------------
+
+
+def bench_profile(engine, final_pos: int | None = None) -> dict:
+    """The bench's per-leg device profile: build a PARTIAL cost table over
+    exactly the decode/verify programs the leg's series recorded (a handful
+    of compiles, not the whole ladder — the full table is a serving-time
+    concern) and return the ledger + roofline numbers for the BENCH json."""
+    kvb = engine._kv_bucket(
+        final_pos if final_pos is not None else engine.cfg.seq_len
+    )
+    plan = []
+    for name in list(engine.stats.series):
+        m = _SERIES_RE.match(name)
+        if not m or m.group(1) not in _SERIES_KINDS:
+            continue
+        for kind, off in _SERIES_KINDS[m.group(1)]:
+            size = int(m.group(2)) + off
+            if kind in ("verify", "verify_row") and (
+                engine.spec_mode is None or engine.batch <= 1
+                and kind == "verify_row"
+            ):
+                continue
+            plan.append((kind, size, max(kvb, size)))
+    table = build_cost_table(engine, plan=plan)
+    if engine._cost_table is None:
+        engine._cost_table = table
+    gauges, _ = roofline_view(engine, table)
+    ledger = hbm_ledger(engine)
+    out = {
+        "dlt_mfu": gauges.get("mfu"),
+        "dlt_bw_utilization": gauges.get("bw_utilization"),
+        "hbm_modeled_gb": round(ledger["modeled_bytes"] / 1e9, 3),
+        "hbm_components_gb": {
+            k: round(v / 1e9, 3) for k, v in ledger["components"].items()
+        },
+    }
+    dchunk = table.lookup("decode", engine.decode_chunk_size)
+    if dchunk is not None:
+        out["decode_bytes_per_token_modeled"] = round(dchunk.bytes_per_token, 1)
+        out["decode_flops_per_token_modeled"] = round(dchunk.flops_per_token, 1)
+    return out
+
+
+# -- prefill overlap probe (scripts/profile_prefill.py rides this) -----------
+
+
+def prefill_overlap_probe(
+    model_path: str,
+    prompt_tokens: int,
+    reps: int = 3,
+    max_chunk: int = 512,
+    compute_dtype: str = "bfloat16",
+) -> list:
+    """Dispatch-vs-compute overlap of the pipelined prefill, pipelined vs
+    the forced-serial arm — the ONE timing pathway: every number comes from
+    ``engine.last_prefill_timing`` and the ``prefill_dispatch[size]``
+    StepStats series, the same sources `/stats` and `/metrics` export, so
+    the probe script can never drift from serving telemetry."""
+    from .engine import InferenceEngine
+
+    arms = []
+    for pipelined in (True, False):
+        eng = InferenceEngine(
+            model_path, compute_dtype=compute_dtype, max_chunk=max_chunk,
+            prefill_pipelined=pipelined,
+            prefix_cache_mb=0,  # repeated-prompt probe: a splice would
+            # replace the prefill being measured
+        )
+        try:
+            prompt = [(i % 1000) + 1 for i in range(prompt_tokens)]
+            eng.prefill(prompt)  # compile the ladder
+            walls = []
+            for _ in range(reps):
+                eng.reset()
+                t0 = time.perf_counter()
+                eng.prefill(prompt)
+                walls.append((time.perf_counter() - t0) * 1e3)
+            t = dict(eng.last_prefill_timing or {})
+            arms.append(
+                {
+                    "pipelined": pipelined,
+                    "n_tokens": prompt_tokens,
+                    "n_chunks": t.get("n_chunks", 0),
+                    "best_wall_ms": round(min(walls), 1),
+                    "tok_s": round(prompt_tokens / min(walls) * 1e3, 1),
+                    "dispatch_ms": round(t.get("dispatch_us", 0) / 1e3, 1),
+                    "sync_ms": round(t.get("sync_us", 0) / 1e3, 1),
+                    "overlap_pct": t.get("overlap_pct"),
+                    "dispatch_series": {
+                        k: {
+                            "count": s.count,
+                            "avg_ms": round(s.total_us / s.count / 1e3, 2),
+                        }
+                        for k, s in sorted(eng.stats.series.items())
+                        if k.startswith("prefill_dispatch") and s.count
+                    },
+                }
+            )
+        finally:
+            eng.close()
+    return arms
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already in flight — the profiler is process-wide, so
+    overlapping windows would corrupt each other's traces."""
+
+
+class ProfilerCapture:
+    """Single-flight ``jax.profiler.trace`` window around live serving.
+    The capture blocks only ITS caller (the ``/debug/profile`` handler
+    thread); serving threads keep dispatching and their device work lands
+    in the trace — that is the point."""
+
+    MIN_MS, MAX_MS = 10, 30000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last: dict | None = None
+
+    @staticmethod
+    def _dir() -> str:
+        return os.environ.get("DLT_PROFILE_DIR") or os.path.join(
+            tempfile.gettempdir(), "dlt-profiles"
+        )
+
+    def capture(self, ms: int) -> dict:
+        ms = max(self.MIN_MS, min(int(ms), self.MAX_MS))
+        if not self._lock.acquire(blocking=False):  # dlt: allow(lock-with) — single-flight try-lock, released in the finally below
+            raise ProfileBusy("a profile capture is already in flight")
+        try:
+            path = os.path.join(
+                self._dir(), f"capture-{int(time.time() * 1000)}-{os.getpid()}"
+            )
+            os.makedirs(path, exist_ok=True)
+            t0 = time.perf_counter()
+            with jax.profiler.trace(path):
+                time.sleep(ms / 1000.0)
+            files = sorted(
+                os.path.relpath(f, path)
+                for f in glob.glob(os.path.join(path, "**", "*"), recursive=True)
+                if os.path.isfile(f)
+            )
+            perfetto = [f for f in files if f.endswith(".trace.json.gz")]
+            self.last = {
+                "path": path,
+                "requested_ms": ms,
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 1),
+                "files": files,
+                "perfetto_trace": os.path.join(path, perfetto[0]) if perfetto else None,
+            }
+            return self.last
+        finally:
+            self._lock.release()
+
+
+PROFILER = ProfilerCapture()
+
+
+def capture_profile(ms: int) -> dict:
+    """Run one bounded profiler window on the process singleton (the
+    ``/debug/profile`` endpoint's backend). Raises :class:`ProfileBusy`
+    when a window is already open."""
+    return PROFILER.capture(ms)

@@ -377,12 +377,17 @@ def render_hist(lines: list, name: str, snap: dict) -> None:
     lines.append(prom_line(name + "_count", None, snap["count"]))
 
 
-def render_step_stats(stats, extra_gauges: dict | None = None, prefix: str = "dlt") -> str:
+def render_step_stats(
+    stats, extra_gauges: dict | None = None, prefix: str = "dlt",
+    extra_series: dict | None = None,
+) -> str:
     """Render a StepStats-shaped object (``snapshot()`` with reserved
     ``counters``/``gauges``/``histograms`` keys plus latency series) as
     Prometheus text: counters as ``_total``, gauges as-is, series as
     per-kind quantile gauges + cumulative step counts, histograms as
-    cumulative ``_bucket`` series."""
+    cumulative ``_bucket`` series. `extra_series` adds LABELED gauge
+    families — ``{name: [(labels_dict, value), ...]}`` — e.g. the HBM
+    ledger's ``dlt_hbm_bytes{component=...}`` (runtime/profiling.py)."""
     snap = stats.snapshot()
     counters = snap.pop("counters", {})
     gauges = dict(snap.pop("gauges", {}))
@@ -392,6 +397,11 @@ def render_step_stats(stats, extra_gauges: dict | None = None, prefix: str = "dl
     lines: list = []
     render_counters(lines, counters, prefix)
     render_gauges(lines, gauges, prefix)
+    for name in sorted(extra_series or {}):
+        m = f"{prefix}_{_metric(name)}"
+        lines.append(f"# TYPE {m} gauge")
+        for labels, value in extra_series[name]:
+            lines.append(prom_line(m, labels, value))
     if snap:
         m = f"{prefix}_step_latency_ms"
         lines.append(f"# TYPE {m} gauge")

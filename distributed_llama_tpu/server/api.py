@@ -927,7 +927,11 @@ class Handler(BaseHTTPRequestHandler):
         if route == "/metrics":
             # Prometheus text exposition: every StepStats counter/gauge/
             # percentile series plus the TTFT / per-output-token histograms,
-            # with Batcher occupancy and prefix-cache occupancy as gauges
+            # with Batcher occupancy and prefix-cache occupancy as gauges —
+            # and the device-performance layer (runtime/profiling.py): the
+            # dlt_hbm_bytes{component=...} ledger, dlt_mfu /
+            # dlt_bw_utilization / duty-cycle roofline gauges (once a cost
+            # table exists), and the TTFT/TPOT SLO-attainment gauges
             st = self.state
             extra = {}
             if st.batcher is not None:
@@ -939,8 +943,50 @@ class Handler(BaseHTTPRequestHandler):
                 for k in ("entries", "bytes", "budget_bytes", "pinned"):
                     if k in snap:
                         extra[f"prefix_cache_{k}"] = snap[k]
-            body = render_step_stats(st.engine.stats, extra_gauges=extra)
+            from ..runtime.profiling import metrics_view
+
+            prof_gauges, prof_series = metrics_view(st.engine)
+            extra.update(prof_gauges)
+            body = render_step_stats(
+                st.engine.stats, extra_gauges=extra, extra_series=prof_series
+            )
             self._respond(200, body.encode(), ctype=PROM_CONTENT_TYPE)
+            return
+        if route == "/debug/costs":
+            # the warm-ladder cost table (runtime/profiling.py): builds
+            # lazily on first hit (AOT compile work — a cold operator
+            # action, never a serving-path cost; the engine runs it inside
+            # the sentinel's thread-scoped exempt() window so fatal-
+            # sanitizer servers stay clean while serving threads keep full
+            # breach detection). Coverage vs warm_plan() rides the payload — the same
+            # contract `graph_audit --costs` enforces.
+            engine = self.state.engine
+            table = engine.cost_table()
+            body = json.dumps(table.snapshot(engine.warm_plan())).encode()
+            self._json(200, body)
+            return
+        if route == "/debug/profile":
+            from ..runtime.profiling import ProfileBusy, capture_profile
+
+            try:
+                ms = int(self._query_params().get("ms", "500"))
+            except ValueError:
+                self._json(400, b'{"error":"bad ms parameter"}')
+                return
+            try:
+                rec = capture_profile(ms)
+            except ProfileBusy:
+                self._json(
+                    409, b'{"error":"a profile capture is already in flight"}'
+                )
+                return
+            except Exception as e:
+                self._json(
+                    500,
+                    json.dumps({"error": f"profiler failed: {e}"}).encode(),
+                )
+                return
+            self._json(200, json.dumps(rec).encode())
             return
         if route == "/debug/trace":
             tid = self._query_params().get("id", "")
@@ -1209,6 +1255,14 @@ def serve(args) -> HTTPServer:
         # compile the chunk ladder before accepting connections so the first
         # request pays serving latency, not XLA compile (cold-TTFT)
         engine.warmup()
+        if _os.environ.get("DLT_COST_TABLE") != "0":
+            # serving processes carry the warm-ladder cost table from the
+            # start (/debug/costs, /metrics roofline gauges); with
+            # DLT_COMPILE_CACHE set the AOT compiles dedupe against the
+            # warmup the line above just paid. DLT_COST_TABLE=0 opts out
+            # (e.g. slow remote-compiler tunnels); the table then builds
+            # lazily on the first /debug/costs hit.
+            engine.cost_table()
     state = ApiState(engine, tokenizer, args)
     # a fresh Handler subclass per server: `state` as a class attribute on
     # the shared Handler would make two in-process replicas (gateway tests,

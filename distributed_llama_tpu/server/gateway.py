@@ -724,7 +724,14 @@ def handle_client(client: socket.socket, balancer: Balancer):
             return
         method, path = _request_line(request)
         route = path.partition("?")[0]
-        if route.startswith("/gateway/") or route.startswith("/debug/") or route == "/metrics":
+        # control routes the gateway answers ITSELF: its own stats/metrics
+        # and the trace/flightrecord views of its own ring. Every OTHER
+        # /debug/* route (/debug/costs, /debug/profile — the engine-side
+        # device-performance endpoints, runtime/profiling.py) is backend
+        # state and proxies through like a normal request.
+        if route.startswith("/gateway/") or route == "/metrics" or route in (
+            "/debug/trace", "/debug/flightrecord"
+        ):
             _handle_control(client, balancer, method, path)
             return
         # request-lifecycle trace: adopt the client's X-DLT-Trace-Id or

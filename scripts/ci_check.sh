@@ -16,6 +16,11 @@
 #                            exposition format, /debug/trace + /metrics on
 #                            a live server, flight recorder, zero-host-sync
 #                            contract with tracing on)
+#   7. profiling suite      (warm-ladder cost table analytic sanity +
+#                            coverage, HBM ledger + drift detector,
+#                            roofline/MFU/SLO gauge math, /debug/costs +
+#                            /debug/profile on a live server, fatal-
+#                            sanitizer cleanliness of every profiling path)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -26,8 +31,8 @@ export JAX_PLATFORMS=cpu
 echo "== dlt-lint =="
 python scripts/dlt_lint.py
 
-echo "== graph audit (tiny config) =="
-python -m distributed_llama_tpu.analysis.graph_audit
+echo "== graph audit (tiny config, --costs coverage) =="
+python -m distributed_llama_tpu.analysis.graph_audit --costs
 
 echo "== analysis suite (pytest -m analysis) =="
 python -m pytest tests/ -q -m analysis -p no:cacheprovider
@@ -40,6 +45,9 @@ python -m pytest tests/test_speculative.py -q -p no:cacheprovider
 
 echo "== tracing suite =="
 python -m pytest tests/test_tracing.py -q -p no:cacheprovider
+
+echo "== profiling suite =="
+python -m pytest tests/test_profiling.py -q -p no:cacheprovider
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

@@ -26,49 +26,29 @@ from profile_decode import dev_ms  # differenced timing
 
 
 def overlap_report(path: str, prompt_tokens: int, reps: int = 3):
-    """Dispatch-vs-compute overlap of the pipelined prefill on the real
-    chip: per-chunk dispatch walls, the final sync wait, and the overlap
-    percentage (share of the wall spent inside dispatches — ~100% means the
-    sync found the device already done), pipelined vs the forced-serial
-    dispatch->block->dispatch path for the A/B."""
-    import time
+    """Thin CLI over `runtime.profiling.prefill_overlap_probe` — the ONE
+    owner of the dispatch-wall math. Every number printed here comes from
+    `engine.last_prefill_timing` and the `prefill_dispatch[size]` StepStats
+    series via the probe, the same sources `/stats` and `/metrics` export,
+    so this script can never drift from serving telemetry."""
+    from distributed_llama_tpu.runtime.profiling import prefill_overlap_probe
 
-    from distributed_llama_tpu.runtime.engine import InferenceEngine
-
-    for pipelined in (True, False):
-        eng = InferenceEngine(
-            path, compute_dtype="bfloat16", max_chunk=512,
-            prefill_pipelined=pipelined,
-            prefix_cache_mb=0,  # repeated-prompt probe: a splice would
-            # replace the prefill being measured
-        )
-        prompt = [(i % 1000) + 1 for i in range(prompt_tokens)]
-        eng.prefill(prompt)  # compile the ladder
-        eng.reset()
-        walls = []
-        for _ in range(reps):
-            eng.reset()
-            t0 = time.perf_counter()
-            eng.prefill(prompt)
-            walls.append((time.perf_counter() - t0) * 1e3)
-        t = eng.last_prefill_timing
-        label = "pipelined" if pipelined else "serial (DLT_PREFILL_PIPELINE=0)"
-        print(
-            f"{label}: {prompt_tokens} tokens / {t['n_chunks']} chunks, "
-            f"best wall {min(walls):.1f} ms "
-            f"({prompt_tokens / min(walls) * 1e3:.0f} tok/s)"
+    for arm in prefill_overlap_probe(path, prompt_tokens, reps=reps):
+        label = (
+            "pipelined" if arm["pipelined"]
+            else "serial (DLT_PREFILL_PIPELINE=0)"
         )
         print(
-            f"    last rep: dispatch {t['dispatch_us'] / 1e3:.1f} ms, "
-            f"sync wait {t['sync_us'] / 1e3:.1f} ms, "
-            f"overlap {t['overlap_pct']:.1f}%"
+            f"{label}: {arm['n_tokens']} tokens / {arm['n_chunks']} chunks, "
+            f"best wall {arm['best_wall_ms']:.1f} ms ({arm['tok_s']:.0f} tok/s)"
         )
-        for kind, s in sorted(eng.stats.series.items()):
-            if kind.startswith("prefill_dispatch"):
-                print(
-                    f"    {kind}: n={s.count} avg={s.total_us / s.count / 1e3:.1f} ms"
-                )
-        del eng
+        print(
+            f"    last rep: dispatch {arm['dispatch_ms']:.1f} ms, "
+            f"sync wait {arm['sync_ms']:.1f} ms, "
+            f"overlap {arm['overlap_pct']:.1f}%"
+        )
+        for kind, s in sorted(arm["dispatch_series"].items()):
+            print(f"    {kind}: n={s['count']} avg={s['avg_ms']:.1f} ms")
 
 
 def main():

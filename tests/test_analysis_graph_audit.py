@@ -287,3 +287,42 @@ def test_cli_tiny_config_exit_code():
     """The CI entry point: audits a synthetic tiny model end to end
     (speculative verify ladder included by default)."""
     assert ga.main([]) == 0
+
+
+_SLIM = [
+    "--max-chunk", "8", "--decode-chunk-size", "4", "--prefix-cache-mb", "0",
+    "--speculative", "off",
+]
+
+
+@pytest.mark.slow  # three full CLI audits with cost builds (~25 s); the CI
+# graph-audit stage runs `--costs` itself, so the contract stays CI-enforced
+def test_cli_costs_coverage_enforced(capsys):
+    """`graph_audit --costs` owns the /debug/costs coverage contract:
+    every warm_plan() program must have a cost/memory entry. Clean tree
+    passes; a warm-plan kind the cost model can't lower (planted by
+    breaking lower_entry for decode) fails the audit with exit 1."""
+    from distributed_llama_tpu.runtime import profiling
+
+    assert ga.main(_SLIM + ["--costs"]) == 0
+    out = capsys.readouterr().out
+    assert "warm-ladder cost table" in out
+    assert "cost coverage" not in out
+
+    real = profiling.lower_entry
+
+    def breaks_on_decode(engine, key):
+        if key[0] == "decode":
+            raise RuntimeError("planted: unloweable kind")
+        return real(engine, key)
+
+    profiling.lower_entry = breaks_on_decode
+    try:
+        assert ga.main(_SLIM + ["--costs"]) == 1
+    finally:
+        profiling.lower_entry = real
+    out = capsys.readouterr().out
+    assert "cost coverage" in out and "planted" in out
+    # without --costs the same config still passes: the graph checks are
+    # independent of the cost model
+    assert ga.main(_SLIM) == 0

@@ -74,6 +74,18 @@ def test_host_sync_scoped_to_hot_packages():
     assert _rules(src, "server/x.py") == []  # server is not a hot package
 
 
+def test_memory_stats_is_a_host_sync():
+    """`.memory_stats()` is a device-runtime round trip: flagged in the hot
+    packages, sanctioned only behind a pragma (the HBM-ledger site in
+    runtime/profiling.py), fine in host-side packages."""
+    src = "s = d.memory_stats()\n"
+    assert _rules(src, "runtime/x.py") == ["host-sync"]
+    assert _rules(src, "parallel/x.py") == ["host-sync"]
+    assert _rules(src, "server/x.py") == []
+    ok = "s = d.memory_stats()  # dlt: allow(host-sync) — cold-path ledger\n"
+    assert _rules(ok, "runtime/x.py") == []
+
+
 def test_trace_hot_emit_scoped_to_hot_packages():
     """Per-iteration span emission in runtime loops must ride a pre-bound
     emitter (runtime/tracing.py Emitter): `.event(...)` in a loop body —
