@@ -155,9 +155,16 @@ class LadderEntry:
     speculative-decoding verify forwards — logits at every drafted
     position, scalar vs per-row positions; runtime/speculative.py),
     "prefix_extract" /"prefix_copy" / "prefix_copy_row" (the prefix
-    cache's publish/splice copy programs). `size` is the token-chunk size,
-    decode n_steps, draft bucket + 1, or prefix bucket; `kv_len` the
-    static KV read bucket (== size for prefix programs)."""
+    cache's publish/splice copy programs — contiguous engines only),
+    "page_copy" (the paged layout's copy-on-write page copy,
+    runtime/paged_kv.py — paged engines share prefix pages host-side and
+    carry no prefix copy programs). `size` is the token-chunk size,
+    decode n_steps, draft bucket + 1, prefix bucket, or page size;
+    `kv_len` the static KV read bucket (== size for prefix/page
+    programs). On paged engines every forward-shaped program additionally
+    takes the [b, slots] int32 page table as a small operand — the page
+    count a bucket gathers is kv_len/page_size, so the same triples pin
+    the paged shapes."""
 
     kind: str
     size: int
@@ -182,12 +189,36 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _paged_args(engine):
+    """(page_table ShapeDtypeStruct, page_size) for a paged engine, or
+    (None, None) — the extra operands every forward-shaped paged program
+    carries (runtime/paged_kv.py)."""
+    if not getattr(engine, "paged", False):
+        return None, None
+    return (
+        _sds((engine.batch, engine.page_pool.max_slots), jnp.int32),
+        engine.page_size,
+    )
+
+
 def trace_entry(engine, entry: LadderEntry):
     """`jax.make_jaxpr` of the program `entry` names, with abstract token /
     position inputs and the engine's real params/cache closed over (tracing
     reads shapes and shardings; nothing executes)."""
     cfg, b = engine.cfg, engine.batch
+    pt_sds, ps = _paged_args(engine)
     if entry.kind == "prefill":
+        if engine.paged:
+            from ..models.transformer import forward
+
+            fn = lambda toks, pos, pt: forward(
+                cfg, engine.params, engine.rope, engine.cache, toks, pos,
+                logits_mode="last", kv_len=entry.kv_len, page_table=pt,
+                page_size=ps,
+            )
+            return jax.make_jaxpr(fn)(
+                _sds((b, entry.size), jnp.int32), _sds((), jnp.int32), pt_sds
+            )
         fn = lambda toks, pos: engine._forward(
             toks, pos, logits_mode="last", kv_len=entry.kv_len
         )
@@ -195,7 +226,9 @@ def trace_entry(engine, entry: LadderEntry):
             _sds((b, entry.size), jnp.int32), _sds((), jnp.int32)
         )
     if entry.kind == "decode":
-        key = jax.random.PRNGKey(0)
+        from ..runtime.engine import _greedy_prng_key
+
+        key = _greedy_prng_key()
         if engine.use_pipeline:
             from ..parallel.pipeline import pipeline_decode_chunk
 
@@ -207,6 +240,15 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.decode import decode_chunk
 
+            if engine.paged:
+                fn = lambda tok, pos, pt: decode_chunk(
+                    cfg, engine.params, engine.rope, engine.cache, tok, pos,
+                    key, n_steps=entry.size, temperature=0.0, topp=0.9,
+                    kv_len=entry.kv_len, page_table=pt, page_size=ps,
+                )
+                return jax.make_jaxpr(fn)(
+                    _sds((b,), jnp.int32), _sds((), jnp.int32), pt_sds
+                )
             fn = lambda tok, pos: decode_chunk(
                 cfg, engine.params, engine.rope, engine.cache, tok, pos, key,
                 n_steps=entry.size, temperature=0.0, topp=0.9,
@@ -223,6 +265,20 @@ def trace_entry(engine, entry: LadderEntry):
             )
             return jax.make_jaxpr(fn)(
                 _sds((b, entry.size), jnp.int32), _sds((b,), jnp.int32)
+            )
+        if engine.paged:
+            # the paged admission prefill is the b=1 forward steered by the
+            # row's one-row page-table slice (engine._dispatch_prefill_row)
+            from ..models.transformer import forward
+
+            fn = lambda toks, pos, pt: forward(
+                cfg, engine.params, engine.rope, engine.cache, toks, pos,
+                logits_mode="last", kv_len=entry.kv_len, page_table=pt,
+                page_size=ps,
+            )
+            return jax.make_jaxpr(fn)(
+                _sds((1, entry.size), jnp.int32), _sds((), jnp.int32),
+                _sds((1, engine.page_pool.max_slots), jnp.int32),
             )
         from ..runtime.batch_session import prefill_row
 
@@ -246,6 +302,17 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.batch_session import batch_decode_chunk
 
+            if engine.paged:
+                fn = lambda tok, pos, keys, temp, topp, pt: batch_decode_chunk(
+                    cfg, engine.params, engine.rope, engine.cache, tok, pos,
+                    keys, temp, topp, n_steps=entry.size, kv_len=entry.kv_len,
+                    page_table=pt, page_size=ps,
+                )
+                return jax.make_jaxpr(fn)(
+                    _sds((b,), jnp.int32), _sds((b,), jnp.int32),
+                    _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
+                    _sds((b,), jnp.float32), pt_sds,
+                )
             fn = lambda tok, pos, keys, temp, topp: batch_decode_chunk(
                 cfg, engine.params, engine.rope, engine.cache, tok, pos,
                 keys, temp, topp, n_steps=entry.size, kv_len=entry.kv_len,
@@ -255,6 +322,11 @@ def trace_entry(engine, entry: LadderEntry):
             _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
             _sds((b,), jnp.float32),
         )
+    if entry.kind == "page_copy":
+        from ..runtime.paged_kv import copy_page
+
+        fn = lambda src, dst: copy_page(engine.cache, src, dst)
+        return jax.make_jaxpr(fn)(_sds((), jnp.int32), _sds((), jnp.int32))
     if entry.kind in ("verify", "verify_row"):
         # the speculative verify program: a prefill-shaped logits_mode="all"
         # forward (+ in-graph argmax on the fused non-mesh path). Mirrors
@@ -276,6 +348,14 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.speculative import verify_chunk
 
+            if engine.paged:
+                fn = lambda toks, pos, pt: verify_chunk(
+                    cfg, engine.params, engine.rope, engine.cache, toks, pos,
+                    kv_len=entry.kv_len, page_table=pt, page_size=ps,
+                )
+                return jax.make_jaxpr(fn)(
+                    _sds((b, entry.size), jnp.int32), pos_sds, pt_sds
+                )
             fn = lambda toks, pos: verify_chunk(
                 cfg, engine.params, engine.rope, engine.cache, toks, pos,
                 kv_len=entry.kv_len,
@@ -436,7 +516,9 @@ def donation_problems(engine) -> list:
     function, not per shape."""
     cfg, b = engine.cfg, engine.batch
     kvb = engine._kv_bucket(1)
-    key = jax.random.PRNGKey(0)
+    from ..runtime.engine import _greedy_prng_key
+
+    key = _greedy_prng_key()  # the typed key aval serving dispatches
     tok1 = jnp.zeros((b, 1), jnp.int32)
     tokb = jnp.zeros((b,), jnp.int32)
     pos = jnp.int32(0)
@@ -479,11 +561,17 @@ def donation_problems(engine) -> list:
         from ..models.transformer import forward
         from ..runtime.decode import decode_chunk
 
+        pt = (
+            jnp.zeros((b, engine.page_pool.max_slots), jnp.int32)
+            if engine.paged
+            else None
+        )
+        ps = engine.page_size
         check(
             "forward",
             forward.lower(
                 cfg, engine.params, engine.rope, engine.cache, tok1, pos,
-                logits_mode="last", kv_len=kvb,
+                logits_mode="last", kv_len=kvb, page_table=pt, page_size=ps,
             ),
         )
         check(
@@ -491,8 +579,18 @@ def donation_problems(engine) -> list:
             decode_chunk.lower(
                 cfg, engine.params, engine.rope, engine.cache, tokb, pos,
                 key, n_steps=1, temperature=0.0, topp=0.9, kv_len=kvb,
+                page_table=pt, page_size=ps,
             ),
         )
+        if engine.paged:
+            # the copy-on-write page copy moves KV within the donated pool;
+            # a lost donation would duplicate the whole pool per COW
+            from ..runtime.paged_kv import copy_page
+
+            check(
+                "copy_page",
+                copy_page.lower(engine.cache, jnp.int32(0), jnp.int32(1)),
+            )
         if engine.batch > 1:
             from ..runtime.batch_session import batch_decode_chunk, prefill_row
 
@@ -502,16 +600,19 @@ def donation_problems(engine) -> list:
                     cfg, engine.params, engine.rope, engine.cache, tokb,
                     jnp.zeros((b,), jnp.int32), jnp.zeros((b, 2), jnp.uint32),
                     jnp.zeros((b,), jnp.float32), jnp.full((b,), 0.9, jnp.float32),
-                    n_steps=1, kv_len=kvb,
+                    n_steps=1, kv_len=kvb, page_table=pt, page_size=ps,
                 ),
             )
-            check(
-                "prefill_row",
-                prefill_row.lower(
-                    cfg, engine.params, engine.rope, engine.cache,
-                    jnp.zeros((1, 1), jnp.int32), pos, jnp.int32(0), kv_len=kvb,
-                ),
-            )
+            if not engine.paged:
+                # paged admission prefill rides the b=1 `forward` (already
+                # checked above); the row-slice program is contiguous-only
+                check(
+                    "prefill_row",
+                    prefill_row.lower(
+                        cfg, engine.params, engine.rope, engine.cache,
+                        jnp.zeros((1, 1), jnp.int32), pos, jnp.int32(0), kv_len=kvb,
+                    ),
+                )
     if engine.spec_mode is not None and not engine.use_pipeline:
         # the fused verify program donates the cache exactly like a prefill
         # chunk; a lost donation would copy the whole KV stack every round
@@ -523,9 +624,19 @@ def donation_problems(engine) -> list:
             verify_chunk.lower(
                 cfg, engine.params, engine.rope, engine.cache,
                 jnp.zeros((b, k0 + 1), jnp.int32), pos, kv_len=kvb,
+                page_table=(
+                    jnp.zeros((b, engine.page_pool.max_slots), jnp.int32)
+                    if engine.paged
+                    else None
+                ),
+                page_size=engine.page_size,
             ),
         )
-    if engine.prefix_cache is not None and engine.prefix_cache.buckets:
+    if (
+        engine.prefix_cache is not None
+        and engine.prefix_cache.buckets
+        and not getattr(engine.prefix_cache, "paged", False)
+    ):
         # the prefix-cache splice programs donate the live cache too: a
         # lost donation would double the cache's HBM footprint on every hit
         from ..runtime.prefix_cache import (
@@ -689,6 +800,12 @@ def main(argv=None) -> int:
         help="draft budget for the audited verify ladder (8 = both buckets)",
     )
     p.add_argument(
+        "--kv-layout", choices=["contiguous", "paged"], default="contiguous",
+        help="audit the paged-KV program ladder (page-table gather/scatter "
+        "forwards + the copy-on-write page copy) instead of the contiguous "
+        "one (runtime/paged_kv.py)",
+    )
+    p.add_argument(
         "--costs", action="store_true",
         help="also build the warm-ladder cost/memory table "
         "(runtime/profiling.py) and FAIL if any warm_plan() program is "
@@ -710,6 +827,7 @@ def main(argv=None) -> int:
             max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
             prefix_cache_mb=args.prefix_cache_mb,
             speculative=args.speculative, draft_k=args.draft_k,
+            kv_layout=args.kv_layout,
         )
         try:
             reports = audit_engine(engine)

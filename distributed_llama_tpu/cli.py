@@ -106,6 +106,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help=".m file for the --speculative model draft engine (a smaller "
         "model drafting autoregressively)",
     )
+    p.add_argument(
+        "--kv-layout", choices=["contiguous", "paged"], default=None,
+        help="KV cache layout (runtime/paged_kv.py): 'paged' = fixed-size "
+        "KV pages + per-row page tables with zero-copy prefix sharing and "
+        "copy-on-write (the batch-scale layout; single-chip engines only); "
+        "'contiguous' = per-row seq_len slabs (the bit-identity A/B arm). "
+        "Default: DLT_KV_LAYOUT env, else contiguous",
+    )
+    p.add_argument(
+        "--kv-page-size", type=int, default=0,
+        help="tokens per KV page (power of two; default DLT_KV_PAGE env, "
+        "else 16 — aligned with the prefix cache's bucket floor so hits "
+        "share whole pages)",
+    )
+    p.add_argument(
+        "--kv-pool-mb", type=int, default=0,
+        help="paged KV pool HBM budget in MB (default DLT_KV_POOL_MB env, "
+        "else contiguous parity: batch x seq_len worth of pages). Smaller "
+        "pools serve MORE rows per HBM byte when rows are shorter than "
+        "seq_len; exhaustion parks admissions and sheds with 503",
+    )
     return p
 
 
@@ -177,6 +198,17 @@ def make_engine(args) -> InferenceEngine:
             ),
             owns=True,
         )
+    from .runtime.paged_kv import resolve_kv_layout
+
+    kv_layout = resolve_kv_layout(getattr(args, "kv_layout", None))
+    if kv_layout == "paged" and mesh is not None:
+        # multi-chip engines keep the contiguous layout (paged is
+        # single-chip for now) — say so instead of failing the launch
+        print(
+            "⚠️  --kv-layout paged is single-chip only: this mesh engine "
+            "keeps the contiguous KV layout"
+        )
+        kv_layout = "contiguous"
     try:
         engine = InferenceEngine(
             args.model,
@@ -192,6 +224,9 @@ def make_engine(args) -> InferenceEngine:
             speculative=spec_mode or "off",
             draft_k=draft_k,
             draft_source=draft_source,
+            kv_layout=kv_layout,
+            kv_page_size=getattr(args, "kv_page_size", 0) or None,
+            kv_pool_mb=getattr(args, "kv_pool_mb", 0) or None,
         )
     except BaseException:
         # the main engine failed to build: release the draft engine's

@@ -332,6 +332,13 @@ def _layer(
     cache_layer=None,  # stacked_cache index; defaults to layer_idx (the
     # pipeline path passes per-layer weight slices — layer_idx None — but
     # still carries a stacked LOCAL cache, so the two indices differ there)
+    page_table=None,  # [b, max_slots] int32 traced array (paged KV layout,
+    # runtime/paged_kv.py): k_cache/v_cache are then the [L, n_pages,
+    # page_size, h, d] page POOLS, writes scatter through the table and
+    # attention reads gather the first kv_len/page_size pages per row. -1
+    # entries are unmapped: their writes DROP, their reads clamp to page 0
+    # and are causally masked. None = contiguous layout (unchanged).
+    page_size=None,  # static page length in tokens (paged layout only)
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
@@ -373,7 +380,47 @@ def _layer(
     q = apply_rope(q, rope, positions, cfg.rope_type)
     k = apply_rope(k, rope, positions, cfg.rope_type)
 
-    if sp_ctx is None:
+    if page_table is not None:
+        # -- paged KV layout (runtime/paged_kv.py): the cache stacks are
+        # page POOLS [L, P, ps, h, d]; logical positions map through the
+        # per-row page table. Same write-before-read/causal-mask invariants
+        # as contiguous — outputs are token-identical by construction.
+        li = cache_layer
+        ps = page_size
+        n_pool = k_cache.shape[1]
+        max_slots = page_table.shape[1]
+        # write: scatter each new row to (table[pos // ps], pos % ps).
+        # Invalid writes — parked rows at/past seq_len, or an unmapped
+        # (-1) table entry — remap to pairwise-distinct page indices past
+        # the pool and DROP (colliding dropped indices would be undefined
+        # scatter behavior, the same discipline as scatter_cache_update_sp)
+        slot = positions // ps
+        offset = positions % ps
+        safe_slot = jnp.clip(slot, 0, max_slots - 1)
+        phys = jnp.take_along_axis(page_table, safe_slot, axis=1)  # [b, t]
+        invalid = (positions >= cfg.seq_len) | (slot >= max_slots) | (phys < 0)
+        b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
+        col = jnp.arange(t, dtype=jnp.int32)[None, :]
+        phys = jnp.where(invalid, n_pool + b_idx * t + col, phys)
+        k_cache = k_cache.at[li, phys, offset].set(
+            k.astype(k_cache.dtype), mode="drop", unique_indices=True
+        )
+        v_cache = v_cache.at[li, phys, offset].set(
+            v.astype(v_cache.dtype), mode="drop", unique_indices=True
+        )
+        # read: gather the first kv_len/ps page entries per row into the
+        # contiguous [b, n*ps, h, d] view the attention math consumes —
+        # this gather is the layout's whole read cost (the cost model
+        # counts it; analysis/profiling.py). Unmapped entries clamp to
+        # page 0: garbage, causally masked like any junk past a row's pos.
+        n_read = max_slots if kv_len is None else min(-(-kv_len // ps), max_slots)
+        pages = jnp.maximum(
+            jax.lax.slice_in_dim(page_table, 0, n_read, axis=1), 0
+        )  # [b, n_read]
+        k_view = k_cache[li, pages].reshape(b, n_read * ps, -1, cfg.head_dim)
+        v_view = v_cache[li, pages].reshape(b, n_read * ps, -1, cfg.head_dim)
+        a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
+    elif sp_ctx is None:
         if stacked_cache:
             # in-place update of this layer's rows inside the full carried
             # stack; attention then reads a bucketed dynamic-slice view. The
@@ -518,6 +565,9 @@ def forward_uncompiled(
     # batch decode / DP serving)
     logits_mode: str = "last",  # "last" | "all"
     kv_len: int | None = None,  # static KV read bound (see _layer)
+    page_table: jnp.ndarray | None = None,  # [b, max_slots] int32 — paged
+    # KV layout (cache = page pools; see _layer's paged branch)
+    page_size: int | None = None,  # static page length (paged layout only)
 ) -> tuple[jnp.ndarray, KVCache]:
     """One forward step (prefill chunk or decode token).
 
@@ -546,6 +596,7 @@ def forward_uncompiled(
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
             layer_idx=li, kv_len=kv_len, stacked_cache=True,
+            page_table=page_table, page_size=page_size,
         )
         return (x, k_c, v_c), None
 
@@ -560,7 +611,11 @@ def forward_uncompiled(
 
 
 # The jit entry point: cache is donated (updated in place in HBM); one
-# compiled program per (cfg, token-shape, logits_mode, kv_len bucket).
+# compiled program per (cfg, token-shape, logits_mode, kv_len bucket,
+# page_size arm). The page table (paged layout) rides as a small non-donated
+# operand.
 forward = partial(
-    jax.jit, static_argnames=("cfg", "logits_mode", "kv_len"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("cfg", "logits_mode", "kv_len", "page_size"),
+    donate_argnames=("cache",),
 )(forward_uncompiled)

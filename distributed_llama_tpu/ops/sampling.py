@@ -37,21 +37,77 @@ def sample_logits(
     return _sample_topp(probs, key, topp)
 
 
-def _sample_topp(probs: jnp.ndarray, key: jnp.ndarray, topp: float) -> jnp.ndarray:
+def _sample_topp(
+    probs: jnp.ndarray, key: jnp.ndarray | None, topp, coin=None
+) -> jnp.ndarray:
+    """Top-p pick over [b, vocab] probs: keep everything up to and
+    including the first element whose cumulative probability exceeds topp
+    (reference: sample_topp, tokenizer.cpp:426-447). `topp` may be a static
+    float (the host-parity path) or a traced scalar (`sample_logits_traced`
+    — which also passes its pre-drawn `coin` so both of its arms consume
+    ONE uniform); with `coin=None` the draw happens here, bit-matching the
+    original static program's stream."""
     b, n = probs.shape
     sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
     order = jnp.argsort(-probs, axis=-1)
     csum = jnp.cumsum(sorted_probs, axis=-1)
-    # keep everything up to and including the first element whose cumulative
-    # probability exceeds topp (reference: sample_topp, tokenizer.cpp:426-447)
     over = csum > topp
     keep = jnp.logical_not(jnp.concatenate([jnp.zeros((b, 1), bool), over[:, :-1]], axis=-1))
     kept = jnp.where(keep, sorted_probs, 0.0)
     kept_sum = jnp.sum(kept, axis=-1, keepdims=True)
-    coin = jax.random.uniform(key, (b, 1)) * kept_sum
+    if coin is None:
+        coin = jax.random.uniform(key, (b, 1))
     cdf = jnp.cumsum(kept, axis=-1)
-    pick = jnp.sum(cdf < coin, axis=-1).clip(0, n - 1)
+    pick = jnp.sum(cdf < coin * kept_sum, axis=-1).clip(0, n - 1)
     return jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def sample_logits_traced(
+    logits: jnp.ndarray,  # [b, vocab] f32
+    key: jnp.ndarray,
+    temperature: jnp.ndarray,  # traced scalar; <= 0 = greedy
+    topp: jnp.ndarray,  # traced scalar; outside (0, 1) = full distribution
+) -> jnp.ndarray:
+    """`sample_logits` with TRACED temperature/top-p scalars: ONE compiled
+    program serves every sampling setting, so a sampled request can never
+    compile a new decode program after warmup (the recompile-sentinel
+    contract — warmup only ever runs temperature 0). The greedy/sampled
+    split is a `lax.cond` on the traced scalar: BOTH branches live in the
+    one compiled program, but a greedy step executes only the argmax at
+    runtime — the sampled branch's O(vocab log vocab) sorts would otherwise
+    tax every step of the default greedy serving path. The greedy arm is
+    the exact argmax chain (bit-identical to the old static program at
+    temperature 0); the top-p arm draws the same single
+    `uniform(key, (b, 1))` the static program's 0 < topp < 1 branch drew,
+    so seeded top-p streams carry over too."""
+
+    def greedy_arm(logits, key, temperature, topp):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled_arm(logits, key, temperature, topp):
+        b, n = logits.shape
+        temp_safe = jnp.maximum(temperature, 1e-6)
+        probs = jax.nn.softmax(logits / temp_safe, axis=-1)
+        coin = jax.random.uniform(key, (b, 1))
+
+        # full-distribution arm (topp outside (0, 1)): vocab-order CDF
+        full_cdf = jnp.cumsum(probs, axis=-1)
+        full_pick = (
+            jnp.sum(full_cdf < coin, axis=-1).clip(0, n - 1).astype(jnp.int32)
+        )
+
+        # top-p arm: THE shared truncated-CDF pick, traced topp + the one
+        # coin above (clamped to 1.0 outside (0,1) so both arms are finite)
+        topp_safe = jnp.where((topp > 0.0) & (topp < 1.0), topp, 1.0)
+        topp_pick = _sample_topp(probs, None, topp_safe, coin=coin)
+
+        in_topp = (topp > 0.0) & (topp < 1.0)
+        return jnp.where(in_topp, topp_pick, full_pick)
+
+    return jax.lax.cond(
+        temperature <= 0.0, greedy_arm, sampled_arm, logits, key, temperature,
+        topp,
+    )
 
 
 def split_row_keys(keys_data: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

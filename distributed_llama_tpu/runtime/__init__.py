@@ -22,6 +22,8 @@ _EXPORTS = {
     "DraftSource": ".speculative",
     "NGramDraft": ".speculative",
     "ModelDraft": ".speculative",
+    "PagePool": ".paged_kv",
+    "PagePoolExhausted": ".paged_kv",
 }
 
 __all__ = list(_EXPORTS)

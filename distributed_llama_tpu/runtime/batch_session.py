@@ -56,7 +56,7 @@ from .tracing import to_us
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "kv_len"),
+    static_argnames=("cfg", "n_steps", "kv_len", "page_size"),
     donate_argnames=("cache",),
 )
 def batch_decode_chunk(
@@ -71,6 +71,8 @@ def batch_decode_chunk(
     topp: jnp.ndarray,  # [b] f32
     n_steps: int = 16,
     kv_len: int | None = None,
+    page_table: jnp.ndarray | None = None,  # paged KV layout (paged_kv.py)
+    page_size: int | None = None,
 ):
     """n_steps decode iterations with everything per-row and TRACED — one
     compiled program per (batch, n_steps, kv_len) serves any mix of
@@ -81,6 +83,7 @@ def batch_decode_chunk(
         logits, cache = forward_uncompiled(
             cfg, params, rope, cache, token[:, None], pos,
             logits_mode="last", kv_len=kv_len,
+            page_table=page_table, page_size=page_size,
         )
         keys, subs = split_row_keys(keys)
         nxt = sample_logits_per_row(logits, subs, temperature, topp)
@@ -280,11 +283,16 @@ class BatchSession:
                 # row write-before-read invariant).
                 t_splice = time.perf_counter()
                 try:
-                    with eng._guard(
-                        f"prefix_copy_row[{entry.length}]",
-                        ("prefix_copy_row", entry.length, entry.length),
-                    ):
-                        eng.cache = eng.prefix_cache.splice_row(eng, entry, row)
+                    if eng.paged:
+                        # zero-copy: the entry's pages map into this row's
+                        # table host-side (no device dispatch, no guard)
+                        eng.prefix_cache.share_row(eng, entry, row, st["resume"])
+                    else:
+                        with eng._guard(
+                            f"prefix_copy_row[{entry.length}]",
+                            ("prefix_copy_row", entry.length, entry.length),
+                        ):
+                            eng.cache = eng.prefix_cache.splice_row(eng, entry, row)
                 finally:
                     # ALWAYS unpin — a watchdog StallError out of the guard
                     # must not leave the entry pinned (unevictable) forever
@@ -315,32 +323,11 @@ class BatchSession:
                 )
                 chunk = pre[done : done + n_real] + [0] * (size - n_real)
                 kv_len = eng._kv_bucket(done + size)
-                if eng.use_pipeline:
-                    # mesh path: whole-batch forward with every other row
-                    # parked at seq_len (writes dropped)
-                    from ..parallel.pipeline import pipeline_forward
-
-                    toks = np.zeros((eng.batch, size), np.int32)
-                    toks[row, :] = chunk
-                    pos_vec = np.full((eng.batch,), self.seq_len, np.int32)
-                    pos_vec[row] = done
-                    toks_dev, pos_dev = jax.device_put((toks, pos_vec))
-                    _, eng.cache = pipeline_forward(
-                        eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
-                        toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
-                    )
-                else:
-                    toks_dev, pos_dev, row_dev = jax.device_put(
-                        (
-                            np.asarray([chunk], np.int32),  # dlt: allow(host-sync) — host token list -> device operand prep
-                            np.int32(done),
-                            np.int32(row),
-                        )
-                    )
-                    eng.cache = prefill_row(
-                        eng.cfg, eng.params, eng.rope, eng.cache,
-                        toks_dev, pos_dev, row_dev, kv_len=kv_len,
-                    )
+                # dispatch through the ONE owner of the admission-prefill
+                # chunk program (engine._dispatch_prefill_row: pipeline /
+                # paged / contiguous-row arms — warmup's ladder fill and
+                # the session must compile the same shapes)
+                eng._dispatch_prefill_row(row, chunk, done, kv_len)
                 if em_chunk is not None:
                     # dispatch wall of this admission-prefill chunk (the
                     # dispatch is async; completion is observed by the next
@@ -376,13 +363,19 @@ class BatchSession:
         the slot can be re-admitted later without disturbing anyone. Also
         drops any staged admission mid-prefill (its partial KV is junk past
         every live row's view, same as any parked interval) — unpinning the
-        prefix-cache entry a never-spliced admission still holds."""
+        prefix-cache entry a never-spliced admission still holds. Paged
+        engines release the row's page mappings here: pages shared with
+        prefix-cache entries survive via the entry's own refs, everything
+        else returns to the pool (the refcount-release-on-finish contract)."""
         self.active[row] = False
         self.pos[row] = self.seq_len
         self.temp[row] = 0.0  # greedy is the cheap sampling path for junk
         st = self._pending.pop(row, None)
         if st is not None and st.get("entry") is not None:
             self.engine.prefix_cache.entry_release(st["entry"])
+        if self.engine.paged:
+            self.engine.page_pool.release_row(row)
+            self.engine._pt_cache = None
 
     def publish_row(self, row: int, tokens: list) -> None:
         """Publish the first `len(tokens) - 1` tokens' KV of `row` into the
@@ -462,6 +455,15 @@ class BatchSession:
             )
         kv_len = eng._kv_bucket(min(max(ends, default=1), self.seq_len))
         t_chunk = time.perf_counter()
+        if eng.paged:
+            # paged layout: every live row needs private pages over its
+            # chunk span BEFORE the dispatch (PagePoolExhausted surfaces
+            # here — the Batcher's park/shed path; parked rows write
+            # nothing and need nothing)
+            eng._ensure_pages(
+                (r, int(self.pos[r]), int(self.pos[r]) + n_steps)
+                for r in self.active_rows()
+            )
         # the sanitizer scope covers the Batcher's production decode path
         # exactly like the solo loops: the ONLY device->host syncs allowed
         # in here are the two _host_fetch calls below (DLT_SANITIZERS=1)
@@ -482,6 +484,8 @@ class BatchSession:
                 toks, eng.cache, keys = batch_decode_chunk(
                     eng.cfg, eng.params, eng.rope, eng.cache,
                     token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+                    page_table=eng._pt_operand() if eng.paged else None,
+                    page_size=eng.page_size,
                 )
             # the fetch is the batch path's one blocking device call —
             # watchdog it like the solo decode path, so a wedged device

@@ -25,12 +25,12 @@ from ..models.config import ModelConfig
 from ..models.params import KVCache, ModelParams
 from ..models.transformer import forward_uncompiled
 from ..ops.rope import RopeTables
-from ..ops.sampling import sample_logits
+from ..ops.sampling import sample_logits_traced
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "temperature", "topp", "kv_len"),
+    static_argnames=("cfg", "n_steps", "kv_len", "page_size"),
     donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -42,11 +42,17 @@ def decode_chunk(
     pos_start,  # scalar int32
     key: jnp.ndarray,  # PRNG key (ignored when temperature == 0)
     n_steps: int = 16,
-    temperature: float = 0.0,
-    topp: float = 0.9,
+    temperature=0.0,  # TRACED scalar: one compiled program per (n_steps,
+    # kv_len) serves every temperature — a sampled request can no longer
+    # compile a fresh program mid-serving (the /v1/chat post-warmup
+    # recompile: warmup only ever ran temperature 0)
+    topp=0.9,  # traced, same reason
     kv_len: int | None = None,  # static KV read bound covering
     # pos_start + n_steps (the engine's position bucket): attention reads
     # scale with the position, not the allocated cache
+    page_table: jnp.ndarray | None = None,  # paged KV layout: [b, slots]
+    # int32 (runtime/paged_kv.py); cache is then the page pools
+    page_size: int | None = None,
 ):
     """Run n_steps feed-forward+sample iterations on device.
 
@@ -56,15 +62,17 @@ def decode_chunk(
     host-issued device op costs a round trip, and the decode loop's per-chunk
     op count is the serving overhead floor.
     """
+    temperature = jnp.asarray(temperature, jnp.float32)
+    topp = jnp.asarray(topp, jnp.float32)
 
     def step(carry, _):
         token, pos, cache, key = carry
         logits, cache = forward_uncompiled(
             cfg, params, rope, cache, token[:, None], pos, logits_mode="last",
-            kv_len=kv_len,
+            kv_len=kv_len, page_table=page_table, page_size=page_size,
         )
         key, sub = jax.random.split(key)
-        nxt = sample_logits(logits, sub, temperature, topp)
+        nxt = sample_logits_traced(logits, sub, temperature, topp)
         return (nxt, pos + 1, cache, key), nxt
 
     (last, _, cache, _), toks = jax.lax.scan(

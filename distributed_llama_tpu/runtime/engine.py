@@ -111,6 +111,18 @@ def _next_subkey(key, temperature: float):
     return jax.random.split(key)
 
 
+def _greedy_prng_key() -> jax.Array:
+    """The throwaway key greedy chunks carry (they never draw). TYPED
+    threefry key — the same aval `_sampler_prng_key` produces — so greedy
+    warmup and sampled serving dispatch ONE compiled decode program per
+    (n, kv-bucket): a legacy `PRNGKey(0)` operand here gave the sampled
+    path a different key dtype and a post-warmup recompile (the recorded
+    /v1/chat fatal-sanitizer hole)."""
+    return jax.random.wrap_key_data(
+        jnp.zeros((2,), dtype=jnp.uint32), impl="threefry2x32"
+    )
+
+
 def _sampler_prng_key(sampler) -> jax.Array:
     """Device PRNG key derived from the host sampler's xorshift* state.
 
@@ -120,7 +132,7 @@ def _sampler_prng_key(sampler) -> jax.Array:
     int64 for half the state space."""
     state = getattr(sampler, "_state", None)
     if state is None:
-        return jax.random.PRNGKey(0)
+        return _greedy_prng_key()
     s = int(state)
     return jax.random.wrap_key_data(
         jnp.asarray([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], dtype=jnp.uint32),
@@ -194,6 +206,18 @@ class InferenceEngine:
         # (bucketed at {4, 8}). None = DLT_DRAFT_K env, default 4
         draft_source=None,  # DraftSource override; REQUIRED for "model"
         # (a speculative.ModelDraft wrapping the smaller draft engine)
+        kv_layout: str | None = None,  # "contiguous" (per-row seq_len KV
+        # slabs — the reference shape and the bit-identity A/B arm) or
+        # "paged" (fixed-size KV pages + per-row page tables, zero-copy
+        # prefix sharing, copy-on-write; runtime/paged_kv.py). None =
+        # DLT_KV_LAYOUT env, default contiguous. Paged requires mesh=None
+        # (single-chip/GSPMD-free — multi-chip paging is a follow-on).
+        kv_page_size: int | None = None,  # tokens per KV page (power of
+        # two). None = DLT_KV_PAGE env, default 16 — aligned with the
+        # prefix cache's bucket floor so hits share whole pages
+        kv_pool_mb: int | None = None,  # paged-pool HBM budget. None =
+        # DLT_KV_POOL_MB env; 0/unset = contiguous parity (batch x seq_len
+        # worth of pages), so default paged never fits fewer tokens
     ):
         maybe_enable_compilation_cache()
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
@@ -267,6 +291,40 @@ class InferenceEngine:
         self.device_decode = device_decode
         self.decode_chunk_size = decode_chunk_size
         self.stats = StepStats()
+        # KV layout (runtime/paged_kv.py): paged replaces the per-row
+        # contiguous slabs with a page pool + per-row page tables. The
+        # contiguous arm stays byte-for-byte what it was — it is the
+        # bit-identity A/B reference for the paged programs.
+        from .paged_kv import (
+            PagePool,
+            page_pool_bytes,
+            resolve_kv_layout,
+            resolve_page_size,
+            resolve_pool_pages,
+        )
+
+        self.kv_layout = resolve_kv_layout(kv_layout)
+        self.paged = self.kv_layout == "paged"
+        self.page_size = resolve_page_size(kv_page_size) if self.paged else None
+        self.page_pool = None
+        self._pt_cache = None  # (pool.version, device tables) — the cached
+        # page-table operand; invalidated by any pool mutation
+        if self.paged:
+            if mesh is not None:
+                raise ValueError(
+                    "kv_layout='paged' requires mesh=None (single-chip); "
+                    "multi-chip engines keep the contiguous layout"
+                )
+            ps = self.page_size
+            max_slots = -(-self.cfg.seq_len // ps)
+            parity = self.batch * max_slots
+            n_pages = resolve_pool_pages(
+                kv_pool_mb, page_pool_bytes(self.cfg, 1, ps), parity
+            )
+            self.page_pool = PagePool(
+                n_pages, ps, self.batch, self.cfg.seq_len, stats=self.stats,
+                reclaim=self._reclaim_pages,
+            )
         self.cache = self._new_cache()
         if verbose:
             print(memory_report(self.params, self.cache))
@@ -470,7 +528,7 @@ class InferenceEngine:
                         plan.append(("verify", k + 1, kvb))
                         if self.batch > 1:
                             plan.append(("verify_row", k + 1, kvb))
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self.paged:
             for P in self.prefix_cache.buckets:
                 # extract first: its (correctly sharded) outputs are the
                 # operands the copy warms compile against, exactly like the
@@ -479,6 +537,13 @@ class InferenceEngine:
                 plan.append(("prefix_copy", P, P))
                 if self.batch > 1 and self.device_decode:
                     plan.append(("prefix_copy_row", P, P))
+        if self.paged:
+            # the paged prefix cache shares pages host-side (zero copy
+            # programs); its ONE device program is the copy-on-write page
+            # copy. Keyed (page_copy, page_size, page_size): the page count
+            # in the gather programs above is kv-bucket/page_size, so the
+            # (kind, size, kv-bucket) triples already pin the paged shapes.
+            plan.append(("page_copy", self.page_size, self.page_size))
         return plan
 
     def cost_table(self, build: bool = True):
@@ -525,12 +590,22 @@ class InferenceEngine:
                 tokens_arr, pos_start, logits_mode=logits_mode,
                 microbatches=micro, kv_len=kv_len,
             )
+        if self.paged:
+            return forward(
+                self.cfg, self.params, self.rope, self.cache, tokens_arr,
+                pos_start, logits_mode=logits_mode, kv_len=kv_len,
+                page_table=self._pt_operand(), page_size=self.page_size,
+            )
         return forward(
             self.cfg, self.params, self.rope, self.cache, tokens_arr,
             pos_start, logits_mode=logits_mode, kv_len=kv_len,
         )
 
     def _new_cache(self):
+        if self.paged:
+            from .paged_kv import init_kv_pool
+
+            return init_kv_pool(self.cfg, self.page_pool.n_pages, self.page_size)
         cache = init_kv_cache(self.cfg, self.batch)
         if self._cache_sharding is not None:
             import jax as _jax
@@ -542,8 +617,73 @@ class InferenceEngine:
         return cache
 
     def reset(self):
-        """Zero the cache (new independent sequence)."""
+        """Fresh independent sequence: contiguous zeros the cache; paged
+        releases every row's page mappings IN PLACE (the pool arrays must
+        survive — prefix-cache entries hold page indices into them; their
+        pinned pages keep their refcounts and the next request's writes
+        land in freshly allocated pages — write-before-read, as ever)."""
+        if self.paged:
+            self.page_pool.release_all_rows()
+            self._pt_cache = None
+            try:
+                dead = self.cache.k.is_deleted()
+            except Exception:  # dlt: allow(swallowed-exception) — treat an unreadable buffer as dead and rebuild
+                dead = True
+            if dead:
+                # a failed dispatch donated the pool and died before
+                # producing the output: the old buffer is gone. Rebuild —
+                # recover() cleared the prefix cache (its page CONTENT
+                # lived in the dead pool), so no entry can splice stale ids.
+                self.cache = self._new_cache()
+            return
         self.cache = self._new_cache()
+
+    # -- paged-KV plumbing (runtime/paged_kv.py) -----------------------------
+
+    def _reclaim_pages(self) -> bool:
+        """Page-pool pressure valve: evict one LRU unpinned prefix-cache
+        entry (releasing its page refs) so the allocation can retry. False
+        = nothing to evict — the pool is truly exhausted."""
+        pc = self.prefix_cache
+        if pc is None:
+            return False
+        return pc.evict_one()
+
+    def _pt_operand(self):
+        """The device page-table operand, re-uploaded only when the pool's
+        tables actually changed (one small host->device transfer per
+        mutation, not per dispatch)."""
+        pool = self.page_pool
+        if self._pt_cache is None or self._pt_cache[0] != pool.version:
+            tables = pool.device_tables()
+            self._pt_cache = (pool.version, jax.device_put(tables))
+        return self._pt_cache[1]
+
+    def _ensure_pages(self, spans) -> None:
+        """Make every (row, start, end) span privately writable before a
+        dispatch writes it: allocates unmapped slots, replaces shared pages
+        (copy-on-write), and dispatches the :func:`paged_kv.copy_page`
+        program for the rare partial-page COW (a write starting mid-page
+        over a shared page — the only case whose old content must move)."""
+        from .paged_kv import copy_page
+
+        pool = self.page_pool
+        # per-span: each span's COW copies dispatch before the next span's
+        # allocation can raise, so an exhaustion mid-spans leaves every
+        # COMPLETED span consistent (pool.ensure itself is atomic per span)
+        for row, start, end in spans:
+            for src, dst in pool.ensure(row, start, end):
+                src_dev, dst_dev = jax.device_put(
+                    (np.int32(src), np.int32(dst))
+                )
+                with self._guard(
+                    f"page_copy[{self.page_size}]",
+                    ("page_copy", self.page_size, self.page_size),
+                ):
+                    self.cache = copy_page(self.cache, src_dev, dst_dev)
+
+    def _ensure_pages_all_rows(self, start: int, end: int) -> None:
+        self._ensure_pages((r, start, end) for r in range(self.batch))
 
     def forward_tokens(
         self, tokens: list[int], pos_start: int, logits_mode: str = "last"
@@ -551,6 +691,8 @@ class InferenceEngine:
         """Run one (unpadded, caller-shaped) forward over `tokens` for every
         batch row; returns host logits."""
         arr = jnp.asarray([tokens] * self.batch, dtype=jnp.int32)
+        if self.paged:
+            self._ensure_pages_all_rows(pos_start, pos_start + len(tokens))
         logits, self.cache = self._forward(arr, jnp.int32(pos_start), logits_mode)
         return np.asarray(logits)  # dlt: allow(host-sync) — deliberate blocking fetch; library entry, not the serving loop
 
@@ -582,6 +724,19 @@ class InferenceEngine:
             steps = min(n + self.decode_chunk_size + 8, self.cfg.seq_len)
             self.generate(prompt, steps, sampler=None, on_token=lambda t: None)
             self.reset()
+            # sampled-request RNG plumbing: a seeded/sampled request derives
+            # its device PRNG key through EAGER ops (wrap_key_data, the
+            # per-chunk split, the Batcher's key_data round trip) that XLA
+            # compiles on first use. The canonical pass above is greedy
+            # (sampler=None -> PRNGKey(0)), so without this the FIRST
+            # sampled /v1/chat request after seal tripped the recompile
+            # sentinel (the recorded fatal-sanitizer chat hole; the decode
+            # program itself is temperature-agnostic now — decode_chunk
+            # takes temperature/topp as traced operands).
+            warm_sampler = Sampler(self.cfg.vocab_size, 1.0, 0.9, 12345)
+            wkey = _sampler_prng_key(warm_sampler)
+            wkey, _ = _next_subkey(wkey, 1.0)
+            np.asarray(jax.random.key_data(wkey))  # dlt: allow(host-sync) — warmup-only compile of the seed-derivation ops
             if self.batch > 1 and self.device_decode:
                 from .batch_session import BatchSession
 
@@ -630,9 +785,17 @@ class InferenceEngine:
         synthetic positions) — warmup resets afterwards. Each entry runs the
         PRODUCTION dispatch path for its kind so the compiled shapes (and
         the `_warm` watchdog keys) are exactly what serving hits."""
-        key = jax.random.PRNGKey(0)
+        key = _greedy_prng_key()
         prefix_segs: dict = {}  # bucket -> (k_seg, v_seg) from the extract warm
         for kind, size, kvb in self.warm_plan():
+            if self.paged:
+                # bound the pool high-water during the ladder sweep: each
+                # entry allocates only its own span, and a sub-parity pool
+                # (the whole point of paging) must still warm the full
+                # ladder. Reads below the span gather unmapped sentinels —
+                # junk, same as the contiguous ladder's zero reads.
+                self.page_pool.release_all_rows()
+                self._pt_cache = None
             pos = kvb - size  # bucket(pos + size) == kvb by construction
             if kind == "prefill":
                 if ("prefill", ((size, kvb),)) in self._warm:
@@ -641,6 +804,8 @@ class InferenceEngine:
             elif kind == "decode":
                 if ("decode", size, kvb) in self._warm:
                     continue
+                if self.paged:
+                    self._ensure_pages_all_rows(pos, pos + size)
                 with self._sanitizer_scope(), self._guard(
                     f"decode[{size}]", ("decode", size, kvb)
                 ):
@@ -710,6 +875,18 @@ class InferenceEngine:
                         self.cache, k_seg, v_seg, jnp.asarray(0, jnp.int32),
                         out_sharding=self.prefix_cache.cache_sharding,
                     )
+            elif kind == "page_copy":
+                from .paged_kv import copy_page
+
+                if self.page_pool.n_pages < 2:
+                    continue  # degenerate pool: nothing to COW between
+                src_dev, dst_dev = jax.device_put(
+                    (np.int32(0), np.int32(self.page_pool.n_pages - 1))
+                )
+                with self._sanitizer_scope(), self._guard(
+                    f"page_copy[{size}]", ("page_copy", size, kvb)
+                ):
+                    self.cache = copy_page(self.cache, src_dev, dst_dev)
 
     def _dispatch_prefill_row(self, row: int, chunk: list, pos: int, kv_len: int):
         """One admission-prefill chunk dispatch for `row` — the SAME program
@@ -728,6 +905,23 @@ class InferenceEngine:
             _, self.cache = pipeline_forward(
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
+            )
+        elif self.paged:
+            # paged admission prefill: the b=1 forward against the SHARED
+            # pool, steered to the row purely by its page-table slice — no
+            # row slice/unslice copies at all (the contiguous prefill_row
+            # moves one whole cache row in and out per chunk)
+            self._ensure_pages([(row, pos, pos + len(chunk))])
+            pt_row = jax.device_put(
+                self.page_pool.device_tables()[row : row + 1]
+            )
+            toks_dev, pos_dev = jax.device_put(
+                (_np.asarray([chunk], _np.int32), _np.int32(pos))  # dlt: allow(host-sync) — host token list -> device operand prep
+            )
+            _, self.cache = forward(
+                self.cfg, self.params, self.rope, self.cache, toks_dev,
+                pos_dev, logits_mode="last", kv_len=kv_len,
+                page_table=pt_row, page_size=self.page_size,
             )
         else:
             from .batch_session import prefill_row
@@ -749,6 +943,8 @@ class InferenceEngine:
         operands (positions at `pos` so the kv bucket matches; tokens/keys
         zero) — compiles exactly the program `BatchSession.step` runs."""
         b = self.batch
+        if self.paged:
+            self._ensure_pages_all_rows(pos, pos + n_steps)
         token = jnp.zeros((b,), jnp.int32)
         pos_vec = jnp.full((b,), pos, jnp.int32)
         keys = jnp.zeros((b, 2), jnp.uint32)
@@ -769,6 +965,12 @@ class InferenceEngine:
                 self.cfg, self.params, self.rope, self.cache,
                 token, pos_vec, keys, temp, topp, n_steps=n_steps,
                 kv_len=kv_len,
+                # the paged operands are part of the compiled shape: warming
+                # without them compiled a contiguous-signature program the
+                # serving path never dispatches (a post-seal recompile at
+                # every deep kv bucket — caught by the deep-bucket test)
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
             )
 
     def _guard(self, label: str, key) -> watchdog:
@@ -863,11 +1065,17 @@ class InferenceEngine:
             if entry is not None:
                 t_splice = time.perf_counter()
                 try:
-                    with self._sanitizer_scope(), self._guard(
-                        f"prefix_copy[{entry.length}]",
-                        ("prefix_copy", entry.length, entry.length),
-                    ):
-                        self.cache = pc.splice_rows(self, entry)
+                    if self.paged:
+                        # zero-copy splice: the entry's pages map into every
+                        # row's table host-side — no device dispatch at all
+                        # (the prefix_copy series stays untouched)
+                        pc.share_rows(self, entry, resume)
+                    else:
+                        with self._sanitizer_scope(), self._guard(
+                            f"prefix_copy[{entry.length}]",
+                            ("prefix_copy", entry.length, entry.length),
+                        ):
+                            self.cache = pc.splice_rows(self, entry)
                 finally:
                     # ALWAYS unpin — a watchdog StallError out of the guard
                     # must not leave the entry unevictable forever
@@ -890,6 +1098,12 @@ class InferenceEngine:
         chunk_shapes = [
             (size, self._kv_bucket(base + i + size)) for i, size, _ in plan
         ]
+        if self.paged and plan:
+            # allocate the whole prefill span (padded tail included — its
+            # junk writes need real pages like the contiguous slab's tail)
+            # up front so the chunk loop stays dispatch-only
+            i_last, size_last, _ = plan[-1]
+            self._ensure_pages_all_rows(base, base + i_last + size_last)
 
         def prep(idx):
             """Host-side work for one chunk: token slicing + ONE combined
@@ -1024,6 +1238,8 @@ class InferenceEngine:
         return decode_chunk(
             self.cfg, self.params, self.rope, self.cache, token, pos, key,
             n_steps=n_steps, temperature=temperature, topp=topp, kv_len=kv_len,
+            page_table=self._pt_operand() if self.paged else None,
+            page_size=self.page_size,
         )
 
     def _dispatch_verify(self, tokens_np, pos, kv_len: int):
@@ -1035,6 +1251,18 @@ class InferenceEngine:
         ("verify_row", ...) program). Dispatch-only: the caller fetches the
         ids. Returns (ids_dev [b, t], logits_dev [b, t, vocab])."""
         per_row = np.ndim(pos) != 0
+        if self.paged:
+            # the verify feed writes positions [pos, pos + t) per live row
+            # (parked rows sit at seq_len and their writes drop)
+            t = np.shape(tokens_np)[1]
+            if per_row:
+                self._ensure_pages(
+                    (r, int(p), int(p) + t)
+                    for r, p in enumerate(pos)
+                    if int(p) < self.cfg.seq_len
+                )
+            else:
+                self._ensure_pages_all_rows(int(pos), int(pos) + t)
         toks_dev, pos_dev = jax.device_put(
             (
                 np.asarray(tokens_np, np.int32),  # dlt: allow(host-sync) — host token rows -> device operand prep
@@ -1065,12 +1293,16 @@ class InferenceEngine:
         ids, logits, self.cache = verify_chunk(
             self.cfg, self.params, self.rope, self.cache, toks_dev, pos_dev,
             kv_len=kv_len,
+            page_table=self._pt_operand() if self.paged else None,
+            page_size=self.page_size,
         )
         return ids, logits
 
     def decode_one(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns host logits [batch, vocab]."""
         arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
+        if self.paged:
+            self._ensure_pages_all_rows(pos, pos + 1)
         logits, self.cache = self._forward(
             arr, jnp.int32(pos), kv_len=self._kv_bucket(pos + 1)
         )
@@ -1229,11 +1461,14 @@ class InferenceEngine:
                 )
                 if entry is not None:
                     try:
-                        with self._sanitizer_scope(), self._guard(
-                            f"prefix_copy[{entry.length}]",
-                            ("prefix_copy", entry.length, entry.length),
-                        ):
-                            self.cache = pc.splice_rows(self, entry)
+                        if self.paged:
+                            pc.share_rows(self, entry, resume)
+                        else:
+                            with self._sanitizer_scope(), self._guard(
+                                f"prefix_copy[{entry.length}]",
+                                ("prefix_copy", entry.length, entry.length),
+                            ):
+                                self.cache = pc.splice_rows(self, entry)
                     finally:
                         pc.entry_release(entry)
                     pc.record_hit(resume)
@@ -1248,6 +1483,9 @@ class InferenceEngine:
             plan = list(
                 chunk_plan(pre_t - resume, resume, self.max_chunk, self.cfg.seq_len)
             )
+            if self.paged and plan:
+                i_last, size_last, _ = plan[-1]
+                self._ensure_pages_all_rows(resume, resume + i_last + size_last)
 
             def prep(idx):
                 i, size, _ = plan[idx]
@@ -1352,6 +1590,16 @@ class InferenceEngine:
                 self.cfg.seq_len,
             )
             kvb = self._kv_bucket(max_end)
+            if self.paged:
+                # LIVE rows need pages over their chunk span; DONE rows
+                # keep stepping but their junk writes land on unmapped
+                # slots and DROP (the phys < 0 guard) — allocating for
+                # them would burn pool pages on output nobody reads
+                self._ensure_pages(
+                    (r, lens[r] - 1 + planned, lens[r] - 1 + planned + n)
+                    for r in range(self.batch)
+                    if not done[r] and lens[r] - 1 + planned < self.cfg.seq_len
+                )
             toks, last, self.cache = self._decode_chunk_any(
                 state["token"], state["pos"], sub, n_steps=n,
                 temperature=temperature, topp=topp, kv_len=kvb,
@@ -1411,7 +1659,7 @@ class InferenceEngine:
         b = self.batch
         seq_len = self.cfg.seq_len
         ds = self.draft_source
-        key = jax.random.PRNGKey(0)  # greedy chunks never draw
+        key = _greedy_prng_key()  # greedy chunks never draw
         pos = [l - 1 for l in lens]
         token = [int(p[-1]) for p in prompts]
         done = [budgets[r] <= 0 for r in range(b)]
@@ -1461,6 +1709,10 @@ class InferenceEngine:
                     kvb = self._kv_bucket(
                         min(max(pos[r] for r in live) + 1 + n, seq_len)
                     )
+                    if self.paged:
+                        self._ensure_pages(
+                            (r, pos[r], pos[r] + n) for r in live
+                        )
                     tok_dev, pos_dev = jax.device_put((tv, pv))
                     with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
                         toks, _, self.cache = self._decode_chunk_any(
@@ -1492,6 +1744,8 @@ class InferenceEngine:
             t0 = time.perf_counter()
             if greedy:
                 arr = jnp.full((self.batch, 1), token, dtype=jnp.int32)
+                if self.paged:
+                    self._ensure_pages_all_rows(pos, pos + 1)
                 logits, self.cache = self._forward(
                     arr, jnp.int32(pos), kv_len=self._kv_bucket(pos + 1)
                 )
@@ -1532,6 +1786,8 @@ class InferenceEngine:
             n = max(n, 1)
             key[0], sub = _next_subkey(key[0], temperature)
             kvb = self._kv_bucket(at_pos + n)
+            if self.paged:
+                self._ensure_pages_all_rows(at_pos, at_pos + n)
             toks, last, self.cache = self._decode_chunk_any(
                 tok_arr, jnp.int32(at_pos), sub, n_steps=n,
                 temperature=temperature, topp=topp, kv_len=kvb,
@@ -1628,7 +1884,7 @@ class InferenceEngine:
 
         ds = self.draft_source
         seq_len = self.cfg.seq_len
-        key = jax.random.PRNGKey(0)  # greedy chunks never draw
+        key = _greedy_prng_key()  # greedy chunks never draw
         t0 = time.perf_counter()
         rounds = fallback_chunks = drafted = accepted = emitted_total = 0
         draft_us = verify_us = 0
@@ -1689,6 +1945,8 @@ class InferenceEngine:
                     n //= 2
                 n = max(n, 1)
                 kvb = self._kv_bucket(pos + n)
+                if self.paged:
+                    self._ensure_pages_all_rows(pos, pos + n)
                 with self._guard(f"decode[{n}]", ("decode", n, kvb)):
                     toks, _, self.cache = self._decode_chunk_any(
                         jnp.full((self.batch,), int(token), jnp.int32),

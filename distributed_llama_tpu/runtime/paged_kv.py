@@ -1,0 +1,377 @@
+"""Paged KV cache: fixed-size KV pages, per-row page tables, refcounted
+zero-copy sharing, and copy-on-write — the vLLM/PagedAttention memory
+discipline (Kwon et al. 2023) on top of the engine's trace-once programs.
+
+The contiguous layout binds every batch row to a full ``seq_len`` KV slab:
+a 64-token co-tenant pays the same HBM as a 32k-token one, and the radix
+prefix cache (prefix_cache.py) can only reuse KV by *copying* bucket-length
+slices in and out of that slab. This module replaces the slab with a
+device-resident **page pool** — ``[L, n_pages, page_size, n_kv, head_dim]``
+key/value tensors — plus a host-managed **page table** per batch row
+(``int32 [max_slots]``, slot ``s`` naming the physical page holding logical
+positions ``[s*page_size, (s+1)*page_size)``).
+
+Device side, the forward pass changes in exactly two places
+(models/transformer.py ``_layer``):
+
+* **write**: new KV rows scatter to ``(page_table[row, pos // ps],
+  pos % ps)`` — out-of-range positions (parked rows) remap to page indices
+  past the pool and drop, the same OOB-scatter semantics the contiguous
+  per-row path uses;
+* **read**: attention gathers the first ``kv_len / ps`` page entries per
+  row and reshapes them into the ``[b, kv_len, h, d]`` view the unchanged
+  attention math consumes. Garbage in unallocated/foreign slots is causally
+  masked exactly like contiguous junk past a row's length — which is why
+  paged decode is token-identical to the contiguous arm.
+
+Host side, :class:`PagePool` owns allocation: a free list, per-page
+refcounts, and the page tables. Sharing is refcounting — a prefix-cache hit
+maps the entry's pages into the new row's table (refs bumped, ZERO device
+copies) — and writes demand exclusivity: before a dispatch writes span
+``[a, b)`` of a row, :meth:`PagePool.ensure` replaces every overlapping
+page whose refcount > 1 with a fresh page (**copy-on-write**). The old
+page's content is device-copied (:func:`copy_page`, one jitted program)
+only when the row still needs positions below ``a`` from it — a write
+starting on the page boundary fully overwrites the page, so the copy is
+skipped (allocate-on-write).
+
+Exhaustion is a first-class signal: :class:`PagePoolExhausted` from an
+allocation that found no free page (after the reclaim hook — prefix-cache
+LRU eviction — made no progress). The Batcher parks admissions and sheds
+load on it; library callers see the typed error.
+
+Every page-count mutation is under one lock (allocation decisions happen on
+the engine's dispatch thread, but ``/stats`` snapshots and prefix-cache
+retain/release may arrive from handler threads).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.params import KVCache
+
+#: default page size in token positions. 16 == prefix_cache.PREFIX_MIN_TOKENS:
+#: every accepted prefix-cache resume boundary (a multiple of max_chunk, or a
+#: power of two >= 16) is then page-aligned, so a hit shares WHOLE pages and
+#: needs no partial-page copy.
+DEFAULT_PAGE_SIZE = 16
+
+KV_LAYOUTS = ("contiguous", "paged")
+
+
+def resolve_kv_layout(explicit: str | None, default: str = "contiguous") -> str:
+    """THE one resolver of the KV layout: an explicit value wins; otherwise
+    ``DLT_KV_LAYOUT``; unset/unrecognized env means `default` (same parsing
+    everywhere — engine constructor, CLI, server)."""
+    layout = explicit
+    if layout is None:
+        raw = (os.environ.get("DLT_KV_LAYOUT") or "").strip().lower()
+        layout = raw if raw in KV_LAYOUTS else default
+    layout = layout.strip().lower()
+    if layout not in KV_LAYOUTS:
+        raise ValueError(f"unknown kv layout {layout!r} (choose from {KV_LAYOUTS})")
+    return layout
+
+
+def resolve_page_size(explicit: int | None = None) -> int:
+    """Page size in tokens: explicit > ``DLT_KV_PAGE`` env > 16. Must be a
+    power of two (bucket/boundary arithmetic relies on it)."""
+    v = explicit
+    if v is None:
+        raw = os.environ.get("DLT_KV_PAGE")
+        try:
+            v = int(raw) if raw else 0
+        except ValueError:
+            v = 0
+    v = int(v) if v else DEFAULT_PAGE_SIZE
+    if v <= 0 or (v & (v - 1)) != 0:
+        raise ValueError(f"kv page size must be a positive power of two, got {v}")
+    return v
+
+
+def resolve_pool_pages(
+    explicit_mb: int | None, page_bytes: int, parity_pages: int
+) -> int:
+    """Pool size in pages: an explicit MB budget (constructor arg >
+    ``DLT_KV_POOL_MB`` env) wins; 0/unset means CONTIGUOUS PARITY — exactly
+    the pages a ``batch x seq_len`` slab holds, so the default paged engine
+    can never fit fewer tokens than the contiguous one."""
+    mb = explicit_mb
+    if mb is None:
+        raw = os.environ.get("DLT_KV_POOL_MB")
+        try:
+            mb = int(raw) if raw else 0
+        except ValueError:
+            mb = 0
+    if mb and mb > 0:
+        return max(1, (int(mb) * 1024 * 1024) // max(page_bytes, 1))
+    return parity_pages
+
+
+def page_pool_bytes(cfg, n_pages: int, page_size: int) -> int:
+    """Device bytes of a pool's k+v tensors."""
+    return (
+        2
+        * cfg.n_layers
+        * n_pages
+        * page_size
+        * cfg.n_kv_heads
+        * cfg.head_dim
+        * jnp.dtype(cfg.kv_dtype).itemsize
+    )
+
+
+def init_kv_pool(cfg, n_pages: int, page_size: int) -> KVCache:
+    """The device page pool, riding the existing :class:`KVCache` pytree so
+    every jit entry point's ``donate_argnames=("cache",)`` keeps working:
+    ``k``/``v`` are ``[L, n_pages, page_size, n_kv, head_dim]``."""
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype=cfg.kv_dtype),
+        v=jnp.zeros(shape, dtype=cfg.kv_dtype),
+    )
+
+
+# -- the jitted copy-on-write program ----------------------------------------
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def copy_page(cache: KVCache, src, dst) -> KVCache:
+    """Copy one physical page's k/v (every layer) to another page — THE
+    copy-on-write device program, one compiled shape per engine regardless
+    of which pages move (`src`/`dst` are traced scalars). Donated cache:
+    in-place in HBM; the host guarantees ``src != dst``."""
+    L, _, ps, h, d = cache.k.shape
+    k_seg = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), (L, 1, ps, h, d))
+    v_seg = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), (L, 1, ps, h, d))
+    k = jax.lax.dynamic_update_slice(cache.k, k_seg, (0, dst, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, v_seg, (0, dst, 0, 0, 0))
+    return KVCache(k=k, v=v)
+
+
+# -- host-side pool ----------------------------------------------------------
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free page and the reclaim hook made no progress. The Batcher
+    parks/sheds on this; library callers size the pool or free rows."""
+
+
+class PagePool:
+    """Host-side page allocator + per-row page tables (module docstring).
+
+    ``tables[row, slot]`` is the physical page holding the row's logical
+    positions ``[slot*ps, (slot+1)*ps)``, or -1 (unmapped). ``version``
+    bumps on every table mutation so the engine can cache the device copy
+    of the tables between dispatches."""
+
+    def __init__(
+        self,
+        n_pages: int,
+        page_size: int,
+        n_rows: int,
+        seq_len: int,
+        stats=None,
+        reclaim=None,  # () -> bool: try to free pages (prefix-cache LRU
+        # eviction); True = progress was made, retry the allocation
+    ):
+        if n_pages <= 0:
+            raise ValueError("page pool needs at least one page")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.n_rows = int(n_rows)
+        self.seq_len = int(seq_len)
+        self.max_slots = -(-seq_len // page_size)  # ceil
+        self.stats = stats
+        self.reclaim = reclaim
+        self.refs = np.zeros(self.n_pages, np.int32)
+        self._free: list = list(range(self.n_pages - 1, -1, -1))
+        self.tables = np.full((self.n_rows, self.max_slots), -1, np.int32)
+        self.version = 0
+        self._lock = threading.Lock()
+
+    # -- observability -------------------------------------------------------
+
+    def _incr(self, name: str, n: int = 1):
+        if self.stats is not None:
+            self.stats.incr(name, n)
+
+    def _gauges(self):
+        if self.stats is not None:
+            self.stats.gauge("kv_pool_pages_used", self.n_pages - len(self._free))
+            self.stats.gauge("kv_pool_pages_free", len(self._free))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "n_pages": self.n_pages,
+                "page_size": self.page_size,
+                "used_pages": self.used_pages,
+                "free_pages": self.free_pages,
+                "max_slots": self.max_slots,
+                "shared_pages": int(np.sum(self.refs > 1)),
+            }
+
+    # -- allocation ----------------------------------------------------------
+
+    def ensure(self, row: int, start: int, end: int) -> list:
+        """Make span ``[start, end)`` of `row` privately writable: allocate
+        unmapped slots, copy-on-write shared ones. Returns the
+        ``[(src_page, dst_page), ...]`` device copies the caller must
+        dispatch (:func:`copy_page`) BEFORE the write — non-empty only when
+        a shared page holds positions below `start` the row still needs.
+
+        ATOMIC per span: the whole plan is applied under one lock hold only
+        when every needed page is available, otherwise nothing mutates and
+        :class:`PagePoolExhausted` raises (after the reclaim hook stops
+        making progress). A partial application would be a real corruption:
+        slot remapped, refcount dropped, but the COW copy never dispatched
+        because the caller saw the exception — the retry would then see a
+        private page and silently skip the copy."""
+        if end <= start:
+            return []
+        end = min(end, self.seq_len)
+        ps = self.page_size
+        while True:
+            with self._lock:
+                plan = []  # (slot, cur_page_or_-1)
+                for slot in range(start // ps, -(-end // ps)):
+                    cur = int(self.tables[row, slot])
+                    if cur < 0 or int(self.refs[cur]) > 1:
+                        plan.append((slot, cur))
+                if not plan:
+                    return []
+                if len(self._free) >= len(plan):
+                    cow: list = []
+                    for slot, cur in plan:
+                        page = self._free.pop()
+                        self.refs[page] = 1
+                        if cur >= 0:
+                            # copy-on-write: this row loses its claim on
+                            # the shared page; content is copied only when
+                            # the write starts mid-page (positions below
+                            # `start` must survive). A shared page keeps
+                            # refs >= 1 here, so it can't join the free
+                            # list mid-plan.
+                            self.refs[cur] -= 1
+                            if self.refs[cur] == 0:
+                                self._free.append(cur)
+                            if slot * ps < start:
+                                cow.append((cur, page))
+                                self._incr("kv_cow_copies")
+                            self._incr("kv_cow_pages")
+                        self.tables[row, slot] = page
+                    self.version += 1
+                    self._gauges()
+                    return cow
+            # not enough pages for the WHOLE span: reclaim outside the
+            # lock and re-plan (tables untouched so far)
+            if self.reclaim is None or not self.reclaim():
+                self._incr("kv_pool_exhausted")
+                raise PagePoolExhausted(
+                    f"kv page pool exhausted ({self.n_pages} pages of "
+                    f"{self.page_size} tokens)"
+                )
+            self._incr("kv_pool_reclaims")
+
+    def share(self, row: int, pages) -> None:
+        """Map `pages` (physical ids) into the row's leading slots with
+        refcounts bumped — the ZERO-COPY prefix-cache splice. Existing
+        mappings in those slots are released (retain-before-release so a
+        self-share is safe)."""
+        pages = list(pages)
+        if len(pages) > self.max_slots:
+            raise ValueError("shared prefix longer than the row's table")
+        with self._lock:
+            for p in pages:
+                self.refs[p] += 1
+            for slot, p in enumerate(pages):
+                cur = int(self.tables[row, slot])
+                if cur >= 0:
+                    self.refs[cur] -= 1
+                    if self.refs[cur] == 0:
+                        self._free.append(cur)
+                self.tables[row, slot] = p
+            self.version += 1
+            self._incr("kv_pages_shared", len(pages))
+            self._gauges()
+
+    def row_holds_pages(self, row: int) -> bool:
+        """Whether any slot of `row` is mapped — the Batcher's park-vs-shed
+        test: a parked admission only waits when SOMEONE ELSE holds pages
+        that can eventually free (waiting on co-tenants that hold nothing
+        is a livelock)."""
+        with self._lock:
+            return bool((self.tables[row] >= 0).any())
+
+    def row_pages(self, row: int, n_slots: int):
+        """The row's first `n_slots` physical pages (publish path). Raises
+        when any slot is unmapped — the caller's length accounting is off."""
+        with self._lock:
+            pages = [int(p) for p in self.tables[row, :n_slots]]
+        if any(p < 0 for p in pages):
+            raise ValueError(
+                f"row {row} has unmapped slots below {n_slots * self.page_size}"
+            )
+        return tuple(pages)
+
+    def retain(self, pages) -> None:
+        """Pin `pages` (prefix-cache entry publish): refs bumped, pages
+        survive every row release until the entry releases them."""
+        with self._lock:
+            for p in pages:
+                self.refs[p] += 1
+
+    def release(self, pages) -> None:
+        """Drop one reference per page (entry eviction / clear)."""
+        with self._lock:
+            for p in pages:
+                self.refs[p] -= 1
+                if self.refs[p] == 0:
+                    self._free.append(p)
+                elif self.refs[p] < 0:  # double release — keep it visible
+                    self.refs[p] = 0
+                    self._incr("kv_pool_double_release")
+            self._gauges()
+
+    def release_row(self, row: int) -> None:
+        """Unmap the whole row (park/finish/reset): every mapped page loses
+        the row's reference; shared pages survive via their other holders."""
+        with self._lock:
+            for slot in range(self.max_slots):
+                cur = int(self.tables[row, slot])
+                if cur >= 0:
+                    self.refs[cur] -= 1
+                    if self.refs[cur] == 0:
+                        self._free.append(cur)
+                    self.tables[row, slot] = -1
+            self.version += 1
+            self._gauges()
+
+    def release_all_rows(self) -> None:
+        for r in range(self.n_rows):
+            self.release_row(r)
+
+    def device_tables(self) -> np.ndarray:
+        """The gather/scatter operand: raw tables with -1 sentinels for
+        unmapped slots. The device write path DROPS writes whose entry is
+        negative (so a padded tail or allocation bug can never land through
+        a stale sentinel into someone else's page), and the read path clamps
+        to 0 (the garbage it gathers is causally masked)."""
+        with self._lock:
+            return self.tables.copy()

@@ -175,8 +175,12 @@ def extract_prefix_from_row(cache, row, length, out_sharding=None):
 @dataclass
 class PrefixEntry:
     """One published slice: `tokens` (a bucket-length tuple) is the trie
-    key; k/v are the device arrays; `refs` pins the entry against eviction
-    while an admission is between match and splice-dispatch."""
+    key; `refs` pins the entry against eviction while an admission is
+    between match and splice-dispatch. Contiguous engines store extracted
+    device arrays in k/v; PAGED engines store `pages` instead — the
+    physical page ids of the publishing row, refcount-retained in the
+    engine's PagePool (runtime/paged_kv.py), so publishing moves ZERO
+    device bytes and a hit maps the pages into the new row's table."""
 
     tokens: tuple
     k: object
@@ -184,6 +188,7 @@ class PrefixEntry:
     nbytes: int
     refs: int = 0
     last_used: int = 0
+    pages: tuple = ()  # paged engines: physical page ids covering tokens
 
     @property
     def length(self) -> int:
@@ -214,6 +219,9 @@ class PrefixCache:
         stats=None,
         seg_sharding=None,
         cache_sharding=None,
+        page_pool=None,  # runtime/paged_kv.PagePool: the cache then shares
+        # refcounted pages instead of extracting/splicing copies (zero
+        # device work on publish AND on hit)
     ):
         self.budget_bytes = int(budget_bytes)
         self.seq_len = seq_len
@@ -221,6 +229,8 @@ class PrefixCache:
         self.stats = stats  # StepStats: counters surface in /stats, /health
         self.seg_sharding = seg_sharding  # published-slice layout (pipeline)
         self.cache_sharding = cache_sharding  # live-cache layout to preserve
+        self.page_pool = page_pool
+        self.paged = page_pool is not None
         self.buckets = prefix_buckets(seq_len)
         self._root = _Node()
         self._entries: dict = {}  # token tuple -> PrefixEntry
@@ -256,6 +266,7 @@ class PrefixCache:
             stats=engine.stats,
             seg_sharding=seg_sh,
             cache_sharding=cache_sh,
+            page_pool=engine.page_pool if engine.paged else None,
         )
 
     # -- observability ------------------------------------------------------
@@ -372,6 +383,13 @@ class PrefixCache:
         metric is "prefill compute actually skipped")."""
         covered, entry = self.match(tokens)
         B = self.resume_boundary(min(covered, len(tokens)))
+        if self.paged and entry is not None:
+            # page sharing maps WHOLE pages read-only: floor the boundary
+            # to a page multiple and cap it at the entry's own coverage
+            # (the contiguous splice copies positions past the divergence
+            # too — rewritten later; shared pages must never be written)
+            ps = self.page_pool.page_size
+            B = (min(B, entry.length) // ps) * ps
         if entry is None or B < PREFIX_MIN_TOKENS:
             self._incr("prefix_misses")
             return 0, None
@@ -410,6 +428,21 @@ class PrefixCache:
             out_sharding=self.cache_sharding,
         )
 
+    def share_row(self, engine, entry, row: int, resume: int) -> None:
+        """The PAGED splice: map the entry's pages covering [0, resume)
+        into `row`'s page table with refcounts bumped — ZERO device
+        dispatches, zero KV bytes moved. `resume` is the page-aligned
+        boundary `match_for_splice` returned."""
+        n = resume // self.page_pool.page_size
+        self.page_pool.share(row, entry.pages[:n])
+        engine._pt_cache = None  # table changed: refresh the operand
+
+    def share_rows(self, engine, entry, resume: int) -> None:
+        """Paged splice into EVERY row (the solo generate / generate_batch
+        aligned-front paths): each row maps the same shared pages."""
+        for row in range(engine.batch):
+            self.share_row(engine, entry, row, resume)
+
     # -- publishing ---------------------------------------------------------
 
     def publish_from_row(self, engine, row: int, tokens, max_len=None) -> bool:
@@ -422,6 +455,9 @@ class PrefixCache:
         inserted or refreshed."""
         n = len(tokens) if max_len is None else min(max_len, len(tokens))
         P = bucket_down(n, self.seq_len)
+        if self.paged:
+            # only whole pages can be shared read-only
+            P = (P // self.page_pool.page_size) * self.page_pool.page_size
         if P < PREFIX_MIN_TOKENS:
             return False
         key = tuple(int(t) for t in tokens[:P])
@@ -438,22 +474,46 @@ class PrefixCache:
             if not self._evict_until(self.budget_bytes - need):
                 self._incr("prefix_publish_skipped")
                 return False
-        # dispatch OUTSIDE the lock: /stats readers must not wait on a
-        # device dispatch. The extract is async; the arrays become the
-        # entry's storage and are only consumed by later splice dispatches,
-        # which XLA orders after the producing program.
-        with engine._guard(f"prefix_extract[{P}]", ("prefix_extract", P, P)):
-            k, v = extract_prefix_from_row(
-                engine.cache, jnp.asarray(row, jnp.int32), length=P,
-                out_sharding=self.seg_sharding,
-            )
+        if self.paged:
+            # PAGED publish: retain the publisher row's own pages — no
+            # extract program, no device bytes moved. Positions < P are
+            # final for the row (the callers' max_len contract), and the
+            # row's future writes land past P in other pages (or trigger
+            # copy-on-write if it ever rewinds), so the shared pages are
+            # immutable from here on.
+            try:
+                pages = self.page_pool.row_pages(
+                    row, P // self.page_pool.page_size
+                )
+            except ValueError:
+                # unmapped slots below P: the row never actually held this
+                # span (shouldn't happen — defensive, counted)
+                self._incr("prefix_publish_skipped")
+                return False
+            self.page_pool.retain(pages)
+            k = v = None
+            nbytes = self._slice_nbytes(engine, P)
+        else:
+            # dispatch OUTSIDE the lock: /stats readers must not wait on a
+            # device dispatch. The extract is async; the arrays become the
+            # entry's storage and are only consumed by later splice
+            # dispatches, which XLA orders after the producing program.
+            pages = ()
+            with engine._guard(f"prefix_extract[{P}]", ("prefix_extract", P, P)):
+                k, v = extract_prefix_from_row(
+                    engine.cache, jnp.asarray(row, jnp.int32), length=P,
+                    out_sharding=self.seg_sharding,
+                )
+            nbytes = k.nbytes + v.nbytes
         with self._lock:
             if key in self._entries:  # raced with another publisher
+                if pages:
+                    self.page_pool.release(pages)
                 return True
             self._clock += 1
             entry = PrefixEntry(
-                tokens=key, k=k, v=v, nbytes=k.nbytes + v.nbytes,
-                last_used=self._clock,
+                tokens=key, k=k, v=v, nbytes=nbytes,
+                last_used=self._clock, pages=pages,
             )
             self._insert(entry)
             self._entries[key] = entry
@@ -464,6 +524,11 @@ class PrefixCache:
         return True
 
     def _slice_nbytes(self, engine, P: int) -> int:
+        if self.paged:
+            from .paged_kv import page_pool_bytes
+
+            ps = self.page_pool.page_size
+            return page_pool_bytes(engine.cfg, P // ps, ps)
         L, _, _, h, d = engine.cache.k.shape
         return 2 * L * P * h * d * engine.cache.k.dtype.itemsize
 
@@ -544,12 +609,30 @@ class PrefixCache:
         self._detach(entry)
         self._entries.pop(entry.tokens, None)
         self._bytes -= entry.nbytes
+        if entry.pages:
+            self.page_pool.release(entry.pages)
         self._gauges()
+
+    def evict_one(self) -> bool:
+        """Evict the LRU UNPINNED entry (the page pool's reclaim hook:
+        allocation pressure trades cached prefixes for live-row pages).
+        False when everything is pinned or the cache is empty."""
+        with self._lock:
+            victims = [e for e in self._entries.values() if e.refs == 0]
+            if not victims:
+                return False
+            self._remove(min(victims, key=lambda e: e.last_used))
+            self._incr("prefix_evictions")
+            return True
 
     def clear(self) -> None:
         """Drop every entry (engine recovery: after an engine failure the
-        in-flight extracts may descend from the failed computation)."""
+        in-flight extracts may descend from the failed computation).
+        Paged entries release their page refs back to the pool."""
         with self._lock:
+            for entry in self._entries.values():
+                if entry.pages:
+                    self.page_pool.release(entry.pages)
             self._root = _Node()
             self._entries.clear()
             self._bytes = 0

@@ -223,7 +223,9 @@ def entry_tokens(engine, kind: str, size: int) -> int:
     b = engine.batch
     if kind in ("prefill", "decode", "batch_decode", "verify", "verify_row"):
         return b * size
-    return size  # prefill_row / prefix_extract / prefix_copy(_row)
+    # prefill_row / prefix_extract / prefix_copy(_row) / page_copy: one
+    # row's chunk, one cached slice, or one page worth of positions
+    return size
 
 
 def lower_entry(engine, key):
@@ -238,8 +240,19 @@ def lower_entry(engine, key):
     a_params = _abstract(engine.params)
     a_rope = _abstract(engine.rope)
     a_cache = _abstract(engine.cache)
-    key0 = jax.random.PRNGKey(0)
+    from .engine import _greedy_prng_key
 
+    key0 = _greedy_prng_key()
+    paged = getattr(engine, "paged", False)
+    ps = engine.page_size
+    pt_sds = (
+        _sds((b, engine.page_pool.max_slots), jnp.int32) if paged else None
+    )
+
+    if kind == "page_copy":
+        from .paged_kv import copy_page
+
+        return copy_page.lower(a_cache, _sds((), jnp.int32), _sds((), jnp.int32))
     if kind in ("prefill", "verify", "verify_row"):
         mode = "last" if kind == "prefill" else "all"
         per_row = kind == "verify_row"
@@ -262,12 +275,13 @@ def lower_entry(engine, key):
             return forward.lower(
                 cfg, a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
                 pos_sds, logits_mode="last", kv_len=kvb,
+                page_table=pt_sds, page_size=ps,
             )
         from .speculative import verify_chunk
 
         return verify_chunk.lower(
             cfg, a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
-            pos_sds, kv_len=kvb,
+            pos_sds, kv_len=kvb, page_table=pt_sds, page_size=ps,
         )
     if kind == "decode":
         if engine.use_pipeline:
@@ -286,7 +300,7 @@ def lower_entry(engine, key):
         return decode_chunk.lower(
             cfg, a_params, a_rope, a_cache, _sds((b,), jnp.int32),
             _sds((), jnp.int32), key0, n_steps=size, temperature=0.0,
-            topp=0.9, kv_len=kvb,
+            topp=0.9, kv_len=kvb, page_table=pt_sds, page_size=ps,
         )
     if kind == "batch_decode":
         args = (
@@ -305,7 +319,8 @@ def lower_entry(engine, key):
         from .batch_session import batch_decode_chunk
 
         return batch_decode_chunk.lower(
-            cfg, a_params, a_rope, a_cache, *args, n_steps=size, kv_len=kvb
+            cfg, a_params, a_rope, a_cache, *args, n_steps=size, kv_len=kvb,
+            page_table=pt_sds, page_size=ps,
         )
     if kind == "prefill_row":
         if engine.use_pipeline:
@@ -318,6 +333,17 @@ def lower_entry(engine, key):
             return jax.jit(fn).lower(
                 a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
                 _sds((b,), jnp.int32),
+            )
+        if paged:
+            # the paged admission prefill is the b=1 forward steered by a
+            # one-row page-table slice (engine._dispatch_prefill_row)
+            from ..models.transformer import forward
+
+            return forward.lower(
+                cfg, a_params, a_rope, a_cache, _sds((1, size), jnp.int32),
+                _sds((), jnp.int32), logits_mode="last", kv_len=kvb,
+                page_table=_sds((1, engine.page_pool.max_slots), jnp.int32),
+                page_size=ps,
             )
         from .batch_session import prefill_row
 
@@ -477,6 +503,16 @@ def _census_walk(jaxpr, mult: float, hbm: dict, acc: dict) -> None:
                 acc["bytes"] += _aval_bytes(eqn.invars[1].aval) * mult
                 hbm[id(eqn.outvars[0])] = True  # still the resident cache
             continue
+        if name.startswith("scatter"):
+            # in-place scatter into a resident array (the per-row cache
+            # writes, and the paged layout's page-table writes —
+            # runtime/paged_kv.py): traffic is the UPDATES region plus its
+            # index rows, never the whole operand (counting the operand as
+            # a read overstated a batch_decode step by the full cache)
+            if in_hbm[0]:
+                acc["bytes"] += _aval_bytes(eqn.invars[-1].aval) * mult
+                hbm[id(eqn.outvars[0])] = True
+            continue
         if name in _SLICE_PRIMS:
             if any(in_hbm):
                 acc["bytes"] += _aval_bytes(eqn.outvars[0].aval) * mult
@@ -595,7 +631,10 @@ def hbm_ledger(engine) -> dict:
         "kv_cache": _tree_bytes(engine.cache),
     }
     pc = engine.prefix_cache
-    if pc is not None:
+    if pc is not None and not getattr(pc, "paged", False):
+        # paged entries own no storage of their own — their bytes ARE pool
+        # pages already counted under kv_cache; adding them double-counted
+        # and made every eviction wave look like measured-vs-modeled drift
         components["prefix_cache"] = pc.total_bytes
     draft_eng = getattr(engine.draft_source, "engine", None)
     if draft_eng is not None:

@@ -121,8 +121,15 @@ def spec_buckets(draft_k: int) -> tuple:
 # -- the verify program ------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cfg", "kv_len"), donate_argnames=("cache",))
-def verify_chunk(cfg, params, rope, cache, tokens, pos_start, kv_len=None):
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "kv_len", "page_size"),
+    donate_argnames=("cache",),
+)
+def verify_chunk(
+    cfg, params, rope, cache, tokens, pos_start, kv_len=None,
+    page_table=None, page_size=None,
+):
     """One verify forward: a prefill-shaped pass over ``[last_token,
     d1..dk]`` returning logits at EVERY position (``logits_mode="all"``)
     plus their in-graph greedy argmax, so a verify round costs one dispatch
@@ -135,7 +142,7 @@ def verify_chunk(cfg, params, rope, cache, tokens, pos_start, kv_len=None):
     Returns (greedy_ids [b, t] int32, logits [b, t, vocab] f32, cache)."""
     logits, cache = forward_uncompiled(
         cfg, params, rope, cache, tokens, pos_start, logits_mode="all",
-        kv_len=kv_len,
+        kv_len=kv_len, page_table=page_table, page_size=page_size,
     )
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
 
@@ -360,12 +367,25 @@ class ModelDraft(DraftSource):
             eng.prefill(pre[cp:], pos_start=cp, publish=False)
         pos = L - 1
         kvb = eng._kv_bucket(pos + n)
+        # typed greedy key: the draft engine's warm ladder compiles decode
+        # with _greedy_prng_key's aval — a legacy PRNGKey(0) operand here
+        # would be a different key dtype and a post-seal recompile on the
+        # first model-draft round
+        from .engine import _greedy_prng_key
+
+        if eng.paged:
+            # a paged draft engine (ambient DLT_KV_LAYOUT=paged) must map
+            # pages for the chunk's KV writes like any other decode site —
+            # unmapped slots DROP writes silently, which here would mean
+            # drafting against a cache missing the very tokens _synced
+            # claims it holds
+            eng._ensure_pages_all_rows(pos, pos + n)
         with eng._sanitizer_scope(), eng._guard(
             f"draft_decode[{n}]", ("decode", n, kvb)
         ):
             toks, _, eng.cache = eng._decode_chunk_any(
                 jnp.full((1,), int(ctx[-1]), jnp.int32), jnp.int32(pos),
-                jax.random.PRNGKey(0), n_steps=n, temperature=0.0, topp=0.9,
+                _greedy_prng_key(), n_steps=n, temperature=0.0, topp=0.9,
                 kv_len=kvb,
             )
             out = [int(t) for t in eng._host_fetch(toks)[0]]

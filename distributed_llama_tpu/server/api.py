@@ -367,6 +367,7 @@ class Batcher:
         import queue
 
         from ..runtime.batch_session import BatchSession
+        from ..runtime.paged_kv import PagePoolExhausted
 
         import collections
 
@@ -449,6 +450,36 @@ class Batcher:
                     remaining = session.prefill_pending(row, budget)
                     if decode_rows:
                         engine.stats.incr("interleaved_prefill_chunks")
+                except PagePoolExhausted:
+                    # paged KV pool out of pages mid-admission. If no
+                    # OTHER row actually HOLDS pages (slot occupancy is
+                    # not enough — a staged co-tenant that never got a
+                    # page can free nothing), this prompt can never fit:
+                    # shed it with the standard 503 instead of spinning
+                    # forever. Reclaimable prefix entries don't count
+                    # either — the failed allocation already ran the
+                    # reclaim hook to exhaustion.
+                    if not decode_rows and not any(
+                        engine.page_pool.row_holds_pages(r)
+                        for r in range(engine.batch)
+                        if r != row
+                    ):
+                        engine.stats.incr("kv_pool_shed_503")
+                        req.error = Overloaded(retry_after_s=2)
+                        self._finish(req, session, slots, row)
+                        continue
+                    # otherwise PARK: keep the prompt's progress and retry
+                    # at the next chunk boundary. Live decode rows MUST
+                    # keep stepping below — they are what finishes and
+                    # frees the pages the parked admission waits for (a
+                    # bare `continue` here livelocked: nobody decoded,
+                    # nobody freed). With co-tenants but none decoding,
+                    # yield briefly so the retry loop doesn't spin hot.
+                    engine.stats.incr("kv_pool_admission_parked")
+                    remaining = None
+                    if not decode_rows:
+                        time.sleep(0.005)
+                        continue
                 except Exception as e:
                     req.error = e
                     self._finish(req, session, slots, row)
@@ -510,19 +541,29 @@ class Batcher:
                         and session.seq_len - int(session.pos[r]) >= K + 1
                         for r in decode_rows
                     ):
-                        drafts = {}
-                        for r in decode_rows:
-                            req = slots[r]
-                            cap = min(K, req.max_new - req.n - 1)
-                            drafts[r] = (
-                                engine.draft_source.draft(
-                                    list(req.ids) + req.out_ids, cap
+                        try:
+                            drafts = {}
+                            for r in decode_rows:
+                                req = slots[r]
+                                cap = min(K, req.max_new - req.n - 1)
+                                drafts[r] = (
+                                    engine.draft_source.draft(
+                                        list(req.ids) + req.out_ids, cap
+                                    )
+                                    if cap > 0
+                                    else []
                                 )
-                                if cap > 0
-                                else []
-                            )
-                        if any(drafts.values()):
-                            spec_drafts = drafts
+                            if any(drafts.values()):
+                                spec_drafts = drafts
+                        except PagePoolExhausted:
+                            # a paged DRAFT engine ran out of ITS OWN pool
+                            # (a separate allocator from the main engine's)
+                            # — shedding a main-batch row would free
+                            # nothing there. Degrade this round to the
+                            # plain chunk, the same fallback draft-hostile
+                            # traffic takes.
+                            engine.stats.incr("kv_pool_draft_skipped")
+                            spec_drafts = None
                 if spec_drafts is not None:
                     per_row = session.spec_step(spec_drafts)
                 else:
@@ -537,6 +578,20 @@ class Batcher:
                         for r, s in enumerate(slots)
                         if s is not None and not s.prefilling
                     }
+            except PagePoolExhausted:
+                # paged KV pool out of pages mid-decode (co-tenants grew
+                # into the budget together): SHED the decode row with the
+                # least progress — its pages free immediately, everyone
+                # else keeps decoding. The shed client gets the standard
+                # 503 + Retry-After, not an engine error.
+                victim = min(
+                    decode_rows, key=lambda r: (slots[r].n, -r)
+                )
+                vreq = slots[victim]
+                vreq.error = vreq.error or Overloaded(retry_after_s=1)
+                self._finish(vreq, session, slots, victim)
+                engine.stats.incr("kv_pool_shed_503")
+                continue
             except Exception as e:
                 # engine failure: fail every in-flight request, rebuild the
                 # session on a recovered engine
@@ -1043,6 +1098,17 @@ class Handler(BaseHTTPRequestHandler):
                 # spec_* counters ride steps.counters and /health too; this
                 # section is the one-stop operator view)
                 "speculative": spec_snapshot(st.engine),
+                # paged KV pool occupancy (None on contiguous engines); the
+                # kv_cow_* / kv_pages_shared / kv_pool_* counters ride
+                # steps.counters like every other engine event
+                "kv_pool": (
+                    dict(
+                        st.engine.page_pool.snapshot(),
+                        layout=st.engine.kv_layout,
+                    )
+                    if st.engine.paged
+                    else None
+                ),
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,

@@ -21,6 +21,11 @@
 #                            roofline/MFU/SLO gauge math, /debug/costs +
 #                            /debug/profile on a live server, fatal-
 #                            sanitizer cleanliness of every profiling path)
+#   8. paged-kv suite       (page pool alloc/COW/refcounts, paged-vs-
+#                            contiguous token identity at engine/session/
+#                            HTTP levels, zero-copy prefix sharing,
+#                            exhaustion park/shed, sanitizer acceptance,
+#                            the fatal-sanitizer /v1/chat regression)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -33,6 +38,9 @@ python scripts/dlt_lint.py
 
 echo "== graph audit (tiny config, --costs coverage) =="
 python -m distributed_llama_tpu.analysis.graph_audit --costs
+
+echo "== graph audit (paged KV ladder, --costs coverage) =="
+python -m distributed_llama_tpu.analysis.graph_audit --kv-layout paged --costs
 
 echo "== analysis suite (pytest -m analysis) =="
 python -m pytest tests/ -q -m analysis -p no:cacheprovider
@@ -49,9 +57,14 @@ python -m pytest tests/test_tracing.py -q -p no:cacheprovider
 echo "== profiling suite =="
 python -m pytest tests/test_profiling.py -q -p no:cacheprovider
 
+echo "== paged-kv suite =="
+python -m pytest tests/test_paged_kv.py -q -p no:cacheprovider
+
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="
   python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+  echo "== heavyweight (slow-marked) suite =="
+  python -m pytest tests/ -q -m slow --continue-on-collection-errors -p no:cacheprovider
 fi
 
 echo "ci_check: all stages passed"

@@ -50,6 +50,7 @@ def _engine(path, **kw):
     return InferenceEngine(path, **kw)
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_ladder_matches_actual_warmup_compiles(model_path):
     """warm_key_ladder's simulation must equal the exact (size, kv-bucket)
     set warmup() really executes (engine._warm): if the two drift, either
@@ -230,6 +231,7 @@ def test_sharding_audit_catches_unsharded_cache(mesh_model_path):
         eng.close()
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_speculative_verify_ladder_covered_and_clean(model_path):
     """A speculative engine's warm ladder grows the verify programs (both
     draft buckets, scalar AND per-row variants), they audit clean (no f64,

@@ -115,6 +115,7 @@ def test_sealed_sentinel_ignores_a_coresident_warmup():
         b.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_52_token_prompt_and_deep_buckets_zero_recompiles(tmp_path, monkeypatch):
     """The ROADMAP warm-ladder open item, closed: the recorded repro was a
     52-token prompt on the default max_chunk=32 config — its prefill plan

@@ -16,6 +16,7 @@ def _model(tmp_path):
     return path
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_engine_pp_mesh_uses_pipeline_and_matches(tmp_path):
     path = _model(tmp_path)
     solo = InferenceEngine(path, compute_dtype="float32")
@@ -122,6 +123,7 @@ def test_engine_gspmd_rejects_pp(tmp_path):
         )
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_engine_tp_pipeline_runs_fused_kernel(tmp_path, monkeypatch):
     """The tp=4 shard_map path with the Pallas kernel force-enabled
     (interpret mode on CPU) matches the XLA-path generations — the fused

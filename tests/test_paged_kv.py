@@ -1,0 +1,694 @@
+"""Paged KV cache tests (runtime/paged_kv.py): page-pool allocation /
+refcount / copy-on-write semantics, paged-vs-contiguous token identity at
+engine, BatchSession, and HTTP levels, zero-copy prefix sharing (splice
+counters stay at 0), COW divergence mid-conversation, pool exhaustion →
+park/shed, refcount release on row finish/recover, and the sanitizer
+acceptance contract (zero post-warmup recompiles on the paged path,
+including the previously-broken sampled /v1/chat shape)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.runtime.batch_session import BatchSession
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.runtime.paged_kv import (
+    PagePool,
+    PagePoolExhausted,
+    resolve_kv_layout,
+    resolve_page_size,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+from distributed_llama_tpu.tokenizer import Sampler
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("paged")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=256), seed=7)
+    return path
+
+
+def _engine(path, layout, **kw):
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("max_chunk", 16)
+    kw.setdefault("decode_chunk_size", 8)
+    kw.setdefault("prefix_cache_mb", 0)
+    kw.setdefault("speculative", "off")
+    return InferenceEngine(path, kv_layout=layout, **kw)
+
+
+# -- host-side pool semantics ------------------------------------------------
+
+
+def test_pool_alloc_free_and_tables():
+    pool = PagePool(n_pages=8, page_size=16, n_rows=2, seq_len=128)
+    assert pool.ensure(0, 0, 40) == []  # 3 fresh pages, no COW copies
+    assert pool.used_pages == 3
+    t = pool.device_tables()
+    assert (t[0, :3] >= 0).all() and (t[0, 3:] == -1).all()
+    assert (t[1] == -1).all()
+    pool.release_row(0)
+    assert pool.used_pages == 0
+    assert (pool.device_tables() == -1).all()
+
+
+def test_pool_share_refcount_and_cow():
+    pool = PagePool(n_pages=8, page_size=16, n_rows=2, seq_len=128)
+    pool.ensure(0, 0, 64)  # row 0 owns pages for slots 0..3
+    pages = pool.row_pages(0, 4)
+    pool.retain(pages)  # a prefix entry pins them
+    pool.share(1, pages[:2])  # row 1 maps the first two, zero-copy
+    assert pool.snapshot()["shared_pages"] == 4
+    # row 1 writes page-aligned at 0: COW remap, NO device copy needed
+    assert pool.ensure(1, 0, 16) == []
+    # row 1 writes MID-page over its remaining shared page: real COW copy
+    cows = pool.ensure(1, 24, 32)
+    assert len(cows) == 1 and cows[0][0] == pages[1]
+    # row 0's own pages were never touched
+    assert pool.row_pages(0, 4) == pages
+    # releases: row 0 + row 1 + the entry pin -> everything free again
+    pool.release_row(0)
+    pool.release_row(1)
+    pool.release(pages)
+    assert pool.used_pages == 0
+
+
+def test_pool_exhaustion_and_reclaim_hook():
+    calls = []
+
+    def reclaim():
+        calls.append(1)
+        if len(calls) == 1:
+            pool.release_row(0)  # simulate a prefix-entry eviction
+            return True
+        return False
+
+    pool = PagePool(n_pages=4, page_size=16, n_rows=2, seq_len=128,
+                    reclaim=reclaim)
+    pool.ensure(0, 0, 64)  # all 4 pages
+    pool.ensure(1, 0, 32)  # exhausted -> reclaim frees row 0 -> succeeds
+    assert calls == [1]
+    with pytest.raises(PagePoolExhausted):
+        pool.ensure(1, 32, 128)  # needs 6 pages total; only 4 exist
+
+
+def test_layout_resolvers(monkeypatch):
+    assert resolve_kv_layout(None) == "contiguous"
+    monkeypatch.setenv("DLT_KV_LAYOUT", "paged")
+    assert resolve_kv_layout(None) == "paged"
+    assert resolve_kv_layout("contiguous") == "contiguous"  # explicit wins
+    with pytest.raises(ValueError):
+        resolve_kv_layout("strided")
+    assert resolve_page_size(None) == 16
+    with pytest.raises(ValueError):
+        resolve_page_size(24)  # not a power of two
+
+
+# -- engine-level token identity ---------------------------------------------
+
+
+def test_solo_generate_identity(model_path):
+    """Greedy AND seeded-sampled solo generate: paged output == contiguous
+    output token for token (the bit-identity A/B contract)."""
+    prompt = [3, 7, 11, 2, 9, 4, 8, 5, 6, 10, 12, 13]
+    ec = _engine(model_path, "contiguous")
+    ep = _engine(model_path, "paged")
+    try:
+        rc = ec.generate(prompt, 48)
+        rp = ep.generate(prompt, 48)
+        assert rc.tokens == rp.tokens
+        sc = Sampler(ec.cfg.vocab_size, 0.8, 0.9, 42)
+        sp = Sampler(ep.cfg.vocab_size, 0.8, 0.9, 42)
+        ec.reset(), ep.reset()
+        rc = ec.generate(prompt, 48, sampler=sc)
+        rp = ep.generate(prompt, 48, sampler=sp)
+        assert rc.tokens == rp.tokens
+    finally:
+        ec.close(), ep.close()
+
+
+def test_generate_batch_and_session_identity(model_path):
+    """generate_batch and BatchSession (mixed greedy + seeded sampled rows,
+    release/re-admit cycle) are token-identical across layouts; finishing a
+    row RELEASES its pages back to the pool."""
+    prompts = [[3, 7, 11, 2, 9, 4, 8, 5], [5, 4, 3, 2, 1]]
+    ec = _engine(model_path, "contiguous", batch=2)
+    ep = _engine(model_path, "paged", batch=2)
+    try:
+        assert ec.generate_batch(prompts, 24) == ep.generate_batch(prompts, 24)
+        scs, sps = BatchSession(ec), BatchSession(ep)
+        for s in (scs, sps):
+            s.admit(0, prompts[0], temperature=0.0)
+            s.admit(1, prompts[1], temperature=0.7, key_data=(123, 456))
+        for _ in range(3):
+            assert np.array_equal(scs.step(8), sps.step(8))
+        used_before = ep.page_pool.used_pages
+        assert used_before > 0
+        scs.release(0), sps.release(0)
+        assert ep.page_pool.used_pages < used_before  # refcounts released
+        scs.admit(0, [9, 8, 7, 6], temperature=0.0)
+        sps.admit(0, [9, 8, 7, 6], temperature=0.0)
+        assert np.array_equal(scs.step(8), sps.step(8))
+    finally:
+        ec.close(), ep.close()
+
+
+def test_speculative_verify_identity(model_path):
+    """Greedy speculative decode (ngram drafts + paged verify programs)
+    emits the exact plain-decode chain of the contiguous arm."""
+    rep = [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2]
+    ec = _engine(model_path, "contiguous")
+    ep = _engine(model_path, "paged", speculative="ngram")
+    try:
+        rc = ec.generate(rep, 56)
+        rp = ep.generate(rep, 56)
+        assert rc.tokens == rp.tokens
+        assert ep.stats.counters_snapshot().get("spec_rounds", 0) >= 1
+    finally:
+        ec.close(), ep.close()
+
+
+def test_model_draft_paged_engine_identity(model_path):
+    """A PAGED draft engine (ambient DLT_KV_LAYOUT=paged reaches it too)
+    must allocate pages for its draft-decode writes — dropped writes would
+    silently turn drafts into noise. Same-model drafting gives ~100%
+    acceptance only if the draft cache holds REAL KV; output must equal
+    plain contiguous decode exactly."""
+    from distributed_llama_tpu.runtime.speculative import ModelDraft
+
+    draft_eng = _engine(model_path, "paged")
+    main = _engine(model_path, "paged", speculative="model",
+                   draft_source=ModelDraft(draft_eng, owns=True))
+    plain = _engine(model_path, "contiguous")
+    try:
+        rep = [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2]
+        r1 = main.generate(rep, 56)
+        r0 = plain.generate(rep, 56)
+        assert r0.tokens == r1.tokens
+        c = main.stats.counters_snapshot()
+        drafted = c.get("spec_draft_tokens", 0)
+        assert drafted > 0
+        # a draft cache missing KV (dropped writes) drafts garbage and
+        # acceptance collapses; real KV + same model accepts nearly all
+        assert c.get("spec_accepted_tokens", 0) / drafted > 0.5, c
+    finally:
+        main.close(), plain.close()
+
+
+# -- zero-copy prefix sharing -------------------------------------------------
+
+
+def test_prefix_hit_zero_copy_and_identity(model_path):
+    """A prefix-cache hit under paging performs ZERO KV-copy device
+    dispatches — pages pinned into the row's table, the splice/extract
+    series untouched — and the warm reply is identical to the cold one."""
+    eng = _engine(model_path, "paged", prefix_cache_mb=64)
+    try:
+        prompt = list(range(1, 48))
+        cold = eng.generate(prompt, 72)
+        eng.reset()
+        warm = eng.generate(prompt, 72)
+        assert cold.tokens == warm.tokens
+        c = eng.stats.counters_snapshot()
+        assert c.get("prefix_hits", 0) >= 1
+        assert c.get("prefix_hit_tokens", 0) >= 16
+        assert eng.last_prefix_hit_tokens >= 16
+        assert c.get("kv_pages_shared", 0) >= 1
+        # the splice/extract copy programs never dispatched (no series, no
+        # warm keys) — sharing is host-side refcounting only
+        copies = [k for k in eng.stats.series if k.startswith("prefix_")]
+        assert copies == [], copies
+        assert not any(k[0].startswith("prefix_") for k in eng._warm
+                       if isinstance(k, tuple) and isinstance(k[0], str))
+    finally:
+        eng.close()
+
+
+def test_prefix_eviction_under_pin_paged(model_path):
+    """A pinned paged entry survives eviction pressure; its pages free only
+    after both the pin and the trie entry drop."""
+    eng = _engine(model_path, "paged", prefix_cache_mb=64)
+    try:
+        pc = eng.prefix_cache
+        eng.generate(list(range(1, 40)), 48)
+        eng.reset()
+        resume, entry = pc.match_for_splice(list(range(1, 40)))
+        assert entry is not None and entry.refs == 1 and entry.pages
+        assert not pc.evict_one()  # only the pinned entry exists
+        assert entry.tokens in pc._entries
+        pc.entry_release(entry)
+        pages = entry.pages
+        assert pc.evict_one()
+        # rows were reset, entry gone -> the shared pages returned
+        assert all(eng.page_pool.refs[p] == 0 for p in pages)
+    finally:
+        eng.close()
+
+
+def test_cow_divergence_mid_conversation(model_path):
+    """Divergence INSIDE the published region: turn 1 publishes the
+    conversation's pages (bucket 32 -> pages 0 and 1 shared with the trie
+    entry); the caller then regenerates from the UNALIGNED position 20 —
+    the delta-prompt continuation shape (`generate(pos_start=20)`), mid
+    page 1. Copy-on-write must COPY that page before the overwrite
+    (positions 16..19 are still live context below the write), and the
+    regenerated tokens must match the contiguous twin exactly — which also
+    proves the copy carried real bytes."""
+    ec = _engine(model_path, "contiguous", prefix_cache_mb=64)
+    ep = _engine(model_path, "paged", prefix_cache_mb=64)
+    try:
+        turn1 = list(range(1, 30))
+        rc1 = ec.generate(turn1, 40)
+        rp1 = ep.generate(turn1, 40)
+        assert rc1.tokens == rp1.tokens
+        assert ep.stats.counters_snapshot().get("prefix_inserts", 0) == 1
+        turn2 = [21, 22, 23, 24, 25]
+        rc2 = ec.generate(turn2, 44, pos_start=20)
+        rp2 = ep.generate(turn2, 44, pos_start=20)
+        assert rc2.tokens == rp2.tokens
+        c = ep.stats.counters_snapshot()
+        assert c.get("kv_cow_pages", 0) >= 1
+        assert c.get("kv_cow_copies", 0) >= 1  # the mid-page copy happened
+        assert "page_copy" in repr(sorted(ep._warm))  # program dispatched
+    finally:
+        ec.close(), ep.close()
+
+
+# -- pool exhaustion: park / shed / recover ----------------------------------
+
+
+def test_session_exhaustion_parks_and_recovers(model_path):
+    """A BatchSession admission that exhausts the pool raises the typed
+    error with the session state intact; releasing a row frees pages and
+    the SAME admission then completes (the Batcher's park-then-retry)."""
+    # 4 pages of 16 = 64 tokens of KV for 2 rows
+    eng = _engine(model_path, "paged", batch=2, kv_pool_mb=None)
+    eng.page_pool = type(eng.page_pool)(
+        4, eng.page_size, eng.batch, eng.cfg.seq_len, stats=eng.stats,
+        reclaim=eng._reclaim_pages,
+    )
+    try:
+        s = BatchSession(eng)
+        s.admit(0, [1] * 50)  # 4 pages: positions 0..48
+        with pytest.raises(PagePoolExhausted):
+            s.admit(1, [2] * 40)
+        # the staged admission survives; freeing row 0 un-parks it
+        assert 1 in s.pending_rows()
+        s.release(0)
+        assert s.prefill_pending(1) == 0
+        toks = s.step(8)
+        assert toks.shape == (2, 8)
+    finally:
+        eng.close()
+
+
+def test_recover_releases_pages(model_path):
+    """Engine reset + prefix-cache clear (the api.recover path) returns
+    every page to the pool — no leaks across failures."""
+    eng = _engine(model_path, "paged", prefix_cache_mb=64)
+    try:
+        eng.generate(list(range(1, 40)), 56)
+        assert eng.page_pool.used_pages > 0
+        eng.prefix_cache.clear()
+        eng.reset()
+        assert eng.page_pool.used_pages == 0
+        assert (eng.page_pool.refs == 0).all()
+    finally:
+        eng.close()
+
+
+# -- analysis integration ----------------------------------------------------
+
+
+@pytest.mark.analysis
+def test_graph_audit_paged_ladder_clean(model_path):
+    """The paged program ladder (gather/scatter forwards + page_copy)
+    passes the full graph audit: dtypes, zero collectives, donation."""
+    from distributed_llama_tpu.analysis.graph_audit import (
+        assert_clean,
+        audit_engine,
+    )
+
+    eng = _engine(model_path, "paged", batch=2, prefix_cache_mb=64,
+                  speculative="ngram")
+    try:
+        reports = audit_engine(eng)
+        assert_clean(reports)
+        kinds = {r.entry.kind for r in reports}
+        assert "page_copy" in kinds
+        # paged engines carry no prefix copy programs at all
+        assert not any(k.startswith("prefix_") for k in kinds)
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+@pytest.mark.slow
+def test_cost_table_covers_paged_ladder(model_path):
+    """graph_audit --costs contract on the paged arm: every warm-plan
+    program (page_copy included) gets a cost entry, and the paged decode's
+    modeled bytes grow with the kv bucket (the page-gather traffic)."""
+    from distributed_llama_tpu.runtime.profiling import (
+        build_cost_table,
+        cost_problems,
+    )
+
+    eng = _engine(model_path, "paged", batch=2, prefix_cache_mb=64,
+                  speculative="ngram")
+    try:
+        table = build_cost_table(eng)
+        assert cost_problems(eng, table) == []
+        assert table.lookup("page_copy", eng.page_size) is not None
+        deep = [e for (k, s, kv), e in table.entries.items()
+                if k == "decode" and s == 8]
+        deep.sort(key=lambda e: e.kv_len)
+        if len(deep) >= 2:
+            assert deep[-1].bytes_accessed > deep[0].bytes_accessed
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+@pytest.mark.slow
+@pytest.mark.parametrize("layout", ["paged", "contiguous"])
+def test_zero_post_warmup_recompiles_paged(model_path, monkeypatch, layout):
+    """DLT_SANITIZERS=1 acceptance on BOTH layouts: a WARMED engine serves
+    solo greedy, SAMPLED (the previously-broken /v1/chat shape — static
+    decode temperature + the eager seeded-key derivation), prefix-hit, and
+    BatchSession traffic with zero post-warmup recompiles."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    eng = _engine(model_path, layout, batch=2, prefix_cache_mb=32,
+                  speculative="ngram")
+    try:
+        eng.warmup()
+        eng.generate(list(range(1, 40)), 64)
+        eng.reset()
+        eng.generate(list(range(1, 40)), 64)  # prefix hit (zero-copy share)
+        s = Sampler(eng.cfg.vocab_size, 0.8, 0.9, 42)
+        eng.reset()
+        eng.generate([1, 2, 3, 4, 5, 6, 7], 40, sampler=s)
+        sess = BatchSession(eng)
+        sess.admit(0, [1] * 20)
+        sess.admit(1, [2] * 9, temperature=0.6, key_data=(7, 9))
+        sess.step(8)
+        sess.release(0), sess.release(1)
+        c = eng.stats.counters_snapshot()
+        assert c.get("sanitizer_recompiles", 0) == 0, c
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+@pytest.mark.slow
+def test_paged_deep_bucket_batch_decode_zero_recompiles(
+    tmp_path_factory, monkeypatch
+):
+    """Deep-kv-bucket regression (found in review): the warm-ladder fill
+    must compile the PAGED batch_decode programs — warming the contiguous
+    signature against the pool left every bucket beyond the canonical
+    pass's to compile post-seal. seq_len 512 gives two buckets (256, 512);
+    a session decoding across the boundary must stay recompile-free."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    d = tmp_path_factory.mktemp("deepkv")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=512), seed=9)
+    eng = _engine(path, "paged", batch=2, prefix_cache_mb=0,
+                  speculative="off")
+    try:
+        eng.warmup()
+        s = BatchSession(eng)
+        s.admit(0, [1] * 300)
+        s.admit(1, [2] * 280)
+        for _ in range(8):  # crosses the 256 -> 512 bucket boundary
+            s.step(8)
+        c = eng.stats.counters_snapshot()
+        assert c.get("sanitizer_recompiles", 0) == 0, c
+    finally:
+        eng.close()
+
+
+# -- HTTP level ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def paged_twin_servers(tmp_path_factory, request):
+    """Batched (batch=2) API twins: [0] paged, [1] contiguous — warmup
+    skipped (identity tests compile on demand; the fatal-sanitizer chat
+    regression has its own warmed server below)."""
+    import os
+    import socket
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    d = tmp_path_factory.mktemp("pagedsrv")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=256,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(
+        tp, pad_to=288,
+        chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    os.environ["DLT_NO_WARMUP"] = "1"
+    request.addfinalizer(lambda: os.environ.pop("DLT_NO_WARMUP", None))
+    servers, ports = [], []
+    for layout in ("paged", "contiguous"):
+        p = build_arg_parser()
+        p.add_argument("--port", type=int, default=0)
+        port = free_port()
+        args = p.parse_args(
+            [
+                "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+                "--compute-dtype", "float32", "--temperature", "0.0",
+                "--port", str(port), "--prefix-cache-mb", "16",
+                "--batch", "2", "--kv-layout", layout,
+            ]
+        )
+        httpd = api_mod.serve(args)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        ports.append(port)
+    yield ports, [s.RequestHandlerClass.state for s in servers]
+    for s in servers:
+        s.shutdown()
+
+
+def _post(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_http_paged_identity_and_stats(paged_twin_servers):
+    """Concurrent batched conversations over HTTP: every paged reply
+    matches the contiguous twin byte for byte; /stats exposes the kv_pool
+    section with live occupancy and the prefix hits are zero-copy."""
+    (paged_port, contig_port), _states = paged_twin_servers
+
+    def drive(port):
+        replies = {}
+
+        def one(name, text):
+            out = _post(port, {
+                "messages": [{"role": "user", "content": text}],
+                "max_tokens": 8,
+            })
+            replies[name] = out["choices"][0]["message"]["content"]
+
+        threads = [
+            threading.Thread(target=one, args=(n, t))
+            for n, t in (
+                ("a", "shared system preamble alpha question"),
+                ("b", "shared system preamble beta question"),
+            )
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # second round: same leading tokens -> prefix hits
+        one("a2", "shared system preamble alpha question again")
+        return replies
+
+    assert drive(paged_port) == drive(contig_port)
+    snap = _get(paged_port, "/stats")
+    pool = snap["kv_pool"]
+    assert pool is not None and pool["layout"] == "paged"
+    assert pool["n_pages"] > 0 and pool["page_size"] == 16
+    assert _get(contig_port, "/stats")["kv_pool"] is None
+
+
+def test_http_pool_exhaustion_parks_or_sheds(paged_twin_servers):
+    """Batcher-level backpressure: with the pool shrunk to roughly one
+    request's worth of pages, two concurrent growing requests exhaust it.
+    The typed PagePoolExhausted must surface as BACKPRESSURE — a parked
+    admission (kv_pool_admission_parked) or a clean 503 shed of one row
+    (kv_pool_shed_503) — NEVER as an engine failure: no 500s, no engine
+    recovery, and at least one request completes normally."""
+    import urllib.error
+
+    (paged_port, _), states = paged_twin_servers
+    import distributed_llama_tpu.runtime.paged_kv as pk
+
+    eng = states[0].engine
+    assert eng.paged
+    # measure the templated prompt's token count first, then size the pool
+    # so ONE request fits with slack but TWO cannot
+    probe = _post(paged_port, {
+        "messages": [{"role": "user", "content": "a tell me a long story now please"}],
+        "max_tokens": 4,
+    })
+    prompt_tokens = probe["usage"]["prompt_tokens"]
+    ps = eng.page_size
+    need = -(-(prompt_tokens + 96 + 8) // ps)  # pages one request can grow to
+    n_pages = need + 3
+    assert 2 * need > n_pages  # two concurrent requests MUST exhaust it
+    old_pool = eng.page_pool
+    eng.page_pool = pk.PagePool(
+        n_pages, ps, eng.batch, eng.cfg.seq_len, stats=eng.stats,
+        reclaim=eng._reclaim_pages,
+    )
+    if eng.prefix_cache is not None:
+        eng.prefix_cache.clear()
+        eng.prefix_cache.page_pool = eng.page_pool
+    eng._pt_cache = None
+    try:
+        def backpressure_events():
+            c = _get(paged_port, "/stats")["steps"]["counters"]
+            return (
+                c.get("kv_pool_admission_parked", 0)
+                + c.get("kv_pool_shed_503", 0)
+            ), c
+
+        # the race is real concurrency: if round 1's requests happen not to
+        # coexist (request A fully finishes before B admits), no pressure
+        # builds — retry a few rounds; one coexisting pair is guaranteed to
+        # exhaust the pool (2 * need > n_pages above)
+        for _ in range(4):
+            statuses = {}
+
+            def one(name):
+                try:
+                    out = _post(paged_port, {
+                        "messages": [{"role": "user",
+                                      "content": f"{name} tell me a long story now please"}],
+                        "max_tokens": 96,
+                    }, timeout=300)
+                    statuses[name] = (200, out["choices"][0]["message"]["content"])
+                except urllib.error.HTTPError as e:
+                    statuses[name] = (e.code, None)
+                except Exception as e:  # timeout/connection: keep it visible
+                    statuses[name] = (599, repr(e))
+
+            threads = [threading.Thread(target=one, args=(n,)) for n in ("a", "b")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            codes = sorted(c for c, _ in statuses.values())
+            assert 500 not in codes and 599 not in codes, statuses
+            assert 200 in codes, statuses
+            events, counters = backpressure_events()
+            if events >= 1:
+                break
+        assert events >= 1, counters
+        assert counters.get("stall_resets", 0) == 0
+    finally:
+        eng.page_pool = old_pool
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+            eng.prefix_cache.page_pool = old_pool
+        eng.reset()
+
+
+@pytest.mark.slow
+def test_chat_fatal_sanitizer_regression(tmp_path_factory, monkeypatch):
+    """The PR 7 out-of-scope bug, fixed: a WARMED server under
+    DLT_SANITIZERS_FATAL=1 serves a SAMPLED /v1/chat request (the default
+    temperature-0.8 path) without tripping the recompile sentinel — the
+    sampled RNG-key derivation and the decode program's traced
+    temperature/top-p are on the warm ladder now. Runs the paged arm; the
+    contiguous arm is covered by the engine-level twin in
+    test_zero_post_warmup_recompiles_paged's contiguous siblings."""
+    import socket
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    monkeypatch.setenv("DLT_SANITIZERS_FATAL", "1")
+    monkeypatch.setenv("DLT_COST_TABLE", "0")
+    # the twin fixture sets DLT_NO_WARMUP for the identity tests; THIS
+    # test is about the post-warmup seal — warmup must actually run
+    monkeypatch.delenv("DLT_NO_WARMUP", raising=False)
+    d = tmp_path_factory.mktemp("fatalsrv")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=128,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(
+        tp, pad_to=288,
+        chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(
+        [
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.8",
+            "--port", str(port), "--prefix-cache-mb", "16",
+            "--max-batch-size", "8", "--kv-layout", "paged",
+        ]
+    )
+    httpd = api_mod.serve(args)  # warms up (no DLT_NO_WARMUP here)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        # sampled request (server default temperature 0.8) AND an explicit
+        # seeded one — both previously compiled post-warmup
+        for payload in (
+            {"messages": [{"role": "user", "content": "hi there"}],
+             "max_tokens": 6},
+            {"messages": [{"role": "user", "content": "hi there"}],
+             "max_tokens": 6, "seed": 42, "temperature": 0.7},
+        ):
+            out = _post(port, payload)
+            assert out["choices"][0]["message"] is not None
+        counters = _get(port, "/stats")["steps"]["counters"]
+        assert counters.get("sanitizer_recompiles", 0) == 0, counters
+    finally:
+        httpd.shutdown()

@@ -310,6 +310,7 @@ def test_warmed_engine_hits_with_zero_recompiles(model_path, monkeypatch):
 
 
 @pytest.mark.analysis
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_warm_plan_matches_warmup_prefix_keys(model_path, monkeypatch):
     """The prefix-cache programs land on the engine's warm-key set exactly
     as warm_plan enumerates them (the graph auditor audits this plan)."""
@@ -352,6 +353,7 @@ def test_graph_audit_covers_prefix_programs(model_path):
 # -- mesh sharding -----------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_pipeline_mesh_slice_sharding_and_identity(tmp_path):
     """On a pp mesh: published slices carry pp_prefix_sharding (per-stage
     layout equal to the cache's), the live cache keeps pp_cache_sharding
@@ -487,6 +489,7 @@ def _post(port, payload):
         return json.loads(r.read())
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_http_interleaved_conversations_bit_identical(prefix_server):
     """Two conversations interleaving over HTTP: every reply from the
     prefix-enabled server matches the cache-off twin byte for byte, and the

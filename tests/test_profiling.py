@@ -93,6 +93,7 @@ def test_kv_bytes_scale_with_bucket(f32_engine):
     assert 0.8 <= ratio <= 3.0, f"kv byte-delta ratio {ratio:.3f}"
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_full_ladder_coverage_and_lookup(f32_engine):
     """Every warm_plan() program builds a cost entry (the /debug/costs +
     graph_audit --costs contract) and lookup() returns the shallowest-kv

@@ -725,6 +725,7 @@ def mesh_batched_api_server(tmp_path_factory):
     httpd.shutdown()
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_mesh_engine_batches_concurrent_requests(mesh_batched_api_server):
     """Two concurrent requests on a tp=2 mesh engine complete with the same
     deterministic completions as their solo runs (per-row positions through

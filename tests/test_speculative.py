@@ -266,6 +266,7 @@ def test_model_draft_snaps_odd_k_to_decode_ladder(model_path):
 # -- generate_batch ----------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_generate_batch_identity_mixed_rows(model_path):
     """Per-row speculation on a mixed batch (repetitive row, short row,
     ordinary row) with PER-ROW budgets: outputs and streaming order match
@@ -376,6 +377,7 @@ def test_session_spec_step_guards(model_path):
 
 
 @pytest.mark.analysis
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_zero_post_warmup_recompiles_with_speculation(model_path, monkeypatch):
     """DLT_SANITIZERS=1 regression: with speculation enabled, warmup
     compiles the verify buckets too, and a post-warmup serving mix —
@@ -472,6 +474,7 @@ def _post(port, payload):
     return urllib.request.urlopen(req, timeout=120)
 
 
+@pytest.mark.slow  # tier-1 wall-time budget: heavyweight; the unfiltered CI suite stage still runs it
 def test_http_greedy_identity_and_stats(http_twins):
     """Non-stream completions bit-match between the speculative and plain
     servers (the Batcher's spec rounds included), and /stats grows the
