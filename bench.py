@@ -785,6 +785,127 @@ def leg_profiling_overhead():
     }
 
 
+def leg_fleet_overhead():
+    """Fleet-observability-overhead leg (server/fleet.py + the batch
+    timeline): batched decode (4 rows, BatchSession — the Batcher's
+    execution path) on the 1B while (a) a scraper thread plays the
+    gateway's fleet scrape against this replica every ~50 ms (40x the
+    production 2 s cadence) — rendering the full /metrics body (StepStats
+    + profiling gauges + goodput) AND parsing it back through the
+    federation parser, i.e. both halves of the scrape — and (b) a
+    pre-bound batch_step timeline event lands per chunk
+    (the DLT_BATCH_TIMELINE=1 serving configuration); vs both off. Every
+    emission/scrape is host-side, so the acceptance bar is the same <=2%
+    decode-throughput delta the tracing/profiling legs hold."""
+    import threading
+
+    from distributed_llama_tpu.runtime.batch_session import BatchSession
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.runtime.telemetry import (
+        GoodputAggregator, GoodputLedger,
+    )
+    from distributed_llama_tpu.runtime.tracing import (
+        Tracer, render_step_stats,
+    )
+    from distributed_llama_tpu.server.fleet import parse_prom_text
+
+    path = ensure_model()
+    b = 4
+    chunk = 64
+    n_chunks = 8
+    prompts = [
+        [(i * (r + 3) % 1000) + 1 for i in range(96 + 13 * r)] for r in range(b)
+    ]
+
+    def run(observed: bool):
+        eng = InferenceEngine(
+            path, compute_dtype="bfloat16", batch=b, max_chunk=256,
+            decode_chunk_size=chunk, prefix_cache_mb=0, speculative="off",
+        )
+        goodput = GoodputAggregator()
+        tracer = Tracer(capacity=1 << 15)
+        em = tracer.bind_global(
+            "batch_step",
+            ("decoding", "prefilling", "free", "spec",
+             "pool_pages_used", "queue_depth"),
+        )
+        from distributed_llama_tpu.runtime.tracing import now_us
+
+        def cycle(record):
+            """One admit -> decode-chunks -> release cycle; returns the
+            measured chunk walls when `record`."""
+            session = BatchSession(eng)
+            for r in range(b):
+                session.admit(r, prompts[r])
+            walls = []
+            for _ in range(n_chunks):
+                t0 = time.perf_counter()
+                session.step(chunk)
+                dur = time.perf_counter() - t0
+                if observed:
+                    em(now_us(), int(dur * 1e6), b, 0, 0, 0, 0, 0)
+                if record:
+                    walls.append(dur)
+            if observed:
+                goodput.record(GoodputLedger(
+                    generated_tokens=b * chunk * n_chunks, outcome="ok",
+                ))
+            for r in range(b):
+                session.release(r)
+            return walls
+
+        cycle(record=False)  # warmup: compiles the batch ladder
+        stop = threading.Event()
+        n_scrapes = [0]
+
+        def scraper():
+            while not stop.is_set():
+                body = render_step_stats(
+                    eng.stats,
+                    extra_gauges={
+                        "goodput_tokens_per_s": goodput.goodput_tokens_per_s()
+                    },
+                    extra_counter_series={
+                        "wasted_tokens": goodput.wasted_series()
+                    },
+                )
+                parse_prom_text(body)  # the gateway-side half of the scrape
+                n_scrapes[0] += 1
+                stop.wait(0.05)
+
+        th = None
+        if observed:
+            th = threading.Thread(target=scraper, daemon=True)
+            th.start()
+        walls = cycle(record=True)
+        if th is not None:
+            stop.set()
+            th.join(timeout=2)
+        per_tok = sorted(w * 1e3 / chunk for w in walls)
+        p95 = per_tok[min(len(per_tok) - 1, int(len(per_tok) * 0.95))]
+        rate = b * chunk * len(walls) / sum(walls)
+        n_events = len(tracer.for_names(("batch_step",)))
+        del eng
+        return rate, p95, n_scrapes[0], n_events
+
+    rate_on, p95_on, n_scrapes, n_events = run(True)
+    assert n_events > 0, "observed arm emitted no timeline steps"
+    assert n_scrapes > 0, "observed arm never scraped"
+    rate_off, p95_off, _, _ = run(False)
+    overhead_pct = 100.0 * (rate_off - rate_on) / max(rate_off, 1e-9)
+    return {
+        "config": "llama-1B q40 1chip fleet-overhead b=4",
+        "decode_tok_s_observed": round(rate_on, 2),
+        "decode_tok_s_unobserved": round(rate_off, 2),
+        "throughput_overhead_pct": round(overhead_pct, 2),
+        "overhead_bar_pct": 2.0,
+        "p95_step_ms_observed": round(p95_on, 3),
+        "p95_step_ms_unobserved": round(p95_off, 3),
+        "fleet_scrapes": n_scrapes,
+        "timeline_steps": n_events,
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -956,6 +1077,13 @@ def main():
         print(f"# profiling-overhead: {po}", file=sys.stderr)
     except Exception as e:
         print(f"# profiling-overhead leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        fo = leg_fleet_overhead()
+        configs.append(fo)
+        print(f"# fleet-overhead: {fo}", file=sys.stderr)
+    except Exception as e:
+        print(f"# fleet-overhead leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

@@ -31,12 +31,16 @@ comma-separate for several — the pragma documents WHY at the site):
   host_sync_guard sanitizer allows (``memory_stats`` is blessed only at
   the cold-path HBM-ledger site, runtime/profiling.py);
 * **trace-hot-emit** — ``trace.event(...)`` / ``TRACER.event(...)`` inside
-  a ``for``/``while`` loop body in the hot packages (runtime/parallel), or
-  an emit call constructing a dict literal anywhere in them: per-iteration
-  span emission must go through a pre-bound ``Trace.bind(...)`` emitter
+  a ``for``/``while`` loop body in the emitting packages
+  (runtime/parallel/server), or an emit call constructing a dict literal
+  anywhere in them: per-iteration span emission must go through a
+  pre-bound ``Trace.bind(...)`` / ``Tracer.bind_global(...)`` emitter
   (one tuple append per event — no name/keys re-tupling, no dict
-  allocation in the decode/spec_step inner loops; runtime/tracing.py
-  Emitter).
+  allocation in the decode/spec_step/Batcher inner loops;
+  runtime/tracing.py Emitter). The server scope exists because the
+  Batcher's step loop and the gateway's retry loop are exactly where the
+  goodput-ledger and batch-timeline emits live; their sanctioned
+  once-per-request/once-per-decision cold sites carry pragmas.
 
 The CLI lives at ``scripts/dlt_lint.py``; CI runs it over the tree.
 """
@@ -66,6 +70,10 @@ _TRACEISH_RE = re.compile(r"^(tr|trace|tracer|TRACER)$")
 FLOAT64_SCOPE = ("ops", "models", "parallel", "runtime", "formats")
 #: packages whose np.asarray/np.array sites are potential host syncs
 HOST_SYNC_SCOPE = ("runtime", "parallel")
+#: packages whose loops must emit spans through pre-bound emitters: the
+#: hot packages PLUS the server (Batcher step loop, gateway retry loop —
+#: the goodput-ledger/batch-timeline emission sites live there)
+TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,12 +247,13 @@ class _Linter(ast.NodeVisitor):
                     "round trip — only the cold-path HBM-ledger site "
                     "(runtime/profiling.py) is sanctioned; pragma it",
                 )
-        # trace-hot-emit: span emission discipline in hot packages —
+        # trace-hot-emit: span emission discipline in emitting packages —
         # per-iteration .event() calls re-tuple name/keys every time and
         # invite dict construction; loops must use a pre-bound
-        # Trace.bind(...) emitter (one tuple append per event)
+        # Trace.bind(...) / Tracer.bind_global(...) emitter (one tuple
+        # append per event)
         if (
-            self._in_scope(HOST_SYNC_SCOPE)
+            self._in_scope(TRACE_EMIT_SCOPE)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr == "event"
             and _TRACEISH_RE.match(_receiver_name(node.func.value) or "")

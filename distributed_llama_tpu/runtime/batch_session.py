@@ -165,6 +165,13 @@ class BatchSession:
         in-flight prefill and grow its TTFT."""
         return list(self._pending)
 
+    def pending_resume(self, row: int) -> int:
+        """Prefix-cache resume boundary of `row`'s staged admission (tokens
+        the splice will cover; 0 = cold). The Batcher reads this into the
+        request's goodput ledger at admission time."""
+        st = self._pending.get(row)
+        return 0 if st is None else int(st["resume"])
+
     def admit(
         self,
         row: int,

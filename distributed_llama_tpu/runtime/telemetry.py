@@ -18,6 +18,13 @@ TPU equivalents of the reference's aux subsystems (SURVEY.md §5):
 * **Memory report** — the reference prints the per-node RAM requirement at
   graph build (reference: src/nn/nn-core.cpp:177-191); `memory_report`
   totals device bytes of params and cache pytrees.
+* **Goodput ledger** — per-request accounting of where wall time went
+  (queue/prefill/decode/spec µs) and what every decoded token became
+  (delivered / prefix-hit / spec-accepted / discarded), rolled up into a
+  process `GoodputAggregator` whose delivered-token rate and per-reason
+  waste counters ride `/metrics` (``dlt_goodput_tokens_per_s``,
+  ``dlt_wasted_tokens_total{reason=...}``) — shed storms and
+  draft-hostile traffic show up as goodput, not just event counters.
 """
 
 from __future__ import annotations
@@ -275,6 +282,165 @@ class StepStats:
                 f"p99={p.get('p99', 0)/1000:8.2f}ms"
             )
         return "\n".join(lines)
+
+
+# -- per-request goodput ledger ----------------------------------------------
+
+#: every waste reason the aggregator labels `dlt_wasted_tokens_total` with:
+#: * ``overrun``     — decoded past the row's stop/budget before the step
+#:                     loop noticed (discarded, never delivered);
+#: * ``shed``        — decoded for a request later shed (pool-pressure
+#:                     victim, overload 503);
+#: * ``stall_retry`` — a failed attempt's tokens discarded before the
+#:                     in-place retry re-decoded them;
+#: * ``client_gone`` — decoded after the client dropped mid-stream;
+#: * ``error``       — decoded before an engine failure killed the request.
+WASTE_REASONS = ("overrun", "shed", "stall_retry", "client_gone", "error")
+
+#: GoodputLedger fields attached to the request trace (one cold `ledger`
+#: event per request) and returned in the `usage` extension — one list so
+#: the trace, the HTTP payload, and the tests can never disagree on shape
+LEDGER_FIELDS = (
+    "queue_us", "prefill_us", "decode_us", "spec_us",
+    "prompt_tokens", "prefix_hit_tokens", "generated_tokens",
+    "spec_accepted_tokens", "discarded_tokens", "retries",
+)
+
+
+@dataclass
+class GoodputLedger:
+    """One request's goodput accounting: where its wall time went and what
+    every decoded token became. Accumulated along the serving path (queue
+    wait at admission, prefill/decode/spec walls per chunk, token outcomes
+    at retirement), attached to the request's trace, returned in the
+    ``usage`` extension, and folded into the process aggregate — so a shed
+    storm or draft-hostile traffic shows up as GOODPUT (delivered tokens/s
+    net of waste), not just as counters.
+
+    The accounting identity every request must satisfy (tested):
+    ``generated_tokens + discarded_tokens == every token the engine decoded
+    into this request's row(s)``."""
+
+    queue_us: int = 0      # submit -> admission (batched; 0 serialized)
+    prefill_us: int = 0    # prompt prefill wall (splice included)
+    decode_us: int = 0     # plain decode-chunk walls
+    spec_us: int = 0       # speculative draft+verify round walls
+    prompt_tokens: int = 0
+    prefix_hit_tokens: int = 0   # prompt tokens resumed from the radix cache
+    generated_tokens: int = 0    # delivered to the client (usage-visible)
+    spec_accepted_tokens: int = 0
+    discarded_tokens: int = 0    # decoded but never delivered
+    retries: int = 0             # in-place stall retries this request took
+    outcome: str = "ok"          # ok | shed | error | client_gone
+
+    def as_dict(self) -> dict:
+        out = {f: getattr(self, f) for f in LEDGER_FIELDS}
+        out["outcome"] = self.outcome
+        return out
+
+    def trace_vals(self) -> tuple:
+        return tuple(getattr(self, f) for f in LEDGER_FIELDS) + (self.outcome,)
+
+
+#: trace-event keys for the per-request `ledger` event (pairs trace_vals)
+LEDGER_TRACE_KEYS = LEDGER_FIELDS + ("outcome",)
+
+
+class GoodputAggregator:
+    """Process-level rollup of request ledgers: cumulative delivered vs
+    wasted tokens (by reason) plus a recent-window delivered-token rate —
+    the ``dlt_goodput_tokens_per_s`` gauge and
+    ``dlt_wasted_tokens_total{reason=...}`` counter family on /metrics.
+
+    Thread-safe; `record()` is one lock hold per REQUEST (never per token),
+    so the serving hot path is untouched."""
+
+    def __init__(self, window_s: float = 60.0):
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self.requests: dict[str, int] = {}   # outcome -> count
+        self.delivered_tokens = 0
+        self.prompt_tokens = 0
+        self.prefix_hit_tokens = 0
+        self.wasted: dict[str, int] = {}     # reason -> tokens
+        self._window: list = []              # (t_monotonic, delivered) pairs
+
+    def record(
+        self,
+        ledger: GoodputLedger,
+        waste_reason: str | None = None,
+        count_request: bool = True,
+    ):
+        """Fold one finished request (or failed attempt) in. `waste_reason`
+        labels the ledger's discarded tokens; None derives it from the
+        outcome (`ok` discards are chunk overrun). `count_request=False`
+        folds the TOKEN accounting without bumping the request outcome
+        counts — a stall-retried attempt's waste belongs to the ledger, but
+        the request itself is counted once, by its final attempt."""
+        if waste_reason is None:
+            waste_reason = "overrun" if ledger.outcome == "ok" else ledger.outcome
+        now = time.monotonic()
+        with self._lock:
+            if count_request:
+                self.requests[ledger.outcome] = (
+                    self.requests.get(ledger.outcome, 0) + 1
+                )
+            self.delivered_tokens += ledger.generated_tokens
+            self.prompt_tokens += ledger.prompt_tokens
+            self.prefix_hit_tokens += ledger.prefix_hit_tokens
+            if ledger.discarded_tokens:
+                self.wasted[waste_reason] = (
+                    self.wasted.get(waste_reason, 0) + ledger.discarded_tokens
+                )
+            self._window.append((now, ledger.generated_tokens))
+            self._trim_locked(now)
+
+    def _trim_locked(self, now: float):
+        cutoff = now - self.window_s
+        w = self._window
+        i = 0
+        while i < len(w) and w[i][0] < cutoff:
+            i += 1
+        if i:
+            del w[:i]
+
+    def goodput_tokens_per_s(self) -> float:
+        """Delivered tokens/s over the recent window — the headline gauge.
+        The divisor is the observed span, floored at ONE second: a scrape
+        landing milliseconds after a fresh replica's first completion must
+        not extrapolate one request into a 50k tok/s routing signal (the
+        fleet table lifts this gauge verbatim), and once the window has
+        aged in the floor is inert."""
+        now = time.monotonic()
+        with self._lock:
+            self._trim_locked(now)
+            if not self._window:
+                return 0.0
+            span = max(now - self._window[0][0], 1.0)
+            total = sum(n for _, n in self._window)
+        return round(total / span, 3)
+
+    def wasted_series(self) -> list:
+        """``[(labels, value), ...]`` for the labeled counter family —
+        every known reason present (zero-valued reasons included, so
+        dashboards never see a series appear from nowhere mid-incident)."""
+        with self._lock:
+            wasted = dict(self.wasted)
+        return [({"reason": r}, wasted.get(r, 0)) for r in WASTE_REASONS]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "window_s": self.window_s,
+                "requests": dict(self.requests),
+                "delivered_tokens": self.delivered_tokens,
+                "prompt_tokens": self.prompt_tokens,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "wasted_tokens": dict(self.wasted),
+                "wasted_tokens_sum": sum(self.wasted.values()),
+            }
+        out["goodput_tokens_per_s"] = self.goodput_tokens_per_s()
+        return out
 
 
 def _tree_bytes(tree) -> int:

@@ -210,8 +210,21 @@ class Tracer:
         publish, watchdog stall) — trace_id ``""``; flight-recorder context."""
         self.ring.append(("", name, t_us, dur_us, tuple(keys), tuple(vals)))
 
+    def bind_global(self, name: str, keys=()) -> Emitter:
+        """A pre-bound emitter for engine-level events NOT owned by any one
+        request (trace_id ``""``) — the hot-loop twin of :meth:`event`. The
+        Batcher's per-step batch-composition timeline rides this: one tuple
+        append per step, no dicts, no locks."""
+        return Emitter(self.ring, "", name, keys)
+
     def for_trace(self, trace_id: str) -> list:
         return self.ring.for_trace(trace_id)
+
+    def for_names(self, names) -> list:
+        """Ring events whose NAME is in `names` (any trace id) — the
+        batch-timeline view reads the ``batch_*`` families this way."""
+        names = frozenset(names)
+        return [e for e in self.ring.snapshot() if e[1] in names]
 
 
 TRACER = Tracer()
@@ -284,6 +297,86 @@ def trace_payload(trace_id: str, events: list) -> dict:
         "events": [render_event(e) for e in events],
         "tree": trace_tree(events),
         "chrome_trace": chrome_trace(events),
+    }
+
+
+# -- batch-composition timeline ----------------------------------------------
+
+#: the event families the Batcher's timeline emits (server/api.py): one
+#: sampled ``batch_step`` snapshot per step (slot composition + pool
+#: occupancy) plus always-landed ``batch_park``/``batch_shed`` marks at the
+#: pool-pressure decisions — the post-hoc view of batching pathologies
+#: (admission stalls, park livelocks, pool thrash).
+BATCH_TIMELINE_NAMES = ("batch_step", "batch_park", "batch_shed")
+
+
+def batch_timeline_chrome(events: list) -> list:
+    """Chrome ``trace_event`` view of a batch timeline: each ``batch_step``
+    becomes an ``X`` slice (the chunk wall) PLUS counter (``C``) samples —
+    ``batch_slots`` stacks decoding/prefilling/free rows, ``kv_pool`` plots
+    pages used — so chrome://tracing / Perfetto render slot composition and
+    pool pressure as stacked area charts over time; park/shed marks land as
+    global instant events."""
+    out: list = []
+    pid = os.getpid()
+    for ev in events:
+        _, name, t_us, dur_us, keys, vals = ev
+        args = dict(zip(keys, vals))
+        if name == "batch_step":
+            out.append(
+                {
+                    "name": "chunk", "cat": "dlt_batch", "ph": "X",
+                    "ts": int(t_us), "dur": max(int(dur_us), 1),
+                    "pid": pid, "tid": 0, "args": args,
+                }
+            )
+            slots = {
+                k: args[k] for k in ("decoding", "prefilling", "free")
+                if k in args
+            }
+            if slots:
+                out.append(
+                    {
+                        "name": "batch_slots", "cat": "dlt_batch", "ph": "C",
+                        "ts": int(t_us), "pid": pid, "args": slots,
+                    }
+                )
+            if "pool_pages_used" in args:
+                out.append(
+                    {
+                        "name": "kv_pool", "cat": "dlt_batch", "ph": "C",
+                        "ts": int(t_us), "pid": pid,
+                        "args": {"pages_used": args["pool_pages_used"]},
+                    }
+                )
+            if "queue_depth" in args:
+                out.append(
+                    {
+                        "name": "backlog", "cat": "dlt_batch", "ph": "C",
+                        "ts": int(t_us), "pid": pid,
+                        "args": {"queue_depth": args["queue_depth"]},
+                    }
+                )
+        else:  # batch_park / batch_shed: instant marks, global scope
+            out.append(
+                {
+                    "name": name, "cat": "dlt_batch", "ph": "i", "s": "g",
+                    "ts": int(t_us), "pid": pid, "tid": 0, "args": args,
+                }
+            )
+    return out
+
+
+def batch_timeline_payload(events: list) -> dict:
+    """The ``/debug/batch_timeline`` response body: raw step snapshots plus
+    the chrome://tracing export, one self-contained JSON."""
+    return {
+        "n_events": len(events),
+        "n_steps": sum(1 for e in events if e[1] == "batch_step"),
+        "parks": sum(1 for e in events if e[1] == "batch_park"),
+        "sheds": sum(1 for e in events if e[1] == "batch_shed"),
+        "events": [render_event(e) for e in events],
+        "chrome_trace": batch_timeline_chrome(events),
     }
 
 
@@ -379,7 +472,7 @@ def render_hist(lines: list, name: str, snap: dict) -> None:
 
 def render_step_stats(
     stats, extra_gauges: dict | None = None, prefix: str = "dlt",
-    extra_series: dict | None = None,
+    extra_series: dict | None = None, extra_counter_series: dict | None = None,
 ) -> str:
     """Render a StepStats-shaped object (``snapshot()`` with reserved
     ``counters``/``gauges``/``histograms`` keys plus latency series) as
@@ -387,7 +480,10 @@ def render_step_stats(
     per-kind quantile gauges + cumulative step counts, histograms as
     cumulative ``_bucket`` series. `extra_series` adds LABELED gauge
     families — ``{name: [(labels_dict, value), ...]}`` — e.g. the HBM
-    ledger's ``dlt_hbm_bytes{component=...}`` (runtime/profiling.py)."""
+    ledger's ``dlt_hbm_bytes{component=...}`` (runtime/profiling.py);
+    `extra_counter_series` the same shape as LABELED counter families
+    (``_total`` appended) — e.g. the goodput ledger's
+    ``dlt_wasted_tokens_total{reason=...}`` (runtime/telemetry.py)."""
     snap = stats.snapshot()
     counters = snap.pop("counters", {})
     gauges = dict(snap.pop("gauges", {}))
@@ -401,6 +497,11 @@ def render_step_stats(
         m = f"{prefix}_{_metric(name)}"
         lines.append(f"# TYPE {m} gauge")
         for labels, value in extra_series[name]:
+            lines.append(prom_line(m, labels, value))
+    for name in sorted(extra_counter_series or {}):
+        m = f"{prefix}_{_metric(name)}_total"
+        lines.append(f"# TYPE {m} counter")
+        for labels, value in extra_counter_series[name]:
             lines.append(prom_line(m, labels, value))
     if snap:
         m = f"{prefix}_step_latency_ms"

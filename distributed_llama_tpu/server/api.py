@@ -35,11 +35,18 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 import numpy as np
 
 from ..runtime.engine import InferenceEngine
+from ..runtime.telemetry import (
+    GoodputAggregator,
+    GoodputLedger,
+    LEDGER_TRACE_KEYS,
+)
 from ..runtime.tracing import (
+    BATCH_TIMELINE_NAMES,
     PROM_CONTENT_TYPE,
     SAMPLED_HEADER,
     TRACE_HEADER,
     TRACER,
+    batch_timeline_payload,
     flight_record,
     last_flight_record,
     now_us,
@@ -170,6 +177,10 @@ class _BatchReq:
         self.topp = topp
         self.seed = seed
         self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        # per-request goodput ledger (runtime/telemetry.py): the Batcher
+        # loop accumulates walls/tokens into it; complete_batched finalizes
+        # and folds it into the process aggregate at retirement
+        self.ledger = GoodputLedger(prompt_tokens=len(ids))
         # request-lifecycle tracing (runtime/tracing.py): the Batcher loop
         # emits this request's queue-wait/decode/spec spans through the
         # pre-bound emitters (one tuple append per chunk; None = untraced
@@ -249,6 +260,32 @@ class Batcher:
         # joining a backlog it would likely rot in (see ApiState shedding)
         self.max_backlog = max_backlog if max_backlog is not None else 8 * engine.batch
         self.q: "queue.Queue[_BatchReq]" = queue.Queue()
+        # batch-composition timeline (runtime/tracing.py): one sampled
+        # snapshot of slot state per step into the bounded TraceRing —
+        # decoding/prefilling/free rows, spec round flag, KV-pool pages,
+        # backlog depth — served post-hoc at /debug/batch_timeline.
+        # DLT_BATCH_TIMELINE=0 disables; DLT_BATCH_TIMELINE_SAMPLE=N keeps
+        # one step in N (default 1 = all; the ring bounds memory either
+        # way). Emission is a pre-bound tuple append: zero device work.
+        import os
+
+        try:
+            sample = int(os.environ.get("DLT_BATCH_TIMELINE_SAMPLE", "1"))
+        except ValueError:
+            sample = 1
+        if os.environ.get("DLT_BATCH_TIMELINE", "1") in ("0", ""):
+            sample = 0
+        self.timeline_sample = max(sample, 0)
+        self._em_timeline = (
+            TRACER.bind_global(
+                "batch_step",
+                ("decoding", "prefilling", "free", "spec",
+                 "pool_pages_used", "queue_depth"),
+            )
+            if self.timeline_sample > 0
+            else None
+        )
+        self._timeline_n = 0
         # observable serving state (/stats): the loop owns the mutations,
         # readers take racy-but-consistent-enough snapshots
         self.slots: list[_BatchReq | None] = [None] * engine.batch
@@ -363,6 +400,30 @@ class Batcher:
         except queue.Full:
             pass  # writer will notice done via its get timeout
 
+    def _timeline_step(
+        self, engine, slots, n_decoding: int, t_us: int, dur_us: int,
+        spec: bool,
+    ):
+        """One sampled batch-composition snapshot: slot roles + pool/backlog
+        occupancy at this step boundary. A pre-bound tuple append when it
+        fires; a counter bump and a modulo when sampled out."""
+        em = self._em_timeline
+        if em is None:
+            return
+        self._timeline_n += 1
+        if self._timeline_n % self.timeline_sample != 0:
+            return
+        n_prefilling = sum(
+            1 for s in slots if s is not None and s.prefilling
+        )
+        n_free = sum(1 for s in slots if s is None)
+        em(
+            t_us, dur_us, n_decoding, n_prefilling, n_free,
+            1 if spec else 0,
+            engine.page_pool.used_pages if engine.paged else 0,
+            self.queue_depth(),
+        )
+
     def _loop(self):
         import queue
 
@@ -400,10 +461,13 @@ class Batcher:
                     continue
                 req = backlog.popleft()
                 try:
+                    nowu = now_us()
+                    t0 = req.t_enqueue_us or nowu
+                    req.ledger.queue_us = max(nowu - t0, 0)
                     if req.trace is not None:
-                        nowu = now_us()
-                        t0 = req.t_enqueue_us or nowu
-                        req.trace.event(
+                        # once per REQUEST (not per token): sanctioned cold
+                        # emit inside the admission sweep
+                        req.trace.event(  # dlt: allow(trace-hot-emit)
                             "queue_wait", t0, max(nowu - t0, 0), ("row",), (row,)
                         )
                     key = self._key_for_seed(req.seed) if req.seed is not None else None
@@ -411,6 +475,7 @@ class Batcher:
                         row, req.ids, temperature=req.temperature,
                         topp=req.topp, key_data=key, trace=req.trace,
                     )
+                    req.ledger.prefix_hit_tokens = session.pending_resume(row)
                     req.prefilling = True
                     slots[row] = req
                 except Exception as e:
@@ -435,6 +500,7 @@ class Batcher:
                 if slots[r] is not None and slots[r].prefilling
             ]
             armed = False
+            prefill_wall_us = 0  # this boundary's prefill advance (timeline)
             if prefill_rows:
                 row = prefill_rows[0]
                 req = slots[row]
@@ -447,7 +513,10 @@ class Batcher:
                     continue
                 try:
                     budget = self.prefill_budget if decode_rows else None
+                    t_pf = time.perf_counter()
                     remaining = session.prefill_pending(row, budget)
+                    prefill_wall_us = int((time.perf_counter() - t_pf) * 1e6)
+                    req.ledger.prefill_us += prefill_wall_us
                     if decode_rows:
                         engine.stats.incr("interleaved_prefill_chunks")
                 except PagePoolExhausted:
@@ -465,6 +534,11 @@ class Batcher:
                         if r != row
                     ):
                         engine.stats.incr("kv_pool_shed_503")
+                        # timeline mark: once per shed decision, cold path
+                        TRACER.event(  # dlt: allow(trace-hot-emit)
+                            "batch_shed", now_us(), 0,
+                            ("row", "reason"), (row, "pool_admission"),
+                        )
                         req.error = Overloaded(retry_after_s=2)
                         self._finish(req, session, slots, row)
                         continue
@@ -476,6 +550,12 @@ class Batcher:
                     # nobody freed). With co-tenants but none decoding,
                     # yield briefly so the retry loop doesn't spin hot.
                     engine.stats.incr("kv_pool_admission_parked")
+                    # timeline mark: once per parked boundary, cold path
+                    TRACER.event(  # dlt: allow(trace-hot-emit)
+                        "batch_park", now_us(), 0,
+                        ("row", "pool_pages_used"),
+                        (row, engine.page_pool.used_pages),
+                    )
                     remaining = None
                     if not decode_rows:
                         time.sleep(0.005)
@@ -489,7 +569,14 @@ class Batcher:
                     decode_rows.append(row)
                     armed = True
             if not decode_rows:
-                continue  # only prefilling rows: no decode chunk to run yet
+                # only prefilling rows: no decode chunk to run yet — still a
+                # timeline step (admission stalls are exactly the pathology
+                # the post-hoc view exists to show)
+                self._timeline_step(
+                    engine, slots, 0, now_us() - prefill_wall_us,
+                    prefill_wall_us, spec=False,
+                )
+                continue
             # a row at pos == seq_len-1 has zero decode headroom: finish it
             # (the request keeps what it generated) instead of flooring the
             # chunk clamp at 1 and letting session.step's overrun guard fail
@@ -589,6 +676,11 @@ class Batcher:
                 )
                 vreq = slots[victim]
                 vreq.error = vreq.error or Overloaded(retry_after_s=1)
+                # timeline mark: once per shed decision, cold path
+                TRACER.event(  # dlt: allow(trace-hot-emit)
+                    "batch_shed", now_us(), 0,
+                    ("row", "reason"), (victim, "pool_decode"),
+                )
                 self._finish(vreq, session, slots, victim)
                 engine.stats.incr("kv_pool_shed_503")
                 continue
@@ -604,6 +696,10 @@ class Batcher:
                 continue
             chunk_dur_us = int((time.perf_counter() - t_chunk) * 1e6)
             t_chunk_us = to_us(t_chunk)
+            self._timeline_step(
+                engine, slots, len(decode_rows), t_chunk_us, chunk_dur_us,
+                spec=spec_drafts is not None,
+            )
             for row, req in enumerate(slots):
                 if req is None or req.prefilling or row not in per_row:
                     continue
@@ -611,14 +707,18 @@ class Batcher:
                 # (a tuple append each; the chunk wall is shared — per-row
                 # attribution is the row's token count / acceptance)
                 if spec_drafts is not None:
+                    req.ledger.spec_us += chunk_dur_us
+                    req.ledger.spec_accepted_tokens += max(len(per_row[row]) - 1, 0)
                     if req._em_spec is not None:
                         req._em_spec(
                             t_chunk_us, chunk_dur_us,
                             len(spec_drafts.get(row) or ()),
                             max(len(per_row[row]) - 1, 0),
                         )
-                elif req._em_decode is not None:
-                    req._em_decode(t_chunk_us, chunk_dur_us, len(per_row[row]))
+                else:
+                    req.ledger.decode_us += chunk_dur_us
+                    if req._em_decode is not None:
+                        req._em_decode(t_chunk_us, chunk_dur_us, len(per_row[row]))
                 for t in per_row[row]:
                     req.n += 1
                     req.out_ids.append(t)
@@ -653,6 +753,14 @@ class ApiState:
         self.tokenizer = tokenizer
         self.args = args
         self.lock = threading.Lock()
+        # per-request goodput rollup (runtime/telemetry.py): every
+        # completed, shed, or retried request folds its ledger in —
+        # /metrics serves dlt_goodput_tokens_per_s +
+        # dlt_wasted_tokens_total{reason=...} from here
+        self.goodput = GoodputAggregator()
+        # serialized path's in-flight ledger (complete/_complete_once talk
+        # through it; the serialized path runs under self.lock)
+        self._inflight_ledger: GoodputLedger | None = None
         self.sampler = Sampler(
             engine.cfg.vocab_size,
             args.temperature,
@@ -687,11 +795,25 @@ class ApiState:
                 "samples on-device); concurrent requests will queue"
             )
 
+    def _record_ledger(
+        self, ledger: GoodputLedger, trace, waste_reason=None,
+        count_request: bool = True,
+    ):
+        """Fold a finished request's (or failed attempt's) ledger into the
+        process aggregate and attach it to the request trace — failures
+        land `always` so /debug/trace reconstructs them unsampled."""
+        self.goodput.record(ledger, waste_reason, count_request=count_request)
+        if trace is not None:
+            trace.event(
+                "ledger", now_us(), 0, LEDGER_TRACE_KEYS, ledger.trace_vals(),
+                always=ledger.outcome != "ok",
+            )
+
     def complete_batched(self, params: dict, emit, client_visible: bool = True,
                          trace=None):
         """One request's slice of a batched generation: encode, submit to the
         Batcher, stream deltas from this row's tokens as they arrive.
-        Returns (full_text, n_prompt_tokens, n_completion_tokens).
+        Returns (full_text, n_prompt_tokens, n_completion_tokens, ledger).
         `client_visible=False` widens stall-retry eligibility exactly like
         `complete` (see there). `trace` (runtime/tracing.py Trace) threads
         the request's span context through the Batcher and the session."""
@@ -717,6 +839,11 @@ class ApiState:
         # patience and a slot's worth of queue memory
         if self.batcher.overloaded():
             self.engine.stats.incr("shed_503")
+            # shed requests land in the goodput ledger too (zero tokens
+            # moved, but the shed storm must be visible as an outcome)
+            self._record_ledger(
+                GoodputLedger(prompt_tokens=len(ids), outcome="shed"), trace
+            )
             raise Overloaded(retry_after_s=1)
 
         base = []
@@ -774,8 +901,18 @@ class ApiState:
 
         from ..runtime.telemetry import StallError
 
+        def fail_ledger(req, outcome):
+            """A failed request (or failed attempt): every token it decoded
+            is waste — nothing reached a successful response."""
+            led = req.ledger
+            led.outcome = outcome
+            led.generated_tokens = 0
+            led.discarded_tokens += req.n
+            return led
+
         for attempt in range(2):
             req = make_req()
+            req.ledger.retries = attempt
             try:
                 self.batcher.submit(req)
                 break
@@ -790,12 +927,35 @@ class ApiState:
                 self.engine.stats.incr("stall_resets")
                 if attempt == 0 and (req.n_out == 0 or not client_visible):
                     self.engine.stats.incr("stall_retries")
+                    # token accounting for the abandoned attempt — the
+                    # REQUEST outcome is the final attempt's to report
+                    self._record_ledger(
+                        fail_ledger(req, "error"), trace,
+                        waste_reason="stall_retry", count_request=False,
+                    )
                     continue
+                self._record_ledger(fail_ledger(req, "error"), trace)
+                raise
+            except Overloaded:
+                # pool-pressure shed mid-flight (the Batcher picked this
+                # row as the victim) — distinct from the backlog shed above
+                self._record_ledger(fail_ledger(req, "shed"), trace)
+                raise
+            except ClientDisconnected:
+                self._record_ledger(fail_ledger(req, "client_gone"), trace)
+                raise
+            except Exception:
+                self._record_ledger(fail_ledger(req, "error"), trace)
                 raise
         # n_out counts tokens the writer actually delivered (the EOS token
         # included) — req.n also counts post-stop overrun decoded before the
         # step loop noticed, which must not inflate usage accounting
         self.engine.stats.incr("requests_completed")
+        led = req.ledger
+        led.outcome = "ok"
+        led.generated_tokens = req.n_out
+        led.discarded_tokens += max(req.n - req.n_out, 0)
+        self._record_ledger(led, trace)
         times = times_box[0]
         if times[0] is not None:
             # per-request latency histograms: TTFT from request arrival to
@@ -808,12 +968,12 @@ class ApiState:
                 self.engine.stats.observe(
                     "tpot_ms", (times[1] - times[0]) * 1e3 / (req.n_out - 1)
                 )
-        return "".join(base + deltas_box[0]), len(ids), req.n_out
+        return "".join(base + deltas_box[0]), len(ids), req.n_out, led
 
     def complete(self, params: dict, emit, client_visible: bool = True,
                  trace=None):
         """Run one completion; calls emit(delta_text) per safe-to-send chunk.
-        Returns (full_text, n_prompt_tokens, n_completion_tokens).
+        Returns (full_text, n_prompt_tokens, n_completion_tokens, ledger).
 
         A `StallError` from the decode watchdog (wedged device step) gets
         ONE bounded in-place retry on the recovered engine — but only when
@@ -829,20 +989,51 @@ class ApiState:
             emitted[0] = True
             emit(delta)
 
-        try:
-            return self._complete_once(params, traced_emit, trace=trace)
-        except StallError:
-            # _complete_once's failure path already ran recover() (engine
-            # reset + prefix cache dropped), so the retry starts clean and
-            # re-prefills from position 0 (the retry builds a fresh buffer,
-            # so nothing from the failed attempt leaks into the result)
-            self.engine.stats.incr("stall_resets")
-            if emitted[0] and client_visible:
-                raise
-            self.engine.stats.incr("stall_retries")
-            return self._complete_once(params, traced_emit, trace=trace)
+        def fail_ledger(outcome):
+            """Finalize the in-flight attempt's ledger on a failure: every
+            token a failed request decoded is waste (partial stream bytes
+            are a truncated response, not delivered goodput)."""
+            led = self._inflight_ledger
+            self._inflight_ledger = None
+            if led is None:
+                led = GoodputLedger()
+            led.outcome = outcome
+            led.generated_tokens = 0
+            return led
 
-    def _complete_once(self, params: dict, emit, trace=None):
+        for attempt in range(2):
+            try:
+                return self._complete_once(
+                    params, traced_emit, trace=trace, retried=attempt > 0
+                )
+            except StallError:
+                # _complete_once's failure path already ran recover()
+                # (engine reset + prefix cache dropped), so the retry starts
+                # clean and re-prefills from position 0 (the retry builds a
+                # fresh buffer, so nothing from the failed attempt leaks
+                # into the result)
+                self.engine.stats.incr("stall_resets")
+                if attempt > 0 or (emitted[0] and client_visible):
+                    self._record_ledger(fail_ledger("error"), trace)
+                    raise
+                self.engine.stats.incr("stall_retries")
+                self._record_ledger(
+                    fail_ledger("error"), trace,
+                    waste_reason="stall_retry", count_request=False,
+                )
+            except PromptTooLong:
+                # client-input 400, raised before any engine work: not an
+                # error OUTCOME — the batched path records nothing for
+                # these either, and error dashboards must not alarm on it
+                raise
+            except ClientDisconnected:
+                self._record_ledger(fail_ledger("client_gone"), trace)
+                raise
+            except Exception:
+                self._record_ledger(fail_ledger("error"), trace)
+                raise
+
+    def _complete_once(self, params: dict, emit, trace=None, retried=False):
         engine, tok = self.engine, self.tokenizer
         messages = params["messages"]
         # full-prompt serving over the radix prefix cache: every request
@@ -885,6 +1076,17 @@ class ApiState:
             self.sampler.set_seed(params["seed"])
         self.sampler.topp = params.get("top_p", self.args.topp)
 
+        # per-request goodput ledger: walls + token outcomes; parked on the
+        # instance (serialized path runs under self.lock) so `complete` can
+        # finalize it if this attempt dies mid-generate
+        led = GoodputLedger(
+            prompt_tokens=len(ids), retries=1 if retried else 0
+        )
+        self._inflight_ledger = led
+        spec_accept_0 = engine.stats.counters_snapshot().get(
+            "spec_accepted_tokens", 0
+        )
+
         # drive the engine's generation loop (chunked on-device decode — one
         # host round trip per K tokens; with on-device sampling the RNG
         # stream differs from the reference's host xorshift*, temperature 0
@@ -893,6 +1095,9 @@ class ApiState:
 
         def on_token(t):
             state["n"] += 1
+            # running decoded count: if this attempt fails, every decoded
+            # token is waste — `complete` reads it off the parked ledger
+            led.discarded_tokens = state["n"]
             piece = tok.decode(t)
             eos_type = detector.append(t, piece)
             if eos_type != EOS_MAYBE:
@@ -939,8 +1144,22 @@ class ApiState:
                 "tpot_ms",
                 (res.total_us - res.ttft_us) / (res.n_pred_tokens - 1) / 1e3,
             )
+        # finalize + fold the goodput ledger (GenerationResult carries the
+        # walls; prefix-hit/spec-accepted from the engine's own accounting)
+        led.prefill_us = res.prefill_us
+        led.decode_us = res.decode_us
+        led.prefix_hit_tokens = engine.last_prefix_hit_tokens
+        led.spec_accepted_tokens = (
+            engine.stats.counters_snapshot().get("spec_accepted_tokens", 0)
+            - spec_accept_0
+        )
+        led.generated_tokens = res.n_pred_tokens
+        led.discarded_tokens = max(state["n"] - res.n_pred_tokens, 0)
+        led.outcome = "ok"
+        self._inflight_ledger = None
+        self._record_ledger(led, trace)
         text = "".join(buffer)
-        return text, len(ids), res.n_pred_tokens
+        return text, len(ids), res.n_pred_tokens, led
 
     def recover(self):
         """Reset engine + prefix cache after a failed generation (the
@@ -964,6 +1183,66 @@ class ApiState:
             # request will hit the broken engine, and the operator needs
             # the counter trail (/stats, /health) to see why
             self.engine.stats.incr("recover_reset_failed")
+
+
+def resolved_config(state: "ApiState") -> dict:
+    """The ``GET /debug/config`` payload: the RESOLVED runtime
+    configuration this replica is actually serving with — after env vars,
+    CLI flags, and capability fallbacks (paged->contiguous on meshes,
+    spec-off on host-decode) have all been applied — so fleet debugging
+    never requires shell access to the box. The gateway proxies this
+    per-backend under its own ``/debug/config``."""
+    import os
+
+    eng = state.engine
+    env = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith("DLT_") and "KEY" not in k and "TOKEN" not in k
+    }
+    pc = eng.prefix_cache
+    batcher = state.batcher
+    return {
+        "model": MODEL_NAME,
+        "engine": {
+            "batch": eng.batch,
+            "seq_len": eng.cfg.seq_len,
+            "compute_dtype": eng.cfg.compute_dtype,
+            "cache_dtype": eng.cfg.cache_dtype,
+            "max_chunk": eng.max_chunk,
+            "decode_chunk_size": eng.decode_chunk_size,
+            "device_decode": eng.device_decode,
+        },
+        "kv": {
+            "layout": eng.kv_layout,
+            "page_size": eng.page_size,
+            "pool": None if eng.page_pool is None else eng.page_pool.snapshot(),
+        },
+        "prefix_cache": None if pc is None else {
+            "budget_bytes": pc.budget_bytes,
+            "buckets": list(pc.buckets),
+        },
+        "speculative": {
+            "mode": eng.spec_mode,
+            "draft_k": eng.draft_k,
+            "buckets": list(eng.spec_buckets),
+        },
+        "batcher": None if batcher is None else {
+            "chunk_size": batcher.chunk,
+            "prefill_budget": batcher.prefill_budget,
+            "max_backlog": batcher.max_backlog,
+            "timeline_sample": batcher.timeline_sample,
+        },
+        "tracing": {
+            "ring_capacity": TRACER.ring.capacity,
+            "sample_every": TRACER.sample_every(),
+        },
+        "sanitizers": {
+            "enabled": bool(getattr(eng, "_sanitize", False)),
+            "fatal": os.environ.get("DLT_SANITIZERS_FATAL", "") not in ("", "0"),
+        },
+        "goodput_window_s": state.goodput.window_s,
+        "env": env,
+    }
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -1002,8 +1281,13 @@ class Handler(BaseHTTPRequestHandler):
 
             prof_gauges, prof_series = metrics_view(st.engine)
             extra.update(prof_gauges)
+            # goodput ledger rollup (runtime/telemetry.py): delivered-token
+            # rate + per-reason waste counters — the federation scraper
+            # (server/fleet.py) lifts these into the per-replica table
+            extra["goodput_tokens_per_s"] = st.goodput.goodput_tokens_per_s()
             body = render_step_stats(
-                st.engine.stats, extra_gauges=extra, extra_series=prof_series
+                st.engine.stats, extra_gauges=extra, extra_series=prof_series,
+                extra_counter_series={"wasted_tokens": st.goodput.wasted_series()},
             )
             self._respond(200, body.encode(), ctype=PROM_CONTENT_TYPE)
             return
@@ -1050,6 +1334,17 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(404, b'{"error":"unknown or expired trace id"}')
                 return
             self._json(200, json.dumps(trace_payload(tid, events)).encode())
+            return
+        if route == "/debug/batch_timeline":
+            # batch-composition timeline (runtime/tracing.py): the sampled
+            # per-step slot snapshots + park/shed marks still in the ring,
+            # as JSON events and a chrome://tracing export — the post-hoc
+            # view of admission stalls, park livelocks, and pool thrash
+            events = TRACER.for_names(BATCH_TIMELINE_NAMES)
+            self._json(200, json.dumps(batch_timeline_payload(events)).encode())
+            return
+        if route == "/debug/config":
+            self._json(200, json.dumps(resolved_config(self.state)).encode())
             return
         if route == "/debug/flightrecord":
             rec = last_flight_record()
@@ -1109,6 +1404,9 @@ class Handler(BaseHTTPRequestHandler):
                     if st.engine.paged
                     else None
                 ),
+                # per-request goodput rollup: outcomes, delivered vs wasted
+                # tokens (by reason), recent-window delivered-token rate
+                "goodput": st.goodput.snapshot(),
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,
@@ -1195,7 +1493,7 @@ class Handler(BaseHTTPRequestHandler):
                         raise ClientDisconnected(str(e)) from e
 
                 try:
-                    text, n_prompt, n_completion = complete_fn(
+                    text, n_prompt, n_completion, _led = complete_fn(
                         params, emit, trace=tr
                     )
                 except PromptTooLong as e:
@@ -1235,7 +1533,7 @@ class Handler(BaseHTTPRequestHandler):
                     # non-stream: emit is a no-op and the response is built
                     # from the return value only — a stall retry can never
                     # duplicate client-visible bytes
-                    text, n_prompt, n_completion = complete_fn(
+                    text, n_prompt, n_completion, led = complete_fn(
                         params, lambda d: None, client_visible=False, trace=tr
                     )
                 except PromptTooLong as e:
@@ -1261,6 +1559,12 @@ class Handler(BaseHTTPRequestHandler):
                             "prompt_tokens": n_prompt,
                             "completion_tokens": n_completion,
                             "total_tokens": n_prompt + n_completion,
+                            # goodput-ledger extension: where this request's
+                            # wall time went and what every decoded token
+                            # became (runtime/telemetry.py GoodputLedger) —
+                            # standard OpenAI clients ignore unknown usage
+                            # keys; fleet tooling joins on them
+                            "goodput": led.as_dict() if led is not None else None,
                         },
                         "choices": [
                             {

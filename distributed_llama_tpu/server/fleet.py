@@ -1,0 +1,471 @@
+"""Fleet signal plane: gateway-side metrics federation over replicas.
+
+PR 6 made the request path observable and PR 7 made the device observable,
+but the gateway balanced on inflight counts alone — it had no idea which
+replica holds a hot prefix cache, which one's KV page pool is about to
+exhaust, or which one is missing its TTFT SLO. This module closes that
+gap: a background :class:`FleetScraper` polls every backend's ``/metrics``
+(+ ``/stats``) on an interval and maintains a per-replica signal table —
+exactly the inputs a prefix-cache-aware router (ROADMAP item 3) scores:
+
+* **prefix_hit_tokens rate** (tokens/s reused from the radix cache —
+  derived from consecutive scrapes of the cumulative counter);
+* **KV-pool headroom** (``kv_pool_pages_free`` / ``_used`` gauges from the
+  paged allocator; absent on contiguous replicas);
+* **batcher occupancy** (active/prefilling slots, queue depth, backlog
+  cap — how loaded the replica's continuous-batching loop really is,
+  which raw inflight connection counts under-report during prefill);
+* **SLO attainment** (``slo_ttft_attainment`` / ``slo_tpot_attainment``
+  gauges the PR 7 layer derives from the cumulative latency histograms);
+* **goodput** (``goodput_tokens_per_s`` — delivered-token rate net of
+  waste, the PR 9 ledger's headline gauge);
+* **staleness** (seconds since the last successful scrape — a replica
+  that stopped answering keeps its last-known signals, flagged stale, so
+  the router can discount rather than crash on it).
+
+The scraper is **failure-isolated by construction**: every poll runs in
+its own try/except, a dead backend just ages into staleness (the chaos
+suite kills one mid-scrape and asserts no exception escapes), and no
+client request ever waits on a scrape. Serving:
+
+* ``GET /gateway/fleet`` — the signal table as JSON (breaker state joined
+  from the balancer, so the router view and the failure view can't
+  disagree);
+* ``GET /metrics`` on the gateway — the gateway's own series plus a
+  **federated rollup**: every replica's scraped samples re-emitted with a
+  ``replica="host:port"`` label, so one Prometheus scrape of the gateway
+  sees the whole fleet (the reference's per-node network perf reports
+  print at shutdown, per node; this is the live, joined equivalent).
+
+Deliberately stdlib-only (no jax, no numpy): the gateway imports this and
+must stay runnable on a box with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+def now_s() -> float:
+    """Monotonic seconds — the staleness clock (module-level so tests can
+    drive time explicitly by patching it)."""
+    return time.monotonic()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+#: scrape cadence (seconds); <= 0 disables the scraper thread entirely
+DEFAULT_SCRAPE_S = 2.0
+#: per-request socket timeout for one scrape round trip
+DEFAULT_TIMEOUT_S = 2.0
+
+
+def http_get_text(host: str, port: int, path: str, timeout_s: float) -> tuple:
+    """One bounded GET round trip: ``(status, body_text)``. Raises OSError
+    family on transport failure — callers isolate."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", path, headers={"Connection": "close"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", errors="replace")
+    finally:
+        conn.close()
+
+
+# -- Prometheus text parsing -------------------------------------------------
+
+
+def parse_prom_text(body: str) -> tuple:
+    """Parse Prometheus text exposition into ``(samples, types)`` where
+    samples is ``[(name, labels_dict, value), ...]`` (file order kept) and
+    types maps metric family name -> declared type. Tolerant: unparseable
+    lines are skipped, never raised — this runs against replicas mid-crash."""
+    samples: list = []
+    types: dict = {}
+    for line in body.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        # NAME{label="v",...} VALUE   |   NAME VALUE
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labstr, valstr = rest.rsplit("}", 1)
+                labels = {}
+                for item in _split_labels(labstr):
+                    k, v = item.split("=", 1)
+                    labels[k.strip()] = v.strip().strip('"')
+            else:
+                name, valstr = line.rsplit(None, 1)
+                labels = {}
+            value = float(valstr)
+        except (ValueError, IndexError):
+            continue
+        samples.append((name.strip(), labels, value))
+    return samples, types
+
+
+def _split_labels(labstr: str) -> list:
+    """Split ``a="x",b="y,z"`` on commas outside quotes."""
+    out, cur, in_q, prev = [], [], False, ""
+    for ch in labstr:
+        if ch == '"' and prev != "\\":
+            in_q = not in_q
+        if ch == "," and not in_q:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        prev = ch
+    if cur:
+        out.append("".join(cur))
+    return [s for s in (x.strip() for x in out) if s]
+
+
+# -- the per-replica signal table --------------------------------------------
+
+#: unlabeled gauges lifted verbatim into the signal table when present
+_GAUGE_SIGNALS = {
+    "dlt_kv_pool_pages_free": "kv_pool_pages_free",
+    "dlt_kv_pool_pages_used": "kv_pool_pages_used",
+    "dlt_batcher_slots_active": "batcher_slots_active",
+    "dlt_batcher_slots_prefilling": "batcher_slots_prefilling",
+    "dlt_batcher_batch_slots": "batcher_batch_slots",
+    "dlt_batcher_queue_depth": "batcher_queue_depth",
+    "dlt_batcher_max_backlog": "batcher_max_backlog",
+    "dlt_slo_ttft_attainment": "slo_ttft_attainment",
+    "dlt_slo_tpot_attainment": "slo_tpot_attainment",
+    "dlt_goodput_tokens_per_s": "goodput_tokens_per_s",
+    "dlt_prefix_cache_bytes": "prefix_cache_bytes",
+    "dlt_prefix_cache_entries": "prefix_cache_entries",
+}
+
+#: cumulative counters turned into rates across consecutive scrapes
+_RATE_SIGNALS = {
+    "dlt_prefix_hit_tokens_total": "prefix_hit_tokens_per_s",
+    "dlt_requests_completed_total": "requests_per_s",
+    "dlt_shed_503_total": "shed_per_s",
+}
+
+
+class ReplicaState:
+    """Last-known signals + scrape bookkeeping for one backend. Mutated
+    only by the scraper thread; snapshot readers copy under the fleet lock."""
+
+    __slots__ = (
+        "key", "signals", "samples", "types", "stats_sections",
+        "last_ok_s", "last_attempt_s", "scrapes_ok", "scrape_failures",
+        "consecutive_failures", "_prev_counters", "_prev_t",
+    )
+
+    def __init__(self, key: str):
+        self.key = key
+        self.signals: dict = {}
+        self.samples: list = []  # parsed /metrics samples, for federation
+        self.types: dict = {}
+        self.stats_sections: dict = {}  # selected /stats fields
+        self.last_ok_s: float | None = None
+        self.last_attempt_s: float | None = None
+        self.scrapes_ok = 0
+        self.scrape_failures = 0
+        self.consecutive_failures = 0
+        self._prev_counters: dict = {}
+        self._prev_t: float | None = None
+
+
+class FleetScraper:
+    """Background per-replica ``/metrics`` (+ ``/stats``) poller over a
+    gateway :class:`~.gateway.Balancer`. Construct and call
+    :meth:`scrape_once` directly in tests; :meth:`start` runs the loop.
+
+    The contract every caller relies on: **no exception ever escapes a
+    scrape** — a replica that refuses, stalls, or returns garbage is
+    counted, aged toward staleness, and retried next interval."""
+
+    def __init__(
+        self,
+        balancer,
+        interval_s: float | None = None,
+        timeout_s: float | None = None,
+        stale_after_s: float | None = None,
+    ):
+        self.balancer = balancer
+        self.interval_s = (
+            _env_float("DLT_FLEET_SCRAPE_S", DEFAULT_SCRAPE_S)
+            if interval_s is None
+            else interval_s
+        )
+        self.timeout_s = (
+            _env_float("DLT_FLEET_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+            if timeout_s is None
+            else timeout_s
+        )
+        # a replica is STALE once its last good scrape is older than this
+        # (default: 3 intervals — one flaky scrape must not flap the flag)
+        self.stale_after_s = (
+            _env_float("DLT_FLEET_STALE_S", 3.0 * max(self.interval_s, 0.1))
+            if stale_after_s is None
+            else stale_after_s
+        )
+        self._lock = threading.Lock()
+        self._replicas: dict = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.scrape_rounds = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetScraper":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="gateway-fleet-scraper"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.scrape_once()
+
+    # -- scraping -----------------------------------------------------------
+
+    def _replica(self, key: str) -> ReplicaState:
+        st = self._replicas.get(key)
+        if st is None:
+            st = self._replicas[key] = ReplicaState(key)
+        return st
+
+    def scrape_once(self):
+        """One scrape round over every configured backend. Never raises."""
+        for b in list(self.balancer.config.backends):
+            try:
+                self._scrape_backend(b)
+            except Exception:
+                # belt over the per-fetch suspenders: a scrape must never
+                # kill the thread (a live request does not depend on it,
+                # but a dead scraper silently freezes the routing signals)
+                with self._lock:
+                    st = self._replica(b.key)
+                    st.scrape_failures += 1
+                    st.consecutive_failures += 1
+        self.scrape_rounds += 1
+
+    def _scrape_backend(self, b):
+        now = now_s()
+        key = b.key
+        try:
+            status, body = http_get_text(b.host, b.port, "/metrics", self.timeout_s)
+            if status != 200:
+                raise OSError(f"/metrics returned {status}")
+            samples, types = parse_prom_text(body)
+            stats_sections = self._fetch_stats(b)
+        except Exception:
+            with self._lock:
+                st = self._replica(key)
+                st.last_attempt_s = now
+                st.scrape_failures += 1
+                st.consecutive_failures += 1
+            return
+        signals: dict = {}
+        counters: dict = {}
+        for name, labels, value in samples:
+            if labels:
+                continue
+            if name in _GAUGE_SIGNALS:
+                signals[_GAUGE_SIGNALS[name]] = value
+            elif name in _RATE_SIGNALS:
+                counters[name] = value
+        with self._lock:
+            st = self._replica(key)
+            st.last_attempt_s = now
+            # counter -> rate across consecutive good scrapes. A counter
+            # that went BACKWARD (replica restarted) resets the baseline
+            # instead of reporting a huge negative rate.
+            if st._prev_t is not None and now > st._prev_t:
+                dt = now - st._prev_t
+                for cname, cur in counters.items():
+                    prev = st._prev_counters.get(cname)
+                    if prev is not None and cur >= prev:
+                        signals[_RATE_SIGNALS[cname]] = round((cur - prev) / dt, 3)
+            st._prev_counters = counters
+            st._prev_t = now
+            st.signals = signals
+            st.samples = samples
+            st.types = types
+            st.stats_sections = stats_sections
+            st.last_ok_s = now
+            st.scrapes_ok += 1
+            st.consecutive_failures = 0
+
+    def _fetch_stats(self, b) -> dict:
+        """Selected ``/stats`` sections (config-ish context the flat
+        metrics don't carry). Best-effort: a replica without /stats — or a
+        mid-crash one — just yields an empty dict."""
+        try:
+            status, body = http_get_text(b.host, b.port, "/stats", self.timeout_s)
+            if status != 200:
+                return {}
+            payload = json.loads(body)
+        except Exception:
+            return {}
+        out = {}
+        for k in ("batcher", "kv_pool", "speculative", "batch", "seq_len"):
+            if isinstance(payload, dict) and payload.get(k) is not None:
+                out[k] = payload[k]
+        return out
+
+    # -- views ---------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``/gateway/fleet`` payload: one row per backend, signal
+        table joined with the balancer's breaker/inflight/draining state."""
+        now = now_s()
+        balancer_state = {
+            s["backend"]: s for s in self.balancer.stats()["backends"]
+        }
+        rows = []
+        with self._lock:
+            replicas = {k: v for k, v in self._replicas.items()}
+        for b in list(self.balancer.config.backends):
+            st = replicas.get(b.key)
+            age = (
+                None
+                if st is None or st.last_ok_s is None
+                else round(now - st.last_ok_s, 3)
+            )
+            rows.append(
+                {
+                    "backend": b.key,
+                    # never scraped OR last good scrape too old -> stale;
+                    # the last-known signals ride along either way so a
+                    # router can discount rather than forget
+                    "stale": age is None or age > self.stale_after_s,
+                    "age_s": age,
+                    "scrapes_ok": 0 if st is None else st.scrapes_ok,
+                    "scrape_failures": 0 if st is None else st.scrape_failures,
+                    "consecutive_failures": (
+                        0 if st is None else st.consecutive_failures
+                    ),
+                    "signals": {} if st is None else dict(st.signals),
+                    "stats": {} if st is None else dict(st.stats_sections),
+                    "balancer": balancer_state.get(b.key, {}),
+                }
+            )
+        return {
+            "interval_s": self.interval_s,
+            "stale_after_s": self.stale_after_s,
+            "scrape_rounds": self.scrape_rounds,
+            "replicas": rows,
+        }
+
+    def federated_lines(self) -> list:
+        """Prometheus text lines re-emitting every replica's scraped
+        samples with a ``replica="host:port"`` label — appended to the
+        gateway's own ``/metrics`` body. TYPE lines are grouped per family
+        (a family may appear on several replicas but must be declared
+        once). Stale replicas' last-known samples still federate; the
+        paired ``dlt_fleet_replica_stale`` / ``_age_seconds`` gauges are
+        the freshness signal consumers must join against."""
+        from ..runtime.tracing import prom_line  # stdlib-only module
+
+        with self._lock:
+            replicas = [
+                (k, list(st.samples), dict(st.types))
+                for k, st in self._replicas.items()
+            ]
+        lines: list = []
+        declared: set = set()
+        meta: list = []  # (key, stale, age) freshness gauges
+        now = now_s()
+        for key, samples, types in replicas:
+            for name, labels, value in samples:
+                family = name
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix) and name[: -len(suffix)] in types:
+                        family = name[: -len(suffix)]
+                        break
+                if family not in declared:
+                    declared.add(family)
+                    lines.append(
+                        f"# TYPE {family} {types.get(family, 'untyped')}"
+                    )
+                lab = dict(labels)
+                lab["replica"] = key
+                val = int(value) if value == int(value) else value
+                lines.append(prom_line(name, lab, val))
+        with self._lock:
+            for key, st in self._replicas.items():
+                age = None if st.last_ok_s is None else now - st.last_ok_s
+                stale = age is None or age > self.stale_after_s
+                meta.append((key, stale, age))
+        if meta:
+            lines.append("# TYPE dlt_fleet_replica_stale gauge")
+            for key, stale, _ in meta:
+                lines.append(
+                    prom_line("dlt_fleet_replica_stale", {"replica": key}, int(stale))
+                )
+            lines.append("# TYPE dlt_fleet_replica_age_seconds gauge")
+            for key, _, age in meta:
+                if age is not None:
+                    lines.append(
+                        prom_line(
+                            "dlt_fleet_replica_age_seconds",
+                            {"replica": key},
+                            round(age, 3),
+                        )
+                    )
+        return lines
+
+
+def fetch_backend_configs(balancer, timeout_s: float | None = None) -> dict:
+    """Live per-backend ``/debug/config`` fetch for the gateway's own
+    ``/debug/config`` view — best-effort, one bounded round trip each,
+    fanned out in parallel so a fleet of dead replicas costs ONE timeout,
+    not backends×timeout (this endpoint matters most mid-outage). A dead
+    backend contributes an ``{"error": ...}`` row, never a failure.
+    `timeout_s=None` uses the attached scraper's configured timeout
+    (``--fleet-timeout-s``), falling back to the module default."""
+    if timeout_s is None:
+        fleet = getattr(balancer, "fleet", None)
+        timeout_s = fleet.timeout_s if fleet is not None else DEFAULT_TIMEOUT_S
+    backends = list(balancer.config.backends)
+    out = {}
+
+    def fetch(b):
+        try:
+            status, body = http_get_text(b.host, b.port, "/debug/config", timeout_s)
+            out[b.key] = (
+                json.loads(body)
+                if status == 200
+                else {"error": f"/debug/config returned {status}"}
+            )
+        except Exception as e:
+            out[b.key] = {"error": f"unreachable: {e}"}
+
+    threads = [
+        threading.Thread(target=fetch, args=(b,), daemon=True) for b in backends
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 1.0)
+    for b in backends:  # a hung join still yields a row, never a KeyError
+        out.setdefault(b.key, {"error": "timed out"})
+    return out

@@ -30,6 +30,14 @@ reference's fixed 3s blackout only gestures at:
   breaker state, failure/retry counters, queue depth) and
   ``POST /gateway/drain?backend=host:port`` / ``undrain`` — draining stops
   new assignments while inflight requests finish;
+* **fleet signal plane** (server/fleet.py): a background scraper polls
+  each backend's ``/metrics`` + ``/stats``, maintaining a per-replica
+  signal table (prefix-hit rate, KV-pool headroom, batcher occupancy,
+  SLO attainment, goodput, staleness) served at ``GET /gateway/fleet``
+  and federated into the gateway's ``/metrics`` with ``replica=...``
+  labels — the routing inputs a prefix-cache-aware balancer scores;
+  ``GET /debug/config`` returns the resolved gateway config plus every
+  backend's own config snapshot, proxied per-replica;
 * thread-per-connection, streaming the backend response through unchanged
   (SSE included).
 
@@ -133,6 +141,13 @@ class GatewayConfig:
     # INITIAL backoff so old call sites keep their intent: "don't re-admit a
     # failed backend for N ms" becomes the first open interval.
     health_retry_ms: int | None = None
+    # fleet signal plane (server/fleet.py): per-replica /metrics + /stats
+    # scrape cadence feeding /gateway/fleet and the federated /metrics
+    # rollup. None resolves the DLT_FLEET_SCRAPE_S env (default 2 s);
+    # <= 0 disables the scraper thread (control endpoints still answer,
+    # reporting every replica as never-scraped/stale).
+    fleet_scrape_s: float | None = None
+    fleet_timeout_s: float | None = None
 
     def __post_init__(self):
         if self.health_retry_ms is not None:
@@ -149,6 +164,11 @@ class Balancer:
 
     def __init__(self, config: GatewayConfig):
         self.config = config
+        # fleet signal plane (server/fleet.py FleetScraper): attached by
+        # run() — or directly by tests — so the control endpoints can serve
+        # /gateway/fleet and the federated /metrics rollup. None = scraping
+        # disabled; both endpoints degrade gracefully.
+        self.fleet = None
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
@@ -597,7 +617,10 @@ def _plain_response(
 def render_gateway_metrics(balancer: Balancer) -> str:
     """The gateway's ``GET /metrics`` body: Prometheus text exposition of
     the balancer counters, queue depth, per-backend breaker/inflight state,
-    and the per-request wall-time histogram."""
+    and the per-request wall-time histogram — plus, when the fleet scraper
+    is attached, the FEDERATED rollup: every replica's scraped samples
+    re-emitted with a ``replica="host:port"`` label (server/fleet.py), so
+    one scrape of the gateway sees the whole fleet."""
     s = balancer.stats()
     lines: list = []
     render_counters(lines, s["counters"], prefix="dlt_gateway")
@@ -627,6 +650,8 @@ def render_gateway_metrics(balancer: Balancer) -> str:
         for b in s["backends"]:
             lines.append(prom_line(m, {"backend": b["backend"]}, b[col]))
     render_hist(lines, "dlt_gateway_request_ms", balancer.request_ms.snapshot())
+    if balancer.fleet is not None:
+        lines.extend(balancer.fleet.federated_lines())
     return "\n".join(lines) + "\n"
 
 
@@ -636,6 +661,51 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
     route, _, query = path.partition("?")
     if route == "/gateway/stats" and method == "GET":
         _plain_response(client, 200, "OK", json.dumps(balancer.stats()))
+        return
+    if route == "/gateway/fleet" and method == "GET":
+        # per-replica signal table (server/fleet.py): routing signals +
+        # staleness + breaker state joined from the balancer. With no
+        # scraper attached the endpoint still answers (enabled: false)
+        # so dashboards never 404-flap on a config change.
+        if balancer.fleet is None:
+            _plain_response(
+                client, 200, "OK",
+                json.dumps({"enabled": False, "replicas": []}),
+            )
+            return
+        payload = dict(balancer.fleet.snapshot(), enabled=True)
+        _plain_response(client, 200, "OK", json.dumps(payload))
+        return
+    if route == "/debug/config" and method == "GET":
+        # resolved gateway configuration + every backend's own
+        # /debug/config proxied per-replica (fleet debugging without
+        # shell access to any box). Backend fetches are bounded and
+        # best-effort — a dead replica contributes an error row.
+        from . import fleet as fleet_mod
+
+        cfg = balancer.config
+        payload = {
+            "gateway": {
+                "backends": [b.key for b in cfg.backends],
+                "max_inflight_per_backend": cfg.max_inflight_per_backend,
+                "queue_size": cfg.queue_size,
+                "queue_timeout_s": cfg.queue_timeout_s,
+                "breaker_failure_threshold": cfg.breaker_failure_threshold,
+                "breaker_backoff_s": cfg.breaker_backoff_s,
+                "breaker_backoff_max_s": cfg.breaker_backoff_max_s,
+                "probe_interval_s": cfg.probe_interval_s,
+                "retry_attempts": cfg.retry_attempts,
+                "upstream_read_timeout_s": cfg.upstream_read_timeout_s,
+                "fleet_scrape_s": (
+                    balancer.fleet.interval_s if balancer.fleet else None
+                ),
+                "fleet_stale_after_s": (
+                    balancer.fleet.stale_after_s if balancer.fleet else None
+                ),
+            },
+            "backends": fleet_mod.fetch_backend_configs(balancer),
+        }
+        _plain_response(client, 200, "OK", json.dumps(payload))
         return
     if route == "/metrics" and method == "GET":
         _plain_response(
@@ -725,12 +795,14 @@ def handle_client(client: socket.socket, balancer: Balancer):
         method, path = _request_line(request)
         route = path.partition("?")[0]
         # control routes the gateway answers ITSELF: its own stats/metrics
-        # and the trace/flightrecord views of its own ring. Every OTHER
-        # /debug/* route (/debug/costs, /debug/profile — the engine-side
-        # device-performance endpoints, runtime/profiling.py) is backend
-        # state and proxies through like a normal request.
+        # (incl. the federated fleet rollup), the trace/flightrecord views
+        # of its own ring, the fleet signal table, and /debug/config (own
+        # config + per-backend proxy). Every OTHER /debug/* route
+        # (/debug/costs, /debug/profile, /debug/batch_timeline — the
+        # engine-side endpoints) is backend state and proxies through like
+        # a normal request.
         if route.startswith("/gateway/") or route == "/metrics" or route in (
-            "/debug/trace", "/debug/flightrecord"
+            "/debug/trace", "/debug/flightrecord", "/debug/config"
         ):
             _handle_control(client, balancer, method, path)
             return
@@ -787,13 +859,15 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 return
             b = config.backends[idx]
             attempt += 1
-            tr.event(
+            # once per ATTEMPT (bounded by retry_attempts, not tokens):
+            # sanctioned cold emits inside the bounded retry loop
+            tr.event(  # dlt: allow(trace-hot-emit)
                 "gw_acquire", to_us(t_acq), acq_us,
                 ("backend", "attempt"), (b.key, attempt),
             )
             t_att = time.perf_counter()
             failed, forwarded, client_gone = _proxy_once(client, request, b, config)
-            tr.event(
+            tr.event(  # dlt: allow(trace-hot-emit)
                 "gw_attempt", to_us(t_att),
                 int((time.perf_counter() - t_att) * 1e6),
                 ("backend", "attempt", "failed", "forwarded"),
@@ -830,7 +904,8 @@ def handle_client(client: socket.socket, balancer: Balancer):
             with balancer.lock:
                 b.n_retries_away += 1
             balancer.count("zero_byte_retries")
-            tr.event(
+            # once per retry decision: sanctioned cold emit
+            tr.event(  # dlt: allow(trace-hot-emit)
                 "gw_retry", now_us(), 0,
                 ("attempt", "from_backend"), (attempt, b.key),
                 always=True,
@@ -864,6 +939,8 @@ def serve(port: int, balancer: Balancer) -> socket.socket:
 
 
 def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
+    from .fleet import FleetScraper
+
     srv = serve(port, balancer)
     srv.settimeout(0.5)
     stop = stop_event if stop_event is not None else threading.Event()
@@ -871,14 +948,28 @@ def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None
     if balancer.config.probe_interval_s > 0:
         prober = HealthProber(balancer, stop)
         prober.start()
+    # fleet signal plane: per-replica /metrics + /stats scraper feeding
+    # /gateway/fleet and the federated /metrics rollup (server/fleet.py).
+    # Interval resolves config -> DLT_FLEET_SCRAPE_S -> 2 s; <= 0 disables.
+    scraper = FleetScraper(
+        balancer,
+        interval_s=balancer.config.fleet_scrape_s,
+        timeout_s=balancer.config.fleet_timeout_s,
+    )
+    if scraper.interval_s > 0:
+        balancer.fleet = scraper.start()
     print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
-    while not stop.is_set():
-        try:
-            client, _ = srv.accept()
-        except socket.timeout:
-            continue
-        threading.Thread(target=handle_client, args=(client, balancer), daemon=True).start()
-    srv.close()
+    try:
+        while not stop.is_set():
+            try:
+                client, _ = srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=handle_client, args=(client, balancer), daemon=True).start()
+    finally:
+        if balancer.fleet is not None:
+            balancer.fleet.stop()
+        srv.close()
 
 
 def parse_backend(s: str) -> Backend:
@@ -904,6 +995,13 @@ def main(argv=None) -> int:
     p.add_argument("--upstream-timeout-s", type=float, default=600.0)
     p.add_argument("--health-retry-ms", type=int, default=None,
                    help="legacy: seeds the breaker's initial backoff")
+    p.add_argument("--fleet-scrape-s", type=float, default=None,
+                   help="per-replica /metrics+/stats scrape interval for "
+                   "/gateway/fleet and the federated /metrics rollup "
+                   "(default: DLT_FLEET_SCRAPE_S or 2.0; <=0 disables)")
+    p.add_argument("--fleet-timeout-s", type=float, default=None,
+                   help="per-scrape socket timeout (default: "
+                   "DLT_FLEET_TIMEOUT_S or 2.0)")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
@@ -917,6 +1015,8 @@ def main(argv=None) -> int:
         retry_attempts=args.retry_attempts,
         upstream_read_timeout_s=args.upstream_timeout_s,
         health_retry_ms=args.health_retry_ms,
+        fleet_scrape_s=args.fleet_scrape_s,
+        fleet_timeout_s=args.fleet_timeout_s,
     )
     run(args.port, Balancer(config))
     return 0

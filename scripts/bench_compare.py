@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Scoreboard guard: diff the newest BENCH_r*.json against its predecessor.
+
+Mechanizes VERDICT.md's "the driver JSON is authoritative" rule: instead of
+eyeballing two 2000-char JSON blobs for regressions, this walks both rounds'
+``parsed.configs`` legs, matches them by ``config`` name, and prints a
+per-metric delta table with tolerance bands:
+
+* **higher-better** metrics (throughput ``*tok_s*``, acceptance/overlap/
+  utilization rates, speedup factors): a drop beyond the tolerance is a
+  REGRESSION;
+* **lower-better** metrics (latencies ``*_ms``/``*_us``, overhead
+  percentages, slowdown/inflation factors): a rise beyond the tolerance is
+  a REGRESSION;
+* everything else is reported informationally (no band).
+
+Runs WARN-ONLY by default — the table is the artifact; the exit code stays
+0 so a noisy leg cannot block CI (``--strict`` flips regressions to exit 1
+for local preflight). New/removed legs are listed, never failed: every PR
+adds legs.
+
+Usage::
+
+    python scripts/bench_compare.py                 # repo root, newest pair
+    python scripts/bench_compare.py --dir . --tol 10
+    python scripts/bench_compare.py --strict        # exit 1 on regression
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+#: metric-name fragments that mean "bigger is better"
+_HIGHER = re.compile(
+    r"tok_s|tokens_per_s|throughput_gain|acceptance|overlap_pct|mfu"
+    r"|bw_utilization|attainment|rows_at_budget|scale_x|_gain"
+)
+#: metric-name fragments that mean "smaller is better"
+_LOWER = re.compile(
+    r"_ms$|_ms_|_us$|_us_|overhead_pct|slowdown|inflation|wasted|_wall_"
+)
+
+
+def find_rounds(directory: str) -> list:
+    """[(round_number, path)] sorted ascending by round."""
+    out = []
+    for name in os.listdir(directory):
+        m = _BENCH_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def load_legs(path: str) -> dict:
+    """config-name -> {metric: value} for one BENCH round. Tolerant of both
+    the driver wrapper shape ({"parsed": {...}}) and a bare bench.py line
+    ({"configs": [...]}); unusable files yield {}."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        parsed = doc if isinstance(doc, dict) else {}
+    configs = parsed.get("configs")
+    if not isinstance(configs, list):
+        return {}
+    legs = {}
+    for cfg in configs:
+        if not isinstance(cfg, dict) or "config" not in cfg:
+            continue
+        legs[cfg["config"]] = {
+            k: v for k, v in cfg.items()
+            if k != "config" and isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+    return legs
+
+
+def direction(metric: str) -> str:
+    """'higher' | 'lower' | 'info' — which way is good for this metric."""
+    if _LOWER.search(metric):
+        return "lower"
+    if _HIGHER.search(metric):
+        return "higher"
+    return "info"
+
+
+def compare_legs(prev: dict, new: dict, tol_pct: float) -> dict:
+    """Compare two rounds' leg maps. Returns ``{"rows": [...],
+    "regressions": [...], "new_legs": [...], "gone_legs": [...]}`` where
+    each row is (leg, metric, prev, new, delta_pct, direction, status)."""
+    rows, regressions = [], []
+    for leg in sorted(set(prev) & set(new)):
+        for metric in sorted(set(prev[leg]) & set(new[leg])):
+            pv, nv = prev[leg][metric], new[leg][metric]
+            if pv == 0:
+                delta_pct = None
+            else:
+                delta_pct = 100.0 * (nv - pv) / abs(pv)
+            d = direction(metric)
+            status = "ok"
+            if delta_pct is None:
+                status = "info"
+            elif d == "higher" and delta_pct < -tol_pct:
+                status = "REGRESSED"
+            elif d == "lower" and delta_pct > tol_pct:
+                status = "REGRESSED"
+            elif d == "info":
+                status = "info"
+            elif abs(delta_pct) > tol_pct:
+                status = "improved"
+            row = (leg, metric, pv, nv, delta_pct, d, status)
+            rows.append(row)
+            if status == "REGRESSED":
+                regressions.append(row)
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "new_legs": sorted(set(new) - set(prev)),
+        "gone_legs": sorted(set(prev) - set(new)),
+    }
+
+
+def render_table(result: dict, prev_name: str, new_name: str, tol_pct: float) -> str:
+    lines = [
+        f"bench_compare: {os.path.basename(prev_name)} -> "
+        f"{os.path.basename(new_name)} (tolerance ±{tol_pct:g}%)",
+        f"{'leg':<44} {'metric':<34} {'prev':>12} {'new':>12} {'Δ%':>8}  status",
+    ]
+    for leg, metric, pv, nv, delta, d, status in result["rows"]:
+        if status == "ok":
+            continue  # within band: keep the table readable
+        dstr = "n/a" if delta is None else f"{delta:+.1f}"
+        lines.append(
+            f"{leg[:43]:<44} {metric[:33]:<34} {pv:>12g} {nv:>12g} {dstr:>8}  {status}"
+        )
+    n_ok = sum(1 for r in result["rows"] if r[6] == "ok")
+    lines.append(
+        f"{len(result['rows'])} compared metrics: {n_ok} within band, "
+        f"{len(result['regressions'])} regressed"
+    )
+    if result["new_legs"]:
+        lines.append(f"new legs: {', '.join(result['new_legs'])}")
+    if result["gone_legs"]:
+        lines.append(f"gone legs: {', '.join(result['gone_legs'])}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_compare", description=__doc__)
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--tol", type=float, default=10.0,
+                    help="tolerance band in percent (default 10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any regression (default: warn-only)")
+    args = ap.parse_args(argv)
+
+    directory = args.dir or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rounds = find_rounds(directory)
+    if len(rounds) < 2:
+        print(f"bench_compare: fewer than two BENCH_r*.json rounds in "
+              f"{directory} — nothing to diff")
+        return 0
+    (_, prev_path), (_, new_path) = rounds[-2], rounds[-1]
+    prev, new = load_legs(prev_path), load_legs(new_path)
+    if not prev or not new:
+        print("bench_compare: could not parse a round's configs — skipping")
+        return 0
+    result = compare_legs(prev, new, args.tol)
+    print(render_table(result, prev_path, new_path, args.tol))
+    if result["regressions"] and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,13 @@
 #                            HTTP levels, zero-copy prefix sharing,
 #                            exhaustion park/shed, sanitizer acceptance,
 #                            the fatal-sanitizer /v1/chat regression)
+#   9. fleet suite          (gateway federation scraper under the chaos
+#                            harness, per-replica signal table + staleness,
+#                            federated /metrics format, goodput-ledger
+#                            token identity, batch timeline, /debug/config)
+#  10. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
+#                            vs predecessor, tolerance-banded — WARN-ONLY:
+#                            the table is the artifact, the exit code is 0)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -59,6 +66,12 @@ python -m pytest tests/test_profiling.py -q -p no:cacheprovider
 
 echo "== paged-kv suite =="
 python -m pytest tests/test_paged_kv.py -q -p no:cacheprovider
+
+echo "== fleet suite (federation + goodput + timeline) =="
+python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovider
+
+echo "== scoreboard guard (warn-only) =="
+python scripts/bench_compare.py
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

@@ -104,8 +104,25 @@ def test_trace_hot_emit_scoped_to_hot_packages():
     # dict construction in an emit call is flagged even outside loops
     dict_arg = "tr.event('x', 1, 2, {'a': 1})\n"
     assert _rules(dict_arg) == ["trace-hot-emit"]
-    # server is not a hot package — the Batcher's cold-path loop emits pass
-    assert _rules(in_loop, "server/x.py") == []
+    # the server package joined the emit scope with the goodput-ledger /
+    # batch-timeline sites (PR 9): the Batcher step loop and the gateway
+    # retry loop are per-iteration emitters too
+    assert _rules(in_loop, "server/x.py") == ["trace-hot-emit"]
+    assert _rules(dict_arg, "server/x.py") == ["trace-hot-emit"]
+    # the sanctioned idioms pass in server scope: pre-bound emitters
+    # (Trace.bind / Tracer.bind_global) and pragma'd once-per-request sites
+    bound_global = (
+        "em = TRACER.bind_global('batch_step', ('n',))\n"
+        "while go:\n    em(1, 2, 3)\n"
+    )
+    assert _rules(bound_global, "server/x.py") == []
+    pragma = (
+        "while go:\n"
+        "    tr.event('queue_wait', 1, 2)  # dlt: allow(trace-hot-emit)\n"
+    )
+    assert _rules(pragma, "server/x.py") == []
+    # formats/ops stay out of scope
+    assert _rules(in_loop, "formats/x.py") == []
     # non-trace receivers named `event` are not span emits
     other = "for i in range(8):\n    bus.event('x')\n"
     assert _rules(other) == []

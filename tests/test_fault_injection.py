@@ -123,6 +123,10 @@ class Stack:
             breaker_failure_threshold=3,
             breaker_backoff_s=60.0,  # tests drive recovery explicitly
             probe_interval_s=0,  # deterministic unless a test opts in
+            # fleet scraping off: a background scrape would consume
+            # ChaosProxy conn indices and perturb the seeded fault plans
+            # (tests/test_fleet.py drives the scraper explicitly)
+            fleet_scrape_s=0,
             retry_attempts=2,
         )
         defaults.update(cfg_overrides)

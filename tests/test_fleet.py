@@ -1,0 +1,509 @@
+"""Fleet signal plane tests: the gateway's per-replica scraper driven
+end-to-end under the PR 1 chaos harness (server/chaos.py), plus the
+Prometheus federation format and the bench_compare scoreboard guard.
+
+The replica backends are STUBS serving canned /metrics + /stats +
+/debug/config bodies — the subject under test is the TRANSPORT and the
+scrape/staleness/federation logic, so no engine (and no jax) is needed."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_llama_tpu.server import fleet as fleet_mod
+from distributed_llama_tpu.server import gateway as gw_mod
+from distributed_llama_tpu.server.chaos import ChaosProxy
+from distributed_llama_tpu.server.fleet import FleetScraper, parse_prom_text
+from distributed_llama_tpu.server.gateway import (
+    BREAKER_OPEN,
+    Backend,
+    Balancer,
+    GatewayConfig,
+    render_gateway_metrics,
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port, up: bool, timeout=5.0):
+    """Block until `port` accepts (up=True) or refuses (up=False)
+    connections — ChaosProxy.down()/up() take effect asynchronously in its
+    accept loop, so tests must wait for the transition to land."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            if up:
+                return
+        except OSError:
+            if not up:
+                return
+        time.sleep(0.02)
+    raise AssertionError(f"port {port} never went {'up' if up else 'down'}")
+
+
+def _mk_replica_stub(tag: str):
+    """A canned replica: /metrics grows its prefix-hit counter by 64 tokens
+    per scrape (so two scrapes yield a computable rate), /stats carries a
+    batcher section, /debug/config a resolved-config snapshot."""
+    state = {"prefix_hit_tokens": 0, "scrapes": 0}
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, body: bytes, ctype="application/json"):
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            route = self.path.partition("?")[0]
+            if route == "/metrics":
+                state["scrapes"] += 1
+                state["prefix_hit_tokens"] += 64
+                body = "\n".join(
+                    [
+                        "# TYPE dlt_prefix_hit_tokens_total counter",
+                        f"dlt_prefix_hit_tokens_total {state['prefix_hit_tokens']}",
+                        "# TYPE dlt_requests_completed_total counter",
+                        "dlt_requests_completed_total 10",
+                        "# TYPE dlt_kv_pool_pages_free gauge",
+                        "dlt_kv_pool_pages_free 17",
+                        "# TYPE dlt_batcher_slots_active gauge",
+                        "dlt_batcher_slots_active 3",
+                        "# TYPE dlt_batcher_batch_slots gauge",
+                        "dlt_batcher_batch_slots 4",
+                        "# TYPE dlt_batcher_queue_depth gauge",
+                        "dlt_batcher_queue_depth 1",
+                        "# TYPE dlt_slo_ttft_attainment gauge",
+                        "dlt_slo_ttft_attainment 0.97",
+                        "# TYPE dlt_goodput_tokens_per_s gauge",
+                        "dlt_goodput_tokens_per_s 812.5",
+                        "# TYPE dlt_ttft_ms histogram",
+                        'dlt_ttft_ms_bucket{le="1024"} 9',
+                        'dlt_ttft_ms_bucket{le="+Inf"} 10',
+                        "dlt_ttft_ms_sum 1234.5",
+                        "dlt_ttft_ms_count 10",
+                        "",
+                    ]
+                ).encode()
+                self._send(body, ctype="text/plain; version=0.0.4")
+            elif route == "/stats":
+                self._send(
+                    json.dumps(
+                        {
+                            "batcher": {"batch_slots": 4, "slots_active": 3},
+                            "kv_pool": {"free_pages": 17, "layout": "paged"},
+                            "batch": 4,
+                            "seq_len": 2048,
+                        }
+                    ).encode()
+                )
+            elif route == "/debug/config":
+                self._send(
+                    json.dumps(
+                        {"model": f"stub-{tag}", "engine": {"batch": 4}}
+                    ).encode()
+                )
+            else:
+                self._send(json.dumps({"status": "ok", "tag": tag}).encode())
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+class FleetStack:
+    """[ChaosProxy -> replica stub] * n behind one Balancer + FleetScraper
+    (manually driven — no background thread unless a test starts one)."""
+
+    def __init__(self, n=2, interval_s=0.2, stale_after_s=0.6):
+        self.stubs, self.states, self.proxies = [], [], []
+        for i in range(n):
+            srv, state = _mk_replica_stub(str(i))
+            px = ChaosProxy("127.0.0.1", srv.server_address[1]).start()
+            self.stubs.append(srv)
+            self.states.append(state)
+            self.proxies.append(px)
+        self.cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", px.port) for px in self.proxies],
+            probe_interval_s=0,
+            fleet_scrape_s=0,  # tests drive scrape_once explicitly
+        )
+        self.bal = Balancer(self.cfg)
+        self.scraper = FleetScraper(
+            self.bal, interval_s=interval_s, timeout_s=0.5,
+            stale_after_s=stale_after_s,
+        )
+        self.bal.fleet = self.scraper
+
+    def close(self):
+        self.scraper.stop()
+        for px in self.proxies:
+            px.stop()
+        for s in self.stubs:
+            s.shutdown()
+            s.server_close()
+
+
+@pytest.fixture
+def fleet_stack():
+    stacks = []
+
+    def make(*a, **kw):
+        s = FleetStack(*a, **kw)
+        stacks.append(s)
+        return s
+
+    yield make
+    for s in stacks:
+        s.close()
+
+
+# ---- Prometheus text parser -------------------------------------------------
+
+
+def test_parse_prom_text_roundtrip():
+    samples, types = parse_prom_text(
+        "# TYPE dlt_foo_total counter\n"
+        "dlt_foo_total 5\n"
+        "# TYPE dlt_bar gauge\n"
+        'dlt_bar{kind="a b",x="1,2"} 3.5\n'
+        "dlt_unlabeled 7\n"
+        "this line is garbage {\n"
+    )
+    assert ("dlt_foo_total", {}, 5.0) in samples
+    assert ("dlt_bar", {"kind": "a b", "x": "1,2"}, 3.5) in samples
+    assert ("dlt_unlabeled", {}, 7.0) in samples
+    assert types == {"dlt_foo_total": "counter", "dlt_bar": "gauge"}
+
+
+# ---- signal table -----------------------------------------------------------
+
+
+def test_scrape_builds_signal_table_with_rates(fleet_stack):
+    st = fleet_stack(n=2)
+    st.scraper.scrape_once()
+    time.sleep(0.05)
+    st.scraper.scrape_once()  # second scrape: counter deltas become rates
+    snap = st.scraper.snapshot()
+    assert len(snap["replicas"]) == 2
+    for row in snap["replicas"]:
+        assert row["stale"] is False
+        assert row["age_s"] is not None
+        sig = row["signals"]
+        assert sig["kv_pool_pages_free"] == 17
+        assert sig["batcher_slots_active"] == 3
+        assert sig["slo_ttft_attainment"] == 0.97
+        assert sig["goodput_tokens_per_s"] == 812.5
+        # 64 tokens per scrape / elapsed -> a positive per-second rate
+        assert sig["prefix_hit_tokens_per_s"] > 0
+        assert row["stats"]["kv_pool"]["layout"] == "paged"
+        assert row["balancer"]["breaker"] == "closed"
+
+
+def test_backend_death_marks_stale_and_revival_reages_in(fleet_stack):
+    # stale window generous enough that a slow-box pause between the live
+    # backend's scrape and the snapshot can't flap it stale
+    st = fleet_stack(n=2, stale_after_s=0.4)
+    st.scraper.scrape_once()
+    assert all(not r["stale"] for r in st.scraper.snapshot()["replicas"])
+    # kill backend 0 mid-flight: connections now REFUSED. The scrape round
+    # must complete without raising, and after the staleness window the
+    # replica reads stale — with its last-known signals still attached.
+    st.proxies[0].down()
+    _wait_port(st.proxies[0].port, up=False)
+    time.sleep(0.45)  # age past stale_after_s
+    st.scraper.scrape_once()  # refreshes the LIVE backend's age only
+    rows = {r["backend"]: r for r in st.scraper.snapshot()["replicas"]}
+    dead = rows[st.cfg.backends[0].key]
+    live = rows[st.cfg.backends[1].key]
+    assert dead["stale"] is True
+    assert dead["consecutive_failures"] >= 1
+    assert dead["signals"]["kv_pool_pages_free"] == 17  # last-known kept
+    assert live["stale"] is False
+    # revival: the backend comes back, the next scrape re-ages it in
+    st.proxies[0].up()
+    _wait_port(st.proxies[0].port, up=True)
+    st.scraper.scrape_once()
+    rows = {r["backend"]: r for r in st.scraper.snapshot()["replicas"]}
+    assert rows[st.cfg.backends[0].key]["stale"] is False
+    assert rows[st.cfg.backends[0].key]["consecutive_failures"] == 0
+
+
+def test_breaker_open_state_is_reflected_in_fleet_view(fleet_stack):
+    st = fleet_stack(n=2)
+    st.scraper.scrape_once()
+    # drive backend 1's breaker open through the balancer (the same
+    # transitions request failures take)
+    with st.bal.lock:
+        for _ in range(st.cfg.breaker_failure_threshold):
+            st.bal._record_failure_locked(st.cfg.backends[1], time.monotonic())
+    snap = st.scraper.snapshot()
+    rows = {r["backend"]: r for r in snap["replicas"]}
+    assert rows[st.cfg.backends[1].key]["balancer"]["breaker"] == BREAKER_OPEN
+    assert rows[st.cfg.backends[0].key]["balancer"]["breaker"] == "closed"
+
+
+def test_scraper_thread_survives_flapping_backend(fleet_stack):
+    """The background loop keeps running through death/revival — no
+    exception ever escapes a scrape (the acceptance bar: the scraper can
+    NEVER fail a live request, so it must never die either)."""
+    st = fleet_stack(n=2, interval_s=0.05)
+    st.scraper.start()
+    deadline = time.monotonic() + 2.0
+    flip = True
+    while time.monotonic() < deadline:
+        (st.proxies[0].down if flip else st.proxies[0].up)()
+        flip = not flip
+        time.sleep(0.1)
+    st.proxies[0].up()
+    assert st.scraper._thread.is_alive()
+    assert st.scraper.scrape_rounds >= 5
+
+
+# ---- federation -------------------------------------------------------------
+
+
+def _parse_prom_for_test(body: str):
+    """Strict-ish Prometheus format walk (the same checks the tracing suite
+    applies): every non-comment line is NAME{labels} VALUE with a float
+    value; TYPE comments well-formed."""
+    for line in body.strip().splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[1] in ("TYPE", "HELP"), line
+            if parts[1] == "TYPE":
+                assert parts[3] in ("counter", "gauge", "histogram", "untyped"), line
+            continue
+        name = line.split("{")[0].split()[0]
+        assert name and all(
+            c.isalnum() or c in "_:" for c in name
+        ), f"bad metric name: {line}"
+        float(line.rsplit(None, 1)[1])  # value must parse
+
+
+def test_federated_metrics_carry_replica_labels(fleet_stack):
+    st = fleet_stack(n=2)
+    st.scraper.scrape_once()
+    body = render_gateway_metrics(st.bal)
+    _parse_prom_for_test(body)
+    samples, types = parse_prom_text(body)
+    keys = {b.key for b in st.cfg.backends}
+    # every replica's goodput gauge federates under its own label
+    goodput = {
+        lab.get("replica"): v
+        for name, lab, v in samples
+        if name == "dlt_goodput_tokens_per_s"
+    }
+    assert set(goodput) == keys and all(v == 812.5 for v in goodput.values())
+    # histogram families federate with their bucket labels intact
+    buckets = [
+        (lab["replica"], lab["le"], v)
+        for name, lab, v in samples
+        if name == "dlt_ttft_ms_bucket"
+    ]
+    assert len(buckets) == 2 * len(keys)
+    assert types["dlt_ttft_ms"] == "histogram"
+    # freshness gauges pair every federated sample
+    stale = {
+        lab["replica"]: v
+        for name, lab, v in samples
+        if name == "dlt_fleet_replica_stale"
+    }
+    assert set(stale) == keys and all(v == 0 for v in stale.values())
+    # the gateway's own series still lead the body
+    assert "dlt_gateway_requests_total" in body
+
+
+def test_stale_replica_federates_with_stale_flag(fleet_stack):
+    st = fleet_stack(n=1, stale_after_s=0.1)
+    st.scraper.scrape_once()
+    st.proxies[0].down()
+    time.sleep(0.15)
+    st.scraper.scrape_once()
+    samples, _ = parse_prom_text(render_gateway_metrics(st.bal))
+    stale = [
+        v for name, lab, v in samples if name == "dlt_fleet_replica_stale"
+    ]
+    assert stale == [1]
+    # last-known samples still present for the router to discount
+    assert any(n == "dlt_goodput_tokens_per_s" for n, _, _ in samples)
+
+
+# ---- live gateway endpoints -------------------------------------------------
+
+
+def _get(port, path, timeout=10):
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout)
+
+
+@pytest.fixture
+def live_gateway(fleet_stack):
+    """A real gateway socket over a FleetStack (scraper driven manually)."""
+    st = fleet_stack(n=2)
+    port = free_port()
+    stop = threading.Event()
+    threading.Thread(
+        target=gw_mod.run, args=(port, st.bal, stop), daemon=True
+    ).start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    yield st, port
+    stop.set()
+
+
+def test_gateway_fleet_endpoint_live(live_gateway):
+    st, port = live_gateway
+    st.scraper.scrape_once()
+    with _get(port, "/gateway/fleet") as r:
+        payload = json.loads(r.read())
+    assert payload["enabled"] is True
+    assert len(payload["replicas"]) == 2
+    assert payload["replicas"][0]["signals"]["goodput_tokens_per_s"] == 812.5
+    # a scrape mid-kill still answers, with the dead replica aged/stale
+    st.proxies[0].down()
+    _wait_port(st.proxies[0].port, up=False)
+    st.scraper.scrape_once()
+    with _get(port, "/gateway/fleet") as r:
+        payload = json.loads(r.read())
+    dead = [
+        x for x in payload["replicas"]
+        if x["backend"] == st.cfg.backends[0].key
+    ][0]
+    assert dead["scrape_failures"] >= 1
+    st.proxies[0].up()
+
+
+def test_gateway_debug_config_proxies_per_backend(live_gateway):
+    st, port = live_gateway
+    with _get(port, "/debug/config") as r:
+        payload = json.loads(r.read())
+    assert payload["gateway"]["queue_size"] == st.cfg.queue_size
+    assert set(payload["backends"]) == {b.key for b in st.cfg.backends}
+    for key, cfg in payload["backends"].items():
+        assert cfg["model"].startswith("stub-")
+    # a dead backend degrades to an error row, not a gateway failure
+    st.proxies[0].down()
+    _wait_port(st.proxies[0].port, up=False)
+    with _get(port, "/debug/config") as r:
+        payload = json.loads(r.read())
+    dead = payload["backends"][st.cfg.backends[0].key]
+    assert "error" in dead
+    st.proxies[0].up()
+
+
+def test_scraper_never_fails_a_live_request(live_gateway):
+    """Acceptance bar: with the scraper hammering a half-dead fleet, every
+    client request through the gateway still lands on the live backend."""
+    st, port = live_gateway
+    st.scraper.interval_s = 0.05
+    st.scraper.start()
+    st.proxies[0].down()  # half the fleet is refusing connections
+    ok = 0
+    for _ in range(10):
+        with _get(port, "/health") as r:  # proxied to a backend stub
+            assert r.status == 200
+            ok += 1
+    assert ok == 10
+    st.proxies[0].up()
+
+
+def test_fleet_disabled_endpoint_degrades(fleet_stack):
+    st = fleet_stack(n=1)
+    st.bal.fleet = None
+    port = free_port()
+    stop = threading.Event()
+    # config says scraping off -> run() must not attach a scraper
+    st.cfg.fleet_scrape_s = 0
+    threading.Thread(
+        target=gw_mod.run, args=(port, st.bal, stop), daemon=True
+    ).start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    try:
+        with _get(port, "/gateway/fleet") as r:
+            payload = json.loads(r.read())
+        assert payload == {"enabled": False, "replicas": []}
+        body = render_gateway_metrics(st.bal)
+        assert "dlt_fleet_replica_stale" not in body
+    finally:
+        stop.set()
+
+
+# ---- bench_compare scoreboard guard ----------------------------------------
+
+
+def _write_round(tmp_path, n, configs):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "parsed": {"configs": configs}})
+    )
+
+
+def test_bench_compare_flags_regressions_only_beyond_band(tmp_path, capsys):
+    import scripts.bench_compare as bc
+
+    _write_round(
+        tmp_path, 1,
+        [
+            {"config": "legA", "decode_tok_s": 100.0, "ttft_ms": 100.0},
+            {"config": "gone", "decode_tok_s": 5.0},
+        ],
+    )
+    _write_round(
+        tmp_path, 2,
+        [
+            # decode within band (-5%), ttft regressed (+50%)
+            {"config": "legA", "decode_tok_s": 95.0, "ttft_ms": 150.0},
+            {"config": "brand_new", "decode_tok_s": 7.0},
+        ],
+    )
+    rc = bc.main(["--dir", str(tmp_path), "--tol", "10"])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn-only by default
+    assert "REGRESSED" in out and "ttft_ms" in out
+    assert "decode_tok_s" not in [
+        line.split()[1] for line in out.splitlines()
+        if "REGRESSED" in line
+    ]
+    assert "brand_new" in out and "gone" in out
+    # --strict flips regressions to a failing exit code
+    assert bc.main(["--dir", str(tmp_path), "--tol", "10", "--strict"]) == 1
+    # throughput regression beyond band is caught too
+    _write_round(tmp_path, 3, [{"config": "legA", "decode_tok_s": 50.0,
+                                "ttft_ms": 150.0}])
+    assert bc.main(["--dir", str(tmp_path), "--tol", "10", "--strict"]) == 1
+
+
+def test_bench_compare_handles_missing_rounds(tmp_path, capsys):
+    import scripts.bench_compare as bc
+
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "nothing to diff" in capsys.readouterr().out
