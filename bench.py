@@ -906,6 +906,182 @@ def leg_fleet_overhead():
     }
 
 
+def leg_routing():
+    """Cache-aware routing twin (server/router.py): FOUR live replicas
+    behind a gateway, shared-512-prefix traffic (6 requests, distinct
+    tails), cache-aware vs least-inflight — the ISSUE-10 serving-tier leg.
+    Cache-aware lands every follow-up on the replica whose radix cache
+    holds the prefix (ONE cold prefill fleet-wide -> 5 hits);
+    least-inflight round-robins the prefix across the fleet (2,2,1,1 ->
+    2 hits), so the expected hit-token gain is 2.5x. Reported per arm:
+    median follow-up TTFT at the CLIENT (first SSE byte through the
+    gateway) and fleet-wide prefix_hit_tokens_per_s (summed replica
+    counters over the traffic window). Each arm uses a disjoint prefix so
+    the second arm can't ride the first arm's cache entries."""
+    import http.client as _hc
+    import json as _json
+    import socket as _socket
+    import statistics as _st
+    import threading
+    import urllib.request
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.server import gateway as gw_mod
+    from distributed_llama_tpu.server.gateway import (
+        Backend, Balancer, GatewayConfig,
+    )
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    model = build_model(
+        "llama_routing_q40_v1",
+        dim=512, hidden_dim=1536, n_layers=8, n_heads=8, n_kv_heads=4,
+        vocab_size=4096, seq_len=2048,
+    )
+    tok_path = os.path.join(CACHE_DIR, "routing_tok_v1.t")
+    if not os.path.exists(tok_path):
+        write_tiny_tokenizer(
+            tok_path, pad_to=4096,
+            chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+        )
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # four replicas (cost tables off: eight AOT ladders would dominate the
+    # leg's wall for zero routing signal)
+    os.environ["DLT_COST_TABLE"] = "0"
+    servers, ports = [], []
+    try:
+        for i in range(4):
+            p = build_arg_parser()
+            p.add_argument("--port", type=int, default=0)
+            port = free_port()
+            args = p.parse_args(
+                [
+                    "inference", "--model", model, "--tokenizer", tok_path,
+                    "--steps", "0", "--temperature", "0.0",
+                    "--port", str(port),
+                ]
+            )
+            httpd = api_mod.serve(args)
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            servers.append(httpd)
+            ports.append(port)
+
+        def fleet_hit_tokens():
+            total = 0
+            for port in ports:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=30
+                ) as r:
+                    total += _json.loads(r.read())["counters"].get(
+                        "prefix_hit_tokens", 0
+                    )
+            return total
+
+        def ttft_request(gw_port, system, user):
+            """Client-observed TTFT: POST a streaming chat through the
+            gateway, clock the first SSE byte (headers go out with the
+            first token chunk on this server)."""
+            conn = _hc.HTTPConnection("127.0.0.1", gw_port, timeout=600)
+            body = _json.dumps(
+                {
+                    "messages": [
+                        {"role": "system", "content": system},
+                        {"role": "user", "content": user},
+                    ],
+                    "max_tokens": 16,
+                    "stream": True,
+                }
+            )
+            t0 = time.perf_counter()
+            conn.request(
+                "POST", "/v1/chat/completions", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            first = resp.read(1)
+            ttft_ms = (time.perf_counter() - t0) * 1e3
+            assert first, "empty response through the gateway"
+            resp.read()
+            conn.close()
+            return ttft_ms
+
+        def run_arm(policy, prefix_char):
+            cfg = GatewayConfig(
+                backends=[Backend("127.0.0.1", port) for port in ports],
+                probe_interval_s=0,
+                # no scraper: the twin isolates the AFFINITY half of the
+                # policy (deterministic serial traffic; signal scoring has
+                # its own unit coverage), and replica hit counters are read
+                # directly off /health
+                fleet_scrape_s=0,
+                router_policy=policy,
+            )
+            bal = Balancer(cfg)
+            gw_port = free_port()
+            stop = threading.Event()
+            threading.Thread(
+                target=gw_mod.run, args=(gw_port, bal, stop), daemon=True
+            ).start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    _socket.create_connection(
+                        ("127.0.0.1", gw_port), timeout=0.2
+                    ).close()
+                    break
+                except OSError:
+                    time.sleep(0.02)
+            shared = prefix_char * 512  # ~512 leading tokens (byte vocab)
+            try:
+                hits0 = fleet_hit_tokens()
+                t_arm0 = time.perf_counter()
+                ttfts = [
+                    ttft_request(gw_port, shared, f"question number {i}")
+                    for i in range(6)
+                ]
+                arm_wall_s = time.perf_counter() - t_arm0
+                hit_tokens = fleet_hit_tokens() - hits0
+            finally:
+                stop.set()
+            return {
+                "ttft_ms_cold": round(ttfts[0], 1),
+                "ttft_ms_hit_median": round(_st.median(ttfts[1:]), 1),
+                "prefix_hit_tokens": hit_tokens,
+                "prefix_hit_tokens_per_s": round(hit_tokens / arm_wall_s, 1),
+            }
+
+        # warm the compile ladder through replica 0 on unrelated traffic
+        # (in-process jit caches are shared by shape, so one replica's
+        # warmup covers the fleet; the prefix is disjoint from both arms)
+        ttft_request(ports[0], "W" * 520, "warm")
+        li = run_arm("least_inflight", "L")
+        ca = run_arm("cache_aware", "C")
+    finally:
+        os.environ.pop("DLT_COST_TABLE", None)
+        for s in servers:
+            s.shutdown()
+    ratio = ca["prefix_hit_tokens"] / max(li["prefix_hit_tokens"], 1)
+    return {
+        "config": "llama-routing q40 4-replica shared-512-prefix x6",
+        "ttft_ms_cold_cache_aware": ca["ttft_ms_cold"],
+        "ttft_ms_hit_median_cache_aware": ca["ttft_ms_hit_median"],
+        "ttft_ms_hit_median_least_inflight": li["ttft_ms_hit_median"],
+        "prefix_hit_tokens_cache_aware": ca["prefix_hit_tokens"],
+        "prefix_hit_tokens_least_inflight": li["prefix_hit_tokens"],
+        "prefix_hit_tokens_per_s_cache_aware": ca["prefix_hit_tokens_per_s"],
+        "prefix_hit_tokens_per_s_least_inflight": li["prefix_hit_tokens_per_s"],
+        "hit_tokens_gain_x": round(ratio, 2),
+        "gain_bar_x": 2.0,
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -1084,6 +1260,13 @@ def main():
         print(f"# fleet-overhead: {fo}", file=sys.stderr)
     except Exception as e:
         print(f"# fleet-overhead leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        rt = leg_routing()
+        configs.append(rt)
+        print(f"# routing: {rt}", file=sys.stderr)
+    except Exception as e:
+        print(f"# routing leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

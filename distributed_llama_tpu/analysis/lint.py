@@ -71,8 +71,9 @@ FLOAT64_SCOPE = ("ops", "models", "parallel", "runtime", "formats")
 #: packages whose np.asarray/np.array sites are potential host syncs
 HOST_SYNC_SCOPE = ("runtime", "parallel")
 #: packages whose loops must emit spans through pre-bound emitters: the
-#: hot packages PLUS the server (Batcher step loop, gateway retry loop —
-#: the goodput-ledger/batch-timeline emission sites live there)
+#: hot packages PLUS the server (Batcher step loop, gateway retry loop,
+#: router decision path (server/router.py), disagg transfer path — the
+#: goodput-ledger/batch-timeline/gw_route/kv_transfer emission sites)
 TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 
 

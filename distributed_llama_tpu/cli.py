@@ -127,6 +127,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "pools serve MORE rows per HBM byte when rows are shorter than "
         "seq_len; exhaustion parks admissions and sheds with 503",
     )
+    p.add_argument(
+        "--role", choices=["unified", "prefill", "decode"], default=None,
+        help="disaggregated serving role (server/disagg.py): 'prefill' "
+        "workers answer POST /v1/prefill (run the prompt, ship bucket-"
+        "aligned KV); 'decode' workers fetch shipped KV from --prefill-peer "
+        "before admission and stream tokens; 'unified' (default, or "
+        "DLT_ROLE env) serves everything locally. Disaggregated roles "
+        "force the contiguous KV layout",
+    )
+    p.add_argument(
+        "--prefill-peer", action="append", default=None, metavar="HOST:PORT",
+        help="prefill worker a --role decode replica fetches KV from "
+        "(repeatable; round-robin with in-request failover; default "
+        "DLT_PREFILL_PEER env, comma-separated). A dead peer degrades the "
+        "request to local prefill, never fails it",
+    )
     return p
 
 

@@ -523,6 +523,66 @@ class PrefixCache:
         global_event("prefix_publish", keys=("tokens", "row"), vals=(P, int(row)))
         return True
 
+    def insert_external(self, engine, tokens, k_np, v_np) -> bool:
+        """Insert a slice computed OUTSIDE this process — the disaggregated
+        serving path (server/disagg.py): a prefill worker ran the prompt,
+        extracted ``[L, P, h, d]`` k/v at a bucket boundary, and shipped the
+        host arrays here. They are device_put (cast to the live cache's
+        dtype, pinned to the pipeline slice sharding where one exists) and
+        inserted exactly like a local publish, so the very next admission's
+        ``match_for_splice`` hits and splices them through the SAME warmed
+        copy programs a local hit uses — which is what makes the
+        disaggregated path bit-identical to unified serving.
+
+        Contiguous engines only: a PAGED entry's storage is physical page
+        ids in this process's pool, which have no host representation (the
+        serve() role gate forces contiguous on disaggregated workers).
+        Returns False — never raises — when the slice is unusable (paged
+        engine, off-bucket length, budget unreachable): the caller then
+        simply prefills locally, the degradation contract."""
+        if self.paged:
+            return False
+        P = len(tokens)
+        if P < PREFIX_MIN_TOKENS or P != bucket_down(P, self.seq_len):
+            return False
+        key = tuple(int(t) for t in tokens)
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                self._clock += 1
+                existing.last_used = self._clock
+                return True
+        dt = engine.cache.k.dtype
+        L, _, _, h, d = engine.cache.k.shape
+        if tuple(k_np.shape) != (L, P, h, d) or tuple(v_np.shape) != (L, P, h, d):
+            return False
+        if self.seg_sharding is not None:
+            k = jax.device_put(k_np.astype(dt), self.seg_sharding)
+            v = jax.device_put(v_np.astype(dt), self.seg_sharding)
+        else:
+            k = jax.device_put(k_np.astype(dt))
+            v = jax.device_put(v_np.astype(dt))
+        nbytes = k.nbytes + v.nbytes
+        with self._lock:
+            if key in self._entries:  # raced with another inserter
+                return True
+            if nbytes > self.budget_bytes or not self._evict_until(
+                self.budget_bytes - nbytes
+            ):
+                self._incr("prefix_publish_skipped")
+                return False
+            self._clock += 1
+            entry = PrefixEntry(
+                tokens=key, k=k, v=v, nbytes=nbytes, last_used=self._clock
+            )
+            self._insert(entry)
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self._gauges()
+        self._incr("prefix_inserts")
+        global_event("prefix_insert_external", keys=("tokens",), vals=(P,))
+        return True
+
     def _slice_nbytes(self, engine, P: int) -> int:
         if self.paged:
             from .paged_kv import page_pool_bytes

@@ -294,14 +294,22 @@ class StepStats:
 #: * ``stall_retry`` — a failed attempt's tokens discarded before the
 #:                     in-place retry re-decoded them;
 #: * ``client_gone`` — decoded after the client dropped mid-stream;
-#: * ``error``       — decoded before an engine failure killed the request.
-WASTE_REASONS = ("overrun", "shed", "stall_retry", "client_gone", "error")
+#: * ``error``       — decoded before an engine failure killed the request;
+#: * ``transfer_retry`` — prompt tokens a dead/failed disaggregated KV
+#:                     transfer (server/disagg.py) forced the decode worker
+#:                     to re-prefill locally (the prefill worker's compute
+#:                     for them is lost fleet-wide).
+WASTE_REASONS = (
+    "overrun", "shed", "stall_retry", "client_gone", "error",
+    "transfer_retry",
+)
 
 #: GoodputLedger fields attached to the request trace (one cold `ledger`
 #: event per request) and returned in the `usage` extension — one list so
 #: the trace, the HTTP payload, and the tests can never disagree on shape
 LEDGER_FIELDS = (
     "queue_us", "prefill_us", "decode_us", "spec_us",
+    "remote_prefill_us", "kv_transfer_us",
     "prompt_tokens", "prefix_hit_tokens", "generated_tokens",
     "spec_accepted_tokens", "discarded_tokens", "retries",
 )
@@ -325,6 +333,9 @@ class GoodputLedger:
     prefill_us: int = 0    # prompt prefill wall (splice included)
     decode_us: int = 0     # plain decode-chunk walls
     spec_us: int = 0       # speculative draft+verify round walls
+    remote_prefill_us: int = 0  # prefill-WORKER wall of a disaggregated
+    # request (server/disagg.py; the worker reports it in its KV payload)
+    kv_transfer_us: int = 0     # fetch + device-load wall of the shipped KV
     prompt_tokens: int = 0
     prefix_hit_tokens: int = 0   # prompt tokens resumed from the radix cache
     generated_tokens: int = 0    # delivered to the client (usage-visible)
@@ -394,6 +405,16 @@ class GoodputAggregator:
                 )
             self._window.append((now, ledger.generated_tokens))
             self._trim_locked(now)
+
+    def add_waste(self, reason: str, tokens: int):
+        """Count waste OUTSIDE any request ledger — tokens whose compute is
+        lost without a failed request to pin them on (a degraded KV
+        transfer's re-prefill: the REQUEST succeeds, the prefill worker's
+        compute for those tokens is what was wasted)."""
+        if tokens <= 0:
+            return
+        with self._lock:
+            self.wasted[reason] = self.wasted.get(reason, 0) + tokens
 
     def _trim_locked(self, now: float):
         cutoff = now - self.window_s

@@ -794,6 +794,24 @@ class ApiState:
                 "⚠️  --host-decode serves requests serialized (batched serving "
                 "samples on-device); concurrent requests will queue"
             )
+        # disaggregated serving (server/disagg.py): role + the decode
+        # worker's prefill-tier client. The client exists only when it can
+        # actually work — decode role, peers named, a prefix cache to land
+        # shipped KV in, contiguous layout (serve() forces it; a library
+        # caller who built a paged engine just gets local prefill).
+        from .disagg import DisaggClient, resolve_peers, resolve_role
+
+        self.role = resolve_role(getattr(args, "role", None))
+        peers = resolve_peers(getattr(args, "prefill_peer", None))
+        self.disagg = None
+        if self.role == "decode" and peers and not engine.paged \
+                and engine.prefix_cache is not None:
+            self.disagg = DisaggClient(self, peers)
+        elif self.role == "decode" and not peers:
+            print(
+                "⚠️  --role decode without --prefill-peer serves prompts "
+                "locally (unified behavior)"
+            )
 
     def _record_ledger(
         self, ledger: GoodputLedger, trace, waste_reason=None,
@@ -845,6 +863,12 @@ class ApiState:
                 GoodputLedger(prompt_tokens=len(ids), outcome="shed"), trace
             )
             raise Overloaded(retry_after_s=1)
+        # disaggregated prefill (server/disagg.py): land the prompt's
+        # leading-bucket KV in the prefix cache BEFORE admission, so
+        # begin_admit's ordinary match/splice picks it up. Runs after the
+        # shed check (never burn a prefill worker on a shed request);
+        # degrades to local prefill on any failure — zeros ride the ledger.
+        disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
 
         base = []
         if prompt.public_prompt:
@@ -913,6 +937,9 @@ class ApiState:
         for attempt in range(2):
             req = make_req()
             req.ledger.retries = attempt
+            if disagg_walls is not None:
+                req.ledger.remote_prefill_us = disagg_walls["remote_prefill_us"]
+                req.ledger.kv_transfer_us = disagg_walls["kv_transfer_us"]
             try:
                 self.batcher.submit(req)
                 break
@@ -1058,6 +1085,10 @@ class ApiState:
         prompt_end = len(ids) - 1
         max_tokens = params.get("max_tokens", -1)
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
+        # disaggregated prefill (server/disagg.py): the fetched KV lands in
+        # the prefix cache and engine.generate's ordinary prefill match
+        # splices it; any failure degrades to local prefill (zeros returned)
+        disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
 
         buffer = []
         if prompt.public_prompt:
@@ -1082,6 +1113,9 @@ class ApiState:
         led = GoodputLedger(
             prompt_tokens=len(ids), retries=1 if retried else 0
         )
+        if disagg_walls is not None:
+            led.remote_prefill_us = disagg_walls["remote_prefill_us"]
+            led.kv_transfer_us = disagg_walls["kv_transfer_us"]
         self._inflight_ledger = led
         spec_accept_0 = engine.stats.counters_snapshot().get(
             "spec_accepted_tokens", 0
@@ -1232,6 +1266,8 @@ def resolved_config(state: "ApiState") -> dict:
             "max_backlog": batcher.max_backlog,
             "timeline_sample": batcher.timeline_sample,
         },
+        "role": state.role,
+        "disagg": None if state.disagg is None else state.disagg.snapshot(),
         "tracing": {
             "ring_capacity": TRACER.ring.capacity,
             "sample_every": TRACER.sample_every(),
@@ -1407,6 +1443,13 @@ class Handler(BaseHTTPRequestHandler):
                 # per-request goodput rollup: outcomes, delivered vs wasted
                 # tokens (by reason), recent-window delivered-token rate
                 "goodput": st.goodput.snapshot(),
+                # disaggregated serving (server/disagg.py): this replica's
+                # role and, on decode workers, the prefill-peer view — the
+                # disagg_* counters ride steps.counters like every other
+                # engine event; the fleet scraper lifts both into the
+                # per-replica table
+                "role": st.role,
+                "disagg": None if st.disagg is None else st.disagg.snapshot(),
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,
@@ -1416,8 +1459,19 @@ class Handler(BaseHTTPRequestHandler):
             self._json(404, b'{"error":"not found"}')
 
     def do_POST(self):
+        if self.path == "/v1/prefill":
+            self._serve_prefill()
+            return
         if self.path != "/v1/chat/completions":
             self._json(404, b'{"error":"not found"}')
+            return
+        if self.state.role == "prefill":
+            # a prefill worker owns its chips for prompt compute; routing
+            # chat here is a topology error, not something to half-serve
+            self._json(
+                404, b'{"error":"this replica serves role=prefill; '
+                b'POST /v1/prefill"}'
+            )
             return
         length = int(self.headers.get("Content-Length", 0))
         try:
@@ -1451,6 +1505,57 @@ class Handler(BaseHTTPRequestHandler):
                 "request", t_req0, now_us() - t_req0, ("path", "status"),
                 (self.path, getattr(self, "_last_status", 200)), always=True,
             )
+
+    def _serve_prefill(self):
+        """``POST /v1/prefill`` (server/disagg.py): prefill workers run the
+        prompt's leading bucket and ship the extracted KV as one binary
+        payload. Other roles 404 — the decode worker's degradation path
+        treats that exactly like a dead peer."""
+        st = self.state
+        if st.role != "prefill":
+            self._json(
+                404, b'{"error":"this replica does not serve role=prefill"}'
+            )
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            params = json.loads(self.rfile.read(length) or b"{}")
+            ids = [int(t) for t in params["ids"]]
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            self._json(400, b'{"error":"ids (a token id list) required"}')
+            return
+        if not ids:
+            self._json(400, b'{"error":"empty ids"}')
+            return
+        # adopt the decode worker's trace id so one trace stitches
+        # decode-worker -> kv_transfer -> prefill-worker spans together
+        tr = TRACER.start(
+            self.headers.get(TRACE_HEADER),
+            sampled=parse_sampled(self.headers.get(SAMPLED_HEADER)),
+        )
+        self._trace = tr
+        t0 = now_us()
+        from .disagg import run_prefill
+
+        try:
+            payload = run_prefill(st, ids, trace=tr)
+        except ValueError as e:
+            self._json(400, json.dumps({"error": str(e)}).encode())
+            return
+        except Exception as e:
+            # engine failure: recover like the chat path (reset + prefix
+            # cache drop) and report — the decode worker degrades locally
+            st.recover()
+            self._json(
+                500, json.dumps({"error": f"prefill failed: {e}"}).encode()
+            )
+            return
+        finally:
+            tr.event(
+                "prefill_request", t0, now_us() - t0, ("n_ids",), (len(ids),),
+                always=True,
+            )
+        self._respond(200, payload, ctype="application/octet-stream")
 
     def _serve_chat(self, params, stream):
         st = self.state
@@ -1616,7 +1721,25 @@ def serve(args) -> HTTPServer:
     from http.server import ThreadingHTTPServer
 
     from ..cli import make_engine
+    from .disagg import resolve_role
 
+    # disaggregated roles (server/disagg.py) force the contiguous KV
+    # layout BEFORE the engine is built: shipped KV travels as host arrays
+    # into the prefix cache, and a paged entry's storage is physical page
+    # ids that mean nothing outside their own pool
+    role = resolve_role(getattr(args, "role", None))
+    if role != "unified":
+        import os as _os_kv
+
+        layout = getattr(args, "kv_layout", None) or _os_kv.environ.get(
+            "DLT_KV_LAYOUT"
+        )
+        if layout == "paged":
+            print(
+                f"⚠️  --role {role} requires the contiguous KV layout; "
+                "overriding --kv-layout paged"
+            )
+        args.kv_layout = "contiguous"
     engine = make_engine(args)
     tokenizer = Tokenizer(args.tokenizer)
     import os as _os

@@ -1,0 +1,397 @@
+"""Prefill/decode disaggregation: dedicated prefill workers ship KV.
+
+TTFT-heavy and decode-heavy traffic contend for the same chips on a unified
+replica: one long prompt's prefill chunks interleave with — and bound the
+latency of — every co-batched decode stream. DistServe's answer (and ours)
+is to split the roles: **prefill workers** run prompts and ship the finished
+KV; **decode workers** splice it and stream tokens. The split rides this
+repo's existing machinery end to end:
+
+* the prefill worker runs an ordinary ``engine.prefill`` over the prompt's
+  leading ``P`` tokens (``P`` = the prefix cache's bucket_down boundary, so
+  the shipped slice lands exactly on the warm copy-program ladder) and
+  extracts ``[L, P, h, d]`` k/v with the SAME ``extract_prefix_from_row``
+  program a local publish uses (``POST /v1/prefill`` -> one binary payload:
+  length-prefixed JSON header + raw k + raw v);
+* the decode worker inserts the shipped slice into its radix prefix cache
+  (:meth:`~..runtime.prefix_cache.PrefixCache.insert_external`), and the
+  request then takes the UNMODIFIED admission path — match, pin, splice,
+  resume — which is what makes disaggregated output bit-identical to
+  unified serving (the prefix cache's write-before-read invariant already
+  proves splice-then-resume ≡ cold prefill);
+* **degradation, not failure**: a prefill worker dying mid-transfer (the
+  chaos suite kills one mid-KV-body) leaves the decode worker exactly one
+  request-local consequence — no cache entry — so the request cold-prefills
+  locally and completes token-identical. The event is counted
+  (``disagg_degraded``), ledgered (the re-prefilled tokens land in
+  ``dlt_wasted_tokens_total{reason=transfer_retry}`` — the prefill worker's
+  compute for them is lost fleet-wide), and traced (a ``kv_transfer`` event
+  with ``failed=1`` lands even on unsampled traces).
+
+Roles are picked with ``--role {prefill,decode,unified}`` (``DLT_ROLE``) on
+the API server; decode workers name their peers with ``--prefill-peer
+host:port`` (repeatable; ``DLT_PREFILL_PEER`` comma-separated). Both
+disaggregated roles force the contiguous KV layout: the wire format is host
+arrays, and a paged entry's storage is physical page ids that mean nothing
+outside their own pool.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+
+ROLES = ("unified", "prefill", "decode")
+
+#: decode->prefill-worker round-trip budget (connect + prefill + transfer);
+#: generous because the worker's wall includes real prefill compute
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def resolve_role(explicit=None) -> str:
+    """``--role`` flag > ``DLT_ROLE`` env > unified. Unknown values raise:
+    a typo'd role silently serving unified would defeat the topology."""
+    role = explicit or os.environ.get("DLT_ROLE") or "unified"
+    if role not in ROLES:
+        raise ValueError(f"unknown serving role {role!r} (one of {ROLES})")
+    return role
+
+
+def resolve_peers(explicit=None) -> list:
+    """``--prefill-peer`` (repeatable) > ``DLT_PREFILL_PEER`` (comma-
+    separated) > none. Returns ``[(host, port), ...]``."""
+    raw = list(explicit) if explicit else [
+        s for s in os.environ.get("DLT_PREFILL_PEER", "").split(",") if s.strip()
+    ]
+    peers = []
+    for s in raw:
+        host, _, port = s.strip().rpartition(":")
+        peers.append((host or "127.0.0.1", int(port)))
+    return peers
+
+
+def _np_dtype(name: str):
+    """Dtype-by-name incl. the ml_dtypes extended floats (``np.dtype`` alone
+    does not know ``bfloat16``)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# -- the wire format ----------------------------------------------------------
+#
+# 4-byte big-endian header length | JSON header | raw k bytes | raw v bytes
+# Header: tokens (the P token ids the slice covers), k_shape/v_shape, dtype,
+# prefill_us (the worker's wall — the decode side's ledger field). Raw bytes
+# rather than base64-in-JSON: a 512-token 8B-class slice is tens of MB and
+# the transfer wall is the metric under test.
+
+
+def kv_payload(header: dict, k_np: np.ndarray, v_np: np.ndarray) -> bytes:
+    hjson = json.dumps(header).encode()
+    return struct.pack(">I", len(hjson)) + hjson + k_np.tobytes() + v_np.tobytes()
+
+
+def parse_kv_payload(body: bytes):
+    """``(header, k_np, v_np)`` from one payload; raises ValueError on any
+    truncation or shape/dtype mismatch (the caller's degradation path)."""
+    if len(body) < 4:
+        raise ValueError("kv payload truncated before header length")
+    (hlen,) = struct.unpack(">I", body[:4])
+    if len(body) < 4 + hlen:
+        raise ValueError("kv payload truncated inside header")
+    header = json.loads(body[4 : 4 + hlen])
+    dt = _np_dtype(header["dtype"])
+    k_shape = tuple(header["k_shape"])
+    v_shape = tuple(header["v_shape"])
+    k_bytes = int(np.prod(k_shape)) * dt.itemsize
+    v_bytes = int(np.prod(v_shape)) * dt.itemsize
+    blob = body[4 + hlen :]
+    if len(blob) != k_bytes + v_bytes:
+        raise ValueError(
+            f"kv payload truncated: body {len(blob)} B, "
+            f"header names {k_bytes + v_bytes} B"
+        )
+    k = np.frombuffer(blob[:k_bytes], dtype=dt).reshape(k_shape)
+    v = np.frombuffer(blob[k_bytes:], dtype=dt).reshape(v_shape)
+    return header, k, v
+
+
+# -- the prefill-worker side --------------------------------------------------
+
+
+def prefill_boundary(n_prompt_tokens: int, seq_len: int) -> int:
+    """The bucket boundary a disaggregated transfer covers: the largest
+    prefix bucket <= the prompt's prefillable span (the last prompt token is
+    fed at decode time, exactly like the local publish cap). 0 = the prompt
+    is too short to be worth a transfer."""
+    from ..runtime.prefix_cache import PREFIX_MIN_TOKENS, bucket_down
+
+    P = bucket_down(max(n_prompt_tokens - 1, 0), seq_len)
+    return P if P >= PREFIX_MIN_TOKENS else 0
+
+
+def run_prefill(state, ids: list, trace=None) -> bytes:
+    """The ``POST /v1/prefill`` body builder, run on the prefill worker
+    under its serialized engine lock: prefill ``ids[:P]`` (riding the
+    worker's OWN prefix cache, so a repeated shared prefix costs one splice
+    instead of a re-prefill), extract the slice through the warmed
+    ``prefix_extract`` program, and frame it for the wire. Raises ValueError
+    for client errors (too short / too long); engine failures propagate for
+    the handler's recover path."""
+    import jax.numpy as jnp
+
+    from ..runtime.prefix_cache import extract_prefix_from_row
+
+    engine = state.engine
+    if engine.paged:
+        raise ValueError("prefill role requires the contiguous KV layout")
+    n = len(ids)
+    if n >= engine.cfg.seq_len:
+        raise ValueError(
+            f"prompt ({n} tokens) exceeds the context window ({engine.cfg.seq_len})"
+        )
+    P = prefill_boundary(n, engine.cfg.seq_len)
+    if P <= 0:
+        raise ValueError(
+            f"prompt ({n} tokens) below the disaggregation floor"
+        )
+    with state.lock:
+        t0 = time.perf_counter()
+        engine.trace = trace
+        try:
+            engine.reset()
+            # publish=True: the worker's own radix cache keeps the slice,
+            # so the NEXT request sharing this prefix splices instead of
+            # re-prefilling — the prefill tier has cache locality too
+            engine.prefill(list(ids[:P]))
+            seg_sh = (
+                engine.prefix_cache.seg_sharding
+                if engine.prefix_cache is not None
+                else None
+            )
+            with engine._guard(f"prefix_extract[{P}]", ("prefix_extract", P, P)):
+                k, v = extract_prefix_from_row(
+                    engine.cache, jnp.asarray(0, jnp.int32), length=P,
+                    out_sharding=seg_sh,
+                )
+            k_np = np.asarray(k)
+            v_np = np.asarray(v)
+        finally:
+            engine.trace = None
+        wall_us = int((time.perf_counter() - t0) * 1e6)
+    engine.stats.incr("disagg_prefills")
+    engine.stats.incr("disagg_prefill_tokens", P)
+    header = {
+        "tokens": [int(t) for t in ids[:P]],
+        "p": P,
+        "k_shape": list(k_np.shape),
+        "v_shape": list(v_np.shape),
+        "dtype": str(k_np.dtype),
+        "prefill_us": wall_us,
+    }
+    return kv_payload(header, k_np, v_np)
+
+
+# -- the decode-worker side ---------------------------------------------------
+
+
+class DisaggClient:
+    """The decode worker's prefill-tier client: one bounded fetch per
+    request, inserted into the local radix cache on success, degraded to
+    local prefill on ANY failure — a dead peer must cost this request one
+    timeout, never an error. Peers rotate round-robin with in-request
+    failover (the next peer is tried before degrading), and a FAILED peer
+    enters a backoff window (``DLT_DISAGG_PEER_BACKOFF_S``, default 10 s)
+    during which requests skip it — without this, a hung worker (accepts
+    TCP, never answers) would add the full fetch timeout to EVERY
+    request's TTFT until an operator intervened. With every peer backing
+    off, requests prefill locally immediately (counted, no waste: no
+    prefill-tier compute was spent). A successful fetch clears the peer's
+    backoff."""
+
+    def __init__(self, state, peers, timeout_s: float | None = None,
+                 backoff_s: float | None = None):
+        self.state = state
+        self.engine = state.engine
+        self.peers = list(peers)
+        if timeout_s is None:
+            try:
+                timeout_s = float(
+                    os.environ.get("DLT_DISAGG_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+                )
+            except ValueError:
+                timeout_s = DEFAULT_TIMEOUT_S
+        self.timeout_s = timeout_s
+        if backoff_s is None:
+            try:
+                backoff_s = float(
+                    os.environ.get("DLT_DISAGG_PEER_BACKOFF_S", 10.0)
+                )
+            except ValueError:
+                backoff_s = 10.0
+        self.backoff_s = backoff_s
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._backoff_until: dict = {}  # (host, port) -> monotonic deadline
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            backing_off = [
+                f"{h}:{p}" for (h, p), t in self._backoff_until.items()
+                if t > now
+            ]
+        return {
+            "peers": [f"{h}:{p}" for h, p in self.peers],
+            "timeout_s": self.timeout_s,
+            "peer_backoff_s": self.backoff_s,
+            "peers_backing_off": backing_off,
+        }
+
+    def _peer_usable(self, peer) -> bool:
+        with self._lock:
+            return self._backoff_until.get(peer, 0.0) <= time.monotonic()
+
+    def _peer_failed(self, peer):
+        with self._lock:
+            self._backoff_until[peer] = time.monotonic() + self.backoff_s
+
+    def _peer_ok(self, peer):
+        with self._lock:
+            self._backoff_until.pop(peer, None)
+
+    def _fetch_one(self, host: str, port: int, ids: list, trace_id=None):
+        from ..runtime.tracing import TRACE_HEADER
+
+        conn = http.client.HTTPConnection(host, port, timeout=self.timeout_s)
+        try:
+            headers = {"Content-Type": "application/json", "Connection": "close"}
+            if trace_id:
+                headers[TRACE_HEADER] = trace_id
+            conn.request(
+                "POST", "/v1/prefill", body=json.dumps({"ids": ids}),
+                headers=headers,
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise OSError(f"/v1/prefill returned {resp.status}")
+            return body
+        finally:
+            conn.close()
+
+    def fetch(self, ids: list, trace=None) -> dict:
+        """Try to land ``ids``' leading-bucket KV in the local prefix cache
+        ahead of admission. Returns the ledger walls
+        ``{remote_prefill_us, kv_transfer_us, transferred_tokens}`` —
+        zeros whenever the request proceeds on local prefill (short prompt,
+        local cache already warm, or a degraded transfer). Never raises."""
+        out = {"remote_prefill_us": 0, "kv_transfer_us": 0, "transferred_tokens": 0}
+        engine = self.engine
+        pc = engine.prefix_cache
+        if pc is None or engine.paged or not self.peers:
+            return out
+        P = prefill_boundary(len(ids), engine.cfg.seq_len)
+        if P <= 0:
+            return out
+        covered, _entry = pc.match(ids[:P])
+        if covered >= P:
+            # the local cache already holds the span (an earlier transfer,
+            # or plain cross-request reuse): nothing to ship
+            engine.stats.incr("disagg_local_hits")
+            return out
+        usable = [p for p in self.peers if self._peer_usable(p)]
+        if not usable:
+            # every peer is in its failure-backoff window: prefill locally
+            # NOW instead of burning a timeout per request on known-bad
+            # peers. Not waste — no prefill-tier compute was spent.
+            engine.stats.incr("disagg_peer_backoff_skips")
+            return out
+        t0 = time.perf_counter()
+        body = None
+        peer_key = None
+        err = None
+        with self._lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % len(usable)
+        for i in range(len(usable)):
+            peer = usable[(start + i) % len(usable)]
+            host, port = peer
+            try:
+                # ship ids[:P+1]: the worker derives the SAME boundary from
+                # the same formula (bucket_down over len-1), so its slice
+                # covers exactly ids[:P] — truncating at P would make the
+                # worker floor one bucket lower
+                body = self._fetch_one(
+                    host, port, ids[: P + 1],
+                    trace_id=None if trace is None else trace.id,
+                )
+                peer_key = f"{host}:{port}"
+                self._peer_ok(peer)
+                break
+            except (OSError, ValueError, http.client.HTTPException) as e:
+                # OSError: refused/reset/timeout; HTTPException: a mid-body
+                # death that surfaces as IncompleteRead/BadStatusLine — all
+                # the chaos suite's kill shapes land here
+                err = e
+                engine.stats.incr("disagg_peer_errors")
+                self._peer_failed(peer)
+        inserted = False
+        if body is not None:
+            try:
+                header, k_np, v_np = parse_kv_payload(body)
+                tokens = header["tokens"]
+                if tokens != [int(t) for t in ids[:P]]:
+                    raise ValueError("peer returned KV for different tokens")
+                inserted = pc.insert_external(engine, tokens, k_np, v_np)
+                if not inserted:
+                    raise ValueError("local cache refused the external slice")
+                out["remote_prefill_us"] = int(header.get("prefill_us", 0))
+                out["transferred_tokens"] = P
+            except (ValueError, KeyError, TypeError) as e:
+                err = e
+                inserted = False
+        from ..runtime.tracing import to_us
+
+        wall_us = int((time.perf_counter() - t0) * 1e6)
+        if inserted:
+            # the transfer share of the wall: the fetch blocks on the
+            # worker's prefill too, which the worker reports separately
+            out["kv_transfer_us"] = max(wall_us - out["remote_prefill_us"], 0)
+            engine.stats.incr("disagg_kv_fetched")
+            engine.stats.incr("disagg_kv_tokens", P)
+            if trace is not None:
+                trace.event(
+                    "kv_transfer", to_us(t0), wall_us,
+                    ("peer", "tokens", "failed"), (peer_key, P, 0),
+                )
+        else:
+            # DEGRADE to local prefill: the request must complete (token-
+            # identical — it simply takes the unified path). Counted,
+            # ledgered as transfer_retry waste (the P tokens the prefill
+            # tier computed — or would have — now re-prefill locally), and
+            # traced even unsampled so a chaos kill is reconstructable.
+            engine.stats.incr("disagg_degraded")
+            engine.stats.incr("disagg_degraded_tokens", P)
+            self.state.goodput.add_waste("transfer_retry", P)
+            if trace is not None:
+                trace.event(
+                    "kv_transfer", to_us(t0), wall_us,
+                    ("peer", "tokens", "failed", "error"),
+                    (
+                        peer_key or "none", P, 1,
+                        "" if err is None else f"{type(err).__name__}: {err}",
+                    ),
+                    always=True,
+                )
+        return out

@@ -326,12 +326,30 @@ class FleetScraper:
         except Exception:
             return {}
         out = {}
-        for k in ("batcher", "kv_pool", "speculative", "batch", "seq_len"):
+        for k in ("batcher", "kv_pool", "speculative", "batch", "seq_len",
+                  "role", "disagg"):
             if isinstance(payload, dict) and payload.get(k) is not None:
                 out[k] = payload[k]
         return out
 
     # -- views ---------------------------------------------------------------
+
+    def router_signals(self) -> dict:
+        """The router's per-request view (server/router.py): one lock hold,
+        no balancer join — ``{backend_key: {stale, age_s, signals}}``. A
+        never-scraped replica simply has no row (the router treats absence
+        as stale)."""
+        now = now_s()
+        with self._lock:
+            out = {}
+            for k, st in self._replicas.items():
+                age = None if st.last_ok_s is None else now - st.last_ok_s
+                out[k] = {
+                    "stale": age is None or age > self.stale_after_s,
+                    "age_s": age,
+                    "signals": dict(st.signals),
+                }
+        return out
 
     def snapshot(self) -> dict:
         """The ``/gateway/fleet`` payload: one row per backend, signal

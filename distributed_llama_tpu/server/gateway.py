@@ -4,9 +4,15 @@ Started as a behavior-parity port of the reference gateway (reference:
 src/dllama-gateway.cpp:266-373) and grew the fault-tolerance layer the
 reference's fixed 3s blackout only gestures at:
 
-* backend selection: among assignable backends under their inflight cap,
-  pick least-inflight, tie-broken by a round-robin cursor — closed-breaker
-  backends are preferred over half-open ones
+* backend selection: **cache-aware routing** by default (server/router.py,
+  ``--router``/``DLT_ROUTER``) — shared-prefix chat traffic lands on the
+  replica whose radix prefix cache already holds the prefix, scored
+  against the fleet signal table (staleness-discounted), with decisions
+  counted by reason on ``/metrics``; anything the router abstains from
+  (non-chat routes, saturated favorites, policy least_inflight) falls to
+  the reference selection: among assignable backends under their inflight
+  cap, pick least-inflight, tie-broken by a round-robin cursor —
+  closed-breaker backends preferred over half-open ones
   (selectBackendAndAcquire, dllama-gateway.cpp:266-301);
 * **circuit breaker** per backend: `breaker_failure_threshold` consecutive
   failures OPEN the breaker (exponential backoff, capped at
@@ -148,6 +154,10 @@ class GatewayConfig:
     # reporting every replica as never-scraped/stale).
     fleet_scrape_s: float | None = None
     fleet_timeout_s: float | None = None
+    # cache-aware routing (server/router.py): None resolves DLT_ROUTER
+    # (default cache_aware); "least_inflight" keeps the legacy selection
+    # (the A/B arm the routing bench compares against)
+    router_policy: str | None = None
 
     def __post_init__(self):
         if self.health_retry_ms is not None:
@@ -169,6 +179,10 @@ class Balancer:
         # /gateway/fleet and the federated /metrics rollup. None = scraping
         # disabled; both endpoints degrade gracefully.
         self.fleet = None
+        # cache-aware router (server/router.py Router): attached by run()
+        # — or directly by tests. None = least-inflight only (the legacy
+        # selection path, byte-for-byte unchanged).
+        self.router = None
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
@@ -270,9 +284,22 @@ class Balancer:
             ]
         return max(0.0, min(deadlines)) if deadlines else 1.0
 
-    def _select_locked(self, exclude=frozenset()) -> int:
+    def _select_locked(self, exclude=frozenset(), prefer=None) -> int:
         now = time.monotonic()
         n = len(self.config.backends)
+        # router preference (server/router.py): try the ranked candidates
+        # in order first — but only onto CLOSED breakers (a half-open trial
+        # is a probe slot, not a cache-affinity opportunity; the default
+        # path below still admits it when nothing preferred is assignable)
+        if prefer:
+            for idx in prefer:
+                if idx < 0 or idx >= n or idx in exclude:
+                    continue
+                b = self.config.backends[idx]
+                if b.breaker == BREAKER_CLOSED and self._assignable_locked(b, now):
+                    b.inflight += 1
+                    self.rr_cursor = (idx + 1) % n
+                    return idx
         selected, best = -1, None
         for i in range(n):
             idx = (self.rr_cursor + i) % n
@@ -302,11 +329,15 @@ class Balancer:
             self.rr_cursor = (selected + 1) % n
         return selected
 
-    def acquire(self, exclude=frozenset()) -> int:
+    def acquire(self, exclude=frozenset(), prefer=None) -> int:
         """Returns a backend index, or BUSY (-1) when every backend is
         saturated AND the wait queue is full (or the queued wait timed out),
         or SHED (-2) when no backend is routable at all (every breaker open
-        or every backend draining) — the caller should 503 immediately."""
+        or every backend draining) — the caller should 503 immediately.
+        `prefer` (server/router.py RoutePlan.ranked) biases selection: the
+        ranked candidates are tried in order before the least-inflight
+        fallback, and a queued waiter keeps its preference for when it
+        reaches the head of the line."""
         exclude = frozenset(exclude)
         with self.cond:
             if not self._routable_in_principle_locked(exclude, time.monotonic()):
@@ -314,7 +345,7 @@ class Balancer:
             # fast path only when nobody is already waiting — otherwise this
             # caller must take its place at the back of the line
             if not self._queue:
-                idx = self._select_locked(exclude)
+                idx = self._select_locked(exclude, prefer)
                 if idx >= 0:
                     return idx
             if exclude:
@@ -334,7 +365,7 @@ class Balancer:
                 while True:
                     # only the head of the line may claim capacity
                     if self._queue[0] == ticket:
-                        idx = self._select_locked(exclude)
+                        idx = self._select_locked(exclude, prefer)
                         if idx >= 0:
                             return idx
                     now = time.monotonic()
@@ -650,6 +681,17 @@ def render_gateway_metrics(balancer: Balancer) -> str:
         for b in s["backends"]:
             lines.append(prom_line(m, {"backend": b["backend"]}, b[col]))
     render_hist(lines, "dlt_gateway_request_ms", balancer.request_ms.snapshot())
+    if balancer.router is not None:
+        # routing decisions by reason (server/router.py): every known
+        # reason always renders, zero-valued included, so dashboards never
+        # see a series appear from nowhere mid-incident
+        from .router import REASONS
+
+        counts = balancer.router.decisions_snapshot()
+        m = "dlt_router_decisions_total"
+        lines.append(f"# TYPE {m} counter")
+        for reason in REASONS:
+            lines.append(prom_line(m, {"reason": reason}, counts.get(reason, 0)))
     if balancer.fleet is not None:
         lines.extend(balancer.fleet.federated_lines())
     return "\n".join(lines) + "\n"
@@ -670,10 +712,22 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
         if balancer.fleet is None:
             _plain_response(
                 client, 200, "OK",
-                json.dumps({"enabled": False, "replicas": []}),
+                json.dumps({
+                    "enabled": False, "replicas": [],
+                    "router": (
+                        None if balancer.router is None
+                        else balancer.router.snapshot()
+                    ),
+                }),
             )
             return
         payload = dict(balancer.fleet.snapshot(), enabled=True)
+        # router view (server/router.py): policy, per-reason decision
+        # counts, locality-map occupancy — joined here so the routing view
+        # and the signal table it scores can never disagree
+        payload["router"] = (
+            None if balancer.router is None else balancer.router.snapshot()
+        )
         _plain_response(client, 200, "OK", json.dumps(payload))
         return
     if route == "/debug/config" and method == "GET":
@@ -701,6 +755,10 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
                 ),
                 "fleet_stale_after_s": (
                     balancer.fleet.stale_after_s if balancer.fleet else None
+                ),
+                "router": (
+                    None if balancer.router is None
+                    else balancer.router.cfg.policy
                 ),
             },
             "backends": fleet_mod.fetch_backend_configs(balancer),
@@ -819,11 +877,32 @@ def handle_client(client: socket.socket, balancer: Balancer):
         hdrs = {TRACE_HEADER: tr.id}
         t_req0 = now_us()
         balancer.count("requests")
+        # cache-aware routing (server/router.py): rank the backends by
+        # prefix affinity × fleet signals ONCE per request — the plan rides
+        # every retry attempt (the failed backend is excluded, the ranking
+        # still stands). None = the router abstained (non-chat route,
+        # unparsable body) or routing is off; selection is then pure
+        # least-inflight, exactly the legacy behavior.
+        plan = None
+        router = balancer.router
+        # `routed` gates decision accounting to CHAT traffic: health/debug
+        # proxies are not routing decisions, and counting them would dilute
+        # the per-reason breakdown dashboards read
+        routed = (
+            router is not None
+            and method == "POST"
+            and route == "/v1/chat/completions"
+        )
+        if routed:
+            body = request.partition(b"\r\n\r\n")[2]
+            plan = router.plan(body, balancer)
         tried: set[int] = set()
         attempt = 0
         while True:
             t_acq = time.perf_counter()
-            idx = balancer.acquire(exclude=tried)
+            idx = balancer.acquire(
+                exclude=tried, prefer=plan.ranked if plan is not None else None
+            )
             acq_us = int((time.perf_counter() - t_acq) * 1e6)
             held = idx if idx >= 0 else -1
             if idx < 0 and tried:
@@ -865,6 +944,22 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 "gw_acquire", to_us(t_acq), acq_us,
                 ("backend", "attempt"), (b.key, attempt),
             )
+            if routed:
+                # attribute + count the decision and land the scored
+                # candidates on the trace — one event per attempt, same
+                # bound as gw_acquire (locality learning waits for the
+                # attempt to SUCCEED below)
+                reason = router.resolve(plan, b.key)
+                tr.event(  # dlt: allow(trace-hot-emit)
+                    "gw_route", now_us(), 0,
+                    ("backend", "reason", "candidates"),
+                    (
+                        b.key, reason,
+                        "" if plan is None else " ".join(
+                            f"{k}={s}" for k, s in plan.scored
+                        ),
+                    ),
+                )
             t_att = time.perf_counter()
             failed, forwarded, client_gone = _proxy_once(client, request, b, config)
             tr.event(  # dlt: allow(trace-hot-emit)
@@ -882,6 +977,11 @@ def handle_client(client: socket.socket, balancer: Balancer):
             if not failed:
                 balancer.count("proxied_ok")
                 outcome = "ok"
+                if routed:
+                    # the attempt SUCCEEDED: this backend is now the
+                    # prefix's learned home (a zero-byte-failed attempt
+                    # must never teach the locality map a dead backend)
+                    router.learn(plan, b.key)
                 return
             if forwarded:
                 # mid-stream failure: appending a second status line to a
@@ -940,7 +1040,13 @@ def serve(port: int, balancer: Balancer) -> socket.socket:
 
 def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
     from .fleet import FleetScraper
+    from .router import Router
 
+    # cache-aware routing (server/router.py): ON by default (DLT_ROUTER /
+    # --router least_inflight keeps the legacy selection); None means every
+    # routing call below is skipped, not a null-check on the hot path
+    if balancer.router is None:
+        balancer.router = Router.build(balancer.config.router_policy)
     srv = serve(port, balancer)
     srv.settimeout(0.5)
     stop = stop_event if stop_event is not None else threading.Event()
@@ -1002,6 +1108,13 @@ def main(argv=None) -> int:
     p.add_argument("--fleet-timeout-s", type=float, default=None,
                    help="per-scrape socket timeout (default: "
                    "DLT_FLEET_TIMEOUT_S or 2.0)")
+    p.add_argument("--router", choices=["cache_aware", "least_inflight"],
+                   default=None,
+                   help="backend selection policy (server/router.py): "
+                   "cache_aware lands shared-prefix traffic on the replica "
+                   "whose radix cache holds it, scored against the fleet "
+                   "signal table; least_inflight keeps the legacy "
+                   "selection (default: DLT_ROUTER or cache_aware)")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
@@ -1017,6 +1130,7 @@ def main(argv=None) -> int:
         health_retry_ms=args.health_retry_ms,
         fleet_scrape_s=args.fleet_scrape_s,
         fleet_timeout_s=args.fleet_timeout_s,
+        router_policy=args.router,
     )
     run(args.port, Balancer(config))
     return 0

@@ -1,0 +1,400 @@
+"""Cache-aware routing: prefix-locality placement over the fleet signal plane.
+
+The reference gateway — and this one until now — picks least-inflight
+(reference: dllama-gateway.cpp:266-301): it balances *load* but is blind to
+*state*. Serving traffic is dominated by shared prefixes (system prompts,
+few-shot templates, multi-turn histories), and every replica keeps a radix
+prefix cache of published KV (runtime/prefix_cache.py) — so WHERE a request
+lands decides whether its prompt re-prefills from token 0 or splices cached
+KV. Least-inflight sprays a shared prefix across the fleet and every replica
+pays the cold prefill once; cache-aware routing (SGLang's cache-aware policy
+over radix caches; DistServe frames the placement half) lands it on the ONE
+replica whose cache already holds it.
+
+Mechanics — all host-side, stdlib-only (the gateway imports this and must
+run on a box with no jax):
+
+* **prefix hash chain** — the leading text of the request's chat messages is
+  hashed in fixed-size blocks (:data:`PAGE_CHARS` characters ≈ the prefix
+  cache's 16-token pages at ~4 chars/token), each block chained onto the
+  previous hash (FNV-1a): ``chain[i]`` names the first ``i+1`` blocks, so
+  two requests sharing a prefix share a chain prefix — the same structure
+  the radix trie keys on, approximated pre-tokenization;
+* **locality map** — a bounded LRU of ``chain key -> backend`` learned from
+  this gateway's own routing decisions: the deepest known chain key names
+  the replica whose cache most specifically holds the prefix;
+* **rendezvous owner** — cold prefixes (no locality entry) fall to
+  highest-random-weight hashing over the live backends: deterministic, and
+  a replica join/leave remaps only the keys the changed replica owned
+  (~1/n), never reshuffles the rest — the affinity-stability property the
+  tests pin;
+* **fleet-signal scoring** — :func:`score_backend` (a pure function) folds
+  the PR 9 signal table into the rank: KV-pool headroom, batcher occupancy,
+  TTFT-SLO attainment — *discounted to zero when the replica's signals are
+  stale* (the scraper aged out), so a silent replica never wins on
+  last-known numbers. Prefix affinity is NOT staleness-discounted: cache
+  contents outlive a scrape gap;
+* **fallback** — with no parseable prefix, no affinity, and stale signals,
+  the router abstains and the balancer's least-inflight selection stands.
+
+Every decision is counted by reason (``dlt_router_decisions_total{reason=
+prefix_affinity|headroom|fallback_stale|least_inflight}`` on the gateway's
+``/metrics``), traced per request (``gw_route`` with the scored candidates),
+and summarized in the ``router`` section of ``GET /gateway/fleet``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+#: characters per hash block — the prefix cache publishes at 16-token pages
+#: and text runs ~4 chars/token, so one block approximates one page
+PAGE_CHARS = 64
+#: chain depth cap: prefixes deeper than this share their fate with the
+#: 32-block (≈2k-char) chain head — long-tail depth adds nothing to routing
+MAX_BLOCKS = 32
+
+#: every reason `dlt_router_decisions_total` is labeled with — the zero
+#: -valued reasons always render, so dashboards never see a series appear
+#: from nowhere mid-incident
+REASONS = ("prefix_affinity", "headroom", "fallback_stale", "least_inflight")
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+
+
+def fnv1a(data: bytes, h: int = _FNV64_OFFSET) -> int:
+    """64-bit FNV-1a over ``data`` seeded with ``h`` — deterministic across
+    processes and runs (Python's builtin hash is salted per process, which
+    would break cross-gateway agreement on prefix ownership)."""
+    for b in data:
+        h ^= b
+        h = (h * _FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def prefix_chain(text: str, block_chars: int = PAGE_CHARS,
+                 max_blocks: int = MAX_BLOCKS) -> list:
+    """Chained block hashes of the leading text: ``chain[i]`` covers the
+    first ``i+1`` blocks, and each hash seeds the next — so texts sharing a
+    leading span share exactly the chain entries that span covers. Only
+    COMPLETE blocks hash (a half-filled tail block would make the chain key
+    depend on where the request happens to end, splitting identical
+    prefixes across keys)."""
+    out: list = []
+    h = _FNV64_OFFSET
+    n_full = min(len(text) // block_chars, max_blocks)
+    for i in range(n_full):
+        h = fnv1a(
+            text[i * block_chars : (i + 1) * block_chars].encode(
+                "utf-8", errors="replace"
+            ),
+            h,
+        )
+        out.append(h)
+    return out
+
+
+def chat_prefix_text(body: bytes) -> str | None:
+    """The routable prefix text of a ``/v1/chat/completions`` body: the
+    messages' roles+contents concatenated in order (the same order the chat
+    template feeds the tokenizer, so equal text here means equal leading
+    tokens there). None = not a routable chat request (bad JSON, no
+    messages) — the caller falls back to least-inflight."""
+    try:
+        params = json.loads(body)
+        messages = params["messages"]
+        parts = []
+        for m in messages:
+            parts.append(str(m.get("role", "")))
+            parts.append("\x00")
+            parts.append(str(m.get("content", "")))
+            parts.append("\x1e")
+        return "".join(parts)
+    except (ValueError, KeyError, TypeError, AttributeError):
+        # AttributeError included: a JSON-valid body whose messages entries
+        # are not dicts ({"messages": ["hi"]}) must abstain, not crash the
+        # gateway's connection thread — the backend owns the 400
+        return None
+
+
+def rendezvous_owner(key: int, backends: list) -> str | None:
+    """Highest-random-weight owner of ``key`` among ``backends`` (keys are
+    backend ``host:port`` strings). Adding/removing a backend remaps only
+    the keys the changed backend wins — every other key's owner is decided
+    by a pairwise comparison the change didn't touch."""
+    best, best_w = None, -1
+    for b in backends:
+        w = fnv1a(b.encode(), key)
+        if w > best_w:
+            best, best_w = b, w
+    return best
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class RouterConfig:
+    """Routing knobs (``DLT_ROUTER_*`` envs; the gateway's ``--router``
+    flag picks the policy). Weights are unitless score points — affinity
+    must dominate the sum of the signal terms so a known-warm cache beats
+    any amount of idle headroom, while the inflight penalty lets a truly
+    swamped affinity replica lose to an idle one."""
+
+    policy: str = "cache_aware"  # cache_aware | least_inflight (= off)
+    locality_size: int = 4096    # LRU entries in the chain-key -> backend map
+    w_affinity: float = 4.0      # expected-prefix-hit bonus
+    w_headroom: float = 1.0      # KV-pool free-page fraction
+    w_occupancy: float = 1.0     # 1 - batcher slot occupancy
+    w_slo: float = 1.0           # TTFT-SLO attainment
+    w_inflight: float = 0.5      # per-inflight-request penalty
+
+    @classmethod
+    def resolve(cls, policy: str | None = None) -> "RouterConfig":
+        """Env-driven construction: an explicit ``policy`` wins, then
+        ``DLT_ROUTER`` (default cache_aware — the serving tier's point)."""
+        return cls(
+            policy=policy or os.environ.get("DLT_ROUTER", "cache_aware"),
+            locality_size=_env_int("DLT_ROUTER_LOCALITY", 4096),
+            w_affinity=_env_float("DLT_ROUTER_W_AFFINITY", 4.0),
+            w_headroom=_env_float("DLT_ROUTER_W_HEADROOM", 1.0),
+            w_occupancy=_env_float("DLT_ROUTER_W_OCCUPANCY", 1.0),
+            w_slo=_env_float("DLT_ROUTER_W_SLO", 1.0),
+            w_inflight=_env_float("DLT_ROUTER_W_INFLIGHT", 0.5),
+        )
+
+
+def score_backend(
+    affinity: bool,
+    signals: dict,
+    stale: bool,
+    inflight: int,
+    cfg: RouterConfig,
+) -> float:
+    """The PURE scoring function every routing decision ranks with.
+
+    * ``affinity`` — this backend is the prefix's locality/rendezvous owner
+      (expected prefix hit). NOT staleness-discounted: cached KV outlives a
+      scrape gap, and the cost of re-prefilling elsewhere is certain;
+    * ``signals``/``stale`` — the fleet table's last-known row and its
+      freshness. A stale row contributes ZERO signal score (the stale
+      discount): last-known headroom on a silent replica is a guess, and
+      guessing high is how a dead replica keeps winning traffic. Fresh rows
+      score KV-pool headroom (free-page fraction; contiguous replicas
+      without a pool get full credit — they cannot exhaust), batcher
+      occupancy (free-slot fraction), and TTFT-SLO attainment, each capped
+      at its weight so no single signal can swamp the others;
+    * ``inflight`` — the balancer's live connection count, a penalty in
+      both regimes (it is the one signal that is never stale)."""
+    s = 0.0
+    if affinity:
+        s += cfg.w_affinity
+    if not stale and signals:
+        free = signals.get("kv_pool_pages_free")
+        if free is not None:
+            total = free + signals.get("kv_pool_pages_used", 0)
+            s += cfg.w_headroom * (free / total if total > 0 else 1.0)
+        else:
+            s += cfg.w_headroom
+        slots = signals.get("batcher_batch_slots")
+        if slots:
+            active = min(signals.get("batcher_slots_active", 0), slots)
+            s += cfg.w_occupancy * (1.0 - active / slots)
+        else:
+            s += cfg.w_occupancy
+        slo = signals.get("slo_ttft_attainment")
+        s += cfg.w_slo * (slo if slo is not None else 1.0)
+    s -= cfg.w_inflight * inflight
+    return s
+
+
+@dataclass
+class RoutePlan:
+    """One request's routing verdict: ``ranked`` backend indexes (best
+    first — the balancer tries them in order before falling back to
+    least-inflight), the affinity/top-signal keys the reason resolution
+    compares the actual choice against, the chain keys to learn from the
+    outcome, and the scored candidates for the ``gw_route`` trace event."""
+
+    ranked: list = field(default_factory=list)       # backend indexes
+    affinity_key: str | None = None                  # locality/rendezvous owner
+    best_signal_key: str | None = None               # top fresh-signal backend
+    fresh: bool = False                              # any non-stale signal row
+    chain: list = field(default_factory=list)        # this prefix's chain keys
+    scored: list = field(default_factory=list)       # (backend_key, score)
+
+
+class Router:
+    """Per-gateway routing state: the locality map, the decision counters,
+    and the plan/resolve pair the gateway's request loop calls. Thread-safe
+    (one lock around the locality map and counters — both are a dict touch
+    per REQUEST, never per token)."""
+
+    def __init__(self, cfg: RouterConfig | None = None):
+        self.cfg = cfg or RouterConfig()
+        self._lock = threading.Lock()
+        self._locality: "OrderedDict[int, str]" = OrderedDict()
+        self.decisions = {r: 0 for r in REASONS}
+
+    @classmethod
+    def build(cls, policy: str | None = None) -> "Router | None":
+        """The gateway's factory: None when routing is OFF (policy
+        least_inflight/off) — the request loop then skips planning
+        entirely and the legacy selection stands. Unknown policies raise:
+        a typo'd DLT_ROUTER silently serving cache_aware (or silently NOT
+        serving it) would defeat the operator's intent."""
+        cfg = RouterConfig.resolve(policy)
+        if cfg.policy in ("least_inflight", "off", ""):
+            return None
+        if cfg.policy != "cache_aware":
+            raise ValueError(
+                f"unknown router policy {cfg.policy!r} "
+                "(one of: cache_aware, least_inflight, off)"
+            )
+        return cls(cfg)
+
+    # -- planning ------------------------------------------------------------
+
+    def plan(self, body: bytes | None, balancer) -> RoutePlan | None:
+        """Rank the backends for one request. None = the router abstains
+        (non-chat request, unparsable body, or a prompt too short to carry
+        a full hash block) and the decision counts as least_inflight."""
+        text = chat_prefix_text(body) if body else None
+        if text is None:
+            return None
+        chain = prefix_chain(text)
+        if not chain:
+            return None
+        backends = list(balancer.config.backends)
+        keys = [b.key for b in backends if not b.draining]
+        if not keys:
+            return None
+        # affinity: deepest learned chain key first (most specific), the
+        # rendezvous owner of the chain HEAD for cold prefixes — the head
+        # block is what unrelated requests sharing a system prompt share,
+        # so the cold placement already co-locates them
+        affinity_key = None
+        with self._lock:
+            for ck in reversed(chain):
+                owner = self._locality.get(ck)
+                if owner is not None and owner in keys:
+                    affinity_key = owner
+                    self._locality.move_to_end(ck)
+                    break
+        if affinity_key is None:
+            affinity_key = rendezvous_owner(chain[0], keys)
+        fleet = getattr(balancer, "fleet", None)
+        rows = fleet.router_signals() if fleet is not None else {}
+        scored = []
+        best_signal_key, best_signal = None, None
+        fresh = False
+        with balancer.lock:
+            inflight = {b.key: b.inflight for b in backends}
+        for b in backends:
+            if b.draining:
+                continue
+            row = rows.get(b.key) or {}
+            stale = bool(row.get("stale", True))
+            signals = row.get("signals") or {}
+            if not stale:
+                fresh = True
+                sig = score_backend(False, signals, False, 0, self.cfg)
+                if best_signal is None or sig > best_signal:
+                    best_signal, best_signal_key = sig, b.key
+            score = score_backend(
+                b.key == affinity_key, signals, stale,
+                inflight.get(b.key, 0), self.cfg,
+            )
+            scored.append((b.key, score))
+        if not scored:
+            return None
+        order = sorted(
+            range(len(scored)), key=lambda i: scored[i][1], reverse=True
+        )
+        key_to_idx = {b.key: i for i, b in enumerate(backends)}
+        return RoutePlan(
+            ranked=[key_to_idx[scored[i][0]] for i in order],
+            affinity_key=affinity_key,
+            best_signal_key=best_signal_key,
+            fresh=fresh,
+            chain=chain,
+            scored=[(k, round(s, 3)) for k, s in scored],
+        )
+
+    # -- outcome -------------------------------------------------------------
+
+    def resolve(self, plan: RoutePlan | None, chosen_key: str) -> str:
+        """Attribute a completed selection to its reason and count it. The
+        chosen backend can differ from the plan's favorite (saturated,
+        breaker open): that is a least_inflight outcome, honestly counted.
+        Locality is learned separately (:meth:`learn`, on request SUCCESS)
+        — counting a selection must not teach the map a backend that is
+        about to fail the request zero-byte."""
+        if plan is None:
+            reason = "least_inflight"
+        elif chosen_key == plan.affinity_key:
+            reason = "prefix_affinity"
+        elif not plan.fresh:
+            reason = "fallback_stale"
+        elif chosen_key == plan.best_signal_key:
+            reason = "headroom"
+        else:
+            reason = "least_inflight"
+        with self._lock:
+            self.decisions[reason] += 1
+        return reason
+
+    def learn(self, plan: RoutePlan | None, chosen_key: str) -> None:
+        """Record the locality of a SUCCESSFUL request: every chain key now
+        names the replica that served it — its radix cache holds the prefix
+        once the request publishes. Called by the gateway after the proxied
+        attempt succeeds, never for failed attempts (a dead backend must
+        not become the prefix's learned home)."""
+        if plan is None:
+            return
+        with self._lock:
+            for ck in plan.chain:
+                self._locality[ck] = chosen_key
+                self._locality.move_to_end(ck)
+            while len(self._locality) > self.cfg.locality_size:
+                self._locality.popitem(last=False)
+
+    # -- views ---------------------------------------------------------------
+
+    def decisions_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.decisions)
+
+    def snapshot(self) -> dict:
+        """The ``router`` section of ``GET /gateway/fleet``."""
+        with self._lock:
+            return {
+                "policy": self.cfg.policy,
+                "decisions": dict(self.decisions),
+                "locality_entries": len(self._locality),
+                "locality_size": self.cfg.locality_size,
+                "weights": {
+                    "affinity": self.cfg.w_affinity,
+                    "headroom": self.cfg.w_headroom,
+                    "occupancy": self.cfg.w_occupancy,
+                    "slo": self.cfg.w_slo,
+                    "inflight": self.cfg.w_inflight,
+                },
+                "block_chars": PAGE_CHARS,
+            }

@@ -30,7 +30,12 @@
 #                            harness, per-replica signal table + staleness,
 #                            federated /metrics format, goodput-ledger
 #                            token identity, batch timeline, /debug/config)
-#  10. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
+#  10. router suite         (cache-aware routing: scoring purity, rendez-
+#                            vous affinity stability, the 4-replica >=2x
+#                            concentration twin; disaggregated serving:
+#                            KV wire codec, token identity vs unified,
+#                            chaos mid-transfer degradation)
+#  11. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
 #                            vs predecessor, tolerance-banded — WARN-ONLY:
 #                            the table is the artifact, the exit code is 0)
 #
@@ -69,6 +74,9 @@ python -m pytest tests/test_paged_kv.py -q -p no:cacheprovider
 
 echo "== fleet suite (federation + goodput + timeline) =="
 python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovider
+
+echo "== router suite (cache-aware routing + disaggregated serving) =="
+python -m pytest tests/test_router.py tests/test_disagg.py -q -p no:cacheprovider
 
 echo "== scoreboard guard (warn-only) =="
 python scripts/bench_compare.py

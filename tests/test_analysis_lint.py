@@ -121,6 +121,11 @@ def test_trace_hot_emit_scoped_to_hot_packages():
         "    tr.event('queue_wait', 1, 2)  # dlt: allow(trace-hot-emit)\n"
     )
     assert _rules(pragma, "server/x.py") == []
+    # the router's per-request decision path (server/router.py, PR 10)
+    # rides the same server-package scope: a per-iteration emit in it is
+    # flagged exactly like the Batcher/gateway loops
+    assert _rules(in_loop, "server/router.py") == ["trace-hot-emit"]
+    assert _rules(bound, "server/router.py") == []
     # formats/ops stay out of scope
     assert _rules(in_loop, "formats/x.py") == []
     # non-trace receivers named `event` are not span emits

@@ -1,0 +1,443 @@
+"""Prefill/decode disaggregation tests (server/disagg.py).
+
+Unit layer: the KV wire codec (round trip incl. bfloat16, truncation
+detection), the boundary math, role/peer resolution, and
+PrefixCache.insert_external's refusal cases.
+
+HTTP layer: a live prefill worker behind a ChaosProxy, a decode worker
+peered at the proxy, and a unified twin — proving (1) disaggregated serving
+is token-identical to unified, (2) killing the prefill worker MID-KV-
+TRANSFER degrades the request to local prefill (completed, token-identical)
+with the degradation visible in the goodput ledger
+(``dlt_wasted_tokens_total{reason=transfer_retry}``), the counters
+(``disagg_degraded``), and the request trace (a ``kv_transfer`` event with
+``failed=1``) — the acceptance chaos case."""
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.server.chaos import (
+    MIDSTREAM_RESET,
+    Fault,
+    FaultPlan,
+    ChaosProxy,
+)
+from distributed_llama_tpu.server.disagg import (
+    kv_payload,
+    parse_kv_payload,
+    prefill_boundary,
+    resolve_peers,
+    resolve_role,
+)
+from distributed_llama_tpu.runtime.telemetry import (
+    LEDGER_FIELDS,
+    WASTE_REASONS,
+)
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- wire codec ---------------------------------------------------------------
+
+
+def test_kv_payload_roundtrip_f32():
+    k = np.arange(2 * 16 * 2 * 4, dtype=np.float32).reshape(2, 16, 2, 4)
+    v = (k * 2 + 1).astype(np.float32)
+    hdr = {
+        "tokens": list(range(16)), "p": 16,
+        "k_shape": list(k.shape), "v_shape": list(v.shape),
+        "dtype": "float32", "prefill_us": 1234,
+    }
+    h2, k2, v2 = parse_kv_payload(kv_payload(hdr, k, v))
+    assert h2["tokens"] == hdr["tokens"] and h2["prefill_us"] == 1234
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_kv_payload_roundtrip_bfloat16():
+    import ml_dtypes
+
+    k = np.arange(2 * 16 * 2 * 4).reshape(2, 16, 2, 4).astype(ml_dtypes.bfloat16)
+    v = (np.asarray(k, np.float32) + 0.5).astype(ml_dtypes.bfloat16)
+    hdr = {
+        "tokens": list(range(16)), "p": 16,
+        "k_shape": list(k.shape), "v_shape": list(v.shape),
+        "dtype": str(k.dtype), "prefill_us": 0,
+    }
+    h2, k2, v2 = parse_kv_payload(kv_payload(hdr, k, v))
+    assert str(k2.dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(k, np.float32), np.asarray(k2, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(v, np.float32), np.asarray(v2, np.float32)
+    )
+
+
+def test_kv_payload_truncation_raises():
+    k = np.zeros((1, 16, 1, 4), np.float32)
+    hdr = {
+        "tokens": list(range(16)), "p": 16,
+        "k_shape": list(k.shape), "v_shape": list(k.shape),
+        "dtype": "float32", "prefill_us": 0,
+    }
+    body = kv_payload(hdr, k, k)
+    for cut in (2, 6, len(body) - 17):  # before header / inside / inside KV
+        with pytest.raises(ValueError):
+            parse_kv_payload(body[:cut])
+
+
+def test_prefill_boundary_math():
+    # boundary = bucket_down(n-1), floored at the 16-token publish floor,
+    # capped at seq_len // 2 by the bucket ladder itself
+    assert prefill_boundary(10, 256) == 0
+    assert prefill_boundary(17, 256) == 16
+    assert prefill_boundary(129, 256) == 128
+    assert prefill_boundary(300, 256) == 128  # ladder cap: seq_len // 2
+
+
+def test_resolve_role_and_peers(monkeypatch):
+    assert resolve_role(None) == "unified"
+    assert resolve_role("prefill") == "prefill"
+    monkeypatch.setenv("DLT_ROLE", "decode")
+    assert resolve_role(None) == "decode"
+    with pytest.raises(ValueError):
+        resolve_role("typo")
+    assert resolve_peers(["10.0.0.1:900", "h2:901"]) == [
+        ("10.0.0.1", 900), ("h2", 901)
+    ]
+    monkeypatch.setenv("DLT_PREFILL_PEER", "a:1, b:2")
+    assert resolve_peers(None) == [("a", 1), ("b", 2)]
+
+
+def test_ledger_shape_carries_disagg_fields():
+    assert "remote_prefill_us" in LEDGER_FIELDS
+    assert "kv_transfer_us" in LEDGER_FIELDS
+    assert "transfer_retry" in WASTE_REASONS
+
+
+# -- live disaggregated stack -------------------------------------------------
+
+
+class Stack:
+    """prefill worker <- ChaosProxy <- decode worker, plus a unified twin
+    — one tiny model, three engines, torn down as one unit."""
+
+    def __init__(self, tmpdir):
+        import os
+
+        # three engines in one module: skip the per-engine cost-table AOT
+        # build (profiling coverage has its own suite)
+        os.environ["DLT_COST_TABLE"] = "0"
+        from distributed_llama_tpu.formats.mfile import ArchType
+        from distributed_llama_tpu.server import api as api_mod
+        from distributed_llama_tpu.testing import (
+            tiny_header, write_tiny_model, write_tiny_tokenizer,
+        )
+        from distributed_llama_tpu.cli import build_arg_parser
+
+        h = tiny_header(
+            arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+            seq_len=256, vocab_size=288,
+        )
+        mp, tp = str(tmpdir / "m.m"), str(tmpdir / "t.t")
+        write_tiny_model(mp, h, seed=3)
+        write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+
+        def start(extra):
+            p = build_arg_parser()
+            p.add_argument("--port", type=int, default=0)
+            port = free_port()
+            args = p.parse_args(
+                [
+                    "inference", "--model", mp, "--tokenizer", tp,
+                    "--steps", "0", "--compute-dtype", "float32",
+                    "--temperature", "0.0", "--port", str(port),
+                ] + extra
+            )
+            httpd = api_mod.serve(args)
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            return port, httpd
+
+        self.pf_port, self.pf = start(["--role", "prefill"])
+        # the chaos proxy between decode worker and prefill worker: every
+        # transfer-failure test just swaps self.proxy.plan
+        self.proxy = ChaosProxy("127.0.0.1", self.pf_port, FaultPlan()).start()
+        self.dec_port, self.dec = start(
+            ["--role", "decode", "--prefill-peer", f"127.0.0.1:{self.proxy.port}"]
+        )
+        self.uni_port, self.uni = start([])
+
+    def stop(self):
+        import os
+
+        os.environ.pop("DLT_COST_TABLE", None)
+        self.proxy.stop()
+        for s in (self.pf, self.dec, self.uni):
+            s.shutdown()
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    st = Stack(tmp_path_factory.mktemp("disagg"))
+    yield st
+    st.stop()
+
+
+def _ask(port, system, user, trace_id=None, max_tokens=8):
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers["X-DLT-Trace-Id"] = trace_id
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(
+            {
+                "messages": [
+                    {"role": "system", "content": system},
+                    {"role": "user", "content": user},
+                ],
+                "max_tokens": max_tokens,
+            }
+        ).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _counters(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=30
+    ) as r:
+        return json.loads(r.read())["steps"]["counters"]
+
+
+def _stats(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def test_disagg_token_identity_and_walls(stack):
+    """The happy path: KV ships from the prefill worker, the decode worker
+    splices it, and the answer is byte-identical to unified serving."""
+    shared = "identity-prefix " * 9  # >= 128 prompt tokens after templating
+    before = _counters(stack.dec_port)
+    r_dec = _ask(stack.dec_port, shared, "what is up")
+    r_uni = _ask(stack.uni_port, shared, "what is up")
+    assert (
+        r_dec["choices"][0]["message"]["content"]
+        == r_uni["choices"][0]["message"]["content"]
+    )
+    after = _counters(stack.dec_port)
+    assert after.get("disagg_kv_fetched", 0) == before.get("disagg_kv_fetched", 0) + 1
+    assert after.get("prefix_hit_tokens", 0) > before.get("prefix_hit_tokens", 0)
+    g = r_dec["usage"]["goodput"]
+    assert g["remote_prefill_us"] > 0
+    assert g["kv_transfer_us"] >= 0
+    assert g["prefix_hit_tokens"] >= 16
+    # the usage extension's shape is LEDGER_FIELDS — the one source
+    assert set(g) == set(LEDGER_FIELDS) | {"outcome"}
+    # the prefill worker did the prompt work
+    wc = _counters(stack.pf_port)
+    assert wc.get("disagg_prefills", 0) >= 1
+    assert wc.get("disagg_prefill_tokens", 0) >= 16
+
+
+def test_disagg_second_request_hits_local_cache(stack):
+    shared = "local-hit-prefix " * 9
+    _ask(stack.dec_port, shared, "first")
+    before = _counters(stack.dec_port)
+    _ask(stack.dec_port, shared, "second")
+    after = _counters(stack.dec_port)
+    # no refetch: the first transfer (or its local publish) covers the span
+    assert after.get("disagg_kv_fetched", 0) == before.get("disagg_kv_fetched", 0)
+    assert after.get("disagg_local_hits", 0) >= before.get("disagg_local_hits", 0) + 1
+
+
+def test_chaos_midstream_kill_degrades_to_local_prefill(stack):
+    """THE acceptance chaos case: the prefill worker dies mid-KV-transfer
+    (RST after 2000 response bytes — inside the KV body). The request must
+    COMPLETE, token-identical to unified, with the degradation counted,
+    ledgered as transfer_retry waste, and traced."""
+    shared = "chaos-kill-prefix " * 9
+    trace_id = "disagg-chaos-trace-0001"
+    before = _counters(stack.dec_port)
+    goodput_before = _stats(stack.dec_port)["goodput"]["wasted_tokens"]
+    old_plan = stack.proxy.plan
+    stack.proxy.plan = FaultPlan(
+        default=Fault(MIDSTREAM_RESET, after_bytes=2000)
+    )
+    try:
+        r_dec = _ask(stack.dec_port, shared, "chaos question", trace_id=trace_id)
+    finally:
+        stack.proxy.plan = old_plan
+    r_uni = _ask(stack.uni_port, shared, "chaos question")
+    # completed, token-identical to the unified path
+    assert (
+        r_dec["choices"][0]["message"]["content"]
+        == r_uni["choices"][0]["message"]["content"]
+    )
+    after = _counters(stack.dec_port)
+    assert after.get("disagg_degraded", 0) == before.get("disagg_degraded", 0) + 1
+    assert after.get("disagg_peer_errors", 0) > before.get("disagg_peer_errors", 0)
+    # ledger: the re-prefilled tokens are transfer_retry waste...
+    g = r_dec["usage"]["goodput"]
+    assert g["remote_prefill_us"] == 0 and g["kv_transfer_us"] == 0
+    wasted = _stats(stack.dec_port)["goodput"]["wasted_tokens"]
+    assert wasted.get("transfer_retry", 0) >= goodput_before.get(
+        "transfer_retry", 0
+    ) + 16
+    # ...visible on /metrics as the labeled counter family
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{stack.dec_port}/metrics", timeout=30
+    ) as r:
+        body = r.read().decode()
+    line = next(
+        l for l in body.splitlines()
+        if l.startswith('dlt_wasted_tokens_total{reason="transfer_retry"}')
+    )
+    assert float(line.rsplit(None, 1)[1]) >= 16
+    # ...and on the request trace: a kv_transfer event with failed=1
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{stack.dec_port}/debug/trace?id={trace_id}",
+        timeout=30,
+    ) as r:
+        trace = json.loads(r.read())
+    ev = [e for e in trace["events"] if e["name"] == "kv_transfer"]
+    assert ev, trace["events"]
+    assert any(e["args"].get("failed") == 1 for e in ev), ev
+    # the failed peer entered its backoff window: the NEXT request (fresh
+    # prefix) skips the fetch immediately instead of burning another
+    # timeout on a known-bad peer — and no new peer error is counted
+    client = stack.dec.RequestHandlerClass.state.disagg
+    assert client.snapshot()["peers_backing_off"], client.snapshot()
+    mid = _counters(stack.dec_port)
+    _ask(stack.dec_port, "post-chaos-prefix " * 9, "after")
+    post = _counters(stack.dec_port)
+    assert post.get("disagg_peer_backoff_skips", 0) == mid.get(
+        "disagg_peer_backoff_skips", 0
+    ) + 1
+    assert post.get("disagg_peer_errors", 0) == mid.get("disagg_peer_errors", 0)
+    # clear the window so later tests see a usable peer again
+    client._backoff_until.clear()
+
+
+def test_chaos_peer_down_degrades_without_failing(stack):
+    shared = "down-peer-prefix " * 9
+    before = _counters(stack.dec_port)
+    stack.proxy.down()
+    try:
+        # wait for the listener to actually close
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline:
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", stack.proxy.port), timeout=0.2
+                ).close()
+                _t.sleep(0.02)
+            except OSError:
+                break
+        r = _ask(stack.dec_port, shared, "still answered")
+        assert r["choices"][0]["message"]["content"]
+    finally:
+        stack.proxy.up()
+        stack.dec.RequestHandlerClass.state.disagg._backoff_until.clear()
+    after = _counters(stack.dec_port)
+    assert after.get("disagg_degraded", 0) == before.get("disagg_degraded", 0) + 1
+
+
+def test_prefill_role_rejects_chat(stack):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _ask(stack.pf_port, "x" * 100, "q")
+    assert ei.value.code == 404
+
+
+def test_unified_rejects_prefill_endpoint(stack):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{stack.uni_port}/v1/prefill",
+        data=json.dumps({"ids": list(range(64))}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 404
+
+
+def test_prefill_endpoint_validates_input(stack):
+    for payload in (b"not json", b'{"ids": []}', b'{"ids": [1,2,3]}'):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{stack.pf_port}/v1/prefill",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400, payload
+
+
+def test_prefill_endpoint_ships_spliceable_kv(stack):
+    """Drive /v1/prefill directly and validate the payload against the
+    worker's own model shape (the decode worker's parse path)."""
+    ids = [(i * 7) % 250 + 1 for i in range(130)]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{stack.pf_port}/v1/prefill",
+        data=json.dumps({"ids": ids}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = r.read()
+    hdr, k, v = parse_kv_payload(body)
+    P = prefill_boundary(len(ids), 256)
+    assert hdr["p"] == P == 128
+    assert hdr["tokens"] == ids[:P]
+    # [L, P, h, d] against the tiny model: 2 layers, 2 kv heads, head 16
+    assert k.shape == (2, P, 2, 16) and v.shape == (2, P, 2, 16)
+    assert hdr["prefill_us"] > 0
+
+
+def test_insert_external_refuses_bad_slices(stack):
+    """The decode worker's cache refuses off-bucket or mis-shaped slices
+    (the degradation path, not an exception)."""
+    state = stack.dec.RequestHandlerClass.state
+    pc = state.engine.prefix_cache
+    # off-bucket length (17 is not a prefix bucket)
+    k = np.zeros((2, 17, 2, 16), np.float32)
+    assert not pc.insert_external(state.engine, list(range(17)), k, k)
+    # right length, wrong head_dim
+    k16 = np.zeros((2, 16, 2, 16), np.float32)
+    bad = np.zeros((2, 16, 2, 8), np.float32)
+    assert not pc.insert_external(state.engine, list(range(16)), k16, bad)
+
+
+def test_stats_and_config_surface_roles(stack):
+    assert _stats(stack.dec_port)["role"] == "decode"
+    assert _stats(stack.pf_port)["role"] == "prefill"
+    assert _stats(stack.uni_port)["role"] == "unified"
+    assert _stats(stack.dec_port)["disagg"]["peers"] == [
+        f"127.0.0.1:{stack.proxy.port}"
+    ]
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{stack.dec_port}/debug/config", timeout=30
+    ) as r:
+        cfg = json.loads(r.read())
+    assert cfg["role"] == "decode"
+    assert cfg["disagg"]["peers"]
+    assert cfg["kv"]["layout"] == "contiguous"

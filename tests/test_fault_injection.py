@@ -127,6 +127,10 @@ class Stack:
             # ChaosProxy conn indices and perturb the seeded fault plans
             # (tests/test_fleet.py drives the scraper explicitly)
             fleet_scrape_s=0,
+            # least-inflight only: cache-aware routing would re-order which
+            # backend gets which ChaosProxy conn index and perturb the
+            # seeded fault plans (tests/test_router.py drives the router)
+            router_policy="least_inflight",
             retry_attempts=2,
         )
         defaults.update(cfg_overrides)

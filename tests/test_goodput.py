@@ -44,7 +44,7 @@ def test_aggregator_identity_and_waste_labels():
         (labels["reason"], v) for labels, v in agg.wasted_series()
     )
     assert series == {"overrun": 2, "shed": 7, "stall_retry": 3,
-                      "client_gone": 0, "error": 0}
+                      "client_gone": 0, "error": 0, "transfer_retry": 0}
 
 
 def test_aggregator_window_rate_ages_out():

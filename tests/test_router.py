@@ -1,0 +1,517 @@
+"""Cache-aware routing tests (server/router.py).
+
+Unit layer: the pure scoring function (stale discount, headroom tiebreak,
+affinity dominance), the prefix hash chain, and rendezvous affinity
+stability under replica join/leave — no jax, no sockets.
+
+HTTP layer: a 4-replica fleet behind two gateways (cache-aware vs
+least-inflight twins over the SAME backends) proving shared-prefix traffic
+CONCENTRATES prefix hits on one replica under cache-aware routing (>=2x the
+fleet-wide prefix_hit_tokens of least-inflight on identical traffic) while
+disjoint traffic still spreads — plus the decision counters on the
+gateway's /metrics and the router section of /gateway/fleet."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributed_llama_tpu.server import gateway as gw_mod
+from distributed_llama_tpu.server.gateway import (
+    Backend,
+    Balancer,
+    GatewayConfig,
+    render_gateway_metrics,
+)
+from distributed_llama_tpu.server.router import (
+    REASONS,
+    Router,
+    RouterConfig,
+    chat_prefix_text,
+    fnv1a,
+    prefix_chain,
+    rendezvous_owner,
+    score_backend,
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chat_body(system: str, user: str) -> bytes:
+    return json.dumps(
+        {
+            "messages": [
+                {"role": "system", "content": system},
+                {"role": "user", "content": user},
+            ],
+            "max_tokens": 4,
+        }
+    ).encode()
+
+
+# -- hash chain ---------------------------------------------------------------
+
+
+def test_prefix_chain_shares_prefix_and_diverges():
+    a = prefix_chain("A" * 200 + "tail-one-" * 10)
+    b = prefix_chain("A" * 200 + "tail-two-" * 10)
+    assert len(a) >= 4
+    # the 200 shared chars cover 3 full 64-char blocks: those chain
+    # entries are identical; the 4th block contains the divergence
+    assert a[:3] == b[:3]
+    assert a[3] != b[3]
+
+
+def test_prefix_chain_hashes_only_full_blocks():
+    assert prefix_chain("short") == []
+    one = prefix_chain("x" * 64)
+    assert len(one) == 1
+    # a half-filled tail block must not produce a new chain entry
+    assert prefix_chain("x" * 95) == one
+
+
+def test_prefix_chain_is_deterministic_across_calls():
+    t = "system prompt " * 40
+    assert prefix_chain(t) == prefix_chain(t)
+    assert fnv1a(b"abc") == fnv1a(b"abc")
+
+
+def test_chat_prefix_text_orders_messages_and_rejects_garbage():
+    body = _chat_body("sys", "usr")
+    text = chat_prefix_text(body)
+    assert "sys" in text and "usr" in text
+    assert text.index("sys") < text.index("usr")
+    assert chat_prefix_text(b"not json") is None
+    assert chat_prefix_text(b'{"no_messages": 1}') is None
+
+
+# -- rendezvous affinity stability --------------------------------------------
+
+
+def test_rendezvous_leave_only_remaps_the_left_backends_keys():
+    backends = ["h:1", "h:2", "h:3", "h:4"]
+    keys = [fnv1a(f"prefix-{i}".encode()) for i in range(200)]
+    owners = {k: rendezvous_owner(k, backends) for k in keys}
+    # drop one backend: every key it did NOT own keeps its owner
+    gone = "h:3"
+    remaining = [b for b in backends if b != gone]
+    moved = 0
+    for k in keys:
+        new = rendezvous_owner(k, remaining)
+        if owners[k] == gone:
+            moved += 1
+            assert new != gone
+        else:
+            assert new == owners[k], "a surviving backend's key was remapped"
+    assert moved > 0  # the dropped backend owned something
+
+
+def test_rendezvous_join_remaps_only_what_the_newcomer_wins():
+    backends = ["h:1", "h:2", "h:3"]
+    keys = [fnv1a(f"prefix-{i}".encode()) for i in range(300)]
+    owners = {k: rendezvous_owner(k, backends) for k in keys}
+    grown = backends + ["h:4"]
+    moved = 0
+    for k in keys:
+        new = rendezvous_owner(k, grown)
+        if new != owners[k]:
+            assert new == "h:4", "a join remapped a key the newcomer didn't win"
+            moved += 1
+    # HRW moves ~1/n of the keyspace to the newcomer — not none, not most
+    assert 0 < moved < len(keys) // 2
+
+
+# -- pure scoring -------------------------------------------------------------
+
+
+CFG = RouterConfig()
+
+
+def test_score_stale_discount_zeroes_signal_credit():
+    signals = {
+        "kv_pool_pages_free": 100, "kv_pool_pages_used": 0,
+        "batcher_batch_slots": 4, "batcher_slots_active": 0,
+        "slo_ttft_attainment": 1.0,
+    }
+    fresh = score_backend(False, signals, False, 0, CFG)
+    stale = score_backend(False, signals, True, 0, CFG)
+    assert fresh > stale
+    assert stale == 0.0  # no affinity, no inflight: a stale row scores zero
+
+
+def test_score_headroom_tiebreak():
+    lo = {"kv_pool_pages_free": 10, "kv_pool_pages_used": 90}
+    hi = {"kv_pool_pages_free": 90, "kv_pool_pages_used": 10}
+    assert score_backend(False, hi, False, 0, CFG) > score_backend(
+        False, lo, False, 0, CFG
+    )
+
+
+def test_score_occupancy_and_slo_terms():
+    idle = {"batcher_batch_slots": 4, "batcher_slots_active": 0}
+    busy = {"batcher_batch_slots": 4, "batcher_slots_active": 4}
+    assert score_backend(False, idle, False, 0, CFG) > score_backend(
+        False, busy, False, 0, CFG
+    )
+    good = {"slo_ttft_attainment": 1.0}
+    bad = {"slo_ttft_attainment": 0.2}
+    assert score_backend(False, good, False, 0, CFG) > score_backend(
+        False, bad, False, 0, CFG
+    )
+
+
+def test_score_affinity_beats_fully_idle_stranger():
+    # a known-warm cache must outrank any amount of idle headroom
+    idle = {
+        "kv_pool_pages_free": 100, "kv_pool_pages_used": 0,
+        "batcher_batch_slots": 4, "batcher_slots_active": 0,
+        "slo_ttft_attainment": 1.0,
+    }
+    assert score_backend(True, {}, True, 0, CFG) > score_backend(
+        False, idle, False, 0, CFG
+    )
+
+
+def test_score_inflight_penalty_can_dethrone_affinity():
+    # a swamped affinity replica eventually loses to an idle fresh one
+    idle = {"batcher_batch_slots": 4, "batcher_slots_active": 0}
+    swamped_affinity = score_backend(True, {}, True, 20, CFG)
+    assert score_backend(False, idle, False, 0, CFG) > swamped_affinity
+
+
+# -- plan / resolve -----------------------------------------------------------
+
+
+class _FakeFleet:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def router_signals(self):
+        return self.rows
+
+
+def _balancer(n=3):
+    cfg = GatewayConfig(backends=[Backend("h", i + 1) for i in range(n)])
+    return Balancer(cfg)
+
+
+def test_plan_learns_locality_and_reuses_it():
+    bal = _balancer()
+    r = Router(RouterConfig())
+    bal.router = r
+    body = _chat_body("A" * 300, "q1")
+    plan = r.plan(body, bal)
+    assert plan is not None and len(plan.ranked) == 3
+    chosen = bal.config.backends[plan.ranked[0]].key
+    assert r.resolve(plan, chosen) == "prefix_affinity"
+    r.learn(plan, chosen)  # the gateway learns on request SUCCESS
+    # a second request sharing the prefix (different tail) must rank the
+    # SAME backend first, now from the learned locality map
+    plan2 = r.plan(_chat_body("A" * 300, "another question"), bal)
+    assert bal.config.backends[plan2.ranked[0]].key == chosen
+    assert plan2.affinity_key == chosen
+
+
+def test_failed_attempt_does_not_teach_locality():
+    """resolve() counts; only learn() — called on SUCCESS — writes the
+    locality map. A backend that failed the request zero-byte must not
+    become the prefix's learned home."""
+    bal = _balancer()
+    r = Router(RouterConfig())
+    plan = r.plan(_chat_body("Z" * 300, "q"), bal)
+    dead = next(
+        b.key for b in bal.config.backends if b.key != plan.affinity_key
+    )
+    r.resolve(plan, dead)  # counted...
+    assert len(r._locality) == 0  # ...but not learned
+    r.learn(plan, dead)
+    assert len(r._locality) > 0
+
+
+def test_build_rejects_unknown_policy():
+    assert Router.build("least_inflight") is None
+    assert Router.build("cache_aware") is not None
+    with pytest.raises(ValueError):
+        Router.build("least-inflight")  # the typo'd-knob failure mode
+
+
+def test_chat_prefix_text_survives_non_dict_messages():
+    # JSON-valid garbage shapes must make the router ABSTAIN, never crash
+    # the gateway's connection thread (the backend owns the 400)
+    assert chat_prefix_text(b'{"messages": ["hi"]}') is None
+    assert chat_prefix_text(b'{"messages": [null]}') is None
+    assert chat_prefix_text(b'{"messages": 3}') is None
+
+
+def test_plan_abstains_on_non_chat_and_short_prompts():
+    bal = _balancer()
+    r = Router(RouterConfig())
+    assert r.plan(b"garbage", bal) is None
+    assert r.plan(_chat_body("hi", "lo"), bal) is None  # below one block
+    assert r.resolve(None, "h:1") == "least_inflight"
+    assert r.decisions_snapshot()["least_inflight"] == 1
+
+
+def test_plan_scores_fresh_signals_and_resolve_reasons():
+    bal = _balancer(n=2)
+    keys = [b.key for b in bal.config.backends]
+    rows = {
+        keys[0]: {"stale": False, "age_s": 0.1, "signals": {
+            "kv_pool_pages_free": 90, "kv_pool_pages_used": 10}},
+        keys[1]: {"stale": False, "age_s": 0.1, "signals": {
+            "kv_pool_pages_free": 5, "kv_pool_pages_used": 95}},
+    }
+    bal.fleet = _FakeFleet(rows)
+    r = Router(RouterConfig())
+    plan = r.plan(_chat_body("B" * 300, "q"), bal)
+    assert plan.fresh
+    assert plan.best_signal_key == keys[0]
+    # headroom reason: chosen the top-signal backend that is NOT the
+    # affinity owner
+    other = keys[0] if plan.affinity_key != keys[0] else keys[1]
+    if other == plan.best_signal_key:
+        assert r.resolve(plan, other) == "headroom"
+    assert r.resolve(plan, plan.affinity_key) == "prefix_affinity"
+
+
+def test_resolve_fallback_stale_when_no_fresh_signals():
+    bal = _balancer(n=2)
+    bal.fleet = _FakeFleet({})  # never scraped: all stale
+    r = Router(RouterConfig())
+    plan = r.plan(_chat_body("C" * 300, "q"), bal)
+    assert not plan.fresh
+    not_affinity = next(
+        b.key for b in bal.config.backends if b.key != plan.affinity_key
+    )
+    assert r.resolve(plan, not_affinity) == "fallback_stale"
+
+
+def test_locality_map_is_lru_bounded():
+    bal = _balancer()
+    r = Router(RouterConfig(locality_size=4))
+    for i in range(20):
+        plan = r.plan(_chat_body(f"prefix-{i:04d}-" * 30, "q"), bal)
+        r.learn(plan, bal.config.backends[plan.ranked[0]].key)
+    assert len(r._locality) <= 4
+
+
+def test_select_prefers_ranked_backend_and_falls_back():
+    bal = _balancer(n=3)
+    # preference wins while assignable
+    idx = bal.acquire(prefer=[2, 0, 1])
+    assert idx == 2
+    # saturate backend 2 -> the preference falls through to the next rank
+    for _ in range(bal.config.max_inflight_per_backend - 1):
+        bal.config.backends[2].inflight += 1
+    idx2 = bal.acquire(prefer=[2, 0, 1])
+    assert idx2 == 0
+    bal.release(idx, False)
+    bal.release(idx2, False)
+
+
+def test_metrics_render_all_reasons_zero_valued():
+    bal = _balancer()
+    bal.router = Router(RouterConfig())
+    body = render_gateway_metrics(bal)
+    for reason in REASONS:
+        assert f'dlt_router_decisions_total{{reason="{reason}"}} 0' in body
+
+
+# -- HTTP twins: concentration vs spread --------------------------------------
+
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+@pytest.fixture(scope="module")
+def replica_fleet(tmp_path_factory):
+    """Four tiny live replicas (engine + prefix cache each) — the routing
+    twins run two gateways over the SAME four backends."""
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import (
+        tiny_header, write_tiny_model, write_tiny_tokenizer,
+    )
+    from distributed_llama_tpu.cli import build_arg_parser
+
+    import os
+
+    # four engines in one module: skip the per-engine cost-table AOT build
+    # (profiling coverage has its own suite; this one tests routing)
+    os.environ["DLT_COST_TABLE"] = "0"
+    d = tmp_path_factory.mktemp("fleet")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+        seq_len=256, vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    servers, ports = [], []
+    for i in range(4):
+        p = build_arg_parser()
+        p.add_argument("--port", type=int, default=0)
+        port = free_port()
+        args = p.parse_args(
+            [
+                "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+                "--compute-dtype", "float32", "--temperature", "0.0",
+                "--port", str(port),
+            ]
+        )
+        httpd = api_mod.serve(args)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        ports.append(port)
+    yield ports
+    os.environ.pop("DLT_COST_TABLE", None)
+    for s in servers:
+        s.shutdown()
+
+
+def _gateway(ports, policy):
+    cfg = GatewayConfig(
+        backends=[Backend("127.0.0.1", p) for p in ports],
+        probe_interval_s=0,
+        fleet_scrape_s=0,  # signals stay stale: routing is affinity-driven
+        router_policy=policy,
+    )
+    bal = Balancer(cfg)
+    gw_port = free_port()
+    stop = threading.Event()
+    threading.Thread(
+        target=gw_mod.run, args=(gw_port, bal, stop), daemon=True
+    ).start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", gw_port), timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    return gw_port, bal, stop
+
+
+def _ask(port, system, user):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=_chat_body(system, user),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _fleet_hit_tokens(ports) -> int:
+    total = 0
+    for p in ports:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{p}/health", timeout=30
+        ) as r:
+            total += json.loads(r.read())["counters"].get("prefix_hit_tokens", 0)
+    return total
+
+
+def _per_replica_hits(ports) -> list:
+    out = []
+    for p in ports:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{p}/health", timeout=30
+        ) as r:
+            out.append(json.loads(r.read())["counters"].get("prefix_hits", 0))
+    return out
+
+
+def test_cache_aware_concentrates_2x_over_least_inflight(replica_fleet):
+    """THE routing twin: identical shared-prefix traffic through a
+    least-inflight gateway and a cache-aware gateway over the same four
+    replicas — cache-aware must reuse >= 2x the prefix tokens fleet-wide."""
+    ports = replica_fleet
+    n_req = 6
+    # least-inflight arm first, on prefix A (fresh to every cache)
+    gw_li, _bal_li, stop_li = _gateway(ports, "least_inflight")
+    try:
+        base = _fleet_hit_tokens(ports)
+        for i in range(n_req):
+            _ask(gw_li, "L" * 150, f"question {i}")
+        li_hits = _fleet_hit_tokens(ports) - base
+    finally:
+        stop_li.set()
+    # cache-aware arm, on prefix B (equal length, disjoint from A)
+    gw_ca, bal_ca, stop_ca = _gateway(ports, "cache_aware")
+    try:
+        base = _fleet_hit_tokens(ports)
+        hits_before = _per_replica_hits(ports)
+        for i in range(n_req):
+            _ask(gw_ca, "C" * 150, f"question {i}")
+        ca_hits = _fleet_hit_tokens(ports) - base
+        hits_after = _per_replica_hits(ports)
+        decisions = bal_ca.router.decisions_snapshot()
+    finally:
+        stop_ca.set()
+    assert ca_hits >= 2 * max(li_hits, 1), (ca_hits, li_hits)
+    # concentration: ONE replica took every follow-up hit
+    delta = [a - b for a, b in zip(hits_after, hits_before)]
+    assert max(delta) >= n_req - 1, delta
+    # and the decisions say why: every request after the cold one rode
+    # prefix affinity
+    assert decisions["prefix_affinity"] >= n_req - 1, decisions
+
+
+def test_disjoint_traffic_spreads_and_router_is_observable(replica_fleet):
+    ports = replica_fleet
+    gw_ca, bal_ca, stop_ca = _gateway(ports, "cache_aware")
+    try:
+        served_before = []
+        with bal_ca.lock:
+            served_before = [b.n_served for b in bal_ca.config.backends]
+        for i in range(8):
+            _ask(gw_ca, f"distinct-prefix-{i:02d} " * 7, "q")
+        with bal_ca.lock:
+            served = [
+                b.n_served - s0
+                for b, s0 in zip(bal_ca.config.backends, served_before)
+            ]
+        # 8 disjoint prefixes: rendezvous owners spread them over >= 2
+        # replicas (all-on-one would mean the hash ignored the prefix)
+        assert sum(1 for s in served if s > 0) >= 2, served
+        # decision counters on /metrics
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw_ca}/metrics", timeout=30
+        ) as r:
+            body = r.read().decode()
+        assert "dlt_router_decisions_total" in body
+        total = sum(bal_ca.router.decisions_snapshot().values())
+        assert total >= 8
+        # router section on /gateway/fleet
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw_ca}/gateway/fleet", timeout=30
+        ) as r:
+            fleet = json.loads(r.read())
+        assert fleet["router"]["policy"] == "cache_aware"
+        assert fleet["router"]["locality_entries"] > 0
+        assert sum(fleet["router"]["decisions"].values()) == total
+    finally:
+        stop_ca.set()
+
+
+def test_least_inflight_gateway_has_no_router(replica_fleet):
+    gw_li, bal_li, stop_li = _gateway(replica_fleet, "least_inflight")
+    try:
+        assert bal_li.router is None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw_li}/gateway/fleet", timeout=30
+        ) as r:
+            fleet = json.loads(r.read())
+        assert fleet["router"] is None
+    finally:
+        stop_li.set()
