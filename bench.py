@@ -1082,6 +1082,69 @@ def leg_routing():
     }
 
 
+def leg_loadtwin():
+    """Fleet-control-plane leg (server/loadtwin.py + server/scheduler.py):
+    the ISSUE-12 mixed-class SLO twin. One seeded bursty mixed-class trace
+    (interactive chat bursts + shared-prefix RAG fan-out + agentic tool
+    loops with pauses + long batch jobs + client abandonment) replayed
+    against two identical 3-replica stub fleets behind REAL gateways —
+    SLO classes ON vs stripped-to-standard (the no-class baseline). The
+    bars: interactive-class TTFT p95 holds the 300 ms SLO with classes
+    on, and fleet goodput over a common measurement horizon stays >= 90%
+    of the baseline (preempted batch work is deferred-and-retried, not
+    lost). Engine-free (stub service times), so this leg measures the
+    CONTROL PLANE — scheduling, routing, retry dynamics — not matmuls."""
+    from distributed_llama_tpu.server.loadtwin import (
+        LoadTwin, StubReplicaConfig, make_mixed_trace,
+    )
+
+    SLO_MS = 300.0
+    HORIZON_S = 4.5
+    cfg = StubReplicaConfig(batch_slots=2, token_ms=3.0, slo_ttft_ms=SLO_MS)
+    trace = make_mixed_trace(seed=11, scale=1.5, duration_s=2.0)
+    reports = {}
+    decisions = {}
+    for enabled in (True, False):
+        tw = LoadTwin(
+            n_replicas=3, replica_cfg=cfg, classes_enabled=enabled,
+            fleet_scrape_s=0.1,
+        )
+        try:
+            reports[enabled] = tw.report(tw.run(trace), horizon_s=HORIZON_S)
+            if enabled:
+                decisions = {
+                    k: v
+                    for r in tw.replicas
+                    for k, v in r.state.scheduler.decisions_snapshot().items()
+                    if ":" in k and not k.endswith(":admit")
+                }
+        finally:
+            tw.close()
+    cls, noc = reports[True], reports[False]
+    assert cls["failures"] == 0 and noc["failures"] == 0, (cls, noc)
+    retention = 100.0 * cls["goodput_tokens_per_s"] / max(
+        noc["goodput_tokens_per_s"], 1e-9
+    )
+    return {
+        "config": "load-twin 3-replica mixed-class slo",
+        "interactive_ttft_p95_ms": cls["classes"]["interactive"]["ttft_p95_ms"],
+        "interactive_ttft_p95_ms_noclass": (
+            noc["classes"]["interactive"]["ttft_p95_ms"]
+        ),
+        "interactive_ttft_p50_ms": cls["classes"]["interactive"]["ttft_p50_ms"],
+        "slo_ttft_ms_target": SLO_MS,
+        "fleet_goodput_tokens_per_s": cls["goodput_tokens_per_s"],
+        "fleet_goodput_tokens_per_s_noclass": noc["goodput_tokens_per_s"],
+        "goodput_retention_pct": round(retention, 1),
+        "retention_bar_pct": 90.0,
+        "makespan_s": cls["makespan_s"],
+        "makespan_s_noclass": noc["makespan_s"],
+        "delivered_tokens": cls["delivered_tokens"],
+        "scheduler_decisions": decisions,
+        "fleet_prefix_hit_tokens": cls["fleet_prefix_hit_tokens"],
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -1267,6 +1330,13 @@ def main():
         print(f"# routing: {rt}", file=sys.stderr)
     except Exception as e:
         print(f"# routing leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        lt = leg_loadtwin()
+        configs.append(lt)
+        print(f"# load-twin: {lt}", file=sys.stderr)
+    except Exception as e:
+        print(f"# load-twin leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

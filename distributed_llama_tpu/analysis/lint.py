@@ -72,8 +72,11 @@ FLOAT64_SCOPE = ("ops", "models", "parallel", "runtime", "formats")
 HOST_SYNC_SCOPE = ("runtime", "parallel")
 #: packages whose loops must emit spans through pre-bound emitters: the
 #: hot packages PLUS the server (Batcher step loop, gateway retry loop,
-#: router decision path (server/router.py), disagg transfer path — the
-#: goodput-ledger/batch-timeline/gw_route/kv_transfer emission sites)
+#: router decision path (server/router.py), disagg transfer path, and the
+#: fleet control plane — scheduler admission/preemption loops
+#: (server/scheduler.py), autoscaler ticks (server/autoscaler.py), the
+#: load twin's stub decode loop (server/loadtwin.py) — the goodput-ledger
+#: /batch-timeline/gw_route/kv_transfer/scheduler-decision emission sites)
 TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 
 

@@ -298,11 +298,19 @@ class StepStats:
 #: * ``transfer_retry`` — prompt tokens a dead/failed disaggregated KV
 #:                     transfer (server/disagg.py) forced the decode worker
 #:                     to re-prefill locally (the prefill worker's compute
-#:                     for them is lost fleet-wide).
+#:                     for them is lost fleet-wide);
+#: * ``preempt``     — decoded for a lower-SLO-class row the scheduler
+#:                     evicted so a waiting higher-class request could take
+#:                     its slot (server/scheduler.py).
 WASTE_REASONS = (
     "overrun", "shed", "stall_retry", "client_gone", "error",
-    "transfer_retry",
+    "transfer_retry", "preempt",
 )
+
+#: the SLO classes goodput breaks down by (server/scheduler.py is the
+#: policy owner; this copy keeps telemetry jax-light and import-cycle-free
+#: — a mismatch is pinned by tests)
+SLO_CLASSES = ("interactive", "standard", "batch")
 
 #: GoodputLedger fields attached to the request trace (one cold `ledger`
 #: event per request) and returned in the `usage` extension — one list so
@@ -343,18 +351,23 @@ class GoodputLedger:
     discarded_tokens: int = 0    # decoded but never delivered
     retries: int = 0             # in-place stall retries this request took
     outcome: str = "ok"          # ok | shed | error | client_gone
+    slo_class: str = "standard"  # interactive | standard | batch
+    # (server/scheduler.py): labels the per-class goodput breakdown
 
     def as_dict(self) -> dict:
         out = {f: getattr(self, f) for f in LEDGER_FIELDS}
         out["outcome"] = self.outcome
+        out["slo_class"] = self.slo_class
         return out
 
     def trace_vals(self) -> tuple:
-        return tuple(getattr(self, f) for f in LEDGER_FIELDS) + (self.outcome,)
+        return tuple(getattr(self, f) for f in LEDGER_FIELDS) + (
+            self.outcome, self.slo_class,
+        )
 
 
 #: trace-event keys for the per-request `ledger` event (pairs trace_vals)
-LEDGER_TRACE_KEYS = LEDGER_FIELDS + ("outcome",)
+LEDGER_TRACE_KEYS = LEDGER_FIELDS + ("outcome", "slo_class")
 
 
 class GoodputAggregator:
@@ -374,7 +387,13 @@ class GoodputAggregator:
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
         self.wasted: dict[str, int] = {}     # reason -> tokens
-        self._window: list = []              # (t_monotonic, delivered) pairs
+        # per-SLO-class breakdowns (server/scheduler.py): delivered/request
+        # totals and (reason, class)-keyed waste — the slo_class-labeled
+        # series on /metrics and the by_class section of /stats goodput
+        self.delivered_by_class: dict[str, int] = {}
+        self.requests_by_class: dict[str, int] = {}
+        self.wasted_by_class: dict[tuple, int] = {}
+        self._window: list = []              # (t, delivered, slo_class)
 
     def record(
         self,
@@ -390,31 +409,46 @@ class GoodputAggregator:
         the request itself is counted once, by its final attempt."""
         if waste_reason is None:
             waste_reason = "overrun" if ledger.outcome == "ok" else ledger.outcome
+        klass = ledger.slo_class if ledger.slo_class in SLO_CLASSES else "standard"
         now = time.monotonic()
         with self._lock:
             if count_request:
                 self.requests[ledger.outcome] = (
                     self.requests.get(ledger.outcome, 0) + 1
                 )
+                self.requests_by_class[klass] = (
+                    self.requests_by_class.get(klass, 0) + 1
+                )
             self.delivered_tokens += ledger.generated_tokens
+            self.delivered_by_class[klass] = (
+                self.delivered_by_class.get(klass, 0) + ledger.generated_tokens
+            )
             self.prompt_tokens += ledger.prompt_tokens
             self.prefix_hit_tokens += ledger.prefix_hit_tokens
             if ledger.discarded_tokens:
                 self.wasted[waste_reason] = (
                     self.wasted.get(waste_reason, 0) + ledger.discarded_tokens
                 )
-            self._window.append((now, ledger.generated_tokens))
+                self.wasted_by_class[(waste_reason, klass)] = (
+                    self.wasted_by_class.get((waste_reason, klass), 0)
+                    + ledger.discarded_tokens
+                )
+            self._window.append((now, ledger.generated_tokens, klass))
             self._trim_locked(now)
 
-    def add_waste(self, reason: str, tokens: int):
+    def add_waste(self, reason: str, tokens: int, slo_class: str = "standard"):
         """Count waste OUTSIDE any request ledger — tokens whose compute is
         lost without a failed request to pin them on (a degraded KV
         transfer's re-prefill: the REQUEST succeeds, the prefill worker's
         compute for those tokens is what was wasted)."""
         if tokens <= 0:
             return
+        klass = slo_class if slo_class in SLO_CLASSES else "standard"
         with self._lock:
             self.wasted[reason] = self.wasted.get(reason, 0) + tokens
+            self.wasted_by_class[(reason, klass)] = (
+                self.wasted_by_class.get((reason, klass), 0) + tokens
+            )
 
     def _trim_locked(self, now: float):
         cutoff = now - self.window_s
@@ -438,16 +472,78 @@ class GoodputAggregator:
             if not self._window:
                 return 0.0
             span = max(now - self._window[0][0], 1.0)
-            total = sum(n for _, n in self._window)
+            total = sum(n for _, n, _ in self._window)
         return round(total / span, 3)
+
+    def goodput_series(self) -> list:
+        """``[(labels, value), ...]`` for the ``dlt_goodput_tokens_per_s``
+        gauge family: the unlabeled fleet-facing total (the signal the
+        router/fleet table scores — unchanged shape) PLUS one
+        ``slo_class``-labeled row per class over the same recent window,
+        zero-valued classes included."""
+        now = time.monotonic()
+        with self._lock:
+            self._trim_locked(now)
+            window = list(self._window)
+        if not window:
+            return [({}, 0.0)] + [({"slo_class": c}, 0.0) for c in SLO_CLASSES]
+        span = max(now - window[0][0], 1.0)
+        per_class = {c: 0 for c in SLO_CLASSES}
+        total = 0
+        for _, n, klass in window:
+            total += n
+            per_class[klass] = per_class.get(klass, 0) + n
+        return [({}, round(total / span, 3))] + [
+            ({"slo_class": c}, round(per_class[c] / span, 3))
+            for c in SLO_CLASSES
+        ]
 
     def wasted_series(self) -> list:
         """``[(labels, value), ...]`` for the labeled counter family —
         every known reason present (zero-valued reasons included, so
-        dashboards never see a series appear from nowhere mid-incident)."""
+        dashboards never see a series appear from nowhere mid-incident).
+        These reason-only rows are the TOTALS; ``wasted_by_class_series``
+        adds the per-class breakdown rows of the same family."""
         with self._lock:
             wasted = dict(self.wasted)
         return [({"reason": r}, wasted.get(r, 0)) for r in WASTE_REASONS]
+
+    def wasted_by_class_series(self) -> list:
+        """The ``{reason, slo_class}``-labeled breakdown rows of
+        ``dlt_wasted_tokens_total``. Only (reason, class) pairs that have
+        actually wasted tokens render — the zero-fill contract is carried
+        by the reason-only totals; 21 always-zero breakdown rows would be
+        noise. Summing the whole family double-counts: the reason-only
+        rows are totals, the labeled rows their decomposition."""
+        with self._lock:
+            by_class = dict(self.wasted_by_class)
+        return [
+            ({"reason": r, "slo_class": c}, v)
+            for (r, c), v in sorted(by_class.items())
+        ]
+
+    def by_class_snapshot(self) -> dict:
+        """Per-SLO-class goodput view (the ``by_class`` section of the
+        ``/stats`` goodput payload and ``/gateway/fleet`` rows)."""
+        rates = {
+            lab["slo_class"]: v
+            for lab, v in self.goodput_series()
+            if "slo_class" in lab
+        }
+        with self._lock:
+            out = {}
+            for c in SLO_CLASSES:
+                wasted = {
+                    r: v for (r, cc), v in self.wasted_by_class.items()
+                    if cc == c
+                }
+                out[c] = {
+                    "requests": self.requests_by_class.get(c, 0),
+                    "delivered_tokens": self.delivered_by_class.get(c, 0),
+                    "wasted_tokens": wasted,
+                    "goodput_tokens_per_s": rates.get(c, 0.0),
+                }
+        return out
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -461,6 +557,7 @@ class GoodputAggregator:
                 "wasted_tokens_sum": sum(self.wasted.values()),
             }
         out["goodput_tokens_per_s"] = self.goodput_tokens_per_s()
+        out["by_class"] = self.by_class_snapshot()
         return out
 
 

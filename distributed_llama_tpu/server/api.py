@@ -56,6 +56,13 @@ from ..runtime.tracing import (
     trace_payload,
 )
 from . import parse_query
+from .scheduler import (
+    DEFAULT_CLASS,
+    HotPrefixTracker,
+    SLO_CLASS_HEADER,
+    SloScheduler,
+    resolve_slo_class,
+)
 from ..tokenizer import (
     ChatItem,
     ChatTemplateGenerator,
@@ -168,7 +175,7 @@ class _BatchReq:
     EMIT_DEPTH = 8192
 
     def __init__(self, ids, max_new, temperature, topp, seed, on_token,
-                 eos_ids=frozenset(), trace=None):
+                 eos_ids=frozenset(), trace=None, slo_class=DEFAULT_CLASS):
         import queue
 
         self.ids = ids
@@ -177,10 +184,17 @@ class _BatchReq:
         self.topp = topp
         self.seed = seed
         self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        # SLO class (server/scheduler.py): admission priority, shed/preempt
+        # eligibility, and the per-class goodput label
+        self.slo_class = resolve_slo_class(slo_class)
+        self.preempted = False  # set by the loop's preemption decision so
+        # the retirement ledger can label the waste "preempt", not "shed"
         # per-request goodput ledger (runtime/telemetry.py): the Batcher
         # loop accumulates walls/tokens into it; complete_batched finalizes
         # and folds it into the process aggregate at retirement
-        self.ledger = GoodputLedger(prompt_tokens=len(ids))
+        self.ledger = GoodputLedger(
+            prompt_tokens=len(ids), slo_class=self.slo_class
+        )
         # request-lifecycle tracing (runtime/tracing.py): the Batcher loop
         # emits this request's queue-wait/decode/spec spans through the
         # pre-bound emitters (one tuple append per chunk; None = untraced
@@ -259,6 +273,19 @@ class Batcher:
         # slot, a newcomer is turned away with 503 + Retry-After instead of
         # joining a backlog it would likely rot in (see ApiState shedding)
         self.max_backlog = max_backlog if max_backlog is not None else 8 * engine.batch
+        # SLO-class scheduling policy (server/scheduler.py): per-class
+        # admission quotas, queue priorities, shed-victim/preemption
+        # selection, and the (class, action) decision counters /metrics
+        # exports as dlt_scheduler_decisions_total
+        self.scheduler = SloScheduler()
+        # per-class count of submissions still sitting in self.q (accepted
+        # but not yet drained into the class backlog by the loop): the
+        # quota check must see them, or a burst landing mid-chunk would
+        # bypass its class's share entirely and shed-starve the others
+        from .scheduler import SLO_CLASSES as _classes
+
+        self._pending_by_class = {c: 0 for c in _classes}
+        self._pending_lock = threading.Lock()
         self.q: "queue.Queue[_BatchReq]" = queue.Queue()
         # batch-composition timeline (runtime/tracing.py): one sampled
         # snapshot of slot state per step into the bounded TraceRing —
@@ -294,7 +321,10 @@ class Batcher:
         self._thread.start()
 
     def stats(self) -> dict:
+        from .scheduler import SLO_CLASSES
+
         slots = list(self.slots)
+        backlog = self.backlog
         return {
             "batch_slots": len(slots),
             "slots_active": sum(1 for s in slots if s is not None),
@@ -302,6 +332,12 @@ class Batcher:
                 1 for s in slots if s is not None and s.prefilling
             ),
             "queue_depth": self.queue_depth(),
+            # per-class backlog occupancy (server/scheduler.py ClassQueues;
+            # zeros before the loop's first iteration builds the queues)
+            "queue_depths": (
+                backlog.depths() if backlog is not None
+                else {c: 0 for c in SLO_CLASSES}
+            ),
             "max_backlog": self.max_backlog,
             "chunk_size": self.chunk,
             "prefill_budget": self.prefill_budget,
@@ -312,6 +348,54 @@ class Batcher:
 
     def overloaded(self) -> bool:
         return self.queue_depth() >= self.max_backlog
+
+    def admission_blocked(self, klass: str) -> bool:
+        """Class-aware shed decision: the total-backlog cap (`overloaded`,
+        kept as its own method — tests and operators override it) OR the
+        class's own quota share of the backlog (server/scheduler.py) —
+        a batch flood must shed against its quota while interactive
+        admissions still sail through. Read-only; the serving path uses
+        :meth:`try_reserve`, whose check-and-increment is ONE lock hold
+        (a concurrent burst must not all pass the check before any member
+        is counted)."""
+        if self.overloaded():
+            return True
+        backlog = self.backlog
+        if backlog is None:
+            return False
+        with self._pending_lock:
+            pending = self._pending_by_class.get(
+                resolve_slo_class(klass), 0
+            )
+        return not self.scheduler.admission_allowed(
+            klass, backlog, self.max_backlog, extra_depth=pending
+        )
+
+    def try_reserve(self, klass: str) -> bool:
+        """Atomically admit-or-shed one ``klass`` request: the quota check
+        and the pending-count increment happen under ONE lock hold, so N
+        concurrent submissions consume N quota slots — never all passing a
+        stale zero first. The reservation is consumed when the loop drains
+        the submitted request (``_drained``); a caller that fails before
+        handing the request to :meth:`submit` must
+        :meth:`release_reservation`."""
+        klass = resolve_slo_class(klass)
+        if self.overloaded():
+            return False
+        backlog = self.backlog
+        with self._pending_lock:
+            pending = self._pending_by_class.get(klass, 0)
+            if backlog is not None and not self.scheduler.admission_allowed(
+                klass, backlog, self.max_backlog, extra_depth=pending
+            ):
+                return False
+            self._pending_by_class[klass] = pending + 1
+        return True
+
+    def release_reservation(self, klass: str):
+        with self._pending_lock:
+            n = self._pending_by_class.get(resolve_slo_class(klass), 0)
+            self._pending_by_class[resolve_slo_class(klass)] = max(n - 1, 0)
 
     def submit(self, req: _BatchReq):
         """Enqueue and then act as the request's emit-queue writer: client
@@ -424,33 +508,53 @@ class Batcher:
             self.queue_depth(),
         )
 
+    def _drained(self, req: _BatchReq):
+        """One request moved from self.q into the class backlog: its
+        quota accounting moves with it (the backlog's own depth counts it
+        from here on)."""
+        with self._pending_lock:
+            n = self._pending_by_class.get(req.slo_class, 0)
+            self._pending_by_class[req.slo_class] = max(n - 1, 0)
+
     def _loop(self):
         import queue
 
         from ..runtime.batch_session import BatchSession
         from ..runtime.paged_kv import PagePoolExhausted
 
-        import collections
+        from .scheduler import ClassQueues
 
         engine = self.state.engine
         session = BatchSession(engine)
         slots = self.slots
-        backlog: "collections.deque[_BatchReq]" = collections.deque()
+        # class-priority backlog (server/scheduler.py): interactive drains
+        # before standard drains before batch; within a class, FIFO — the
+        # pre-SLO-class all-standard behavior is byte-identical
+        backlog = ClassQueues()
         self.backlog = backlog
         ramped_last = False
+        preempted_last = False  # one preemption per chunk boundary: reset
+        # only after a decode chunk actually ran, so a backlog of waiters
+        # cannot cascade-evict every lower-class row with zero decode
+        # steps between (the twin's one-outstanding-preemption rule)
 
         while True:
-            # drain the queue into the FIFO backlog; block only when fully
+            # drain the queue into the class backlog; block only when fully
             # idle (no active slots and nothing waiting)
             idle = all(s is None for s in slots)
             if idle and not backlog:
-                backlog.append(self.q.get())
+                req = self.q.get()
+                self._drained(req)
+                backlog.append(req, req.slo_class)
             while True:
                 try:
-                    backlog.append(self.q.get_nowait())
+                    req = self.q.get_nowait()
                 except queue.Empty:
                     break
-            # admit in arrival order into free slots at this chunk boundary.
+                self._drained(req)
+                backlog.append(req, req.slo_class)
+            # admit in class-priority order into free slots at this chunk
+            # boundary (within a class: arrival order).
             # Admission only STAGES the prompt (begin_admit): the prefill
             # itself advances in bounded chunks interleaved between decode
             # steps below, so a long newcomer prompt no longer stalls every
@@ -478,9 +582,45 @@ class Batcher:
                     req.ledger.prefix_hit_tokens = session.pending_resume(row)
                     req.prefilling = True
                     slots[row] = req
+                    self.scheduler.record(req.slo_class, "admit")
                 except Exception as e:
                     req.error = e
                     req.done.set()
+
+            # class preemption (server/scheduler.py): with every slot held
+            # and a higher-class request waiting, evict the lowest-class
+            # least-progress decoding row (strictly below the waiter's
+            # class — standard never preempts standard) so the waiter is
+            # admitted at the NEXT boundary instead of after a batch
+            # co-tenant's whole budget. At most one preemption per chunk
+            # boundary (`preempted_last` holds until a decode chunk runs);
+            # the victim gets the standard 503 + Retry-After.
+            if backlog and not preempted_last and all(
+                s is not None for s in slots
+            ):
+                victim = self.scheduler.preempt_victim(
+                    backlog.peek_class(),
+                    [
+                        (r, s.slo_class, s.n)
+                        for r, s in enumerate(slots)
+                        if s is not None and not s.prefilling
+                    ],
+                )
+                if victim is not None:
+                    preempted_last = True
+                    vreq = slots[victim]
+                    vreq.preempted = True
+                    vreq.error = vreq.error or Overloaded(retry_after_s=1)
+                    self.scheduler.record(vreq.slo_class, "preempt")
+                    # timeline mark: once per preemption decision, cold path
+                    TRACER.event(  # dlt: allow(trace-hot-emit)
+                        "batch_shed", now_us(), 0,
+                        ("row", "reason", "slo_class"),
+                        (victim, "preempt", vreq.slo_class),
+                    )
+                    self._finish(vreq, session, slots, victim)
+                    continue  # re-run admission: the freed slot goes to
+                    # the waiting higher-class request immediately
 
             if all(s is None for s in slots):
                 continue
@@ -534,10 +674,12 @@ class Batcher:
                         if r != row
                     ):
                         engine.stats.incr("kv_pool_shed_503")
+                        self.scheduler.record(req.slo_class, "shed_pool")
                         # timeline mark: once per shed decision, cold path
                         TRACER.event(  # dlt: allow(trace-hot-emit)
                             "batch_shed", now_us(), 0,
-                            ("row", "reason"), (row, "pool_admission"),
+                            ("row", "reason", "slo_class"),
+                            (row, "pool_admission", req.slo_class),
                         )
                         req.error = Overloaded(retry_after_s=2)
                         self._finish(req, session, slots, row)
@@ -550,11 +692,12 @@ class Batcher:
                     # nobody freed). With co-tenants but none decoding,
                     # yield briefly so the retry loop doesn't spin hot.
                     engine.stats.incr("kv_pool_admission_parked")
+                    self.scheduler.record(req.slo_class, "park")
                     # timeline mark: once per parked boundary, cold path
                     TRACER.event(  # dlt: allow(trace-hot-emit)
                         "batch_park", now_us(), 0,
-                        ("row", "pool_pages_used"),
-                        (row, engine.page_pool.used_pages),
+                        ("row", "pool_pages_used", "slo_class"),
+                        (row, engine.page_pool.used_pages, req.slo_class),
                     )
                     remaining = None
                     if not decode_rows:
@@ -667,19 +810,23 @@ class Batcher:
                     }
             except PagePoolExhausted:
                 # paged KV pool out of pages mid-decode (co-tenants grew
-                # into the budget together): SHED the decode row with the
-                # least progress — its pages free immediately, everyone
-                # else keeps decoding. The shed client gets the standard
-                # 503 + Retry-After, not an engine error.
-                victim = min(
-                    decode_rows, key=lambda r: (slots[r].n, -r)
+                # into the budget together): SHED the lowest-SLO-class
+                # least-progress decode row (server/scheduler.py — the
+                # "whom" the ROADMAP item asked for; all-standard traffic
+                # reduces to the old least-progress pick) — its pages free
+                # immediately, everyone else keeps decoding. The shed
+                # client gets the standard 503 + Retry-After.
+                victim = self.scheduler.shed_victim(
+                    [(r, slots[r].slo_class, slots[r].n) for r in decode_rows]
                 )
                 vreq = slots[victim]
                 vreq.error = vreq.error or Overloaded(retry_after_s=1)
+                self.scheduler.record(vreq.slo_class, "shed_pool")
                 # timeline mark: once per shed decision, cold path
                 TRACER.event(  # dlt: allow(trace-hot-emit)
                     "batch_shed", now_us(), 0,
-                    ("row", "reason"), (victim, "pool_decode"),
+                    ("row", "reason", "slo_class"),
+                    (victim, "pool_decode", vreq.slo_class),
                 )
                 self._finish(vreq, session, slots, victim)
                 engine.stats.incr("kv_pool_shed_503")
@@ -695,6 +842,8 @@ class Batcher:
                 session = BatchSession(engine)
                 continue
             chunk_dur_us = int((time.perf_counter() - t_chunk) * 1e6)
+            preempted_last = False  # a decode chunk ran: the next boundary
+            # may preempt again if a higher-class waiter is still parked
             t_chunk_us = to_us(t_chunk)
             self._timeline_step(
                 engine, slots, len(decode_rows), t_chunk_us, chunk_dur_us,
@@ -756,8 +905,14 @@ class ApiState:
         # per-request goodput rollup (runtime/telemetry.py): every
         # completed, shed, or retried request folds its ledger in —
         # /metrics serves dlt_goodput_tokens_per_s +
-        # dlt_wasted_tokens_total{reason=...} from here
+        # dlt_wasted_tokens_total{reason=...} from here (both broken down
+        # by slo_class, server/scheduler.py)
         self.goodput = GoodputAggregator()
+        # warm-drain-handoff tracker (server/scheduler.py): per-request
+        # router-compatible prefix chain keys with hit counts, served at
+        # GET /debug/hot_prefixes so the gateway's autoscaler can re-home
+        # affinity BEFORE draining this replica
+        self.hot_prefixes = HotPrefixTracker()
         # serialized path's in-flight ledger (complete/_complete_once talk
         # through it; the serialized path runs under self.lock)
         self._inflight_ledger: GoodputLedger | None = None
@@ -851,29 +1006,46 @@ class ApiState:
         max_tokens = params.get("max_tokens", -1)
         budget = max_tokens if max_tokens and max_tokens > 0 else seq_len
         budget = max(1, min(budget, seq_len - len(ids)))
-        # load shedding: past the backlog cap a request would sit in a queue
-        # it will likely rot in — fail fast with 503 + Retry-After (roughly
-        # one chunk's worth of drain time) instead of burning the client's
-        # patience and a slot's worth of queue memory
-        if self.batcher.overloaded():
+        klass = resolve_slo_class(params.get("slo_class"))
+        # load shedding: past the backlog cap — or past this CLASS's quota
+        # share of it (server/scheduler.py) — a request would sit in a
+        # queue it will likely rot in: fail fast with 503 + Retry-After
+        # (roughly one chunk's worth of drain time) instead of burning the
+        # client's patience and a slot's worth of queue memory. The check
+        # RESERVES a quota slot atomically (a concurrent burst must not
+        # all pass a stale zero); the reservation transfers to the Batcher
+        # at submit and is released on any failure before that.
+        if not self.batcher.try_reserve(klass):
             self.engine.stats.incr("shed_503")
+            self.batcher.scheduler.record(klass, "shed_backlog")
             # shed requests land in the goodput ledger too (zero tokens
             # moved, but the shed storm must be visible as an outcome)
             self._record_ledger(
-                GoodputLedger(prompt_tokens=len(ids), outcome="shed"), trace
+                GoodputLedger(
+                    prompt_tokens=len(ids), outcome="shed", slo_class=klass
+                ),
+                trace,
             )
             raise Overloaded(retry_after_s=1)
-        # disaggregated prefill (server/disagg.py): land the prompt's
-        # leading-bucket KV in the prefix cache BEFORE admission, so
-        # begin_admit's ordinary match/splice picks it up. Runs after the
-        # shed check (never burn a prefill worker on a shed request);
-        # degrades to local prefill on any failure — zeros ride the ledger.
-        disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
+        try:
+            # disaggregated prefill (server/disagg.py): land the prompt's
+            # leading-bucket KV in the prefix cache BEFORE admission, so
+            # begin_admit's ordinary match/splice picks it up. Runs after
+            # the shed check (never burn a prefill worker on a shed
+            # request); degrades to local prefill on any failure — zeros
+            # ride the ledger.
+            disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
 
-        base = []
-        if prompt.public_prompt:
-            emit(prompt.public_prompt)
-            base.append(prompt.public_prompt)
+            base = []
+            if prompt.public_prompt:
+                emit(prompt.public_prompt)
+                base.append(prompt.public_prompt)
+        except BaseException:
+            # the reservation never reached submit (e.g. the client died
+            # on the public-prompt emit): release it, or the class's
+            # quota leaks one slot per failed pre-admission step
+            self.batcher.release_reservation(klass)
+            raise
 
         req_box = []
         deltas_box = []
@@ -919,6 +1091,7 @@ class ApiState:
                 on_token,
                 eos_ids=frozenset(tok.eos_token_ids),
                 trace=trace,
+                slo_class=klass,
             )
             req_box[:] = [req]
             return req
@@ -935,7 +1108,14 @@ class ApiState:
             return led
 
         for attempt in range(2):
-            req = make_req()
+            try:
+                req = make_req()
+            except BaseException:
+                if attempt == 0:  # submit never ran: the reservation is
+                    # still ours to give back (attempt 1's was already
+                    # consumed by the first attempt's drain)
+                    self.batcher.release_reservation(klass)
+                raise
             req.ledger.retries = attempt
             if disagg_walls is not None:
                 req.ledger.remote_prefill_us = disagg_walls["remote_prefill_us"]
@@ -964,9 +1144,15 @@ class ApiState:
                 self._record_ledger(fail_ledger(req, "error"), trace)
                 raise
             except Overloaded:
-                # pool-pressure shed mid-flight (the Batcher picked this
-                # row as the victim) — distinct from the backlog shed above
-                self._record_ledger(fail_ledger(req, "shed"), trace)
+                # pool-pressure shed or class preemption mid-flight (the
+                # Batcher picked this row as the victim) — distinct from
+                # the backlog shed above; a preempted row's decoded tokens
+                # are labeled "preempt" waste so the scheduler's cost is
+                # its own goodput line
+                self._record_ledger(
+                    fail_ledger(req, "shed"), trace,
+                    waste_reason="preempt" if req.preempted else None,
+                )
                 raise
             except ClientDisconnected:
                 self._record_ledger(fail_ledger(req, "client_gone"), trace)
@@ -1111,7 +1297,8 @@ class ApiState:
         # instance (serialized path runs under self.lock) so `complete` can
         # finalize it if this attempt dies mid-generate
         led = GoodputLedger(
-            prompt_tokens=len(ids), retries=1 if retried else 0
+            prompt_tokens=len(ids), retries=1 if retried else 0,
+            slo_class=resolve_slo_class(params.get("slo_class")),
         )
         if disagg_walls is not None:
             led.remote_prefill_us = disagg_walls["remote_prefill_us"]
@@ -1265,6 +1452,7 @@ def resolved_config(state: "ApiState") -> dict:
             "prefill_budget": batcher.prefill_budget,
             "max_backlog": batcher.max_backlog,
             "timeline_sample": batcher.timeline_sample,
+            "scheduler": batcher.scheduler.config.snapshot(),
         },
         "role": state.role,
         "disagg": None if state.disagg is None else state.disagg.snapshot(),
@@ -1306,7 +1494,8 @@ class Handler(BaseHTTPRequestHandler):
             extra = {}
             if st.batcher is not None:
                 for k, v in st.batcher.stats().items():
-                    extra[f"batcher_{k}"] = v
+                    if isinstance(v, (int, float)):  # queue_depths is the
+                        extra[f"batcher_{k}"] = v    # /stats-only dict view
             pc = st.engine.prefix_cache
             if pc is not None:
                 snap = pc.stats_snapshot()
@@ -1318,12 +1507,25 @@ class Handler(BaseHTTPRequestHandler):
             prof_gauges, prof_series = metrics_view(st.engine)
             extra.update(prof_gauges)
             # goodput ledger rollup (runtime/telemetry.py): delivered-token
-            # rate + per-reason waste counters — the federation scraper
-            # (server/fleet.py) lifts these into the per-replica table
-            extra["goodput_tokens_per_s"] = st.goodput.goodput_tokens_per_s()
+            # rate (unlabeled total + slo_class breakdown, one gauge
+            # family) + per-reason waste counters (reason totals + the
+            # {reason, slo_class} breakdown rows) — the federation scraper
+            # (server/fleet.py) lifts both into the per-replica table
+            series = dict(prof_series)
+            series["goodput_tokens_per_s"] = st.goodput.goodput_series()
+            counter_series = {
+                "wasted_tokens": st.goodput.wasted_series()
+                + st.goodput.wasted_by_class_series(),
+            }
+            if st.batcher is not None:
+                # scheduler decisions by (class, action) — zero-filled so
+                # the preemption dashboard exists before the first incident
+                counter_series["scheduler_decisions"] = (
+                    st.batcher.scheduler.decisions_series()
+                )
             body = render_step_stats(
-                st.engine.stats, extra_gauges=extra, extra_series=prof_series,
-                extra_counter_series={"wasted_tokens": st.goodput.wasted_series()},
+                st.engine.stats, extra_gauges=extra, extra_series=series,
+                extra_counter_series=counter_series,
             )
             self._respond(200, body.encode(), ctype=PROM_CONTENT_TYPE)
             return
@@ -1378,6 +1580,22 @@ class Handler(BaseHTTPRequestHandler):
             # view of admission stalls, park livelocks, and pool thrash
             events = TRACER.for_names(BATCH_TIMELINE_NAMES)
             self._json(200, json.dumps(batch_timeline_payload(events)).encode())
+            return
+        if route == "/debug/hot_prefixes":
+            # warm drain handoff (server/scheduler.py HotPrefixTracker +
+            # server/autoscaler.py): this replica's hottest router chain
+            # keys — the gateway fetches this snapshot before draining the
+            # replica and re-homes the listed chains' affinity so
+            # shared-prefix traffic re-concentrates instead of spraying
+            from .router import PAGE_CHARS
+
+            try:
+                top_n = int(self._query_params().get("n", "64"))
+            except ValueError:
+                top_n = 64
+            snap = self.state.hot_prefixes.snapshot(top_n=max(1, top_n))
+            snap["block_chars"] = PAGE_CHARS
+            self._json(200, json.dumps(snap).encode())
             return
         if route == "/debug/config":
             self._json(200, json.dumps(resolved_config(self.state)).encode())
@@ -1441,8 +1659,16 @@ class Handler(BaseHTTPRequestHandler):
                     else None
                 ),
                 # per-request goodput rollup: outcomes, delivered vs wasted
-                # tokens (by reason), recent-window delivered-token rate
+                # tokens (by reason), recent-window delivered-token rate —
+                # incl. the by_class breakdown (server/scheduler.py)
                 "goodput": st.goodput.snapshot(),
+                # SLO-class scheduler policy + (class, action) decision
+                # counts (server/scheduler.py; None on serialized servers)
+                "scheduler": (
+                    st.batcher.scheduler.snapshot()
+                    if st.batcher is not None
+                    else None
+                ),
                 # disaggregated serving (server/disagg.py): this replica's
                 # role and, on decode workers, the prefill-peer view — the
                 # disagg_* counters ride steps.counters like every other
@@ -1482,6 +1708,22 @@ class Handler(BaseHTTPRequestHandler):
         if "messages" not in params:
             self._json(400, b'{"error":"messages required"}')
             return
+        # SLO class (server/scheduler.py): the X-DLT-SLO-Class header (the
+        # gateway forwards client headers byte-transparently, retries
+        # included) wins over the body's slo_class; unknown values degrade
+        # to standard. Normalized ONCE here so every downstream reader
+        # (Batcher, ledgers, scheduler counters) sees one canonical value.
+        params["slo_class"] = resolve_slo_class(
+            self.headers.get(SLO_CLASS_HEADER) or params.get("slo_class")
+        )
+        # warm-handoff tracker: this request's router-compatible prefix
+        # chain keys (None for garbage message shapes — the 400 below owns
+        # those; one bounded-dict touch per request, never per token)
+        from .router import messages_prefix_text, prefix_chain
+
+        prefix_text = messages_prefix_text(params.get("messages"))
+        if prefix_text:
+            self.state.hot_prefixes.record(prefix_chain(prefix_text))
 
         # request-lifecycle trace: adopt the gateway's X-DLT-Trace-Id (one
         # joinable identity across gateway -> retry -> backend) — and its

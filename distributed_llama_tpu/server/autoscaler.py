@@ -1,0 +1,375 @@
+"""Goodput-driven autoscaler: the capacity half of the fleet control plane.
+
+The scheduler (server/scheduler.py) decides *who* runs on a replica; this
+module decides *how many replicas run*. It closes ROADMAP item 3's loop:
+the PR 9 fleet signal plane already measures per-replica goodput, batcher
+occupancy, shed rates, and SLO attainment — the autoscaler watches those
+signals on a tick and actuates through the SAME drain path the operator's
+``POST /gateway/drain`` endpoints use (Balancer.set_draining), so a human
+and the control loop can never disagree about what "drained" means.
+
+Policy per tick (:meth:`Autoscaler.tick`, manually drivable in tests):
+
+* **pressure** — any fresh live replica sheds, queues, or misses its TTFT
+  SLO target → **undrain** a drained replica (scale up), instantly: adding
+  capacity is cheap and reversible;
+* **headroom** — fleet utilization (active batch slots / total slots over
+  fresh, non-draining replicas) below the low watermark for
+  ``down_after`` CONSECUTIVE ticks (one quiet scrape must not shrink the
+  fleet) and more than ``min_live`` replicas live → **drain** the replica
+  contributing the least goodput (scale down);
+* otherwise **hold**.
+
+Draining is where the *warm handoff* lands: before ``set_draining``, the
+autoscaler fetches the victim's ``GET /debug/hot_prefixes`` snapshot (the
+replica-side HotPrefixTracker's router-compatible chain keys) and re-homes
+those chains' affinity onto surviving rendezvous owners
+(Router.rehome_keys) — so the fleet's shared-prefix traffic re-concentrates
+on ONE new home per chain *before* the old home stops taking requests,
+instead of spraying cold prefills across the fleet when it disappears.
+Inflight requests on the drained replica finish normally (draining only
+stops NEW assignments) — zero failed requests by construction.
+
+Every decision is counted (``dlt_autoscaler_decisions_total{action=...}``,
+``dlt_autoscaler_handoff_keys_total``) and summarized in the
+``autoscaler`` section of ``GET /gateway/fleet``. Deliberately
+stdlib-only, like the rest of the gateway.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+#: every action ``dlt_autoscaler_decisions_total`` is labeled with
+ACTIONS = ("drain", "undrain", "hold")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class AutoscalerConfig:
+    """Autoscaler knobs (``DLT_AUTOSCALE_*`` envs; the gateway's
+    ``--autoscale-s`` flag sets the cadence)."""
+
+    interval_s: float = 0.0     # tick cadence; <= 0 disables the thread
+    min_live: int = 1           # never drain below this many live replicas
+    low_water: float = 0.30     # utilization below this = shrink candidate
+    down_after: int = 3         # consecutive low ticks before a drain
+    cooldown_s: float = 30.0    # quiet period after any scale action
+    slo_target: float = 0.90    # TTFT attainment below this = pressure
+    handoff_top_n: int = 64     # hot chains fetched from a drain victim
+    handoff_timeout_s: float = 2.0
+
+    @classmethod
+    def resolve(cls, interval_s: float | None = None) -> "AutoscalerConfig":
+        return cls(
+            interval_s=(
+                _env_float("DLT_AUTOSCALE_S", 0.0)
+                if interval_s is None
+                else interval_s
+            ),
+            min_live=int(_env_float("DLT_AUTOSCALE_MIN_LIVE", 1)),
+            low_water=_env_float("DLT_AUTOSCALE_LOW", 0.30),
+            down_after=int(_env_float("DLT_AUTOSCALE_DOWN_AFTER", 3)),
+            cooldown_s=_env_float("DLT_AUTOSCALE_COOLDOWN_S", 30.0),
+            slo_target=_env_float("DLT_AUTOSCALE_SLO_TARGET", 0.90),
+            handoff_top_n=int(_env_float("DLT_AUTOSCALE_HANDOFF_N", 64)),
+            handoff_timeout_s=_env_float("DLT_AUTOSCALE_HANDOFF_TIMEOUT_S", 2.0),
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "min_live": self.min_live,
+            "low_water": self.low_water,
+            "down_after": self.down_after,
+            "cooldown_s": self.cooldown_s,
+            "slo_target": self.slo_target,
+            "handoff_top_n": self.handoff_top_n,
+        }
+
+
+class Autoscaler:
+    """The gateway's capacity control loop over a Balancer (+ its attached
+    FleetScraper and Router). Construct and call :meth:`tick` directly in
+    tests; :meth:`start` runs the background loop."""
+
+    def __init__(self, balancer, interval_s: float | None = None,
+                 config: AutoscalerConfig | None = None):
+        self.balancer = balancer
+        self.config = config or AutoscalerConfig.resolve(interval_s)
+        self.interval_s = self.config.interval_s
+        self._lock = threading.Lock()
+        self.decisions = {a: 0 for a in ACTIONS}
+        self.handoff_keys = 0
+        self.ticks = 0
+        self.last: dict = {}
+        self._low_ticks = 0
+        self._cooldown_until = 0.0
+        # keys THIS loop drained: the undrain arm only ever re-admits
+        # these — a replica an operator drained via POST /gateway/drain
+        # (for an upgrade, say) must never be undrained by a shed spike
+        self._drained_by_me: set = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="gateway-autoscaler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # the control loop must never die mid-incident: a failed
+                # tick is a held tick, visible as the hold count + a
+                # last-decision gap, retried next interval
+                with self._lock:
+                    self.decisions["hold"] += 1
+
+    # -- the loop body -------------------------------------------------------
+
+    def _fleet_view(self):
+        """Join the balancer's backend state with the scraper's fresh
+        signals: ``[(key, draining, signals|None)]`` — signals None when
+        stale/never-scraped (a silent replica contributes no utilization
+        evidence, so it can neither justify nor block a scale decision)."""
+        fleet = getattr(self.balancer, "fleet", None)
+        rows = fleet.router_signals() if fleet is not None else {}
+        with self.balancer.lock:
+            backends = [
+                (b.key, b.draining) for b in self.balancer.config.backends
+            ]
+        out = []
+        for key, draining in backends:
+            row = rows.get(key) or {}
+            fresh = not row.get("stale", True)
+            out.append((key, draining, row.get("signals") if fresh else None))
+        return out
+
+    @staticmethod
+    def _utilization(fresh_live) -> float | None:
+        """Active-slot fraction over the fresh live replicas (None with no
+        evidence). Queue depth counts as demand beyond capacity: a full
+        replica with a backlog reads >1 busy, not exactly-full."""
+        total = active = 0.0
+        for _, sig in fresh_live:
+            slots = sig.get("batcher_batch_slots") or 0
+            if slots <= 0:
+                continue
+            total += slots
+            active += min(sig.get("batcher_slots_active", 0), slots)
+            active += sig.get("batcher_queue_depth", 0)
+        if total <= 0:
+            return None
+        return active / total
+
+    def _pressure(self, fresh_live) -> str | None:
+        """The scale-up signal: shedding, queued demand, or a missed TTFT
+        SLO on any fresh live replica. Per-class attainment rows (the
+        fleet table's slo_ttft_attainment_by_class, where a replica
+        reports them) are checked class by class — a batch-heavy fleet's
+        healthy aggregate must not mask an interactive-class SLO miss.
+        Returns the reason or None."""
+        for key, sig in fresh_live:
+            if sig.get("shed_per_s", 0) > 0:
+                return f"shed:{key}"
+            if sig.get("batcher_queue_depth", 0) > 0:
+                return f"queue:{key}"
+            by_class = sig.get("slo_ttft_attainment_by_class") or {}
+            for klass, att in by_class.items():
+                if att < self.config.slo_target:
+                    return f"slo:{klass}:{key}"
+            att = sig.get("slo_ttft_attainment")
+            if att is not None and att < self.config.slo_target:
+                return f"slo:{key}"
+        return None
+
+    def _drain_victim(self, fresh_live) -> str:
+        """Whom to drain: the fresh live replica contributing the least
+        goodput (ties: least prefix reuse — its cache is the cheapest to
+        lose — then the later backend)."""
+        return min(
+            fresh_live,
+            key=lambda t: (
+                t[1].get("goodput_tokens_per_s", 0.0),
+                t[1].get("prefix_hit_tokens_per_s", 0.0),
+            ),
+        )[0]
+
+    def _warm_handoff(self, victim_key: str, remaining_keys) -> int:
+        """Fetch the victim's hottest chain keys and re-home their
+        affinity onto surviving rendezvous owners BEFORE the drain lands.
+        Best-effort: a replica that cannot answer just drains cold (the
+        set_draining hook still purges/re-homes the learned map)."""
+        router = getattr(self.balancer, "router", None)
+        if router is None or not remaining_keys:
+            return 0
+        backend = None
+        with self.balancer.lock:
+            for b in self.balancer.config.backends:
+                if b.key == victim_key:
+                    backend = (b.host, b.port)
+                    break
+        if backend is None:
+            return 0
+        from .fleet import http_get_text
+        import json
+
+        try:
+            status, body = http_get_text(
+                backend[0], backend[1],
+                f"/debug/hot_prefixes?n={self.config.handoff_top_n}",
+                self.config.handoff_timeout_s,
+            )
+            if status != 200:
+                return 0
+            chains = json.loads(body).get("chains", [])
+        except Exception:
+            return 0
+        keys = [c.get("key") for c in chains if isinstance(c, dict)]
+        n = router.rehome_keys(
+            [k for k in keys if k], remaining_keys, from_key=victim_key
+        )
+        with self._lock:
+            self.handoff_keys += n
+        return n
+
+    def drain(self, victim_key: str) -> dict:
+        """Warm-handoff + drain one replica (the tick's scale-down arm;
+        public so chaos tests can force the exact decision)."""
+        with self.balancer.lock:
+            remaining = [
+                b.key for b in self.balancer.config.backends
+                if not b.draining and b.key != victim_key
+            ]
+        rehomed = self._warm_handoff(victim_key, remaining)
+        self.balancer.set_draining(victim_key, True)
+        with self._lock:
+            self._drained_by_me.add(victim_key)
+        return {"victim": victim_key, "rehomed_keys": rehomed}
+
+    def forget(self, key: str):
+        """Drop ownership of a drain: called by Balancer.set_draining on
+        ANY undrain (operator or loop) — once a replica has been undrained
+        by anyone, a later drain of it is not ours to revert."""
+        with self._lock:
+            self._drained_by_me.discard(key)
+
+    def tick(self) -> dict:
+        """One control-loop evaluation. Returns (and remembers) the
+        decision record; never raises through the loop."""
+        cfg = self.config
+        now = time.monotonic()
+        view = self._fleet_view()
+        live = [(k, s) for k, d, s in view if not d]
+        drained = [k for k, d, _ in view if d]
+        fresh_live = [(k, s) for k, s in live if s is not None]
+        util = self._utilization(fresh_live)
+        pressure = self._pressure(fresh_live)
+        # only replicas THIS loop drained are undrain candidates — an
+        # operator's drain (upgrade, debugging) is not ours to revert
+        with self._lock:
+            own_drained = [k for k in drained if k in self._drained_by_me]
+        action, detail = "hold", ""
+        if pressure and own_drained:
+            # scale up: re-admit a drained replica. Cooldown does NOT
+            # gate this arm — pressure is user-visible pain and adding
+            # capacity back is safe; flap damping lives on the drain arm.
+            target = own_drained[0]
+            # set_draining's undrain hook calls our forget(target), so the
+            # ownership entry clears on the same path an operator's would
+            self.balancer.set_draining(target, False)
+            action, detail = "undrain", f"{target} ({pressure})"
+            self._low_ticks = 0
+            self._cooldown_until = now + cfg.cooldown_s
+        elif (
+            pressure is None  # NEVER shrink while any replica sheds,
+            # queues, or misses its SLO — even if raw utilization is low
+            and util is not None
+            and util < cfg.low_water
+            # min_live counts replicas with FRESH evidence: a crashed or
+            # silent backend is not capacity, and counting it could drain
+            # the last actually-working replica during a partial outage
+            and len(fresh_live) > cfg.min_live
+            and now >= self._cooldown_until
+        ):
+            self._low_ticks += 1
+            if self._low_ticks >= cfg.down_after and fresh_live:
+                victim = self._drain_victim(fresh_live)
+                res = self.drain(victim)
+                action = "drain"
+                detail = f"{victim} (rehomed {res['rehomed_keys']} keys)"
+                self._low_ticks = 0
+                self._cooldown_until = now + cfg.cooldown_s
+        else:
+            self._low_ticks = 0
+        record = {
+            "action": action,
+            "detail": detail,
+            "utilization": None if util is None else round(util, 3),
+            "pressure": pressure,
+            "live": len(live),
+            "drained": len(drained),
+            "low_ticks": self._low_ticks,
+        }
+        with self._lock:
+            self.decisions[action] += 1
+            self.ticks += 1
+            self.last = record
+        return record
+
+    # -- views ---------------------------------------------------------------
+
+    def metrics_lines(self) -> list:
+        from ..runtime.tracing import prom_line  # stdlib-only module
+
+        with self._lock:
+            decisions = dict(self.decisions)
+            handoff = self.handoff_keys
+            last = dict(self.last)
+        lines = ["# TYPE dlt_autoscaler_decisions_total counter"]
+        for a in ACTIONS:
+            lines.append(
+                prom_line(
+                    "dlt_autoscaler_decisions_total", {"action": a},
+                    decisions.get(a, 0),
+                )
+            )
+        lines.append("# TYPE dlt_autoscaler_handoff_keys_total counter")
+        lines.append(prom_line("dlt_autoscaler_handoff_keys_total", None, handoff))
+        if last.get("utilization") is not None:
+            lines.append("# TYPE dlt_autoscaler_utilization gauge")
+            lines.append(
+                prom_line("dlt_autoscaler_utilization", None, last["utilization"])
+            )
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "config": self.config.snapshot(),
+                "decisions": dict(self.decisions),
+                "handoff_keys": self.handoff_keys,
+                "ticks": self.ticks,
+                "last": dict(self.last),
+            }

@@ -285,13 +285,31 @@ class FleetScraper:
             return
         signals: dict = {}
         counters: dict = {}
+        goodput_by_class: dict = {}
+        attainment_by_class: dict = {}
         for name, labels, value in samples:
             if labels:
+                # per-SLO-class breakdowns (server/scheduler.py): the
+                # slo_class-labeled rows of the goodput and attainment
+                # gauge families ride the signal table so /gateway/fleet
+                # and the autoscaler see per-class delivery/SLO health
+                # without re-parsing (replicas that don't emit per-class
+                # attainment — only the class-blended aggregate exists
+                # today on real engines — simply have no rows here)
+                if "slo_class" in labels:
+                    if name == "dlt_goodput_tokens_per_s":
+                        goodput_by_class[labels["slo_class"]] = value
+                    elif name == "dlt_slo_ttft_attainment":
+                        attainment_by_class[labels["slo_class"]] = value
                 continue
             if name in _GAUGE_SIGNALS:
                 signals[_GAUGE_SIGNALS[name]] = value
             elif name in _RATE_SIGNALS:
                 counters[name] = value
+        if goodput_by_class:
+            signals["goodput_by_class"] = goodput_by_class
+        if attainment_by_class:
+            signals["slo_ttft_attainment_by_class"] = attainment_by_class
         with self._lock:
             st = self._replica(key)
             st.last_attempt_s = now
@@ -327,7 +345,7 @@ class FleetScraper:
             return {}
         out = {}
         for k in ("batcher", "kv_pool", "speculative", "batch", "seq_len",
-                  "role", "disagg"):
+                  "role", "disagg", "scheduler"):
             if isinstance(payload, dict) and payload.get(k) is not None:
                 out[k] = payload[k]
         return out

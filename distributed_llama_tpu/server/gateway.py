@@ -158,6 +158,11 @@ class GatewayConfig:
     # (default cache_aware); "least_inflight" keeps the legacy selection
     # (the A/B arm the routing bench compares against)
     router_policy: str | None = None
+    # goodput-driven autoscaler (server/autoscaler.py): evaluation-tick
+    # cadence. None resolves DLT_AUTOSCALE_S (default 0 = OFF — capacity
+    # decisions are opt-in); > 0 attaches the control loop that drains /
+    # undrains replicas on fleet goodput headroom with warm handoff.
+    autoscale_s: float | None = None
 
     def __post_init__(self):
         if self.health_retry_ms is not None:
@@ -183,6 +188,10 @@ class Balancer:
         # — or directly by tests. None = least-inflight only (the legacy
         # selection path, byte-for-byte unchanged).
         self.router = None
+        # goodput-driven autoscaler (server/autoscaler.py Autoscaler):
+        # attached by run() when autoscale_s > 0 — or directly by tests.
+        # None = no capacity control loop (the default).
+        self.autoscaler = None
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
@@ -472,8 +481,27 @@ class Balancer:
             if idx < 0:
                 return False
             self.config.backends[idx].draining = draining
+            remaining = [
+                b.key for b in self.config.backends
+                if not b.draining and b.key != key
+            ]
+            router = self.router
+            autoscaler = self.autoscaler
             self.cond.notify_all()
-            return True
+        if draining and router is not None:
+            # locality hygiene (server/router.py): learned chain keys must
+            # not keep naming a home acquire() will never hand out again —
+            # re-homed to surviving rendezvous owners (or purged when none).
+            # OUTSIDE the balancer lock: the router takes its own lock, and
+            # plan() holds it before touching ours (lock-order discipline).
+            router.forget_backend(key, remaining)
+        if not draining and autoscaler is not None:
+            # ANY undrain (operator or control loop) clears the
+            # autoscaler's drain ownership: a replica the operator later
+            # re-drains for maintenance must never be auto-undrained on
+            # the strength of a drain the loop did weeks ago
+            autoscaler.forget(key)
+        return True
 
     def reset_breaker(self, idx: int):
         """Force-close a breaker (operator/test override after a restart)."""
@@ -692,6 +720,20 @@ def render_gateway_metrics(balancer: Balancer) -> str:
         lines.append(f"# TYPE {m} counter")
         for reason in REASONS:
             lines.append(prom_line(m, {"reason": reason}, counts.get(reason, 0)))
+        # drain hygiene + warm handoff (server/router.py): the acceptance
+        # signal that affinity was re-homed BEFORE a drained replica
+        # disappeared — fleet prefix_hit_tokens recovering is the effect,
+        # these counters are the cause
+        h = balancer.router.handoff_snapshot()
+        for name, col in (
+            ("dlt_router_handoff_rehomed_keys_total", "rehomed_keys"),
+            ("dlt_router_locality_purged_keys_total", "purged_keys"),
+            ("dlt_router_drain_events_total", "drain_events"),
+        ):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(prom_line(name, None, h.get(col, 0)))
+    if balancer.autoscaler is not None:
+        lines.extend(balancer.autoscaler.metrics_lines())
     if balancer.fleet is not None:
         lines.extend(balancer.fleet.federated_lines())
     return "\n".join(lines) + "\n"
@@ -718,6 +760,10 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
                         None if balancer.router is None
                         else balancer.router.snapshot()
                     ),
+                    "autoscaler": (
+                        None if balancer.autoscaler is None
+                        else balancer.autoscaler.snapshot()
+                    ),
                 }),
             )
             return
@@ -727,6 +773,12 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
         # and the signal table it scores can never disagree
         payload["router"] = (
             None if balancer.router is None else balancer.router.snapshot()
+        )
+        # autoscaler view (server/autoscaler.py): config, last decision,
+        # per-action counts, handoff totals — same join rationale
+        payload["autoscaler"] = (
+            None if balancer.autoscaler is None
+            else balancer.autoscaler.snapshot()
         )
         _plain_response(client, 200, "OK", json.dumps(payload))
         return
@@ -759,6 +811,10 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
                 "router": (
                     None if balancer.router is None
                     else balancer.router.cfg.policy
+                ),
+                "autoscaler": (
+                    None if balancer.autoscaler is None
+                    else balancer.autoscaler.config.snapshot()
                 ),
             },
             "backends": fleet_mod.fetch_backend_configs(balancer),
@@ -1064,6 +1120,16 @@ def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None
     )
     if scraper.interval_s > 0:
         balancer.fleet = scraper.start()
+    # goodput-driven autoscaler (server/autoscaler.py): OFF unless the
+    # operator asked (--autoscale-s / DLT_AUTOSCALE_S > 0) — capacity
+    # decisions must be opt-in. It watches the fleet table the scraper
+    # above maintains and drains/undrains via the same set_draining path
+    # the POST /gateway/drain endpoints use, with warm prefix handoff.
+    from .autoscaler import Autoscaler
+
+    autoscaler = Autoscaler(balancer, interval_s=balancer.config.autoscale_s)
+    if autoscaler.interval_s > 0:
+        balancer.autoscaler = autoscaler.start()
     print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
     try:
         while not stop.is_set():
@@ -1073,6 +1139,8 @@ def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None
                 continue
             threading.Thread(target=handle_client, args=(client, balancer), daemon=True).start()
     finally:
+        if balancer.autoscaler is not None:
+            balancer.autoscaler.stop()
         if balancer.fleet is not None:
             balancer.fleet.stop()
         srv.close()
@@ -1115,6 +1183,11 @@ def main(argv=None) -> int:
                    "whose radix cache holds it, scored against the fleet "
                    "signal table; least_inflight keeps the legacy "
                    "selection (default: DLT_ROUTER or cache_aware)")
+    p.add_argument("--autoscale-s", type=float, default=None,
+                   help="goodput-driven autoscaler tick interval "
+                   "(server/autoscaler.py): drains idle replicas with warm "
+                   "prefix handoff, undrains on pressure (default: "
+                   "DLT_AUTOSCALE_S or 0 = off)")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
@@ -1131,6 +1204,7 @@ def main(argv=None) -> int:
         fleet_scrape_s=args.fleet_scrape_s,
         fleet_timeout_s=args.fleet_timeout_s,
         router_policy=args.router,
+        autoscale_s=args.autoscale_s,
     )
     run(args.port, Balancer(config))
     return 0

@@ -1,0 +1,881 @@
+"""Fleet load twin: deterministic fleet-scale traffic against stub replicas.
+
+Scheduler and autoscaler changes are *fleet* behaviors — priority
+inversion shows up at 10 replicas under a burst, not in a unit test — but
+a 10-50-replica fleet of real engines needs a TPU pod. This module is the
+twin: **stub engine replicas** that serve the REAL serving-tier surface
+(the gateway proxies to them, the FleetScraper scrapes them, the router
+learns affinity over them, the autoscaler drains them) and run the REAL
+scheduling policy (server/scheduler.py `SloScheduler` — the same object
+the live Batcher drives), with the engine itself replaced by deterministic
+simulated service times. The control plane under test is 100% the
+production code; only the matmuls are fake.
+
+Pieces:
+
+* :class:`StubEngineReplica` — an HTTP replica emulating `server/api.py`'s
+  wire surface: SSE ``/v1/chat/completions`` (class-aware admission,
+  priority slots, preemption, prefix-cache hit simulation keyed on the
+  router's OWN chain hashes), ``/metrics`` in the exact families the
+  FleetScraper lifts, ``/stats``, ``/health``, ``/debug/hot_prefixes``,
+  ``/debug/config``;
+* :func:`make_mixed_trace` — a seeded scenario-trace generator (the
+  `server/chaos.py` FaultPlan idiom: one `random.Random(seed)` stream,
+  identical replay per seed) mixing chat bursts, shared-prefix RAG
+  fan-out, agentic tool loops with long pauses, batch jobs, and client
+  abandonment;
+* :class:`LoadTwin` — N stub replicas behind a REAL gateway (balancer +
+  router + fleet scraper + optional autoscaler), a trace replayer whose
+  clients measure TTFT at the first SSE byte, and a per-class report.
+
+CI-cheap by construction: everything is host-side sleeps of a few ms —
+a 10-replica mixed trace runs in seconds on one core, no jax imported.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..runtime.tracing import prom_line as _prom  # stdlib-only: one
+# Prometheus line formatter (escaping included) for the whole serving
+# layer — the twin must emit exactly what the scraper parses
+from .router import PAGE_CHARS, messages_prefix_text, prefix_chain
+from .scheduler import (
+    ClassQueues,
+    DEFAULT_CLASS,
+    HotPrefixTracker,
+    SLO_CLASSES,
+    SLO_CLASS_HEADER,
+    SloScheduler,
+    resolve_slo_class,
+)
+
+#: characters per simulated token (matches the router's ~4 chars/token
+#: assumption, so chain blocks ≈ 16-token prefix-cache pages)
+CHARS_PER_TOKEN = 4
+
+
+@dataclass
+class StubReplicaConfig:
+    """One stub replica's capacity/speed model. The defaults make a
+    request cost a few ms — fleet-scale traces stay CI-cheap."""
+
+    batch_slots: int = 4          # concurrent decode slots (the Batcher twin)
+    max_backlog: int = 32         # admission backlog cap (503 past it)
+    token_ms: float = 2.0         # decode wall per generated token
+    prefill_ms_per_token: float = 0.05  # prefill wall per COLD prompt token
+    slo_ttft_ms: float = 1000.0   # the TTFT target the attainment gauge uses
+    admission_timeout_s: float = 30.0   # slot wait before giving up (503)
+
+
+class _Ticket:
+    __slots__ = ("klass", "event", "preempt", "progress")
+
+    def __init__(self, klass: str):
+        self.klass = klass
+        self.event = threading.Event()   # set when a slot is assigned
+        self.preempt = threading.Event()  # set when the scheduler evicts us
+        self.progress = 0                # tokens generated so far
+
+
+class _SlotGate:
+    """The stub's Batcher twin: ``batch_slots`` concurrent requests,
+    waiting tickets drained in SLO-class priority order (interactive
+    before standard before batch; FIFO within a class), and preemption —
+    a waiting higher-class ticket evicts the lowest-class least-progress
+    ACTIVE request (strictly below its class), exactly the live Batcher's
+    policy because it IS the live policy object deciding."""
+
+    def __init__(self, cfg: StubReplicaConfig, scheduler: SloScheduler):
+        self.cfg = cfg
+        self.scheduler = scheduler
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.free = cfg.batch_slots
+        # the waiting line IS a ClassQueues — the same structure the live
+        # Batcher's backlog uses, so admission_allowed reads it directly
+        self.waiting = ClassQueues()
+        self.active: set = set()
+
+    def _assign_locked(self):
+        while self.free > 0 and len(self.waiting):
+            t = self.waiting.popleft()
+            self.free -= 1
+            self.active.add(t)
+            self.scheduler.record(t.klass, "admit")
+            t.event.set()
+
+    def depth(self) -> int:
+        with self.lock:
+            return len(self.waiting)
+
+    def depths(self) -> dict:
+        with self.lock:
+            return self.waiting.depths()
+
+    def active_count(self) -> int:
+        with self.lock:
+            return len(self.active)
+
+    def admission_blocked(self, klass: str) -> bool:
+        """The REAL policy object's quota/backlog decision over the real
+        waiting queues — the twin must never fork the admission math."""
+        with self.lock:
+            return not self.scheduler.admission_allowed(
+                klass, self.waiting, self.cfg.max_backlog
+            )
+
+    def acquire(self, klass: str) -> _Ticket | None:
+        """Queue for a slot; None = gave up (treated as an overload shed).
+        May preempt a strictly-lower-class active request to make room."""
+        t = _Ticket(klass)
+        with self.lock:
+            self.waiting.append(t, klass)
+            self._assign_locked()
+            if not t.event.is_set() and self.free == 0:
+                # at most ONE outstanding preemption per gate: a whole
+                # burst of waiters must not massacre every batch row at
+                # once — the victim's slot frees within a token wall, and
+                # the next waiter re-evaluates then (bounded thrash, the
+                # same one-preemption-per-chunk-boundary rule as the live
+                # Batcher loop)
+                pending = any(a.preempt.is_set() for a in self.active)
+                victim = None if pending else self.scheduler.preempt_victim(
+                    klass,
+                    [(id(a), a.klass, a.progress) for a in self.active],
+                )
+                if victim is not None:
+                    for a in self.active:
+                        if id(a) == victim:
+                            self.scheduler.record(a.klass, "preempt")
+                            a.preempt.set()
+                            break
+        if not t.event.wait(self.cfg.admission_timeout_s):
+            with self.lock:
+                try:
+                    self.waiting.remove(t, klass)
+                    return None
+                except ValueError:
+                    pass  # assigned between the timeout and the lock:
+                    # keep the slot
+        return t
+
+    def release(self, t: _Ticket):
+        with self.lock:
+            self.active.discard(t)
+            self.free += 1
+            self._assign_locked()
+
+
+class _StubState:
+    """One replica's observable state: counters, warm prefix chains, the
+    scheduling policy objects, and per-class goodput/TTFT windows."""
+
+    def __init__(self, cfg: StubReplicaConfig, name: str):
+        self.cfg = cfg
+        self.name = name
+        self.lock = threading.Lock()
+        self.counters = {
+            "requests_completed": 0, "prefix_hit_tokens": 0,
+            "prefix_hits": 0, "shed_503": 0, "client_gone": 0,
+        }
+        self.scheduler = SloScheduler()
+        self.gate = _SlotGate(cfg, self.scheduler)
+        self.hot_prefixes = HotPrefixTracker()
+        self.warm_chains: set = set()      # the radix cache twin
+        self.wasted: dict = {}             # (reason, class) -> tokens
+        self.delivered: dict = {c: 0 for c in SLO_CLASSES}
+        self._window: deque = deque()      # (t, n, class), 60 s trim
+        self.ttft_ms: dict = {c: deque(maxlen=256) for c in SLO_CLASSES}
+        self.draining_hint = False         # set via ?twin drain helpers
+
+    def incr(self, name: str, n: int = 1):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def add_waste(self, reason: str, klass: str, tokens: int):
+        if tokens <= 0:
+            return
+        with self.lock:
+            self.wasted[(reason, klass)] = (
+                self.wasted.get((reason, klass), 0) + tokens
+            )
+
+    def deliver(self, klass: str, tokens: int):
+        now = time.monotonic()
+        with self.lock:
+            self.delivered[klass] = self.delivered.get(klass, 0) + tokens
+            self._window.append((now, tokens, klass))
+            while self._window and self._window[0][0] < now - 60.0:
+                self._window.popleft()
+
+    def goodput_rows(self) -> list:
+        now = time.monotonic()
+        with self.lock:
+            window = list(self._window)
+        if not window:
+            return [({}, 0.0)] + [({"slo_class": c}, 0.0) for c in SLO_CLASSES]
+        span = max(now - window[0][0], 1.0)
+        per = {c: 0 for c in SLO_CLASSES}
+        total = 0
+        for _, n, c in window:
+            total += n
+            per[c] = per.get(c, 0) + n
+        return [({}, round(total / span, 3))] + [
+            ({"slo_class": c}, round(per[c] / span, 3)) for c in SLO_CLASSES
+        ]
+
+    def attainment(self, klass: str | None = None) -> float:
+        with self.lock:
+            if klass is None:
+                obs = [v for q in self.ttft_ms.values() for v in q]
+            else:
+                obs = list(self.ttft_ms[klass])
+        if not obs:
+            return 1.0
+        ok = sum(1 for v in obs if v <= self.cfg.slo_ttft_ms)
+        return round(ok / len(obs), 4)
+
+
+def _render_stub_metrics(st: _StubState) -> str:
+    """The stub's ``/metrics`` body — exactly the families the
+    FleetScraper lifts (server/fleet.py _GAUGE_SIGNALS/_RATE_SIGNALS) plus
+    the scheduler/goodput label families the control plane reads."""
+    with st.lock:
+        counters = dict(st.counters)
+        wasted = dict(st.wasted)
+    gate = st.gate
+    lines = []
+    for k in ("requests_completed", "prefix_hit_tokens", "shed_503"):
+        m = f"dlt_{k}_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(_prom(m, None, counters.get(k, 0)))
+    gauges = {
+        "dlt_batcher_batch_slots": st.cfg.batch_slots,
+        "dlt_batcher_slots_active": gate.active_count(),
+        "dlt_batcher_slots_prefilling": 0,
+        "dlt_batcher_queue_depth": gate.depth(),
+        "dlt_batcher_max_backlog": st.cfg.max_backlog,
+        "dlt_slo_tpot_attainment": 1.0,
+    }
+    for m, v in gauges.items():
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(_prom(m, None, v))
+    lines.append("# TYPE dlt_slo_ttft_attainment gauge")
+    lines.append(_prom("dlt_slo_ttft_attainment", None, st.attainment()))
+    for c in SLO_CLASSES:
+        lines.append(
+            _prom("dlt_slo_ttft_attainment", {"slo_class": c}, st.attainment(c))
+        )
+    lines.append("# TYPE dlt_goodput_tokens_per_s gauge")
+    for lab, v in st.goodput_rows():
+        lines.append(_prom("dlt_goodput_tokens_per_s", lab or None, v))
+    lines.append("# TYPE dlt_wasted_tokens_total counter")
+    for (reason, klass), v in sorted(wasted.items()):
+        lines.append(
+            _prom("dlt_wasted_tokens_total",
+                  {"reason": reason, "slo_class": klass}, v)
+        )
+    lines.append("# TYPE dlt_scheduler_decisions_total counter")
+    for lab, v in st.scheduler.decisions_series():
+        lines.append(_prom("dlt_scheduler_decisions_total", lab, v))
+    return "\n".join(lines) + "\n"
+
+
+class StubEngineReplica:
+    """One stub replica: start() binds an ephemeral port; the server runs
+    a daemon thread per connection (ThreadingHTTPServer) like the real
+    batched api server."""
+
+    def __init__(self, cfg: StubReplicaConfig | None = None, name: str = "stub"):
+        self.cfg = cfg or StubReplicaConfig()
+        self.state = _StubState(self.cfg, name)
+        self._httpd: ThreadingHTTPServer | None = None
+        self.port = 0
+
+    def start(self) -> "StubEngineReplica":
+        st = self.state
+        cfg = self.cfg
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype="application/json", headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+                self.close_connection = True
+
+            def do_GET(self):
+                route = self.path.partition("?")[0]
+                if route == "/metrics":
+                    self._send(
+                        200, _render_stub_metrics(st).encode(),
+                        ctype="text/plain; version=0.0.4",
+                    )
+                elif route == "/stats":
+                    payload = {
+                        "batcher": {
+                            "batch_slots": cfg.batch_slots,
+                            "slots_active": st.gate.active_count(),
+                            "queue_depth": st.gate.depth(),
+                            "queue_depths": st.gate.depths(),
+                            "max_backlog": cfg.max_backlog,
+                        },
+                        "scheduler": st.scheduler.snapshot(),
+                        "batch": cfg.batch_slots,
+                        "seq_len": 4096,
+                    }
+                    self._send(200, json.dumps(payload).encode())
+                elif route == "/debug/hot_prefixes":
+                    snap = st.hot_prefixes.snapshot()
+                    snap["block_chars"] = PAGE_CHARS
+                    self._send(200, json.dumps(snap).encode())
+                elif route == "/debug/config":
+                    self._send(200, json.dumps({
+                        "model": f"stub-{st.name}",
+                        "engine": {"batch": cfg.batch_slots},
+                    }).encode())
+                else:  # /health and anything else health-shaped
+                    with st.lock:
+                        counters = dict(st.counters)
+                    self._send(200, json.dumps({
+                        "status": "ok", "counters": counters,
+                        "queue_depth": st.gate.depth(),
+                    }).encode())
+
+            def do_POST(self):
+                if self.path.partition("?")[0] != "/v1/chat/completions":
+                    self._send(404, b'{"error":"not found"}')
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    params = json.loads(self.rfile.read(length) or b"{}")
+                    messages = params["messages"]
+                except (ValueError, KeyError):
+                    self._send(400, b'{"error":"bad request"}')
+                    return
+                klass = resolve_slo_class(
+                    self.headers.get(SLO_CLASS_HEADER)
+                    or params.get("slo_class")
+                )
+                # the ONE hash-text builder (server/router.py) — the twin
+                # must never fork the must-hash-identical-text invariant
+                text = messages_prefix_text(messages) or ""
+                chain = prefix_chain(text)
+                st.hot_prefixes.record(chain)
+                # class-aware admission: the REAL policy object's
+                # quota/backlog decision over the gate's real queues —
+                # never a forked copy of the math
+                if st.gate.admission_blocked(klass):
+                    st.incr("shed_503")
+                    st.scheduler.record(klass, "shed_backlog")
+                    self._send(
+                        503, b'{"error":"overloaded"}',
+                        headers={"Retry-After": "1"},
+                    )
+                    return
+                t0 = time.perf_counter()
+                ticket = st.gate.acquire(klass)
+                if ticket is None:
+                    st.incr("shed_503")
+                    st.scheduler.record(klass, "shed_backlog")
+                    self._send(
+                        503, b'{"error":"overloaded"}',
+                        headers={"Retry-After": "1"},
+                    )
+                    return
+                try:
+                    self._serve_generation(params, klass, text, chain,
+                                           ticket, t0)
+                finally:
+                    st.gate.release(ticket)
+
+            def _serve_generation(self, params, klass, text, chain,
+                                  ticket, t0):
+                prompt_tokens = max(len(text) // CHARS_PER_TOKEN, 1)
+                max_tokens = int(params.get("max_tokens") or 16)
+                # prefix-cache twin: leading chain blocks already warm on
+                # THIS replica skip their prefill wall (16 tokens/block,
+                # the page-size equivalence the router is built around)
+                with st.lock:
+                    warm = 0
+                    for ck in chain:
+                        if ck in st.warm_chains:
+                            warm += 1
+                        else:
+                            break
+                hit_tokens = min(warm * 16, prompt_tokens)
+                if hit_tokens:
+                    st.incr("prefix_hits")
+                    st.incr("prefix_hit_tokens", hit_tokens)
+                cold = prompt_tokens - hit_tokens
+                time.sleep(cold * st.cfg.prefill_ms_per_token / 1000.0)
+                with st.lock:  # publish: the whole chain is warm now
+                    st.warm_chains.update(chain)
+                # SSE decode: one chunk per simulated token
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                delivered = 0
+                outcome = "ok"
+                try:
+                    for i in range(max_tokens):
+                        time.sleep(st.cfg.token_ms / 1000.0)
+                        if i == 0:
+                            st.ttft_ms[klass].append(
+                                (time.perf_counter() - t0) * 1e3
+                            )
+                        if ticket.preempt.is_set():
+                            # preemption mid-stream: the only honest wire
+                            # signal is a truncated stream (no [DONE]) —
+                            # the same EOF semantics the real gateway has
+                            # for mid-stream failures; twin clients detect
+                            # it and retry like real clients do
+                            outcome = "preempt"
+                            break
+                        payload = json.dumps({"choices": [{
+                            "index": 0,
+                            "delta": {"role": "assistant", "content": "t "},
+                            "finish_reason": "",
+                        }]})
+                        self.wfile.write(f"data: {payload}\r\n\r\n".encode())
+                        self.wfile.flush()
+                        delivered += 1
+                        ticket.progress = delivered
+                    if outcome == "ok":
+                        self.wfile.write(b"data: [DONE]")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    outcome = "client_gone"
+                self.close_connection = True
+                if outcome == "ok":
+                    st.incr("requests_completed")
+                    st.deliver(klass, delivered)
+                else:
+                    # a preempted or abandoned request's streamed tokens
+                    # are waste: part of an answer nobody finished reading
+                    if outcome == "client_gone":
+                        st.incr("client_gone")
+                    st.add_waste(outcome, klass, max(delivered, 1))
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+# -- scenario traces ----------------------------------------------------------
+
+
+@dataclass
+class TwinRequest:
+    """One scheduled request of a twin trace."""
+
+    at_s: float                 # offset from trace start
+    slo_class: str = DEFAULT_CLASS
+    system: str = ""            # shared prefix text (system prompt)
+    user: str = ""              # unique tail
+    max_tokens: int = 16
+    abandon_after: int | None = None  # client closes after N tokens
+    scenario: str = "chat"
+
+
+def _words(rng: random.Random, n_chars: int, tag: str) -> str:
+    """Deterministic filler text of ~n_chars (seeded, so chain hashes are
+    stable per seed)."""
+    out = []
+    total = 0
+    i = 0
+    while total < n_chars:
+        w = f"{tag}{rng.randrange(1000):03d}"
+        out.append(w)
+        total += len(w) + 1
+        i += 1
+    return " ".join(out)
+
+
+def make_mixed_trace(
+    seed: int = 0,
+    scale: float = 1.0,
+    abandon_p: float = 0.08,
+    duration_s: float = 2.0,
+) -> list:
+    """The standard mixed-scenario trace: chat bursts (interactive),
+    shared-prefix RAG fan-out (standard), agentic tool loops with long
+    pauses (interactive, growing conversation prefix), and long batch jobs
+    — with seeded client abandonment sprinkled across all of it. One
+    ``random.Random(seed)`` stream in a fixed draw order (the FaultPlan
+    discipline), so a fixed seed replays the identical trace."""
+    rng = random.Random(seed)
+    reqs: list = []
+
+    def maybe_abandon(max_tokens: int) -> int | None:
+        if rng.random() < abandon_p and max_tokens >= 4:
+            return rng.randrange(1, max(2, max_tokens // 2))
+        return None
+
+    # batch jobs first: long decodes that occupy slots while latency
+    # traffic arrives (the contention the scheduler exists to resolve)
+    for j in range(max(2, int(4 * scale))):
+        sys_txt = _words(rng, 320, f"batchcorpus{j}")
+        reqs.append(TwinRequest(
+            at_s=rng.uniform(0.0, duration_s * 0.3),
+            slo_class="batch", system=sys_txt,
+            user=f"summarize shard {j}",
+            max_tokens=rng.randrange(120, 200),
+            abandon_after=maybe_abandon(160),
+            scenario="batch_job",
+        ))
+    # chat bursts: clumps of interactive turns sharing one app's system
+    # prompt, arriving within a ~50 ms window
+    for b in range(max(2, int(3 * scale))):
+        t0 = rng.uniform(duration_s * 0.2, duration_s * 0.8)
+        sys_txt = _words(rng, 260, f"chatapp{b}")
+        for i in range(max(3, int(4 * scale))):
+            mt = rng.randrange(8, 20)
+            reqs.append(TwinRequest(
+                at_s=t0 + rng.uniform(0.0, 0.05),
+                slo_class="interactive", system=sys_txt,
+                user=f"burst {b} turn {i}",
+                max_tokens=mt,
+                abandon_after=maybe_abandon(mt),
+                scenario="chat_burst",
+            ))
+    # RAG fan-out: many standard requests over ONE long shared corpus
+    # prefix (the router-concentration workload)
+    rag_sys = _words(rng, 640, "ragcorpus")
+    for i in range(max(4, int(6 * scale))):
+        mt = rng.randrange(12, 28)
+        reqs.append(TwinRequest(
+            at_s=rng.uniform(duration_s * 0.1, duration_s * 0.9),
+            slo_class="standard", system=rag_sys,
+            user=f"rag question {i}",
+            max_tokens=mt,
+            abandon_after=maybe_abandon(mt),
+            scenario="rag_fanout",
+        ))
+    # agentic tool loops: one conversation, several turns with LONG pauses
+    # between them (tool executions), prefix growing each turn
+    for a in range(max(1, int(2 * scale))):
+        t = rng.uniform(0.0, duration_s * 0.3)
+        convo = _words(rng, 200, f"agent{a}")
+        for turn in range(3):
+            mt = rng.randrange(6, 14)
+            reqs.append(TwinRequest(
+                at_s=t, slo_class="interactive", system=convo,
+                user=f"tool step {turn}",
+                max_tokens=mt,
+                abandon_after=maybe_abandon(mt),
+                scenario="agent_loop",
+            ))
+            pause = rng.uniform(0.15, 0.4)  # the "tool runs" pause
+            t += pause
+            convo = convo + " " + _words(rng, 140, f"agent{a}tool{turn}")
+    reqs.sort(key=lambda r: r.at_s)
+    return reqs
+
+
+# -- the twin harness ---------------------------------------------------------
+
+
+@dataclass
+class TwinResult:
+    """One replayed request's client-side observation."""
+
+    slo_class: str
+    scenario: str
+    status: int = 0
+    ttft_ms: float | None = None
+    tokens: int = 0
+    outcome: str = "error"  # ok | shed | abandoned | preempted | error
+    retries: int = 0
+    error: str = ""
+
+
+class LoadTwin:
+    """N stub replicas behind a REAL gateway stack. ``classes_enabled=
+    False`` strips every request to `standard` — the no-class baseline arm
+    the bench leg compares against."""
+
+    def __init__(
+        self,
+        n_replicas: int = 10,
+        replica_cfg: StubReplicaConfig | None = None,
+        router_policy: str = "cache_aware",
+        fleet_scrape_s: float = 0.0,
+        autoscale_s: float | None = None,
+        classes_enabled: bool = True,
+        max_inflight_per_backend: int = 64,
+    ):
+        from . import gateway as gw_mod
+        from .fleet import FleetScraper
+        from .gateway import Backend, Balancer, GatewayConfig
+
+        self.classes_enabled = classes_enabled
+        self.replicas = [
+            StubEngineReplica(replica_cfg, name=str(i)).start()
+            for i in range(n_replicas)
+        ]
+        self.cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", r.port) for r in self.replicas],
+            # capacity lives in the replicas' slot gates: the gateway's
+            # per-backend inflight cap must not serialize the twin ahead
+            # of the scheduler under test
+            max_inflight_per_backend=max_inflight_per_backend,
+            queue_size=256, queue_timeout_s=30.0,
+            probe_interval_s=0, fleet_scrape_s=0,  # scraper driven below
+            router_policy=router_policy,
+            autoscale_s=0,  # autoscaler built (and ticked) explicitly
+        )
+        self.balancer = Balancer(self.cfg)
+        self.scraper = FleetScraper(
+            self.balancer, interval_s=max(fleet_scrape_s, 0.05),
+            timeout_s=1.0,
+        )
+        self.balancer.fleet = self.scraper
+        if fleet_scrape_s > 0:
+            self.scraper.start()
+        # autoscaler semantics mirror the real gateway: None = absent
+        # (run() attaches none by default), 0 = built and attached but
+        # manually driven (tick()/drain() — the chaos tests' mode),
+        # > 0 = background loop
+        self.autoscaler = None
+        if autoscale_s is not None:
+            from .autoscaler import Autoscaler, AutoscalerConfig
+
+            self.autoscaler = Autoscaler(
+                self.balancer,
+                config=AutoscalerConfig(
+                    interval_s=autoscale_s, cooldown_s=0.0, down_after=2,
+                ),
+            )
+            self.balancer.autoscaler = self.autoscaler
+            if autoscale_s > 0:
+                self.autoscaler.start()
+        self._stop = threading.Event()
+        self.port = _free_port()
+        threading.Thread(
+            target=gw_mod.run, args=(self.port, self.balancer, self._stop),
+            daemon=True,
+        ).start()
+        _wait_listening(self.port)
+
+    # -- one client -----------------------------------------------------------
+
+    def _client(self, req: TwinRequest, max_attempts: int = 8) -> TwinResult:
+        """Real-client semantics: honor 503+Retry-After and retry a
+        truncated (preempted) stream, bounded — a preempted batch job's
+        work is deferred, not lost, exactly like a production client."""
+        res = None
+        for attempt in range(max_attempts):
+            res = self._attempt(req)
+            res.retries = attempt
+            if res.outcome == "shed":
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            if res.outcome == "preempted":
+                # back off past the burst that evicted us — immediate
+                # re-entry would meet the same wave again mid-decode
+                time.sleep(0.08 * (attempt + 1))
+                continue
+            return res
+        return res
+
+    def _attempt(self, req: TwinRequest) -> TwinResult:
+        res = TwinResult(slo_class=req.slo_class, scenario=req.scenario)
+        body = json.dumps({
+            "messages": [
+                {"role": "system", "content": req.system},
+                {"role": "user", "content": req.user},
+            ],
+            "max_tokens": req.max_tokens,
+            "stream": True,
+        })
+        headers = {"Content-Type": "application/json"}
+        if self.classes_enabled:
+            headers[SLO_CLASS_HEADER] = req.slo_class
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=60)
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/chat/completions", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            res.status = resp.status
+            if resp.status != 200:
+                resp.read()
+                res.outcome = "shed" if resp.status == 503 else "error"
+                return res
+            first = resp.read(6)  # the leading b"data: " of the first event
+            res.ttft_ms = (time.perf_counter() - t0) * 1e3
+            buf = b""
+            tokens = 0
+            while True:
+                chunk = resp.read(512)
+                if not chunk:
+                    break
+                buf += chunk
+                tokens = buf.count(b"delta")
+                if req.abandon_after is not None and tokens >= req.abandon_after:
+                    res.outcome = "abandoned"
+                    res.tokens = tokens
+                    conn.close()  # the client walks away mid-stream
+                    return res
+            res.tokens = tokens + (1 if first and tokens == 0 else 0)
+            # a 200 stream that ended without [DONE] was truncated by a
+            # preemption — the caller's retry loop re-queues it
+            res.outcome = "ok" if b"[DONE]" in buf else "preempted"
+            return res
+        except OSError as e:
+            res.outcome = "error"
+            res.error = repr(e)
+            return res
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def run(self, trace) -> list:
+        """Replay a trace against the gateway: one client thread per
+        request, released at its scheduled offset. Returns TwinResults in
+        trace order."""
+        results: list = [None] * len(trace)
+        t_start = time.perf_counter()
+        threads = []
+
+        def one(i, req):
+            delay = req.at_s - (time.perf_counter() - t_start)
+            if delay > 0:
+                time.sleep(delay)
+            results[i] = self._client(req)
+
+        for i, req in enumerate(trace):
+            th = threading.Thread(target=one, args=(i, req), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+        self.wall_s = time.perf_counter() - t_start
+        return results
+
+    # -- reporting -----------------------------------------------------------
+
+    @staticmethod
+    def _pct(vals, p):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(len(vals) * p))], 1)
+
+    def report(self, results, horizon_s: float | None = None) -> dict:
+        """Summarize a run. `horizon_s` fixes the goodput denominator to a
+        COMMON measurement horizon when comparing two arms: class-aware
+        scheduling DEFERS batch work past the trace window (that's the
+        point), so rating each arm over its own makespan would read the
+        deferred drain as lost goodput — while genuinely lost work still
+        shows up as a delivered-token deficit. The raw makespan rides the
+        report as `makespan_s` so the deferral itself stays visible."""
+        per_class: dict = {}
+        failures = 0
+        delivered = 0
+        for r in results:
+            if r is None:
+                failures += 1
+                continue
+            c = per_class.setdefault(r.slo_class, {
+                "n": 0, "ok": 0, "shed": 0, "abandoned": 0, "preempted": 0,
+                "error": 0, "ttfts": [], "tokens": 0, "retries": 0,
+            })
+            c["n"] += 1
+            c[r.outcome if r.outcome in
+              ("ok", "shed", "abandoned", "preempted", "error")
+              else "error"] += 1
+            c["retries"] += r.retries
+            if r.outcome in ("ok", "abandoned") and r.ttft_ms is not None:
+                c["ttfts"].append(r.ttft_ms)
+            if r.outcome == "ok":
+                c["tokens"] += r.tokens
+                delivered += r.tokens
+            if r.outcome == "error":
+                failures += 1
+        out = {"classes": {}, "failures": failures}
+        for k, c in per_class.items():
+            out["classes"][k] = {
+                "n": c["n"], "ok": c["ok"], "shed": c["shed"],
+                "abandoned": c["abandoned"], "preempted": c["preempted"],
+                "error": c["error"], "retries": c["retries"],
+                "delivered_tokens": c["tokens"],
+                "ttft_p50_ms": self._pct(c["ttfts"], 0.50),
+                "ttft_p95_ms": self._pct(c["ttfts"], 0.95),
+            }
+        out["delivered_tokens"] = delivered
+        wall = max(getattr(self, "wall_s", 1.0), 1e-6)
+        out["makespan_s"] = round(wall, 3)
+        out["goodput_tokens_per_s"] = round(
+            delivered / max(wall, horizon_s or 0.0), 1
+        )
+        out["fleet_prefix_hit_tokens"] = self.fleet_prefix_hit_tokens()
+        return out
+
+    def fleet_prefix_hit_tokens(self) -> int:
+        return sum(
+            r.state.counters.get("prefix_hit_tokens", 0)
+            for r in self.replicas
+        )
+
+    def replica_keys(self) -> list:
+        return [b.key for b in self.cfg.backends]
+
+    def close(self):
+        self._stop.set()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.scraper.stop()
+        for r in self.replicas:
+            r.stop()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_listening(port: int, timeout: float = 5.0):
+    import socket
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            return
+        except OSError:
+            time.sleep(0.02)
+    raise RuntimeError(f"gateway on {port} never came up")

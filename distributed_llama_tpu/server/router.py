@@ -99,15 +99,15 @@ def prefix_chain(text: str, block_chars: int = PAGE_CHARS,
     return out
 
 
-def chat_prefix_text(body: bytes) -> str | None:
-    """The routable prefix text of a ``/v1/chat/completions`` body: the
-    messages' roles+contents concatenated in order (the same order the chat
-    template feeds the tokenizer, so equal text here means equal leading
-    tokens there). None = not a routable chat request (bad JSON, no
-    messages) — the caller falls back to least-inflight."""
+def messages_prefix_text(messages) -> str | None:
+    """The routable prefix text of a parsed ``messages`` list: roles +
+    contents concatenated in order (the same order the chat template feeds
+    the tokenizer, so equal text here means equal leading tokens there).
+    Shared by the gateway's router (via :func:`chat_prefix_text`) and the
+    replica-side hot-prefix tracker (server/api.py) — BOTH sides must hash
+    the identical text or warm-handoff chain keys would never match the
+    locality map's. None on garbage shapes (non-list, non-dict entries)."""
     try:
-        params = json.loads(body)
-        messages = params["messages"]
         parts = []
         for m in messages:
             parts.append(str(m.get("role", "")))
@@ -115,11 +115,22 @@ def chat_prefix_text(body: bytes) -> str | None:
             parts.append(str(m.get("content", "")))
             parts.append("\x1e")
         return "".join(parts)
-    except (ValueError, KeyError, TypeError, AttributeError):
+    except (TypeError, AttributeError):
         # AttributeError included: a JSON-valid body whose messages entries
         # are not dicts ({"messages": ["hi"]}) must abstain, not crash the
         # gateway's connection thread — the backend owns the 400
         return None
+
+
+def chat_prefix_text(body: bytes) -> str | None:
+    """The routable prefix text of a raw ``/v1/chat/completions`` body.
+    None = not a routable chat request (bad JSON, no messages) — the
+    caller falls back to least-inflight."""
+    try:
+        messages = json.loads(body)["messages"]
+    except (ValueError, KeyError, TypeError):
+        return None
+    return messages_prefix_text(messages)
 
 
 def rendezvous_owner(key: int, backends: list) -> str | None:
@@ -251,6 +262,15 @@ class Router:
         self._lock = threading.Lock()
         self._locality: "OrderedDict[int, str]" = OrderedDict()
         self.decisions = {r: 0 for r in REASONS}
+        # drain/handoff bookkeeping (under _lock): how many learned chain
+        # keys were re-homed (to a surviving rendezvous owner) or purged
+        # (no survivor) when a backend drained, plus the warm-handoff keys
+        # the autoscaler re-homed from /debug/hot_prefixes snapshots —
+        # dlt_router_handoff_rehomed_keys_total / _locality_purged_keys on
+        # the gateway's /metrics
+        self.handoff = {
+            "rehomed_keys": 0, "purged_keys": 0, "drain_events": 0,
+        }
 
     @classmethod
     def build(cls, policy: str | None = None) -> "Router | None":
@@ -375,6 +395,70 @@ class Router:
             while len(self._locality) > self.cfg.locality_size:
                 self._locality.popitem(last=False)
 
+    # -- drain hygiene + warm handoff ----------------------------------------
+
+    def forget_backend(self, key: str, remaining=None) -> dict:
+        """Locality hygiene on drain/leave (Balancer.set_draining calls
+        this): every learned chain key whose home is ``key`` is re-homed to
+        its rendezvous owner among ``remaining`` backends — or dropped when
+        none survive. Without this, every affinity lookup for those chains
+        scores a dead home first: `plan` skips draining backends, so the
+        stale entry silently degrades every shared-prefix request to
+        rendezvous-of-the-head instead of ONE consistent new home."""
+        rehomed = purged = 0
+        remaining = [k for k in (remaining or []) if k != key]
+        with self._lock:
+            for ck, owner in list(self._locality.items()):
+                if owner != key:
+                    continue
+                if remaining:
+                    self._locality[ck] = rendezvous_owner(ck, remaining)
+                    rehomed += 1
+                else:
+                    del self._locality[ck]
+                    purged += 1
+            self.handoff["rehomed_keys"] += rehomed
+            self.handoff["purged_keys"] += purged
+            self.handoff["drain_events"] += 1
+        return {"rehomed": rehomed, "purged": purged}
+
+    def rehome_keys(self, hex_keys, remaining, from_key: str | None = None) -> int:
+        """Warm drain handoff (server/autoscaler.py): point each chain key
+        from a draining replica's ``/debug/hot_prefixes`` snapshot at its
+        rendezvous owner among the surviving backends — BEFORE the drain
+        lands — so the fleet's shared-prefix traffic re-concentrates on
+        one new home (one cold prefill per chain, then hits again) instead
+        of spraying cold across the fleet. A chain whose learned home is a
+        SURVIVING backend (other than ``from_key``) is left alone: the
+        draining replica may have served it once, but the warm affinity
+        elsewhere is still correct and must not be evicted. Returns the
+        keys re-homed."""
+        remaining = list(remaining)
+        if not remaining:
+            return 0
+        n = 0
+        with self._lock:
+            for hk in hex_keys:
+                try:
+                    ck = int(hk, 16)
+                except (TypeError, ValueError):
+                    continue
+                owner = self._locality.get(ck)
+                if owner is not None and owner != from_key \
+                        and owner in remaining:
+                    continue  # a healthy replica's warm home stands
+                self._locality[ck] = rendezvous_owner(ck, remaining)
+                self._locality.move_to_end(ck)
+                n += 1
+            while len(self._locality) > self.cfg.locality_size:
+                self._locality.popitem(last=False)
+            self.handoff["rehomed_keys"] += n
+        return n
+
+    def handoff_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.handoff)
+
     # -- views ---------------------------------------------------------------
 
     def decisions_snapshot(self) -> dict:
@@ -389,6 +473,7 @@ class Router:
                 "decisions": dict(self.decisions),
                 "locality_entries": len(self._locality),
                 "locality_size": self.cfg.locality_size,
+                "handoff": dict(self.handoff),
                 "weights": {
                     "affinity": self.cfg.w_affinity,
                     "headroom": self.cfg.w_headroom,

@@ -35,7 +35,12 @@
 #                            concentration twin; disaggregated serving:
 #                            KV wire codec, token identity vs unified,
 #                            chaos mid-transfer degradation)
-#  11. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
+#  11. scheduler suite      (SLO-class scheduling: priority queues,
+#                            quotas, preemption observable end to end on
+#                            a live engine; autoscaler tick policy; the
+#                            10-replica load-twin smoke + the mixed-class
+#                            SLO and drain-handoff acceptance twins)
+#  12. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
 #                            vs predecessor, tolerance-banded — WARN-ONLY:
 #                            the table is the artifact, the exit code is 0)
 #
@@ -77,6 +82,9 @@ python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovide
 
 echo "== router suite (cache-aware routing + disaggregated serving) =="
 python -m pytest tests/test_router.py tests/test_disagg.py -q -p no:cacheprovider
+
+echo "== scheduler suite (SLO classes + autoscaler + load twin) =="
+python -m pytest tests/test_scheduler.py tests/test_loadtwin.py -q -p no:cacheprovider
 
 echo "== scoreboard guard (warn-only) =="
 python scripts/bench_compare.py

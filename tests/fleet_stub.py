@@ -1,0 +1,177 @@
+"""Shared fleet test fixtures: stub replicas + gateway stacks.
+
+test_fleet and test_router used to hand-roll their fake-replica HTTP
+servers; the scheduler/autoscaler/load-twin suites need the same
+scaffolding at 10-50-replica scale, so it lives here once:
+
+* :func:`free_port` / :func:`wait_port` — socket plumbing;
+* :func:`make_replica_stub` — a CANNED replica (static /metrics + /stats
+  + /debug/config bodies) for scraper/federation tests where the subject
+  is the transport, not serving;
+* :class:`FleetStack` — [ChaosProxy -> canned stub] * n behind one
+  Balancer + manually-driven FleetScraper (the test_fleet harness);
+* re-exports of the BEHAVIORAL stub fleet (`server/loadtwin.py`
+  StubEngineReplica / LoadTwin / make_mixed_trace) — replicas that
+  actually serve simulated SSE chat through the real scheduler policy,
+  for control-plane tests.
+
+No jax anywhere: a 50-replica stack costs sockets and threads only.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distributed_llama_tpu.server.chaos import ChaosProxy
+from distributed_llama_tpu.server.fleet import FleetScraper
+from distributed_llama_tpu.server.gateway import (
+    Backend,
+    Balancer,
+    GatewayConfig,
+)
+from distributed_llama_tpu.server.loadtwin import (  # noqa: F401 (re-export)
+    LoadTwin,
+    StubEngineReplica,
+    StubReplicaConfig,
+    TwinRequest,
+    make_mixed_trace,
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_port(port, up: bool, timeout=5.0):
+    """Block until `port` accepts (up=True) or refuses (up=False)
+    connections — ChaosProxy.down()/up() take effect asynchronously in its
+    accept loop, so tests must wait for the transition to land."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            if up:
+                return
+        except OSError:
+            if not up:
+                return
+        time.sleep(0.02)
+    raise AssertionError(f"port {port} never went {'up' if up else 'down'}")
+
+
+def make_replica_stub(tag: str):
+    """A canned replica: /metrics grows its prefix-hit counter by 64 tokens
+    per scrape (so two scrapes yield a computable rate), /stats carries a
+    batcher section, /debug/config a resolved-config snapshot."""
+    state = {"prefix_hit_tokens": 0, "scrapes": 0}
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, body: bytes, ctype="application/json"):
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            route = self.path.partition("?")[0]
+            if route == "/metrics":
+                state["scrapes"] += 1
+                state["prefix_hit_tokens"] += 64
+                body = "\n".join(
+                    [
+                        "# TYPE dlt_prefix_hit_tokens_total counter",
+                        f"dlt_prefix_hit_tokens_total {state['prefix_hit_tokens']}",
+                        "# TYPE dlt_requests_completed_total counter",
+                        "dlt_requests_completed_total 10",
+                        "# TYPE dlt_kv_pool_pages_free gauge",
+                        "dlt_kv_pool_pages_free 17",
+                        "# TYPE dlt_batcher_slots_active gauge",
+                        "dlt_batcher_slots_active 3",
+                        "# TYPE dlt_batcher_batch_slots gauge",
+                        "dlt_batcher_batch_slots 4",
+                        "# TYPE dlt_batcher_queue_depth gauge",
+                        "dlt_batcher_queue_depth 1",
+                        "# TYPE dlt_slo_ttft_attainment gauge",
+                        "dlt_slo_ttft_attainment 0.97",
+                        'dlt_slo_ttft_attainment{slo_class="interactive"} 0.88',
+                        "# TYPE dlt_goodput_tokens_per_s gauge",
+                        "dlt_goodput_tokens_per_s 812.5",
+                        'dlt_goodput_tokens_per_s{slo_class="interactive"} 300.5',
+                        'dlt_goodput_tokens_per_s{slo_class="standard"} 512',
+                        'dlt_goodput_tokens_per_s{slo_class="batch"} 0',
+                        "# TYPE dlt_ttft_ms histogram",
+                        'dlt_ttft_ms_bucket{le="1024"} 9',
+                        'dlt_ttft_ms_bucket{le="+Inf"} 10',
+                        "dlt_ttft_ms_sum 1234.5",
+                        "dlt_ttft_ms_count 10",
+                        "",
+                    ]
+                ).encode()
+                self._send(body, ctype="text/plain; version=0.0.4")
+            elif route == "/stats":
+                self._send(
+                    json.dumps(
+                        {
+                            "batcher": {"batch_slots": 4, "slots_active": 3},
+                            "kv_pool": {"free_pages": 17, "layout": "paged"},
+                            "batch": 4,
+                            "seq_len": 2048,
+                        }
+                    ).encode()
+                )
+            elif route == "/debug/config":
+                self._send(
+                    json.dumps(
+                        {"model": f"stub-{tag}", "engine": {"batch": 4}}
+                    ).encode()
+                )
+            else:
+                self._send(json.dumps({"status": "ok", "tag": tag}).encode())
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+class FleetStack:
+    """[ChaosProxy -> replica stub] * n behind one Balancer + FleetScraper
+    (manually driven — no background thread unless a test starts one)."""
+
+    def __init__(self, n=2, interval_s=0.2, stale_after_s=0.6):
+        self.stubs, self.states, self.proxies = [], [], []
+        for i in range(n):
+            srv, state = make_replica_stub(str(i))
+            px = ChaosProxy("127.0.0.1", srv.server_address[1]).start()
+            self.stubs.append(srv)
+            self.states.append(state)
+            self.proxies.append(px)
+        self.cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", px.port) for px in self.proxies],
+            probe_interval_s=0,
+            fleet_scrape_s=0,  # tests drive scrape_once explicitly
+        )
+        self.bal = Balancer(self.cfg)
+        self.scraper = FleetScraper(
+            self.bal, interval_s=interval_s, timeout_s=0.5,
+            stale_after_s=stale_after_s,
+        )
+        self.bal.fleet = self.scraper
+
+    def close(self):
+        self.scraper.stop()
+        for px in self.proxies:
+            px.stop()
+        for s in self.stubs:
+            s.shutdown()
+            s.server_close()

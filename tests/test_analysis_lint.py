@@ -126,6 +126,13 @@ def test_trace_hot_emit_scoped_to_hot_packages():
     # flagged exactly like the Batcher/gateway loops
     assert _rules(in_loop, "server/router.py") == ["trace-hot-emit"]
     assert _rules(bound, "server/router.py") == []
+    # the fleet control plane's modules (PR 12: scheduler admission/
+    # preemption loops, autoscaler ticks, the load twin's stub decode
+    # loop) are server-scope too — hot-loop emits must stay pre-bound
+    for mod in ("server/scheduler.py", "server/autoscaler.py",
+                "server/loadtwin.py"):
+        assert _rules(in_loop, mod) == ["trace-hot-emit"]
+        assert _rules(bound, mod) == []
     # formats/ops stay out of scope
     assert _rules(in_loop, "formats/x.py") == []
     # non-trace receivers named `event` are not span emits

@@ -252,7 +252,7 @@ def test_disagg_token_identity_and_walls(stack):
     assert g["kv_transfer_us"] >= 0
     assert g["prefix_hit_tokens"] >= 16
     # the usage extension's shape is LEDGER_FIELDS — the one source
-    assert set(g) == set(LEDGER_FIELDS) | {"outcome"}
+    assert set(g) == set(LEDGER_FIELDS) | {"outcome", "slo_class"}
     # the prefill worker did the prompt work
     wc = _counters(stack.pf_port)
     assert wc.get("disagg_prefills", 0) >= 1

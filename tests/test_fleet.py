@@ -4,161 +4,29 @@ Prometheus federation format and the bench_compare scoreboard guard.
 
 The replica backends are STUBS serving canned /metrics + /stats +
 /debug/config bodies — the subject under test is the TRANSPORT and the
-scrape/staleness/federation logic, so no engine (and no jax) is needed."""
+scrape/staleness/federation logic, so no engine (and no jax) is needed.
+The stub scaffolding itself lives in tests/fleet_stub.py (shared with the
+scheduler and load-twin suites)."""
 
 import json
 import socket
 import threading
 import time
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
-from distributed_llama_tpu.server import fleet as fleet_mod
 from distributed_llama_tpu.server import gateway as gw_mod
-from distributed_llama_tpu.server.chaos import ChaosProxy
-from distributed_llama_tpu.server.fleet import FleetScraper, parse_prom_text
+from distributed_llama_tpu.server.fleet import parse_prom_text
 from distributed_llama_tpu.server.gateway import (
     BREAKER_OPEN,
-    Backend,
-    Balancer,
-    GatewayConfig,
     render_gateway_metrics,
 )
 
+from fleet_stub import FleetStack, free_port
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _wait_port(port, up: bool, timeout=5.0):
-    """Block until `port` accepts (up=True) or refuses (up=False)
-    connections — ChaosProxy.down()/up() take effect asynchronously in its
-    accept loop, so tests must wait for the transition to land."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
-            if up:
-                return
-        except OSError:
-            if not up:
-                return
-        time.sleep(0.02)
-    raise AssertionError(f"port {port} never went {'up' if up else 'down'}")
-
-
-def _mk_replica_stub(tag: str):
-    """A canned replica: /metrics grows its prefix-hit counter by 64 tokens
-    per scrape (so two scrapes yield a computable rate), /stats carries a
-    batcher section, /debug/config a resolved-config snapshot."""
-    state = {"prefix_hit_tokens": 0, "scrapes": 0}
-
-    class Stub(BaseHTTPRequestHandler):
-        def log_message(self, *a):
-            pass
-
-        def _send(self, body: bytes, ctype="application/json"):
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.send_header("Connection", "close")
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_GET(self):
-            route = self.path.partition("?")[0]
-            if route == "/metrics":
-                state["scrapes"] += 1
-                state["prefix_hit_tokens"] += 64
-                body = "\n".join(
-                    [
-                        "# TYPE dlt_prefix_hit_tokens_total counter",
-                        f"dlt_prefix_hit_tokens_total {state['prefix_hit_tokens']}",
-                        "# TYPE dlt_requests_completed_total counter",
-                        "dlt_requests_completed_total 10",
-                        "# TYPE dlt_kv_pool_pages_free gauge",
-                        "dlt_kv_pool_pages_free 17",
-                        "# TYPE dlt_batcher_slots_active gauge",
-                        "dlt_batcher_slots_active 3",
-                        "# TYPE dlt_batcher_batch_slots gauge",
-                        "dlt_batcher_batch_slots 4",
-                        "# TYPE dlt_batcher_queue_depth gauge",
-                        "dlt_batcher_queue_depth 1",
-                        "# TYPE dlt_slo_ttft_attainment gauge",
-                        "dlt_slo_ttft_attainment 0.97",
-                        "# TYPE dlt_goodput_tokens_per_s gauge",
-                        "dlt_goodput_tokens_per_s 812.5",
-                        "# TYPE dlt_ttft_ms histogram",
-                        'dlt_ttft_ms_bucket{le="1024"} 9',
-                        'dlt_ttft_ms_bucket{le="+Inf"} 10',
-                        "dlt_ttft_ms_sum 1234.5",
-                        "dlt_ttft_ms_count 10",
-                        "",
-                    ]
-                ).encode()
-                self._send(body, ctype="text/plain; version=0.0.4")
-            elif route == "/stats":
-                self._send(
-                    json.dumps(
-                        {
-                            "batcher": {"batch_slots": 4, "slots_active": 3},
-                            "kv_pool": {"free_pages": 17, "layout": "paged"},
-                            "batch": 4,
-                            "seq_len": 2048,
-                        }
-                    ).encode()
-                )
-            elif route == "/debug/config":
-                self._send(
-                    json.dumps(
-                        {"model": f"stub-{tag}", "engine": {"batch": 4}}
-                    ).encode()
-                )
-            else:
-                self._send(json.dumps({"status": "ok", "tag": tag}).encode())
-
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    return srv, state
-
-
-class FleetStack:
-    """[ChaosProxy -> replica stub] * n behind one Balancer + FleetScraper
-    (manually driven — no background thread unless a test starts one)."""
-
-    def __init__(self, n=2, interval_s=0.2, stale_after_s=0.6):
-        self.stubs, self.states, self.proxies = [], [], []
-        for i in range(n):
-            srv, state = _mk_replica_stub(str(i))
-            px = ChaosProxy("127.0.0.1", srv.server_address[1]).start()
-            self.stubs.append(srv)
-            self.states.append(state)
-            self.proxies.append(px)
-        self.cfg = GatewayConfig(
-            backends=[Backend("127.0.0.1", px.port) for px in self.proxies],
-            probe_interval_s=0,
-            fleet_scrape_s=0,  # tests drive scrape_once explicitly
-        )
-        self.bal = Balancer(self.cfg)
-        self.scraper = FleetScraper(
-            self.bal, interval_s=interval_s, timeout_s=0.5,
-            stale_after_s=stale_after_s,
-        )
-        self.bal.fleet = self.scraper
-
-    def close(self):
-        self.scraper.stop()
-        for px in self.proxies:
-            px.stop()
-        for s in self.stubs:
-            s.shutdown()
-            s.server_close()
+# back-compat alias for the helper's old private name in this module
+from fleet_stub import wait_port as _wait_port
 
 
 @pytest.fixture
@@ -213,6 +81,12 @@ def test_scrape_builds_signal_table_with_rates(fleet_stack):
         assert sig["goodput_tokens_per_s"] == 812.5
         # 64 tokens per scrape / elapsed -> a positive per-second rate
         assert sig["prefix_hit_tokens_per_s"] > 0
+        # the slo_class-labeled goodput rows ride the signal table too
+        # (ISSUE 12 satellite: per-class view on /gateway/fleet)
+        assert sig["goodput_by_class"] == {
+            "interactive": 300.5, "standard": 512.0, "batch": 0.0,
+        }
+        assert sig["slo_ttft_attainment_by_class"] == {"interactive": 0.88}
         assert row["stats"]["kv_pool"]["layout"] == "paged"
         assert row["balancer"]["breaker"] == "closed"
 
@@ -311,9 +185,17 @@ def test_federated_metrics_carry_replica_labels(fleet_stack):
     goodput = {
         lab.get("replica"): v
         for name, lab, v in samples
-        if name == "dlt_goodput_tokens_per_s"
+        if name == "dlt_goodput_tokens_per_s" and "slo_class" not in lab
     }
     assert set(goodput) == keys and all(v == 812.5 for v in goodput.values())
+    # the per-class breakdown rows federate with BOTH labels intact
+    by_class = {
+        (lab["replica"], lab["slo_class"]): v
+        for name, lab, v in samples
+        if name == "dlt_goodput_tokens_per_s" and "slo_class" in lab
+    }
+    assert len(by_class) == 3 * len(keys)
+    assert all(by_class[(k, "standard")] == 512 for k in keys)
     # histogram families federate with their bucket labels intact
     buckets = [
         (lab["replica"], lab["le"], v)

@@ -29,7 +29,7 @@ def test_aggregator_identity_and_waste_labels():
     agg.record(GoodputLedger(prompt_tokens=10, generated_tokens=8,
                              discarded_tokens=2, outcome="ok"))
     agg.record(GoodputLedger(prompt_tokens=5, discarded_tokens=7,
-                             outcome="shed"))
+                             outcome="shed", slo_class="batch"))
     agg.record(GoodputLedger(prompt_tokens=5, discarded_tokens=3,
                              outcome="error"),
                waste_reason="stall_retry", count_request=False)
@@ -44,7 +44,44 @@ def test_aggregator_identity_and_waste_labels():
         (labels["reason"], v) for labels, v in agg.wasted_series()
     )
     assert series == {"overrun": 2, "shed": 7, "stall_retry": 3,
-                      "client_gone": 0, "error": 0, "transfer_retry": 0}
+                      "client_gone": 0, "error": 0, "transfer_retry": 0,
+                      "preempt": 0}
+
+
+def test_aggregator_per_class_breakdown():
+    """ISSUE 12 satellite: goodput and waste break down by slo_class — the
+    labeled series rows, the by_class snapshot section, and the reason-only
+    totals must stay mutually consistent."""
+    agg = GoodputAggregator(window_s=60.0)
+    agg.record(GoodputLedger(generated_tokens=20, outcome="ok",
+                             slo_class="interactive"))
+    agg.record(GoodputLedger(generated_tokens=5, discarded_tokens=4,
+                             outcome="ok", slo_class="batch"))
+    agg.record(GoodputLedger(discarded_tokens=6, outcome="shed",
+                             slo_class="batch"), waste_reason="preempt")
+    # goodput gauge family: unlabeled total + one row per class (zeros in)
+    series = agg.goodput_series()
+    total = [v for lab, v in series if not lab]
+    by_class = {lab["slo_class"]: v for lab, v in series if lab}
+    assert len(total) == 1 and total[0] > 0
+    assert set(by_class) == {"interactive", "standard", "batch"}
+    assert by_class["interactive"] > by_class["batch"] > 0
+    assert by_class["standard"] == 0.0
+    # waste breakdown rows only where tokens were actually wasted
+    rows = {(lab["reason"], lab["slo_class"]): v
+            for lab, v in agg.wasted_by_class_series()}
+    assert rows == {("overrun", "batch"): 4, ("preempt", "batch"): 6}
+    # by_class snapshot: requests + delivered + waste per class
+    bc = agg.snapshot()["by_class"]
+    assert bc["interactive"]["delivered_tokens"] == 20
+    assert bc["interactive"]["requests"] == 1
+    assert bc["batch"]["requests"] == 2
+    assert bc["batch"]["wasted_tokens"] == {"overrun": 4, "preempt": 6}
+    assert bc["standard"]["delivered_tokens"] == 0
+    # unknown classes fold into standard rather than minting a label
+    agg.record(GoodputLedger(generated_tokens=1, outcome="ok",
+                             slo_class="bogus"))
+    assert agg.by_class_snapshot()["standard"]["delivered_tokens"] == 1
 
 
 def test_aggregator_window_rate_ages_out():

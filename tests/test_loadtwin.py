@@ -1,0 +1,436 @@
+"""Fleet load twin + autoscaler tests (server/loadtwin.py +
+server/autoscaler.py).
+
+The twin runs the REAL gateway stack (balancer, cache-aware router, fleet
+scraper, autoscaler) over stub replicas that execute the REAL scheduler
+policy with simulated service times — so the control plane is CI-testable
+at 10-replica scale in seconds, no jax, no TPUs.
+
+Covers the two ISSUE-12 acceptance scenarios:
+* the bursty mixed-class trace — interactive TTFT p95 holds its SLO while
+  fleet goodput stays >= 90% of the no-class baseline;
+* the drain-handoff chaos — the autoscaler drains a replica under live
+  shared-prefix traffic with ZERO failed requests, affinity re-homed
+  before removal (handoff metric counted, prefix hits keep accruing)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributed_llama_tpu.server.autoscaler import Autoscaler, AutoscalerConfig
+from distributed_llama_tpu.server.gateway import Backend, Balancer, GatewayConfig
+
+from fleet_stub import (
+    LoadTwin,
+    StubReplicaConfig,
+    make_mixed_trace,
+)
+
+
+# ---- trace generator --------------------------------------------------------
+
+
+def test_trace_is_deterministic_per_seed_and_mixed():
+    sig = lambda t: [
+        (r.at_s, r.slo_class, r.system, r.user, r.max_tokens,
+         r.abandon_after, r.scenario)
+        for r in t
+    ]
+    assert sig(make_mixed_trace(seed=3)) == sig(make_mixed_trace(seed=3))
+    assert sig(make_mixed_trace(seed=3)) != sig(make_mixed_trace(seed=4))
+    trace = make_mixed_trace(seed=3)
+    scenarios = {r.scenario for r in trace}
+    assert {"chat_burst", "rag_fanout", "agent_loop", "batch_job"} <= scenarios
+    classes = {r.slo_class for r in trace}
+    assert classes == {"interactive", "standard", "batch"}
+    assert any(r.abandon_after is not None for r in trace)  # abandonment
+    assert trace == sorted(trace, key=lambda r: r.at_s)
+    # agent loops carry long pauses: same conversation, spaced arrivals
+    agent = [r for r in trace if r.scenario == "agent_loop"]
+    assert len(agent) >= 3
+    gaps = [b.at_s - a.at_s for a, b in zip(agent, agent[1:])
+            if b.system.startswith(a.system[:32])]
+    assert any(g >= 0.1 for g in gaps)
+
+
+# ---- 10-replica smoke -------------------------------------------------------
+
+
+def test_twin_smoke_ten_replicas_zero_failures():
+    """A 10-replica mixed trace through the real gateway: every class
+    served, zero failures, prefix reuse accrues fleet-wide, and the
+    gateway's fleet/router/autoscaler control surfaces all answer."""
+    tw = LoadTwin(n_replicas=10, fleet_scrape_s=0.1, autoscale_s=0)
+    try:
+        rep = tw.report(tw.run(make_mixed_trace(seed=1)))
+        assert rep["failures"] == 0
+        for c in ("interactive", "standard", "batch"):
+            assert rep["classes"][c]["ok"] > 0, rep
+        assert rep["delivered_tokens"] > 0
+        assert rep["fleet_prefix_hit_tokens"] > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.port}/gateway/fleet", timeout=30
+        ) as r:
+            fleet = json.loads(r.read())
+        assert len(fleet["replicas"]) == 10
+        assert fleet["router"]["policy"] == "cache_aware"
+        assert fleet["autoscaler"]["decisions"] == {
+            "drain": 0, "undrain": 0, "hold": 0,
+        }
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.port}/metrics", timeout=30
+        ) as r:
+            body = r.read().decode()
+        assert "dlt_autoscaler_decisions_total" in body
+        assert "dlt_router_handoff_rehomed_keys_total" in body
+        # the federated rollup carries the stubs' scheduler decisions
+        assert "dlt_scheduler_decisions_total" in body
+    finally:
+        tw.close()
+
+
+# ---- THE mixed-class SLO acceptance -----------------------------------------
+
+
+def test_mixed_class_trace_holds_interactive_slo_at_full_goodput():
+    """ISSUE 12 acceptance: under a bursty mixed-class trace (interactive
+    bursts + RAG fan-out + agent loops + long batch jobs + abandonment),
+    SLO-class scheduling holds interactive TTFT p95 within the SLO while
+    fleet goodput (over a common horizon) stays >= 90% of the no-class
+    baseline. Same seeded trace, twin fleets, one flag flipped."""
+    SLO_MS = 300.0
+    HORIZON_S = 4.5
+    cfg = StubReplicaConfig(batch_slots=2, token_ms=3.0, slo_ttft_ms=SLO_MS)
+    trace = make_mixed_trace(seed=11, scale=1.5, duration_s=2.0)
+    reports = {}
+    for enabled in (True, False):
+        tw = LoadTwin(
+            n_replicas=3, replica_cfg=cfg, classes_enabled=enabled,
+            fleet_scrape_s=0.1,
+        )
+        try:
+            reports[enabled] = tw.report(tw.run(trace), horizon_s=HORIZON_S)
+        finally:
+            tw.close()
+    cls, noc = reports[True], reports[False]
+    assert cls["failures"] == 0 and noc["failures"] == 0
+    # the SLO holds with classes on (generous margin below the 300 ms
+    # target — calibrated p95 is 80-150 ms on a loaded 1-core box)
+    p95 = cls["classes"]["interactive"]["ttft_p95_ms"]
+    assert p95 is not None and p95 <= SLO_MS, (p95, cls)
+    # and classes actually helped: the no-class FIFO arm is slower for
+    # interactive under the same contention
+    p95_noc = noc["classes"]["interactive"]["ttft_p95_ms"]
+    assert p95 <= p95_noc, (p95, p95_noc)
+    # goodput retention over the common horizon: >= 90% of no-class
+    retention = (
+        cls["goodput_tokens_per_s"] / max(noc["goodput_tokens_per_s"], 1e-9)
+    )
+    assert retention >= 0.9, (retention, cls, noc)
+
+
+# ---- THE drain-handoff chaos ------------------------------------------------
+
+
+def test_autoscaler_drain_handoff_under_live_traffic():
+    """ISSUE 12 acceptance: the autoscaler drains the shared-prefix
+    traffic's affinity home while requests keep flowing — zero failed
+    requests, affinity re-homed BEFORE removal (handoff metric counted),
+    the drained replica stops taking new requests, and fleet-wide prefix
+    hits keep accruing on the new home."""
+    tw = LoadTwin(
+        n_replicas=3,
+        replica_cfg=StubReplicaConfig(batch_slots=4, token_ms=2.0),
+        fleet_scrape_s=0.05,
+        autoscale_s=0,  # built + attached, manually driven (tw.autoscaler)
+    )
+    shared = "drainchaos " * 30  # ~330 chars: 5 full hash blocks
+    statuses = []
+    lock = threading.Lock()
+
+    def one(i):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", tw.port, timeout=30)
+        try:
+            conn.request(
+                "POST", "/v1/chat/completions",
+                body=json.dumps({
+                    "messages": [
+                        {"role": "system", "content": shared},
+                        {"role": "user", "content": f"q {i}"},
+                    ],
+                    "max_tokens": 6, "stream": True,
+                }),
+                headers={"Content-Type": "application/json",
+                         "X-DLT-SLO-Class": "interactive"},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            with lock:
+                statuses.append(
+                    resp.status if b"[DONE]" in body or resp.status != 200
+                    else 599  # truncated stream = a failed request
+                )
+        finally:
+            conn.close()
+
+    try:
+        # phase 1: warm affinity — traffic concentrates on one home
+        for i in range(10):
+            one(i)
+        hits_by_replica = [
+            r.state.counters.get("prefix_hits", 0) for r in tw.replicas
+        ]
+        home_idx = hits_by_replica.index(max(hits_by_replica))
+        home_key = tw.replica_keys()[home_idx]
+        assert max(hits_by_replica) >= 8, hits_by_replica
+        hits_at_drain = tw.fleet_prefix_hit_tokens()
+        served_at_drain = tw.replicas[home_idx].state.counters[
+            "requests_completed"
+        ]
+        # phase 2: drain the home UNDER live traffic (requests in flight)
+        live = [
+            threading.Thread(target=one, args=(100 + j,)) for j in range(6)
+        ]
+        for t in live:
+            t.start()
+        res = tw.autoscaler.drain(home_key)
+        for t in live:
+            t.join(timeout=30)
+        # the handoff re-homed the hot chains BEFORE the drain landed
+        assert res["rehomed_keys"] >= 5, res
+        assert tw.balancer.router.handoff_snapshot()["rehomed_keys"] >= 5
+        # phase 3: post-drain traffic — must land on the new home and hit
+        for i in range(200, 210):
+            one(i)
+        assert all(s == 200 for s in statuses), statuses  # ZERO failures
+        # the drained replica took no new requests (in-flight at the drain
+        # moment may still have completed — allow that overlap)
+        served_after = tw.replicas[home_idx].state.counters[
+            "requests_completed"
+        ]
+        assert served_after - served_at_drain <= 6
+        # prefix reuse RECOVERED: hits kept accruing fleet-wide, and a
+        # NON-drained replica now owns the chain (one cold fill, then hits)
+        assert tw.fleet_prefix_hit_tokens() > hits_at_drain
+        post_hits = [
+            r.state.counters.get("prefix_hits", 0)
+            for j, r in enumerate(tw.replicas) if j != home_idx
+        ]
+        assert max(post_hits) >= 8, post_hits
+        # the gateway's metrics surface counts the handoff
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.port}/metrics", timeout=30
+        ) as r:
+            body = r.read().decode()
+        line = next(
+            l for l in body.splitlines()
+            if l.startswith("dlt_router_handoff_rehomed_keys_total")
+        )
+        assert int(float(line.rsplit(None, 1)[1])) >= 5
+        assert "dlt_autoscaler_handoff_keys_total" in body
+    finally:
+        tw.close()
+
+
+# ---- autoscaler tick policy (units) -----------------------------------------
+
+
+class _FakeFleet:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def router_signals(self):
+        return self.rows
+
+
+def _signals(slots=4, active=0, queue=0, goodput=0.0, shed=0.0,
+             attainment=1.0):
+    return {
+        "batcher_batch_slots": slots, "batcher_slots_active": active,
+        "batcher_queue_depth": queue, "goodput_tokens_per_s": goodput,
+        "shed_per_s": shed, "slo_ttft_attainment": attainment,
+    }
+
+
+def _fresh(sig):
+    return {"stale": False, "age_s": 0.1, "signals": sig}
+
+
+def _balancer(n=3):
+    return Balancer(GatewayConfig(
+        backends=[Backend("h", i + 1) for i in range(n)],
+        probe_interval_s=0, fleet_scrape_s=0,
+    ))
+
+
+def _autoscaler(bal, **kw):
+    kw.setdefault("cooldown_s", 0.0)
+    cfg = AutoscalerConfig(
+        interval_s=0, min_live=1, low_water=0.3, down_after=2, **kw,
+    )
+    return Autoscaler(bal, config=cfg)
+
+
+def test_tick_drains_least_goodput_after_consecutive_low_ticks():
+    bal = _balancer(3)
+    keys = [b.key for b in bal.config.backends]
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals(goodput=900.0)),
+        keys[1]: _fresh(_signals(goodput=50.0)),   # the cheapest to lose
+        keys[2]: _fresh(_signals(goodput=400.0)),
+    })
+    a = _autoscaler(bal)
+    assert a.tick()["action"] == "hold"  # first low tick only counts
+    rec = a.tick()
+    assert rec["action"] == "drain" and keys[1] in rec["detail"]
+    assert bal.config.backends[1].draining is True
+    # draining continues one-at-a-time down to min_live, then holds
+    a.tick()
+    rec = a.tick()
+    assert rec["action"] == "drain"
+    assert sum(1 for b in bal.config.backends if not b.draining) == 1
+    for _ in range(4):
+        assert a.tick()["action"] == "hold"  # min_live floor
+    assert a.snapshot()["decisions"]["drain"] == 2
+
+
+def test_tick_undrains_own_drains_on_pressure_and_ignores_stale_rows():
+    bal = _balancer(2)
+    keys = [b.key for b in bal.config.backends]
+    bal.config.backends[1].draining = True
+    # queued demand on the one live replica = pressure, but the drain is
+    # an OPERATOR's (not the autoscaler's): never reverted
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals(active=4, queue=3)),
+        keys[1]: _fresh(_signals()),
+    })
+    a = _autoscaler(bal)
+    assert a.tick()["action"] == "hold"
+    assert bal.config.backends[1].draining is True
+    # the same drain REGISTERED as the autoscaler's own -> undrained
+    a._drained_by_me.add(keys[1])
+    bal.autoscaler = a
+    rec = a.tick()
+    assert rec["action"] == "undrain" and keys[1] in rec["detail"]
+    assert bal.config.backends[1].draining is False
+    assert keys[1] not in a._drained_by_me  # ownership cleared on undrain
+    # review fix: an OPERATOR undrain clears stale ownership too — a
+    # later operator drain of the same replica is not ours to revert
+    a._drained_by_me.add(keys[0])
+    bal.config.backends[0].draining = True
+    bal.set_draining(keys[0], False)  # the operator's undrain
+    assert keys[0] not in a._drained_by_me
+    # stale signals = no utilization evidence = never drain on silence
+    bal.fleet = _FakeFleet({
+        keys[0]: {"stale": True, "age_s": 99, "signals": {}},
+        keys[1]: {"stale": True, "age_s": 99, "signals": {}},
+    })
+    for _ in range(4):
+        rec = a.tick()
+        assert rec["action"] == "hold" and rec["utilization"] is None
+    assert not any(b.draining for b in bal.config.backends)
+
+
+def test_tick_pressure_blocks_drains():
+    """Review fix: low raw utilization must NOT shrink the fleet while
+    any replica is under pressure (shedding / queueing / missing SLO)."""
+    bal = _balancer(3)
+    keys = [b.key for b in bal.config.backends]
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals(attainment=0.5)),  # SLO pain, util 0
+        keys[1]: _fresh(_signals()),
+        keys[2]: _fresh(_signals()),
+    })
+    a = _autoscaler(bal)
+    for _ in range(4):
+        rec = a.tick()
+        assert rec["action"] == "hold" and "slo:" in rec["pressure"]
+    assert not any(b.draining for b in bal.config.backends)
+    # review fix: a PER-CLASS attainment miss is pressure even when the
+    # class-blended aggregate looks healthy (batch successes dilute it)
+    sig = _signals(attainment=1.0)
+    sig["slo_ttft_attainment_by_class"] = {
+        "interactive": 0.4, "standard": 1.0, "batch": 1.0,
+    }
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(sig),
+        keys[1]: _fresh(_signals()),
+        keys[2]: _fresh(_signals()),
+    })
+    rec = a.tick()
+    assert rec["action"] == "hold"
+    assert rec["pressure"].startswith("slo:interactive:")
+
+
+def test_tick_min_live_counts_only_fresh_replicas():
+    """Review fix: during a partial outage, silent (stale) backends are
+    not capacity — the min_live floor must hold against the replicas with
+    fresh evidence, or the loop drains the last working one."""
+    bal = _balancer(3)
+    keys = [b.key for b in bal.config.backends]
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals()),  # the one healthy, idle replica
+        keys[1]: {"stale": True, "age_s": 99, "signals": {}},
+        keys[2]: {"stale": True, "age_s": 99, "signals": {}},
+    })
+    a = _autoscaler(bal)  # min_live=1; len(live)=3 would wrongly allow
+    for _ in range(4):
+        assert a.tick()["action"] == "hold"
+    assert not any(b.draining for b in bal.config.backends)
+
+
+def test_tick_pressure_reasons_and_cooldown():
+    bal = _balancer(2)
+    keys = [b.key for b in bal.config.backends]
+    # a missed TTFT SLO is pressure even with free slots
+    bal.config.backends[1].draining = True
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals(attainment=0.5)),
+        keys[1]: _fresh(_signals()),
+    })
+    a = _autoscaler(bal, cooldown_s=60.0)
+    a._drained_by_me.add(keys[1])  # the autoscaler's own drain
+    rec = a.tick()
+    assert rec["action"] == "undrain" and "slo:" in rec["pressure"]
+    # cooldown gates the NEXT drain: idle fleet, but the scale action just
+    # happened -> consecutive ticks hold until the cooldown elapses
+    bal.fleet = _FakeFleet({
+        keys[0]: _fresh(_signals()), keys[1]: _fresh(_signals()),
+    })
+    for _ in range(4):
+        assert a.tick()["action"] == "hold"
+    assert not any(b.draining for b in bal.config.backends)
+
+
+def test_set_draining_purges_router_locality(monkeypatch):
+    """Satellite: Balancer.set_draining runs the router's locality
+    hygiene — learned chain keys re-home off the drained backend."""
+    from distributed_llama_tpu.server.router import Router, RouterConfig
+
+    bal = _balancer(3)
+    r = Router(RouterConfig())
+    bal.router = r
+    body = json.dumps({
+        "messages": [{"role": "system", "content": "D" * 300},
+                     {"role": "user", "content": "q"}],
+    }).encode()
+    plan = r.plan(body, bal)
+    victim = bal.config.backends[plan.ranked[0]].key
+    r.learn(plan, victim)
+    assert victim in r._locality.values()
+    assert bal.set_draining(victim, True)
+    assert victim not in r._locality.values()  # re-homed, not just gone
+    assert len(r._locality) == len(plan.chain)
+    snap = r.handoff_snapshot()
+    assert snap["rehomed_keys"] == len(plan.chain)
+    assert snap["drain_events"] == 1
+    # draining the survivors too: with nobody left, entries PURGE
+    for b in bal.config.backends:
+        bal.set_draining(b.key, True)
+    assert len(r._locality) == 0
+    assert r.handoff_snapshot()["purged_keys"] > 0

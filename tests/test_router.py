@@ -295,6 +295,52 @@ def test_resolve_fallback_stale_when_no_fresh_signals():
     assert r.resolve(plan, not_affinity) == "fallback_stale"
 
 
+def test_rehome_keys_points_chains_at_surviving_owners():
+    """Warm drain handoff (server/autoscaler.py -> Router.rehome_keys):
+    hex chain keys from a /debug/hot_prefixes snapshot land in the
+    locality map pointing at rendezvous owners among the SURVIVORS —
+    deterministically, so every gateway re-homes identically."""
+    r = Router(RouterConfig())
+    survivors = ["h:1", "h:2"]
+    keys = [fnv1a(f"hot-{i}".encode()) for i in range(20)]
+    n = r.rehome_keys([f"{k:016x}" for k in keys] + ["not-hex!"], survivors)
+    assert n == 20  # the garbage key is skipped, not fatal
+    with r._lock:
+        for k in keys:
+            assert r._locality[k] == rendezvous_owner(k, survivors)
+    assert r.handoff_snapshot()["rehomed_keys"] == 20
+    assert r.snapshot()["handoff"]["rehomed_keys"] == 20
+    # no survivors: a no-op, never a crash mid-drain
+    assert r.rehome_keys([f"{keys[0]:016x}"], []) == 0
+    # a chain whose learned home is a HEALTHY survivor is left alone —
+    # the drain victim serving it once must not evict warm affinity
+    # elsewhere; a chain homed on the VICTIM is re-homed
+    with r._lock:
+        r._locality[keys[0]] = "h:2"      # healthy home
+        r._locality[keys[1]] = "h:gone"   # the draining replica's
+    n = r.rehome_keys(
+        [f"{keys[0]:016x}", f"{keys[1]:016x}"], survivors, from_key="h:gone"
+    )
+    assert n == 1
+    with r._lock:
+        assert r._locality[keys[0]] == "h:2"
+        assert r._locality[keys[1]] == rendezvous_owner(keys[1], survivors)
+
+
+def test_messages_prefix_text_matches_chat_prefix_text():
+    """The replica-side hot-prefix tracker (server/api.py) and the
+    gateway's router must hash the SAME text for the same request, or
+    handoff chain keys would never match the locality map's."""
+    from distributed_llama_tpu.server.router import messages_prefix_text
+
+    body = _chat_body("S" * 100, "user question")
+    assert chat_prefix_text(body) == messages_prefix_text(
+        json.loads(body)["messages"]
+    )
+    assert messages_prefix_text(["not-a-dict"]) is None
+    assert messages_prefix_text(None) is None
+
+
 def test_locality_map_is_lru_bounded():
     bal = _balancer()
     r = Router(RouterConfig(locality_size=4))
