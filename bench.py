@@ -1082,6 +1082,145 @@ def leg_routing():
     }
 
 
+def leg_kv_movement():
+    """KV movement leg (runtime/kv_transport.py): the ISSUE-13 disagg
+    transfer bar. One prefill worker + one decode worker peered DIRECTLY
+    at it (same-process registry), both on the paged server default. Two
+    arms over identical fresh-prefix traffic: the DEVICE transport (KV
+    handed over as device arrays, zero host serialization) vs the HTTP
+    binary codec forced by DLT_KV_TRANSPORT=http — median per-request
+    kv_transfer_us from the goodput ledger, bar: device cuts the transfer
+    wall >= 3x. Plus the content-addressed re-send proof: a grown prefix
+    ships only its missing pages (disagg_pages_skipped > 0)."""
+    import json as _json
+    import socket as _socket
+    import statistics as _st
+    import threading
+    import urllib.request
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.server.disagg import DisaggClient
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    model = build_model(
+        "llama_routing_q40_v1",
+        dim=512, hidden_dim=1536, n_layers=8, n_heads=8, n_kv_heads=4,
+        vocab_size=4096, seq_len=2048,
+    )
+    tok_path = os.path.join(CACHE_DIR, "routing_tok_v1.t")
+    if not os.path.exists(tok_path):
+        write_tiny_tokenizer(
+            tok_path, pad_to=4096,
+            chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+        )
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    os.environ["DLT_COST_TABLE"] = "0"
+    servers = []
+    try:
+        def start(extra):
+            p = build_arg_parser()
+            p.add_argument("--port", type=int, default=0)
+            port = free_port()
+            args = p.parse_args(
+                [
+                    "inference", "--model", model, "--tokenizer", tok_path,
+                    "--steps", "0", "--temperature", "0.0",
+                    "--port", str(port),
+                ] + extra
+            )
+            httpd = api_mod.serve(args)
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            servers.append(httpd)
+            return port, httpd
+
+        pf_port, _pf = start(["--role", "prefill"])
+        dec_port, dec = start(
+            ["--role", "decode", "--prefill-peer", f"127.0.0.1:{pf_port}"]
+        )
+        state = dec.RequestHandlerClass.state
+
+        def ask(system, user):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dec_port}/v1/chat/completions",
+                data=_json.dumps(
+                    {
+                        "messages": [
+                            {"role": "system", "content": system},
+                            {"role": "user", "content": user},
+                        ],
+                        "max_tokens": 8,
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return _json.loads(r.read())
+
+        def run_arm(transport, tag, n=4):
+            state.disagg = DisaggClient(
+                state, [("127.0.0.1", pf_port)], transport=transport
+            )
+            walls = []
+            tokens = 0
+            for i in range(n):
+                # distinct 512-char prefixes: every request is a real
+                # transfer, never a local hit
+                r = ask(f"{tag}{i}" + "x" * 508, f"question {i}")
+                g = r["usage"]["goodput"]
+                assert g["kv_transfer_path"] == transport, g
+                walls.append(g["kv_transfer_us"])
+                tokens += g["prompt_tokens"] - g["prefix_hit_tokens"]
+            return {
+                "kv_transfer_us_median": int(_st.median(walls)),
+                "remote_prefill_tokens": tokens,
+            }
+
+        # warm both ladders through one throwaway request per arm
+        run_arm("device", "W")
+        run_arm("http", "V", n=1)
+        dev = run_arm("device", "D")
+        http = run_arm("http", "H")
+
+        # content-addressed re-send: base prefix, then the grown twin —
+        # only the missing pages ship
+        state.disagg = DisaggClient(
+            state, [("127.0.0.1", pf_port)], transport="device"
+        )
+        base = "G" + "g" * 255  # ~256-token base prefix
+        ask(base, "first")
+        c0 = state.engine.stats.counters_snapshot()
+        ask(base + "h" * 512, "second")
+        c1 = state.engine.stats.counters_snapshot()
+        skipped = c1.get("disagg_pages_skipped", 0) - c0.get(
+            "disagg_pages_skipped", 0
+        )
+        bytes_dev = c1.get("kv_transfer_bytes_device", 0)
+        bytes_http = c1.get("kv_transfer_bytes_http", 0)
+    finally:
+        os.environ.pop("DLT_COST_TABLE", None)
+        for s in servers:
+            s.shutdown()
+    gain = http["kv_transfer_us_median"] / max(dev["kv_transfer_us_median"], 1)
+    return {
+        "config": "kv-movement q40 prefill->decode disagg, device vs http",
+        "kv_transfer_us_device_median": dev["kv_transfer_us_median"],
+        "kv_transfer_us_http_median": http["kv_transfer_us_median"],
+        "device_gain_x": round(gain, 2),
+        "gain_bar_x": 3.0,
+        "pages_skipped_resend": skipped,
+        "kv_transfer_bytes_device_total": bytes_dev,
+        "kv_transfer_bytes_http_total": bytes_http,
+    }
+
+
 def leg_loadtwin():
     """Fleet-control-plane leg (server/loadtwin.py + server/scheduler.py):
     the ISSUE-12 mixed-class SLO twin. One seeded bursty mixed-class trace
@@ -1330,6 +1469,13 @@ def main():
         print(f"# routing: {rt}", file=sys.stderr)
     except Exception as e:
         print(f"# routing leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        kvm = leg_kv_movement()
+        configs.append(kvm)
+        print(f"# kv-movement: {kvm}", file=sys.stderr)
+    except Exception as e:
+        print(f"# kv-movement leg failed: {e!r}", file=sys.stderr)
 
     try:
         lt = leg_loadtwin()

@@ -208,7 +208,7 @@ def trace_entry(engine, entry: LadderEntry):
     cfg, b = engine.cfg, engine.batch
     pt_sds, ps = _paged_args(engine)
     if entry.kind == "prefill":
-        if engine.paged:
+        if engine.paged and not engine.use_pipeline:
             from ..models.transformer import forward
 
             fn = lambda toks, pos, pt: forward(
@@ -219,6 +219,8 @@ def trace_entry(engine, entry: LadderEntry):
             return jax.make_jaxpr(fn)(
                 _sds((b, entry.size), jnp.int32), _sds((), jnp.int32), pt_sds
             )
+        # pipeline engines (paged included — engine._forward threads the
+        # page-table operand itself) and contiguous non-mesh engines
         fn = lambda toks, pos: engine._forward(
             toks, pos, logits_mode="last", kv_len=entry.kv_len
         )
@@ -236,6 +238,8 @@ def trace_entry(engine, entry: LadderEntry):
                 cfg, engine.mesh, engine.params, engine.rope, engine.cache,
                 tok, pos, key, n_steps=entry.size, temperature=0.0,
                 topp=0.9, kv_len=entry.kv_len,
+                page_table=engine._pt_operand() if engine.paged else None,
+                page_size=ps,
             )
         else:
             from ..runtime.decode import decode_chunk
@@ -262,6 +266,8 @@ def trace_entry(engine, entry: LadderEntry):
             fn = lambda toks, pos_vec: pipeline_forward(
                 cfg, engine.mesh, engine.params, engine.rope, engine.cache,
                 toks, pos_vec, logits_mode="last", kv_len=entry.kv_len,
+                page_table=engine._pt_operand() if engine.paged else None,
+                page_size=ps,
             )
             return jax.make_jaxpr(fn)(
                 _sds((b, entry.size), jnp.int32), _sds((b,), jnp.int32)
@@ -298,6 +304,8 @@ def trace_entry(engine, entry: LadderEntry):
                 cfg, engine.mesh, engine.params, engine.rope, engine.cache,
                 tok, pos, keys, temp, topp, n_steps=entry.size,
                 kv_len=entry.kv_len,
+                page_table=engine._pt_operand() if engine.paged else None,
+                page_size=ps,
             )
         else:
             from ..runtime.batch_session import batch_decode_chunk
@@ -325,8 +333,30 @@ def trace_entry(engine, entry: LadderEntry):
     if entry.kind == "page_copy":
         from ..runtime.paged_kv import copy_page
 
-        fn = lambda src, dst: copy_page(engine.cache, src, dst)
+        fn = lambda src, dst: copy_page(
+            engine.cache, src, dst, out_sharding=engine._cache_sharding
+        )
         return jax.make_jaxpr(fn)(_sds((), jnp.int32), _sds((), jnp.int32))
+    if entry.kind in ("page_extract", "page_insert"):
+        # the KV movement layer's page-shipping programs (runtime/
+        # kv_transport.py): pure gather/scatter between the pool and one
+        # contiguous slice — zero collectives on every topology (the pool's
+        # page axis is replicated; layer/head axes move shard-locally)
+        from ..runtime.paged_kv import gather_pages, scatter_pages
+
+        n = entry.size // engine.page_size
+        if entry.kind == "page_extract":
+            fn = lambda pages: gather_pages(
+                engine.cache, pages,
+                out_sharding=engine.prefix_cache.seg_sharding,
+            )
+            return jax.make_jaxpr(fn)(_sds((n,), jnp.int32))
+        L, _, _, h, d = engine.cache.k.shape
+        seg = _sds((L, entry.size, h, d), engine.cache.k.dtype)
+        fn = lambda k, v, pages: scatter_pages(
+            engine.cache, k, v, pages, out_sharding=engine._cache_sharding
+        )
+        return jax.make_jaxpr(fn)(seg, seg, _sds((n,), jnp.int32))
     if entry.kind in ("verify", "verify_row"):
         # the speculative verify program: a prefill-shaped logits_mode="all"
         # forward (+ in-graph argmax on the fused non-mesh path). Mirrors
@@ -344,6 +374,8 @@ def trace_entry(engine, entry: LadderEntry):
                 cfg, engine.mesh, engine.params, engine.rope, engine.cache,
                 toks, pos, logits_mode="all", microbatches=micro,
                 kv_len=entry.kv_len,
+                page_table=engine._pt_operand() if engine.paged else None,
+                page_size=ps,
             )
         else:
             from ..runtime.speculative import verify_chunk
@@ -416,7 +448,12 @@ def expected_collectives(engine, entry: LadderEntry):
     surprise collective there would mean a splice is reshuffling cached KV
     across stages on every hit.
     """
-    if entry.kind.startswith("prefix_"):
+    if entry.kind.startswith(("prefix_", "page_")):
+        # prefix copies AND the paged layer's page programs (page_copy /
+        # page_extract / page_insert) are plain slice/gather/scatter
+        # programs on EVERY topology — zero explicit collectives always: a
+        # surprise collective there would mean page movement is reshuffling
+        # KV across stages on every COW / ship / insert
         return {}
     if not engine.use_pipeline:
         return {}
@@ -535,28 +572,44 @@ def donation_problems(engine) -> list:
     if engine.use_pipeline:
         from ..parallel import pipeline as pl
 
+        paged = engine.paged
+        psz = engine.page_size
         fn = pl._cached_pipeline_fn(
             cfg, engine.mesh, engine.params, engine.cache,
-            ("fwd", "last", 1, kvb, False),
+            ("fwd", "last", 1, kvb, False, paged, psz),
             lambda ps, cs: pl._build_pipeline_fn(
-                cfg, engine.mesh, ps, cs, "last", 1, kvb, per_row=False
+                cfg, engine.mesh, ps, cs, "last", 1, kvb, per_row=False,
+                page_size=psz if paged else None,
             ),
         )
-        check(
-            "pipeline_forward",
-            fn.lower(engine.params, engine.rope, engine.cache, tok1, pos),
-        )
+        fwd_args = (engine.params, engine.rope, engine.cache, tok1, pos)
+        if paged:
+            fwd_args = fwd_args + (engine._pt_operand(),)
+        check("pipeline_forward", fn.lower(*fwd_args))
         dfn = pl._cached_pipeline_fn(
             cfg, engine.mesh, engine.params, engine.cache,
-            ("decode", 1, 0.0, 0.9, kvb, False),
+            ("decode", 1, 0.0, 0.9, kvb, False, paged, psz),
             lambda ps, cs: pl._build_pipeline_decode_fn(
-                cfg, engine.mesh, ps, cs, 1, 0.0, 0.9, kvb, per_row=False
+                cfg, engine.mesh, ps, cs, 1, 0.0, 0.9, kvb, per_row=False,
+                page_size=psz if paged else None,
             ),
         )
-        check(
-            "pipeline_decode_chunk",
-            dfn.lower(engine.params, engine.rope, engine.cache, tokb, pos, key),
-        )
+        dec_args = (engine.params, engine.rope, engine.cache, tokb, pos, key)
+        if paged:
+            dec_args = dec_args + (engine._pt_operand(),)
+        check("pipeline_decode_chunk", dfn.lower(*dec_args))
+        if paged:
+            # the mesh-paged COW page copy donates the sharded pool exactly
+            # like the single-chip one
+            from ..runtime.paged_kv import copy_page
+
+            check(
+                "copy_page",
+                copy_page.lower(
+                    engine.cache, jnp.int32(0), jnp.int32(1),
+                    out_sharding=engine._cache_sharding,
+                ),
+            )
     else:
         from ..models.transformer import forward
         from ..runtime.decode import decode_chunk
@@ -661,6 +714,30 @@ def donation_problems(engine) -> list:
                 out_sharding=pc.cache_sharding,
             ),
         )
+    if (
+        engine.paged
+        and engine.prefix_cache is not None
+        and engine.prefix_cache.buckets
+    ):
+        # the paged external-insert scatter donates the live pool like
+        # every other pool-writing program (runtime/kv_transport.py)
+        from ..runtime.paged_kv import scatter_pages
+
+        P0 = next(
+            (B for B in engine.prefix_cache.buckets if B >= engine.page_size),
+            None,
+        )
+        if P0:
+            n = P0 // engine.page_size
+            L, _, _, h, d = engine.cache.k.shape
+            seg = jnp.zeros((L, P0, h, d), engine.cache.k.dtype)
+            check(
+                "scatter_pages",
+                scatter_pages.lower(
+                    engine.cache, seg, seg, jnp.zeros((n,), jnp.int32),
+                    out_sharding=engine._cache_sharding,
+                ),
+            )
     return problems
 
 
@@ -674,13 +751,28 @@ def sharding_problems(engine) -> list:
         return []
     from jax.sharding import NamedSharding
 
-    from ..parallel.pipeline import pp_cache_sharding
+    from ..parallel.pipeline import pp_cache_sharding, pp_paged_pool_sharding
 
     problems = []
-    expected_cache = pp_cache_sharding(engine.mesh)
+    expected_cache = (
+        pp_paged_pool_sharding(engine.mesh)
+        if engine.paged
+        else pp_cache_sharding(engine.mesh)
+    )
+
+    def norm(spec):
+        # trailing Nones are unsharded-dim noise: plain-jit programs (the
+        # paged pool's page movement) trim them from output shardings
+        t = tuple(spec)
+        while t and t[-1] is None:
+            t = t[:-1]
+        return t
+
     for name, arr in (("cache.k", engine.cache.k), ("cache.v", engine.cache.v)):
         sh = getattr(arr, "sharding", None)
-        if not isinstance(sh, NamedSharding) or sh.spec != expected_cache.spec:
+        if not isinstance(sh, NamedSharding) or norm(sh.spec) != norm(
+            expected_cache.spec
+        ):
             problems.append(
                 f"{name} sharding {getattr(sh, 'spec', None)} != pipeline "
                 f"cache spec {expected_cache.spec}"
@@ -802,8 +894,20 @@ def main(argv=None) -> int:
     p.add_argument(
         "--kv-layout", choices=["contiguous", "paged"], default="contiguous",
         help="audit the paged-KV program ladder (page-table gather/scatter "
-        "forwards + the copy-on-write page copy) instead of the contiguous "
-        "one (runtime/paged_kv.py)",
+        "forwards, the copy-on-write page copy, and the KV movement "
+        "layer's page_extract/page_insert shipping programs) instead of "
+        "the contiguous one (runtime/paged_kv.py, runtime/kv_transport.py)",
+    )
+    p.add_argument(
+        "--pp", type=int, default=1,
+        help="audit on a pipeline-parallel mesh of this extent (needs that "
+        "many devices — CI uses xla_force_host_platform_device_count); "
+        "with --kv-layout paged this is the MESH-PAGED ladder: collective "
+        "budgets must match the contiguous twin's",
+    )
+    p.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel mesh extent (composes with --pp)",
     )
     p.add_argument(
         "--costs", action="store_true",
@@ -815,19 +919,32 @@ def main(argv=None) -> int:
 
     from ..runtime.engine import InferenceEngine
 
+    mesh = None
+    if args.pp > 1 or args.tp > 1:
+        from ..parallel import make_mesh
+
+        mesh = make_mesh(pp=args.pp, tp=args.tp)
     with tempfile.TemporaryDirectory() as d:
         model = args.model
         if model is None:
             from ..testing import tiny_header, write_tiny_model
 
             model = d + "/tiny.m"
-            write_tiny_model(model, tiny_header(seq_len=128), seed=0)
+            if mesh is not None:
+                # layer/head counts must divide over the mesh axes
+                hdr = tiny_header(
+                    seq_len=128, dim=128, hidden_dim=128, n_layers=4,
+                    n_heads=4, n_kv_heads=4,
+                )
+            else:
+                hdr = tiny_header(seq_len=128)
+            write_tiny_model(model, hdr, seed=0)
         engine = InferenceEngine(
             model, compute_dtype=args.compute_dtype, batch=args.batch,
             max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
             prefix_cache_mb=args.prefix_cache_mb,
             speculative=args.speculative, draft_k=args.draft_k,
-            kv_layout=args.kv_layout,
+            kv_layout=args.kv_layout, mesh=mesh,
         )
         try:
             reports = audit_engine(engine)

@@ -76,7 +76,10 @@ HOST_SYNC_SCOPE = ("runtime", "parallel")
 #: fleet control plane — scheduler admission/preemption loops
 #: (server/scheduler.py), autoscaler ticks (server/autoscaler.py), the
 #: load twin's stub decode loop (server/loadtwin.py) — the goodput-ledger
-#: /batch-timeline/gw_route/kv_transfer/scheduler-decision emission sites)
+#: /batch-timeline/gw_route/kv_transfer/scheduler-decision emission
+#: sites). The KV movement layer (runtime/kv_transport.py) rides the
+#: `runtime` prefix: its transport fetch loops and the per-segment
+#: insert/extract loops are in scope like every other hot path.
 TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 
 

@@ -110,9 +110,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--kv-layout", choices=["contiguous", "paged"], default=None,
         help="KV cache layout (runtime/paged_kv.py): 'paged' = fixed-size "
         "KV pages + per-row page tables with zero-copy prefix sharing and "
-        "copy-on-write (the batch-scale layout; single-chip engines only); "
-        "'contiguous' = per-row seq_len slabs (the bit-identity A/B arm). "
-        "Default: DLT_KV_LAYOUT env, else contiguous",
+        "copy-on-write (the batch-scale layout; single-chip AND pure "
+        "pp x tp pipeline meshes); 'contiguous' = per-row seq_len slabs "
+        "(the bit-identity A/B arm). Default: DLT_KV_LAYOUT env, else "
+        "PAGED for the CLI/server entry points (library engines default "
+        "contiguous)",
     )
     p.add_argument(
         "--kv-page-size", type=int, default=0,
@@ -133,8 +135,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "workers answer POST /v1/prefill (run the prompt, ship bucket-"
         "aligned KV); 'decode' workers fetch shipped KV from --prefill-peer "
         "before admission and stream tokens; 'unified' (default, or "
-        "DLT_ROLE env) serves everything locally. Disaggregated roles "
-        "force the contiguous KV layout",
+        "DLT_ROLE env) serves everything locally. Both roles serve both "
+        "KV layouts; DLT_KV_TRANSPORT={auto,device,http} picks the "
+        "transfer path per peer (runtime/kv_transport.py)",
     )
     p.add_argument(
         "--prefill-peer", action="append", default=None, metavar="HOST:PORT",
@@ -216,15 +219,27 @@ def make_engine(args) -> InferenceEngine:
         )
     from .runtime.paged_kv import resolve_kv_layout
 
-    kv_layout = resolve_kv_layout(getattr(args, "kv_layout", None))
+    # paged is the serving DEFAULT for the CLI/server entry points (library
+    # engines constructed directly keep the contiguous default): it went
+    # through its soak — mesh twins token-identical to contiguous, zero
+    # post-warmup recompiles under sanitizers, disagg roles on both
+    # transports — and the default pool sizes at contiguous parity, so it
+    # never fits fewer tokens. One shared resolver owns the env parsing.
+    kv_layout = resolve_kv_layout(getattr(args, "kv_layout", None), default="paged")
     if kv_layout == "paged" and mesh is not None:
-        # multi-chip engines keep the contiguous layout (paged is
-        # single-chip for now) — say so instead of failing the launch
-        print(
-            "⚠️  --kv-layout paged is single-chip only: this mesh engine "
-            "keeps the contiguous KV layout"
+        # the mesh-paged path (runtime/kv_transport.py's mesh plumbing)
+        # covers the reference's PPxTP topology: the pure pp x tp shard_map
+        # pipeline. Other extents keep contiguous — say so instead of
+        # failing the launch (sp shards the very axis paging replaces).
+        pure_pptp = mesh.shape.get("dp", 1) == 1 and sp == 1 and ep == 1 and (
+            mesh.shape["pp"] > 1 or mesh.shape["tp"] > 1
         )
-        kv_layout = "contiguous"
+        if not pure_pptp:
+            print(
+                "⚠️  --kv-layout paged covers single-chip and pure pp x tp "
+                "pipeline meshes: this topology keeps the contiguous KV layout"
+            )
+            kv_layout = "contiguous"
     try:
         engine = InferenceEngine(
             args.model,

@@ -107,6 +107,18 @@ def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("pp", "dp", "sp", "tp", None))
 
 
+def pp_paged_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the paged KV pool [L, n_pages, page_size, n_kv, head_dim]
+    (runtime/paged_kv.py) on a pipeline mesh: the layer stack over `pp` and
+    the kv heads over `tp` — exactly the axes `pp_cache_sharding` shards on
+    the contiguous cache — with the page axis REPLICATED: page ids are
+    global, so the host-side pool, tables, refcounts, and prefix-page
+    sharing need zero mesh awareness (the mesh-paged design's whole
+    point). Inside shard_map each stage sees [L/pp, n_pages, ps, h/tp, d]
+    and indexes it with the same global page ids every other stage uses."""
+    return NamedSharding(mesh, P("pp", None, None, "tp", None))
+
+
 def pp_prefix_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding of a prefix-cache KV slice [L, P, heads, head_dim]
     (runtime/prefix_cache.py): the live cache's own per-stage layout minus
@@ -120,7 +132,8 @@ def pp_prefix_sharding(mesh: Mesh) -> NamedSharding:
 
 def _local_stage(
     cfg, rope, x, positions, pos_start, layers, k_cache, v_cache, sp_ctx,
-    ep_axis=None, kv_len=None, stacked_cache=False,
+    ep_axis=None, kv_len=None, stacked_cache=False, page_table=None,
+    page_size=None,
 ):
     """Run this device's resident layers over x (a scan, like the global
     forward but over the local slice).
@@ -128,10 +141,15 @@ def _local_stage(
     `stacked_cache`: the local [L_local, b, S, ...] cache rides the scan's
     CARRY with in-place per-layer updates (models/transformer.py) instead of
     being re-stacked through xs/ys — the decode path, where the re-stack was
-    the per-token floor. Weights still arrive as per-layer xs slices."""
+    the per-token floor. Weights still arrive as per-layer xs slices.
+
+    `page_table` (mesh-paged, runtime/paged_kv.py): k/v are then the LOCAL
+    shard of the page pool ([L/pp, n_pages, ps, h/tp, d]) riding the carry;
+    the replicated table steers writes/reads exactly like the single-chip
+    paged path — always stacked (the pool has no per-layer xs form)."""
     reduce_fn = lambda z: jax.lax.psum(z, "tp")
 
-    if stacked_cache:
+    if stacked_cache or page_table is not None:
 
         def body(carry, per_layer):
             x, k_c, v_c = carry
@@ -140,6 +158,7 @@ def _local_stage(
                 cfg, rope, x, positions, pos_start, lp, k_c, v_c,
                 reduce_fn=reduce_fn, sp_ctx=sp_ctx, ep_axis=ep_axis,
                 kv_len=kv_len, stacked_cache=True, cache_layer=li,
+                page_table=page_table, page_size=page_size,
             )
             return (x, k_c, v_c), None
 
@@ -178,6 +197,10 @@ def pipeline_forward(
     kv_len: int | None = None,  # static GLOBAL KV read bound
     # (models.transformer._layer); under sp each shard clamps it to its
     # local slice — min(kv_len, local_seq) — which is exact (see _layer)
+    page_table=None,  # mesh-paged KV (runtime/paged_kv.py): [b, slots]
+    # int32, REPLICATED over the mesh (page ids are global); cache is then
+    # the pp/tp-sharded page pool (pp_paged_pool_sharding)
+    page_size: int | None = None,
 ):
     """PPxTP forward step. Same contract as models.transformer.forward.
 
@@ -195,12 +218,20 @@ def pipeline_forward(
             f"({jnp.shape(tokens)[-1]})"
         )
     per_row = jnp.ndim(pos_start) > 0
+    paged = page_table is not None
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("fwd", logits_mode, microbatches, kv_len, per_row),
+        cfg, mesh, params, cache,
+        ("fwd", logits_mode, microbatches, kv_len, per_row, paged, page_size),
         lambda ps, cs: _build_pipeline_fn(
-            cfg, mesh, ps, cs, logits_mode, microbatches, kv_len, per_row=per_row
+            cfg, mesh, ps, cs, logits_mode, microbatches, kv_len,
+            per_row=per_row, page_size=page_size if paged else None,
         ),
     )
+    if paged:
+        return fn(
+            params, rope, cache, jnp.asarray(tokens),
+            jnp.asarray(pos_start, jnp.int32), jnp.asarray(page_table),
+        )
     return fn(params, rope, cache, jnp.asarray(tokens), jnp.asarray(pos_start, jnp.int32))
 
 
@@ -243,7 +274,7 @@ def _mesh_ctx(mesh, k_cache):
 
 def _stage_rounds(
     cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start, n_micro,
-    sp_ctx, ep_axis, kv_len=None,
+    sp_ctx, ep_axis, kv_len=None, page_table=None, page_size=None,
 ):
     """Push x_all [b, t, dim] through the GPipe schedule; returns
     (x_out [b, t, dim] — valid on every stage, k_cache, v_cache).
@@ -273,7 +304,23 @@ def _stage_rounds(
         pos0 = pos_start + jnp.maximum(mb_idx, 0) * mt
         active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
         off = jnp.arange(mt, dtype=jnp.int32)
-        if mt == 1:
+        if page_table is not None:
+            # mesh-paged rounds (runtime/paged_kv.py): the local pool shard
+            # updates IN PLACE inside the layer scan for ANY microbatch
+            # size — an inactive stage parks at seq_len and its writes DROP
+            # through the paged scatter, so the contiguous path's commit
+            # window (and its whole read+select+write machinery) vanishes.
+            # pos_eff stays scalar on the aligned prefill path so the flash
+            # kernel's scalar-pos gate still sees it.
+            pos_eff = jnp.where(active, pos0, jnp.int32(cfg.seq_len))
+            positions = pos_eff[..., None] + off[None, :]
+            positions = jnp.broadcast_to(positions, (b, mt))
+            y, k_cache, v_cache = _local_stage(
+                cfg, rope_t, x, positions, pos_eff, params.layers, k_cache,
+                v_cache, sp_ctx, ep_axis=ep_axis, kv_len=kv_len,
+                page_table=page_table, page_size=page_size,
+            )
+        elif mt == 1:
             # decode rounds: the local cache stack updates IN PLACE inside
             # the layer scan's carry (stacked_cache). An inactive stage is
             # "parked": its rows point at the global seq_len, so the
@@ -357,29 +404,34 @@ def _logits_of(cfg, params, x_out):
 
 def _build_pipeline_fn(
     cfg, mesh, params_spec, cache_spec, logits_mode, microbatches, kv_len=None,
-    per_row=False,
+    per_row=False, page_size=None,
 ):
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
     logits_spec = P("dp", None) if logits_mode == "last" else P("dp", None, None)
+    paged = page_size is not None
+    in_specs = (
+        params_spec, rope_spec, cache_spec, P("dp", None),
+        P("dp") if per_row else P(),
+    )
+    if paged:
+        in_specs = in_specs + (P(None, None),)  # replicated page table
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            params_spec, rope_spec, cache_spec, P("dp", None),
-            P("dp") if per_row else P(),
-        ),
+        in_specs=in_specs,
         out_specs=(logits_spec, cache_spec),
         check_vma=False,
     )
-    def run(params, rope_t, cache, tokens, pos_start):
+    def run(params, rope_t, cache, tokens, pos_start, page_table=None):
         k_cache, v_cache = cache.k, cache.v  # [L_local, b_local, local_seq, kvh_local, hd]
         sp_ctx, ep_axis = _mesh_ctx(mesh, k_cache)
         x_all = params.embedding[tokens].astype(jnp.float32)  # [b_local, t, dim]
         x_out, k_cache, v_cache = _stage_rounds(
             cfg, pp, params, rope_t, x_all, k_cache, v_cache, pos_start,
             max(microbatches, 1), sp_ctx, ep_axis, kv_len=kv_len,
+            page_table=page_table, page_size=page_size,
         )
         if logits_mode == "last":
             x_out = x_out[:, -1, :]
@@ -402,6 +454,8 @@ def pipeline_decode_chunk(
     topp: float = 0.9,
     kv_len: int | None = None,  # static GLOBAL KV read bound covering
     # pos_start + n_steps; under sp each shard clamps to its local slice
+    page_table=None,  # mesh-paged KV: replicated [b, slots] table
+    page_size: int | None = None,
 ):
     """On-device chunked decode for pipeline meshes: the same
     K-forwards-per-host-call loop as runtime/decode.py decode_chunk, but with
@@ -412,13 +466,20 @@ def pipeline_decode_chunk(
     aliases tokens[:, -1] on device (see runtime/decode.decode_chunk).
     """
     per_row = jnp.ndim(pos_start) > 0
+    paged = page_table is not None
     fn = _cached_pipeline_fn(
         cfg, mesh, params, cache,
-        ("decode", n_steps, temperature, topp, kv_len, per_row),
+        ("decode", n_steps, temperature, topp, kv_len, per_row, paged, page_size),
         lambda ps, cs: _build_pipeline_decode_fn(
-            cfg, mesh, ps, cs, n_steps, temperature, topp, kv_len, per_row=per_row
+            cfg, mesh, ps, cs, n_steps, temperature, topp, kv_len,
+            per_row=per_row, page_size=page_size if paged else None,
         ),
     )
+    if paged:
+        return fn(
+            params, rope, cache, jnp.asarray(token),
+            jnp.asarray(pos_start, jnp.int32), key, jnp.asarray(page_table),
+        )
     return fn(
         params, rope, cache, jnp.asarray(token),
         jnp.asarray(pos_start, jnp.int32), key,
@@ -427,24 +488,28 @@ def pipeline_decode_chunk(
 
 def _build_pipeline_decode_fn(
     cfg, mesh, params_spec, cache_spec, n_steps, temperature, topp, kv_len=None,
-    per_row=False,
+    per_row=False, page_size=None,
 ):
     from ..ops.sampling import sample_logits
 
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
+    paged = page_size is not None
+    in_specs = (
+        params_spec, rope_spec, cache_spec, P("dp"),
+        P("dp") if per_row else P(), P(),
+    )
+    if paged:
+        in_specs = in_specs + (P(None, None),)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            params_spec, rope_spec, cache_spec, P("dp"),
-            P("dp") if per_row else P(), P(),
-        ),
+        in_specs=in_specs,
         out_specs=(P("dp", None), P("dp"), cache_spec),
         check_vma=False,
     )
-    def run(params, rope_t, cache, token, pos_start, key):
+    def run(params, rope_t, cache, token, pos_start, key, page_table=None):
         sp_ctx, ep_axis = _mesh_ctx(mesh, cache.k)
         # independent sampling randomness per dp shard (the key arrives
         # replicated; without the fold every shard would draw the same coins
@@ -457,7 +522,8 @@ def _build_pipeline_decode_fn(
             x = params.embedding[token[:, None]].astype(jnp.float32)
             x_out, k_cache, v_cache = _stage_rounds(
                 cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx,
-                ep_axis, kv_len=kv_len,
+                ep_axis, kv_len=kv_len, page_table=page_table,
+                page_size=page_size,
             )
             logits = _logits_of(cfg, params, x_out[:, -1, :])
             key, sub = jax.random.split(key)
@@ -488,38 +554,54 @@ def pipeline_batch_decode_chunk(
     topp: jnp.ndarray,  # [b] f32
     n_steps: int = 16,
     kv_len: int | None = None,
+    page_table=None,  # mesh-paged KV: replicated [b, slots] table
+    page_size: int | None = None,
 ):
     """Mesh twin of runtime/batch_session.batch_decode_chunk: everything
     per-row and traced (continuous batching on tp/pp/sp/ep meshes). Returns
     (tokens [b, n_steps], cache, keys)."""
+    paged = page_table is not None
     fn = _cached_pipeline_fn(
-        cfg, mesh, params, cache, ("batch_decode", n_steps, kv_len),
-        lambda ps, cs: _build_pipeline_batch_decode_fn(cfg, mesh, ps, cs, n_steps, kv_len),
+        cfg, mesh, params, cache, ("batch_decode", n_steps, kv_len, paged, page_size),
+        lambda ps, cs: _build_pipeline_batch_decode_fn(
+            cfg, mesh, ps, cs, n_steps, kv_len,
+            page_size=page_size if paged else None,
+        ),
     )
-    return fn(
+    args = (
         params, rope, cache, jnp.asarray(token), jnp.asarray(pos, jnp.int32),
         jnp.asarray(keys), jnp.asarray(temperature, jnp.float32),
         jnp.asarray(topp, jnp.float32),
     )
+    if paged:
+        return fn(*args, jnp.asarray(page_table))
+    return fn(*args)
 
 
-def _build_pipeline_batch_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps, kv_len):
+def _build_pipeline_batch_decode_fn(
+    cfg, mesh, params_spec, cache_spec, n_steps, kv_len, page_size=None
+):
     from ..ops.sampling import sample_logits_per_row, split_row_keys
 
     pp = mesh.shape["pp"]
     rope_spec = RopeTables(cos=P(), sin=P())
+    paged = page_size is not None
+    in_specs = (
+        params_spec, rope_spec, cache_spec, P("dp"), P("dp"),
+        P("dp", None), P("dp"), P("dp"),
+    )
+    if paged:
+        in_specs = in_specs + (P(None, None),)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            params_spec, rope_spec, cache_spec, P("dp"), P("dp"),
-            P("dp", None), P("dp"), P("dp"),
-        ),
+        in_specs=in_specs,
         out_specs=(P("dp", None), cache_spec, P("dp", None)),
         check_vma=False,
     )
-    def run(params, rope_t, cache, token, pos0, keys, temperature, topp):
+    def run(params, rope_t, cache, token, pos0, keys, temperature, topp,
+            page_table=None):
         sp_ctx, ep_axis = _mesh_ctx(mesh, cache.k)
 
         def step(carry, _):
@@ -527,7 +609,8 @@ def _build_pipeline_batch_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps,
             x = params.embedding[token[:, None]].astype(jnp.float32)
             x_out, k_cache, v_cache = _stage_rounds(
                 cfg, pp, params, rope_t, x, k_cache, v_cache, pos, 1, sp_ctx,
-                ep_axis, kv_len=kv_len,
+                ep_axis, kv_len=kv_len, page_table=page_table,
+                page_size=page_size,
             )
             logits = _logits_of(cfg, params, x_out[:, -1, :])
             keys, subs = split_row_keys(keys)
@@ -545,5 +628,13 @@ def _build_pipeline_batch_decode_fn(cfg, mesh, params_spec, cache_spec, n_steps,
 def _spec_of(a) -> P:
     sh = getattr(a, "sharding", None)
     if isinstance(sh, NamedSharding):
-        return sh.spec
+        # normalize trailing Nones away: plain-jit programs (the paged
+        # pool's page_copy/gather/scatter) return shardings with the
+        # trailing unsharded dims TRIMMED, and an un-normalized spec here
+        # would give the post-warmup cache a different _cached_pipeline_fn
+        # key than warmup compiled — a guaranteed recompile-sentinel breach
+        spec = tuple(sh.spec)
+        while spec and spec[-1] is None:
+            spec = spec[:-1]
+        return P(*spec)
     return P()

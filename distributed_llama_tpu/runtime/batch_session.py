@@ -486,6 +486,8 @@ class BatchSession:
                 toks, eng.cache, keys = pipeline_batch_decode_chunk(
                     eng.cfg, eng.mesh, eng.params, eng.rope, eng.cache,
                     token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+                    page_table=eng._pt_operand() if eng.paged else None,
+                    page_size=eng.page_size,
                 )
             else:
                 toks, eng.cache, keys = batch_decode_chunk(

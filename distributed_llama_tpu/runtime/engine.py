@@ -210,8 +210,14 @@ class InferenceEngine:
         # slabs — the reference shape and the bit-identity A/B arm) or
         # "paged" (fixed-size KV pages + per-row page tables, zero-copy
         # prefix sharing, copy-on-write; runtime/paged_kv.py). None =
-        # DLT_KV_LAYOUT env, default contiguous. Paged requires mesh=None
-        # (single-chip/GSPMD-free — multi-chip paging is a follow-on).
+        # DLT_KV_LAYOUT env, default contiguous for library engines (the
+        # CLI/server entry points default paged — cli.make_engine). Paged
+        # runs single-chip AND on pure pp x tp shard_map pipeline meshes
+        # (the reference's PPxTP topology): the pool buffer shards like
+        # the contiguous cache (layers over pp, kv heads over tp) and the
+        # page tables stay replicated host-side. dp/sp/ep extents keep
+        # the contiguous layout (sp shards the seq axis paging replaces;
+        # dp/ep paging is a follow-on).
         kv_page_size: int | None = None,  # tokens per KV page (power of
         # two). None = DLT_KV_PAGE env, default 16 — aligned with the
         # prefix cache's bucket floor so hits share whole pages
@@ -310,11 +316,25 @@ class InferenceEngine:
         self._pt_cache = None  # (pool.version, device tables) — the cached
         # page-table operand; invalidated by any pool mutation
         if self.paged:
-            if mesh is not None:
+            if mesh is not None and (
+                not self.use_pipeline
+                or mesh.shape["dp"] > 1
+                or mesh.shape["sp"] > 1
+                or mesh.shape.get("ep", 1) > 1
+            ):
                 raise ValueError(
-                    "kv_layout='paged' requires mesh=None (single-chip); "
-                    "multi-chip engines keep the contiguous layout"
+                    "kv_layout='paged' on meshes requires the pure pp x tp "
+                    "shard_map pipeline path (dp=sp=ep=1); other topologies "
+                    "keep the contiguous layout"
                 )
+            if mesh is not None:
+                # mesh-paged: the pool buffer rides the pipeline cache
+                # shardings (layers over pp, kv heads over tp); the page
+                # axis is replicated — page ids are global, so the host-side
+                # pool/tables need no mesh awareness at all
+                from ..parallel.pipeline import pp_paged_pool_sharding
+
+                self._cache_sharding = pp_paged_pool_sharding(mesh)
             ps = self.page_size
             max_slots = -(-self.cfg.seq_len // ps)
             parity = self.batch * max_slots
@@ -544,6 +564,17 @@ class InferenceEngine:
             # in the gather programs above is kv-bucket/page_size, so the
             # (kind, size, kv-bucket) triples already pin the paged shapes.
             plan.append(("page_copy", self.page_size, self.page_size))
+            if self.prefix_cache is not None:
+                # the KV movement layer's page-shipping programs
+                # (runtime/kv_transport.py): gather pool pages into one
+                # contiguous slice (the paged /v1/prefill extract) and
+                # scatter a shipped slice into freshly allocated pages (the
+                # paged external insert). One pair per prefix bucket —
+                # doubling segments keep every runtime span on this ladder.
+                for P in self.prefix_cache.buckets:
+                    if P >= self.page_size:
+                        plan.append(("page_extract", P, P))
+                        plan.append(("page_insert", P, P))
         return plan
 
     def cost_table(self, build: bool = True):
@@ -589,6 +620,8 @@ class InferenceEngine:
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 tokens_arr, pos_start, logits_mode=logits_mode,
                 microbatches=micro, kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
             )
         if self.paged:
             return forward(
@@ -605,7 +638,13 @@ class InferenceEngine:
         if self.paged:
             from .paged_kv import init_kv_pool
 
-            return init_kv_pool(self.cfg, self.page_pool.n_pages, self.page_size)
+            pool = init_kv_pool(self.cfg, self.page_pool.n_pages, self.page_size)
+            if self._cache_sharding is not None:
+                pool = KVCache(
+                    k=jax.device_put(pool.k, self._cache_sharding),
+                    v=jax.device_put(pool.v, self._cache_sharding),
+                )
+            return pool
         cache = init_kv_cache(self.cfg, self.batch)
         if self._cache_sharding is not None:
             import jax as _jax
@@ -652,11 +691,21 @@ class InferenceEngine:
     def _pt_operand(self):
         """The device page-table operand, re-uploaded only when the pool's
         tables actually changed (one small host->device transfer per
-        mutation, not per dispatch)."""
+        mutation, not per dispatch). On pipeline meshes the table is
+        replicated (page ids are global — every stage reads the same
+        row->page map; only the pool buffer itself is sharded)."""
         pool = self.page_pool
         if self._pt_cache is None or self._pt_cache[0] != pool.version:
             tables = pool.device_tables()
-            self._pt_cache = (pool.version, jax.device_put(tables))
+            if self.use_pipeline:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                dev = jax.device_put(
+                    tables, NamedSharding(self.mesh, PartitionSpec())
+                )
+            else:
+                dev = jax.device_put(tables)
+            self._pt_cache = (pool.version, dev)
         return self._pt_cache[1]
 
     def _ensure_pages(self, spans) -> None:
@@ -680,7 +729,10 @@ class InferenceEngine:
                     f"page_copy[{self.page_size}]",
                     ("page_copy", self.page_size, self.page_size),
                 ):
-                    self.cache = copy_page(self.cache, src_dev, dst_dev)
+                    self.cache = copy_page(
+                        self.cache, src_dev, dst_dev,
+                        out_sharding=self._cache_sharding,
+                    )
 
     def _ensure_pages_all_rows(self, start: int, end: int) -> None:
         self._ensure_pages((r, start, end) for r in range(self.batch))
@@ -809,11 +861,22 @@ class InferenceEngine:
                 with self._sanitizer_scope(), self._guard(
                     f"decode[{size}]", ("decode", size, kvb)
                 ):
-                    _, _, self.cache = self._decode_chunk_any(
+                    _, last, self.cache = self._decode_chunk_any(
                         jnp.zeros((self.batch,), jnp.int32), jnp.int32(pos),
                         key, n_steps=size, temperature=0.0, topp=0.9,
                         kv_len=kvb,
                     )
+                    if self.use_pipeline:
+                        # committed-operand twin: serving's lookahead chunks
+                        # feed the PREVIOUS chunk's on-device `last` token,
+                        # whose output sharding is part of the mesh lowering
+                        # key — warming only the fresh host operand left
+                        # that signature cold (a post-seal recompile on the
+                        # first mid-stream chunk of every new size)
+                        _, _, self.cache = self._decode_chunk_any(
+                            last, jnp.int32(pos), key, n_steps=size,
+                            temperature=0.0, topp=0.9, kv_len=kvb,
+                        )
             elif kind == "prefill_row":
                 if ("prefill_row", size, kvb) in self._warm:
                     continue
@@ -886,7 +949,44 @@ class InferenceEngine:
                 with self._sanitizer_scope(), self._guard(
                     f"page_copy[{size}]", ("page_copy", size, kvb)
                 ):
-                    self.cache = copy_page(self.cache, src_dev, dst_dev)
+                    self.cache = copy_page(
+                        self.cache, src_dev, dst_dev,
+                        out_sharding=self._cache_sharding,
+                    )
+            elif kind == "page_extract":
+                from .paged_kv import gather_pages
+
+                n = size // self.page_size
+                pages = np.zeros((n,), np.int32)  # page-0 junk reads, like
+                # every other ladder entry's synthetic operands
+                with self._sanitizer_scope(), self._guard(
+                    f"page_extract[{size}]", ("page_extract", size, kvb)
+                ):
+                    gather_pages(
+                        self.cache, pages,
+                        out_sharding=self.prefix_cache.seg_sharding,
+                    )
+            elif kind == "page_insert":
+                from .paged_kv import scatter_pages
+
+                n = size // self.page_size
+                L, _, _, h, d = self.cache.k.shape
+                # numpy operands on purpose: the runtime insert path
+                # (prefix_cache.insert_external) feeds host arrays, and the
+                # jit cache keys committed shardings — warming with device
+                # operands would leave the np-operand signature cold
+                seg = np.zeros((L, size, h, d), self.cache.k.dtype)
+                # pairwise-distinct dropped indices past the pool (colliding
+                # dropped indices would be undefined scatter behavior — the
+                # same discipline the forward's paged write path uses)
+                drop = self.page_pool.n_pages + np.arange(n, dtype=np.int32)
+                with self._sanitizer_scope(), self._guard(
+                    f"page_insert[{size}]", ("page_insert", size, kvb)
+                ):
+                    self.cache = scatter_pages(
+                        self.cache, seg, seg, drop,
+                        out_sharding=self._cache_sharding,
+                    )
 
     def _dispatch_prefill_row(self, row: int, chunk: list, pos: int, kv_len: int):
         """One admission-prefill chunk dispatch for `row` — the SAME program
@@ -901,10 +1001,17 @@ class InferenceEngine:
             toks[row, :] = chunk
             pos_vec = _np.full((self.batch,), self.cfg.seq_len, _np.int32)
             pos_vec[row] = pos
+            if self.paged:
+                # mesh-paged admission prefill: the full-batch program with
+                # every other row parked at seq_len — their writes DROP via
+                # the paged scatter, so no per-row table slice is needed
+                self._ensure_pages([(row, pos, pos + len(chunk))])
             toks_dev, pos_dev = jax.device_put((toks, pos_vec))
             _, self.cache = pipeline_forward(
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 toks_dev, pos_dev, logits_mode="last", kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
             )
         elif self.paged:
             # paged admission prefill: the b=1 forward against the SHARED
@@ -957,6 +1064,8 @@ class InferenceEngine:
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 token, pos_vec, keys, temp, topp, n_steps=n_steps,
                 kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
             )
         else:
             from .batch_session import batch_decode_chunk
@@ -1232,6 +1341,8 @@ class InferenceEngine:
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 token, pos, key, n_steps=n_steps, temperature=temperature,
                 topp=topp, kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
             )
         from .decode import decode_chunk
 
@@ -1278,6 +1389,8 @@ class InferenceEngine:
                 logits, self.cache = pipeline_forward(
                     self.cfg, self.mesh, self.params, self.rope, self.cache,
                     toks_dev, pos_dev, logits_mode="all", kv_len=kv_len,
+                    page_table=self._pt_operand() if self.paged else None,
+                    page_size=self.page_size,
                 )
             else:
                 # _forward applies the same microbatch rule a prefill chunk

@@ -1,0 +1,362 @@
+"""The KV movement layer: every cross-boundary KV transfer in one place.
+
+Three subsystems used to move KV each in their own way — the paged pool
+shares pages inside one chip (runtime/paged_kv.py), the prefix cache copies
+bucket slices between rows (runtime/prefix_cache.py), and disaggregation
+ships whole prefixes over HTTP through the host (server/disagg.py) — and
+each exclusion (paged was single-chip, disagg forced contiguous) existed
+because the transfers did not compose. This module is the composition
+point; ROADMAP item 2:
+
+* **content-addressed page naming** — :func:`page_keys` names each
+  :data:`KEY_PAGE_TOKENS`-token span of a token chain by a *chained*
+  FNV-1a hash (key ``i`` covers tokens ``[0, (i+1)*16)``), the token-level
+  twin of the router's char-block chains (server/router.py
+  ``prefix_chain``). Two processes that agree on the tokens agree on the
+  names, so a page's identity is its *content*, never a pool-local
+  physical page id — a decode worker can tell a prefill worker exactly
+  which leading pages it already holds and receive only the rest
+  (``disagg_pages_skipped``);
+* **transport selection** — one :class:`KvTransport` interface per peer,
+  resolved by :func:`resolve_transport` (``DLT_KV_TRANSPORT`` =
+  ``auto`` | ``device`` | ``http``): :class:`DeviceKvTransport` moves KV
+  as device arrays between same-process peers (the registry below; on
+  multi-host deployments the same call shape covers jax-addressable
+  devices) with zero host serialization, and :class:`HttpKvTransport`
+  keeps the PR 10 length-prefixed binary codec as the portable fallback
+  for peers the device path cannot reach. ``auto`` picks device whenever
+  the peer is registered, http otherwise — per peer, per fetch;
+* **the wire codec** — :func:`kv_payload` / :func:`parse_kv_payload`
+  moved here from server/disagg.py (which re-exports them): the header
+  grew ``start`` (the token offset of the shipped slice — partial sends
+  ship only the pages the requester is missing) and ``page_keys`` (the
+  content names of the covered span, so the receiver can verify the
+  naming agreement instead of trusting it).
+
+Every transfer is accounted per path: the ``kv_transfer_us[{path}]``
+StepStats series (rendered as the labeled ``dlt_kv_transfer_us`` family)
+and the ``kv_transfer_bytes_{path}`` counters (rendered as
+``dlt_kv_transfer_bytes_total{path=...}``) — the goodput ledger's
+``kv_transfer_us``/``kv_transfer_path`` fields carry the per-request view.
+
+stdlib + numpy only at import time: the gateway-side tests and the codec
+unit tests must not drag jax in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+import numpy as np
+
+#: tokens per content-addressed page name. Matches the paged pool's default
+#: page size AND the prefix cache's publish floor (PREFIX_MIN_TOKENS), so
+#: every bucket boundary both caches speak is a whole number of named pages.
+KEY_PAGE_TOKENS = 16
+
+KV_TRANSPORTS = ("auto", "device", "http")
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+
+
+def resolve_transport(explicit: str | None = None) -> str:
+    """THE one resolver of the KV transport mode: an explicit value wins;
+    otherwise ``DLT_KV_TRANSPORT``; unset/unrecognized means ``auto``
+    (device for registered same-process peers, http for everyone else)."""
+    mode = explicit
+    if mode is None:
+        raw = (os.environ.get("DLT_KV_TRANSPORT") or "").strip().lower()
+        mode = raw if raw in KV_TRANSPORTS else "auto"
+    mode = mode.strip().lower()
+    if mode not in KV_TRANSPORTS:
+        raise ValueError(
+            f"unknown kv transport {mode!r} (choose from {KV_TRANSPORTS})"
+        )
+    return mode
+
+
+def _fnv1a_bytes(data: bytes, h: int = _FNV64_OFFSET) -> int:
+    for b in data:
+        h ^= b
+        h = (h * _FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def page_keys(tokens, page_tokens: int = KEY_PAGE_TOKENS) -> tuple:
+    """Chained content names of a token chain's FULL pages: key ``i`` is
+    the FNV-1a hash of page ``i``'s token ids (4-byte little-endian each)
+    seeded with key ``i-1`` — so chains sharing a leading span share
+    exactly the keys that span covers, and a one-token divergence renames
+    every later page (the radix property, hashed). Only complete pages are
+    named: a partial tail has no stable identity to ship."""
+    out = []
+    h = _FNV64_OFFSET
+    n_full = len(tokens) // page_tokens
+    for i in range(n_full):
+        page = tokens[i * page_tokens : (i + 1) * page_tokens]
+        h = _fnv1a_bytes(
+            b"".join(struct.pack("<i", int(t)) for t in page), h
+        )
+        out.append(h)
+    return tuple(out)
+
+
+def doubling_segments(start: int, end: int) -> list:
+    """Split ``[start, end)`` into segments along the binary doubling
+    ladder: ``[s, 2s), [2s, 4s), ...`` — when `start` and `end` are prefix
+    buckets (powers of two on the cache ladder), every segment length is
+    itself a bucket, so paged scatter/gather dispatches stay on the warmed
+    program ladder with no padding. ``start == 0`` is one full segment."""
+    if start <= 0:
+        return [(0, end)]
+    out = []
+    s = start
+    while s < end:
+        e = min(2 * s, end)
+        out.append((s, e))
+        s = e
+    return out
+
+
+def matching_pages(expected_keys, have_keys) -> int:
+    """Longest leading run of ``have_keys`` matching ``expected_keys`` —
+    the pages a transfer can skip. A mid-run mismatch stops the match
+    (chained keys make any later agreement impossible anyway)."""
+    n = 0
+    for e, h in zip(expected_keys, have_keys):
+        if int(e) != int(h):
+            break
+        n += 1
+    return n
+
+
+# -- the wire format ----------------------------------------------------------
+#
+# 4-byte big-endian header length | JSON header | raw k bytes | raw v bytes
+# Header: tokens (ALL P token ids the boundary covers), p, start (token
+# offset of the shipped slice — 0 for a full send, a page multiple when the
+# requester already held the leading pages), page_keys (content names of the
+# full span, hex strings), k_shape/v_shape (of the SHIPPED slice), dtype,
+# prefill_us (the worker's wall — the decode side's ledger field). Raw bytes
+# rather than base64-in-JSON: a 512-token 8B-class slice is tens of MB and
+# the transfer wall is the metric under test.
+
+
+def kv_payload(header: dict, k_np: np.ndarray, v_np: np.ndarray) -> bytes:
+    hjson = json.dumps(header).encode()
+    return struct.pack(">I", len(hjson)) + hjson + k_np.tobytes() + v_np.tobytes()
+
+
+def _np_dtype(name: str):
+    """Dtype-by-name incl. the ml_dtypes extended floats (``np.dtype``
+    alone does not know ``bfloat16``)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def parse_kv_payload(body: bytes):
+    """``(header, k_np, v_np)`` from one payload; raises ValueError on any
+    truncation or shape/dtype mismatch (the caller's degradation path)."""
+    if len(body) < 4:
+        raise ValueError("kv payload truncated before header length")
+    (hlen,) = struct.unpack(">I", body[:4])
+    if len(body) < 4 + hlen:
+        raise ValueError("kv payload truncated inside header")
+    header = json.loads(body[4 : 4 + hlen])
+    dt = _np_dtype(header["dtype"])
+    k_shape = tuple(header["k_shape"])
+    v_shape = tuple(header["v_shape"])
+    k_bytes = int(np.prod(k_shape)) * dt.itemsize
+    v_bytes = int(np.prod(v_shape)) * dt.itemsize
+    blob = body[4 + hlen :]
+    if len(blob) != k_bytes + v_bytes:
+        raise ValueError(
+            f"kv payload truncated: body {len(blob)} B, "
+            f"header names {k_bytes + v_bytes} B"
+        )
+    k = np.frombuffer(blob[:k_bytes], dtype=dt).reshape(k_shape)
+    v = np.frombuffer(blob[k_bytes:], dtype=dt).reshape(v_shape)
+    return header, k, v
+
+
+# -- the same-process peer registry -------------------------------------------
+#
+# serve() registers each API server's state under its port; a decode
+# worker whose --prefill-peer names a registered port reaches the prefill
+# engine without touching a socket (the common test/colocated-roles shape,
+# and the faithful single-host stand-in for jax-addressable-device
+# transfer on a real pod). The provider contract is duck-typed — an object
+# with `.role` and `.prefill_extract(ids, have_keys, trace=None) ->
+# (header, k_arr, v_arr)` — so this module never imports the server.
+
+_registry_lock = threading.Lock()
+_device_peers: dict = {}  # port -> weakref.ref(provider)
+
+#: test hook: when set, DeviceKvTransport.fetch raises it once per fetch —
+#: the chaos twin proves a device-path failure degrades exactly like a
+#: dead HTTP peer (see tests/test_kv_transport.py)
+_device_chaos: list = []
+
+
+def register_device_peer(port: int, provider) -> None:
+    """Register a provider under its port. WEAK reference on purpose: the
+    registry must never keep a torn-down server's engine (weights + KV
+    pool) alive, and a dead ref heals `auto` back to the HTTP path for
+    embedders that cycle servers on reused ports."""
+    import weakref
+
+    with _registry_lock:
+        _device_peers[int(port)] = weakref.ref(provider)
+
+
+def unregister_device_peer(port: int) -> None:
+    with _registry_lock:
+        _device_peers.pop(int(port), None)
+
+
+def device_peer(port: int):
+    with _registry_lock:
+        ref = _device_peers.get(int(port))
+        if ref is None:
+            return None
+        provider = ref()
+        if provider is None:  # collected: prune the dead entry
+            _device_peers.pop(int(port), None)
+        return provider
+
+
+def set_device_chaos(exc: BaseException | None) -> None:
+    """Arm (or clear, with None) a one-shot device-path failure."""
+    _device_chaos[:] = [exc] if exc is not None else []
+
+
+# -- transports ---------------------------------------------------------------
+
+
+class TransferResult:
+    """One completed fetch: the worker's header, the KV arrays (numpy on
+    the http path; device arrays — possibly per-doubling-segment LISTS —
+    on the device path; the prefix cache's insert handles all three), the
+    path taken, and the bytes that moved."""
+
+    __slots__ = ("header", "k", "v", "path", "nbytes")
+
+    def __init__(self, header, k, v, path, nbytes):
+        self.header = header
+        self.k = k
+        self.v = v
+        self.path = path
+        self.nbytes = int(nbytes)
+
+
+def _arrays_nbytes(x) -> int:
+    if isinstance(x, (list, tuple)):
+        return sum(int(getattr(a, "nbytes", 0)) for a in x)
+    return int(getattr(x, "nbytes", 0))
+
+
+class KvTransport:
+    """One way of moving a prefix-KV slice from a prefill peer. `fetch`
+    raises OSError/ValueError on any failure — the DisaggClient's
+    degradation machinery (backoff, failover, local prefill) is
+    transport-agnostic by construction."""
+
+    path = "?"
+
+    def fetch(self, peer, ids, have_keys=(), trace_id=None) -> TransferResult:
+        raise NotImplementedError
+
+
+class DeviceKvTransport(KvTransport):
+    """Same-process (or jax-addressable) peer: call the registered
+    provider directly and hand its device arrays straight to the local
+    prefix cache — no socket, no host serialization, no byte copy of the
+    KV payload. The bytes accounted are the slice's device bytes (what an
+    ICI/DCN transfer would move on a real pod)."""
+
+    path = "device"
+
+    def fetch(self, peer, ids, have_keys=(), trace_id=None) -> TransferResult:
+        if _device_chaos:
+            exc = _device_chaos.pop()
+            raise exc
+        host, port = peer
+        provider = device_peer(port)
+        if provider is None:
+            raise OSError(f"no same-process device peer at {host}:{port}")
+        if getattr(provider, "role", None) != "prefill":
+            # mirrors the HTTP path's 404 from a non-prefill replica
+            raise OSError(f"device peer {host}:{port} does not serve prefill")
+        header, k, v = provider.prefill_extract(
+            list(ids), have_keys=tuple(have_keys), trace_id=trace_id
+        )
+        nbytes = _arrays_nbytes(k) + _arrays_nbytes(v)
+        return TransferResult(header, k, v, self.path, nbytes)
+
+
+class HttpKvTransport(KvTransport):
+    """The portable fallback: POST /v1/prefill, length-prefixed binary
+    payload back (the PR 10 codec). Works across any network boundary; a
+    mid-body peer death surfaces as the same OSError/ValueError family
+    the device path raises."""
+
+    path = "http"
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+
+    def fetch(self, peer, ids, have_keys=(), trace_id=None) -> TransferResult:
+        import http.client
+
+        from .tracing import TRACE_HEADER
+
+        host, port = peer
+        conn = http.client.HTTPConnection(host, port, timeout=self.timeout_s)
+        try:
+            headers = {"Content-Type": "application/json", "Connection": "close"}
+            if trace_id:
+                headers[TRACE_HEADER] = trace_id
+            body = {"ids": list(ids)}
+            if have_keys:
+                # content names of the pages this side already holds — the
+                # worker ships only what the names don't cover
+                body["have"] = [format(int(h), "x") for h in have_keys]
+            conn.request(
+                "POST", "/v1/prefill", body=json.dumps(body), headers=headers
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                raise OSError(f"/v1/prefill returned {resp.status}")
+        finally:
+            conn.close()
+        header, k, v = parse_kv_payload(raw)
+        return TransferResult(header, k, v, self.path, len(raw))
+
+
+def build_transports(timeout_s: float) -> dict:
+    """The per-process transport instances a DisaggClient selects from."""
+    return {
+        "device": DeviceKvTransport(),
+        "http": HttpKvTransport(timeout_s),
+    }
+
+
+def transport_for(mode: str, peer, transports: dict) -> KvTransport:
+    """Pick the transport for ONE peer under `mode`: explicit modes are
+    absolute; ``auto`` takes the device path exactly when the peer is
+    registered in this process."""
+    if mode == "device":
+        return transports["device"]
+    if mode == "http":
+        return transports["http"]
+    _, port = peer
+    return transports["device"] if device_peer(port) is not None else transports["http"]

@@ -40,6 +40,15 @@ allocation that found no free page (after the reclaim hook — prefix-cache
 LRU eviction — made no progress). The Batcher parks admissions and sheds
 load on it; library callers see the typed error.
 
+The layout is no longer single-chip: on pure ``pp x tp`` shard_map
+pipeline meshes the pool buffer shards like the contiguous cache (layers
+over ``pp``, kv heads over ``tp`` — ``parallel.pipeline
+.pp_paged_pool_sharding``) with the page axis REPLICATED, so page ids are
+global and everything host-side here — free list, refcounts, tables,
+prefix sharing — runs unchanged. Cross-boundary page movement (the
+``gather_pages``/``scatter_pages`` shipping programs below) belongs to
+the KV movement layer (runtime/kv_transport.py).
+
 Every page-count mutation is under one lock (allocation decisions happen on
 the engine's dispatch thread, but ``/stats`` snapshots and prefix-cache
 retain/release may arrive from handler threads).
@@ -142,17 +151,73 @@ def init_kv_pool(cfg, n_pages: int, page_size: int) -> KVCache:
 # -- the jitted copy-on-write program ----------------------------------------
 
 
-@partial(jax.jit, donate_argnames=("cache",))
-def copy_page(cache: KVCache, src, dst) -> KVCache:
+@partial(jax.jit, donate_argnames=("cache",), static_argnames=("out_sharding",))
+def copy_page(cache: KVCache, src, dst, out_sharding=None) -> KVCache:
     """Copy one physical page's k/v (every layer) to another page — THE
     copy-on-write device program, one compiled shape per engine regardless
     of which pages move (`src`/`dst` are traced scalars). Donated cache:
-    in-place in HBM; the host guarantees ``src != dst``."""
+    in-place in HBM; the host guarantees ``src != dst``. `out_sharding`:
+    mesh-paged engines pin the pool's pp/tp layout in-program (the page
+    moves within every shard locally — the slice keeps the layer and head
+    axes whole, so no collective is traced; graph_audit asserts it)."""
     L, _, ps, h, d = cache.k.shape
     k_seg = jax.lax.dynamic_slice(cache.k, (0, src, 0, 0, 0), (L, 1, ps, h, d))
     v_seg = jax.lax.dynamic_slice(cache.v, (0, src, 0, 0, 0), (L, 1, ps, h, d))
     k = jax.lax.dynamic_update_slice(cache.k, k_seg, (0, dst, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(cache.v, v_seg, (0, dst, 0, 0, 0))
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
+    return KVCache(k=k, v=v)
+
+
+# -- page movement programs (the KV movement layer, runtime/kv_transport.py) --
+#
+# Two bucketed programs move KV between the pool and a contiguous
+# [L, n*ps, h, d] slice — the shape the prefix-extract programs, the disagg
+# wire codec, and the device transport all share. Page-count operands are
+# PADDED to the prefix-bucket ladder so the compiled-program count stays
+# O(log seq_len): a gather pads with clamped page 0 (junk the caller slices
+# off host-side), a scatter pads with indices past the pool (mode="drop" —
+# the same OOB discipline the forward's paged write path uses). Both are
+# collective-free slice/gather programs on every topology (audited).
+
+
+@partial(jax.jit, static_argnames=("out_sharding",))
+def gather_pages(cache: KVCache, pages, out_sharding=None):
+    """Read the named pool pages into one contiguous [L, n*ps, h, d] k/v
+    pair (the paged publish/ship path). `pages` is a traced int32 [n]
+    vector — one compiled program per padded page count; entries past the
+    real span are clamped to 0 and the caller discards their rows. NOT
+    donated: the pool must survive."""
+    pages = jnp.maximum(pages, 0)
+    k = cache.k[:, pages]  # [L, n, ps, h, d]
+    v = cache.v[:, pages]
+    L, n, ps, h, d = k.shape
+    k = k.reshape(L, n * ps, h, d)
+    v = v.reshape(L, n * ps, h, d)
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
+    return k, v
+
+
+@partial(jax.jit, donate_argnames=("cache",), static_argnames=("out_sharding",))
+def scatter_pages(cache: KVCache, k_seg, v_seg, pages, out_sharding=None) -> KVCache:
+    """Write a contiguous [L, n*ps, h, d] slice into the named pool pages
+    (the paged external-insert path — KV computed in ANOTHER process lands
+    in freshly allocated local pages). Pad entries carry indices past the
+    pool and DROP; real indices are pairwise distinct by allocation.
+    Donated cache: in-place in HBM."""
+    L, n = cache.k.shape[0], pages.shape[0]
+    ps, h, d = cache.k.shape[2], cache.k.shape[3], cache.k.shape[4]
+    k_seg = k_seg.reshape(L, n, ps, h, d).astype(cache.k.dtype)
+    v_seg = v_seg.reshape(L, n, ps, h, d).astype(cache.v.dtype)
+    k = cache.k.at[:, pages].set(k_seg, mode="drop", unique_indices=True)
+    v = cache.v.at[:, pages].set(v_seg, mode="drop", unique_indices=True)
+    if out_sharding is not None:
+        k = jax.lax.with_sharding_constraint(k, out_sharding)
+        v = jax.lax.with_sharding_constraint(v, out_sharding)
     return KVCache(k=k, v=v)
 
 
@@ -281,6 +346,33 @@ class PagePool:
                     return cow
             # not enough pages for the WHOLE span: reclaim outside the
             # lock and re-plan (tables untouched so far)
+            if self.reclaim is None or not self.reclaim():
+                self._incr("kv_pool_exhausted")
+                raise PagePoolExhausted(
+                    f"kv page pool exhausted ({self.n_pages} pages of "
+                    f"{self.page_size} tokens)"
+                )
+            self._incr("kv_pool_reclaims")
+
+    def allocate_pages(self, n: int) -> tuple:
+        """Take `n` free pages off the free list with refs=1, bound to NO
+        row — the external-insert path (runtime/kv_transport.py): shipped
+        KV scatters into them and a prefix-cache entry retains them, so
+        they live exactly as long as the entry (release() frees them).
+        Retries through the reclaim hook under pressure; raises
+        :class:`PagePoolExhausted` when nothing frees."""
+        if n <= 0:
+            return ()
+        while True:
+            with self._lock:
+                if len(self._free) >= n:
+                    out = []
+                    for _ in range(n):
+                        page = self._free.pop()
+                        self.refs[page] = 1
+                        out.append(page)
+                    self._gauges()
+                    return tuple(out)
             if self.reclaim is None or not self.reclaim():
                 self._incr("kv_pool_exhausted")
                 raise PagePoolExhausted(

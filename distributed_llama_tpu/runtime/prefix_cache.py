@@ -56,6 +56,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.params import KVCache
 from .tracing import global_event
@@ -399,6 +400,37 @@ class PrefixCache:
             entry.last_used = self._clock
         return B, entry
 
+    def pin_entry(self, entry) -> None:
+        """Pin an entry (refs+1) so eviction cannot drop it while a
+        disaggregated fetch uses it as the merge base
+        (runtime/kv_transport.py); release with `entry_release`. Prefer
+        :meth:`match_pinned` — pinning an entry obtained from a bare
+        `match` leaves an eviction window between the two calls."""
+        with self._lock:
+            entry.refs += 1
+            self._clock += 1
+            entry.last_used = self._clock
+
+    def match_pinned(self, tokens):
+        """Longest-prefix match with the entry PINNED under the SAME lock
+        hold that found it — the disaggregated fetch's lookup: between a
+        bare `match` and a later pin, pool pressure could evict the entry
+        and RECYCLE its pages, so a merge base must never be obtained
+        unpinned. Returns ``(covered, entry|None)``; the caller must
+        `entry_release` a non-None entry exactly once."""
+        with self._lock:
+            m, subtree, best = self._walk(tokens)
+            entry = self._first_entry(subtree)
+            covered = m
+            if entry is None and best is not None:
+                covered, entry = min(m, best.length), best
+            if entry is None:
+                return 0, None
+            entry.refs += 1
+            self._clock += 1
+            entry.last_used = self._clock
+            return covered, entry
+
     def record_hit(self, resume: int) -> None:
         """Count one splice that actually dispatched (`resume` = the
         bucket-aligned prefill tokens it skipped)."""
@@ -523,28 +555,52 @@ class PrefixCache:
         global_event("prefix_publish", keys=("tokens", "row"), vals=(P, int(row)))
         return True
 
-    def insert_external(self, engine, tokens, k_np, v_np) -> bool:
+    def insert_external(
+        self, engine, tokens, k_np, v_np, start: int = 0, base_entry=None
+    ) -> bool:
         """Insert a slice computed OUTSIDE this process — the disaggregated
-        serving path (server/disagg.py): a prefill worker ran the prompt,
-        extracted ``[L, P, h, d]`` k/v at a bucket boundary, and shipped the
-        host arrays here. They are device_put (cast to the live cache's
-        dtype, pinned to the pipeline slice sharding where one exists) and
-        inserted exactly like a local publish, so the very next admission's
-        ``match_for_splice`` hits and splices them through the SAME warmed
-        copy programs a local hit uses — which is what makes the
-        disaggregated path bit-identical to unified serving.
+        serving path (runtime/kv_transport.py, server/disagg.py): a prefill
+        worker ran the prompt, extracted k/v covering tokens ``[start, P)``
+        at bucket boundaries, and shipped them here. The result is inserted
+        exactly like a local publish, so the very next admission's
+        ``match_for_splice`` hits and splices it through the SAME warmed
+        programs a local hit uses — which is what makes the disaggregated
+        path bit-identical to unified serving.
 
-        Contiguous engines only: a PAGED entry's storage is physical page
-        ids in this process's pool, which have no host representation (the
-        serve() role gate forces contiguous on disaggregated workers).
-        Returns False — never raises — when the slice is unusable (paged
-        engine, off-bucket length, budget unreachable): the caller then
-        simply prefills locally, the degradation contract."""
-        if self.paged:
-            return False
+        ``k_np``/``v_np``: one array covering ``[start, P)``, or a list of
+        per-segment arrays along the binary doubling ladder
+        (:func:`~.kv_transport.doubling_segments` of ``(start, P)`` — every
+        segment a prefix-bucket length, which is what keeps the paged
+        scatter on the warm program ladder). ``start > 0`` is a partial
+        send: the content-addressed skip determined this process already
+        holds the leading pages in ``base_entry`` (PINNED by the caller;
+        its tokens must equal ``tokens[:start]``), and the merged entry
+        reuses them — CONTIGUOUS engines splice the base's device slice
+        with the shipped arrays host-side (a cold-path bounce, never a
+        compile), PAGED engines retain the base's physical pages and
+        scatter the shipped segments into freshly allocated ones.
+
+        MUST run on the engine's dispatch thread for paged engines (the
+        scatter donates the live pool — server/disagg.py defers the apply
+        to the Batcher loop / the serialized lock for exactly this reason).
+        Returns False — never raises to the serving path — when the slice
+        is unusable (off-bucket length, misaligned start, budget/pool
+        unreachable): the caller then simply prefills locally, the
+        degradation contract."""
+        from .kv_transport import doubling_segments
+
         P = len(tokens)
         if P < PREFIX_MIN_TOKENS or P != bucket_down(P, self.seq_len):
             return False
+        if start < 0 or start >= P:
+            return False
+        if start > 0:
+            if base_entry is None or start != bucket_down(start, self.seq_len):
+                return False
+            if tuple(base_entry.tokens[:start]) != tuple(
+                int(t) for t in tokens[:start]
+            ):
+                return False
         key = tuple(int(t) for t in tokens)
         with self._lock:
             existing = self._entries.get(key)
@@ -552,36 +608,150 @@ class PrefixCache:
                 self._clock += 1
                 existing.last_used = self._clock
                 return True
-        dt = engine.cache.k.dtype
-        L, _, _, h, d = engine.cache.k.shape
-        if tuple(k_np.shape) != (L, P, h, d) or tuple(v_np.shape) != (L, P, h, d):
-            return False
-        if self.seg_sharding is not None:
-            k = jax.device_put(k_np.astype(dt), self.seg_sharding)
-            v = jax.device_put(v_np.astype(dt), self.seg_sharding)
+        # normalize the shipped arrays to (seg_start, k, v) doubling
+        # segments; a single array is host-sliced (numpy views / one
+        # bounded copy off a device array — a cold path, no compiles)
+        segs = doubling_segments(start, P)
+        if isinstance(k_np, (list, tuple)):
+            if len(k_np) != len(segs) or len(v_np) != len(segs):
+                return False
+            parts = [(a, k_np[i], v_np[i]) for i, (a, _b) in enumerate(segs)]
         else:
-            k = jax.device_put(k_np.astype(dt))
-            v = jax.device_put(v_np.astype(dt))
-        nbytes = k.nbytes + v.nbytes
+            k_host = np.asarray(k_np)  # dlt: allow(host-sync) — cold external-insert path, never the serving loop
+            v_host = np.asarray(v_np)
+            if k_host.shape[1] != P - start or v_host.shape[1] != P - start:
+                return False
+            parts = [
+                (a, k_host[:, a - start : b - start], v_host[:, a - start : b - start])
+                for a, b in segs
+            ]
+        L, _, _, h, d = engine.cache.k.shape
+        for a, kp, vp in parts:
+            b = a + kp.shape[1]
+            if tuple(kp.shape) != (L, b - a, h, d) or tuple(vp.shape) != (
+                L, b - a, h, d,
+            ):
+                return False
+        need = self._slice_nbytes(engine, P)
+        with self._lock:
+            if need > self.budget_bytes or not self._evict_until(
+                self.budget_bytes - need
+            ):
+                self._incr("prefix_publish_skipped")
+                return False
+        if self.paged:
+            ok, k, v, pages = self._materialize_paged(
+                engine, parts, start, P, base_entry
+            )
+        else:
+            ok, k, v, pages = self._materialize_contiguous(
+                engine, parts, start, P, base_entry
+            )
+        if not ok:
+            return False
         with self._lock:
             if key in self._entries:  # raced with another inserter
+                if pages:
+                    self.page_pool.release(pages)
                 return True
-            if nbytes > self.budget_bytes or not self._evict_until(
-                self.budget_bytes - nbytes
-            ):
+            if not self._evict_until(self.budget_bytes - need):
+                if pages:
+                    self.page_pool.release(pages)
                 self._incr("prefix_publish_skipped")
                 return False
             self._clock += 1
             entry = PrefixEntry(
-                tokens=key, k=k, v=v, nbytes=nbytes, last_used=self._clock
+                tokens=key, k=k, v=v, nbytes=need, last_used=self._clock,
+                pages=pages,
             )
             self._insert(entry)
             self._entries[key] = entry
             self._bytes += entry.nbytes
             self._gauges()
         self._incr("prefix_inserts")
-        global_event("prefix_insert_external", keys=("tokens",), vals=(P,))
+        global_event(
+            "prefix_insert_external", keys=("tokens", "start"), vals=(P, start)
+        )
         return True
+
+    def _materialize_contiguous(self, engine, parts, start, P, base_entry):
+        """Build one [L, P, h, d] device pair from the base entry's leading
+        slice plus the shipped segments. Host-side concat + ONE device_put:
+        no eager device ops, so nothing here can trip the recompile
+        sentinel post-seal."""
+        dt = engine.cache.k.dtype
+        pieces_k, pieces_v = [], []
+        if start > 0:
+            # the base entry's arrays may be longer than `start` (a deeper
+            # entry matched); only its verified leading span merges
+            base_k = np.asarray(base_entry.k)[:, :start]  # dlt: allow(host-sync) — cold external-insert path
+            base_v = np.asarray(base_entry.v)[:, :start]  # dlt: allow(host-sync) — cold external-insert path
+            pieces_k.append(base_k)
+            pieces_v.append(base_v)
+        for _a, kp, vp in parts:
+            pieces_k.append(np.asarray(kp))  # dlt: allow(host-sync) — cold external-insert path
+            pieces_v.append(np.asarray(vp))  # dlt: allow(host-sync) — cold external-insert path
+        k_full = np.concatenate(pieces_k, axis=1) if len(pieces_k) > 1 else pieces_k[0]
+        v_full = np.concatenate(pieces_v, axis=1) if len(pieces_v) > 1 else pieces_v[0]
+        k_full = k_full.astype(dt)
+        v_full = v_full.astype(dt)
+        if self.seg_sharding is not None:
+            k = jax.device_put(k_full, self.seg_sharding)
+            v = jax.device_put(v_full, self.seg_sharding)
+        else:
+            k = jax.device_put(k_full)
+            v = jax.device_put(v_full)
+        return True, k, v, ()
+
+    def _materialize_paged(self, engine, parts, start, P, base_entry):
+        """Land the shipped segments in freshly allocated pool pages (one
+        warmed ``page_insert`` scatter per doubling segment) and retain the
+        base entry's leading pages — the merged entry's storage is then
+        location-independent page content under process-local page ids.
+        Allocation runs OUTSIDE the trie lock (the pool's reclaim hook
+        takes it). Engine-thread only: the scatter donates the live pool."""
+        from .paged_kv import PagePoolExhausted, scatter_pages
+
+        pool = self.page_pool
+        ps = pool.page_size
+        if start % ps != 0 or P % ps != 0:
+            return False, None, None, ()
+        if any((a % ps or kp.shape[1] % ps) for a, kp, _v in parts):
+            return False, None, None, ()
+        base_pages = ()
+        if start > 0:
+            base_pages = tuple(base_entry.pages[: start // ps])
+            if len(base_pages) != start // ps:
+                return False, None, None, ()
+        new_pages: list = []
+        try:
+            for a, kp, vp in parts:
+                # numpy operands on purpose: the warm page_insert programs
+                # compiled against host arrays (engine._warmup_fill), and a
+                # committed device operand's sharding would key a different
+                # lowering. Host fetch of a device segment is sanctioned —
+                # one cold external-insert per transfer, never serving-loop.
+                kp = np.asarray(kp)  # dlt: allow(host-sync) — cold external-insert path
+                vp = np.asarray(vp)  # dlt: allow(host-sync) — cold external-insert path
+                n = kp.shape[1] // ps
+                seg_pages = pool.allocate_pages(n)
+                new_pages.extend(seg_pages)
+                pages_np = np.asarray(seg_pages, np.int32)  # dlt: allow(host-sync) — host page-id list, no device source
+                B = kp.shape[1]
+                with engine._guard(
+                    f"page_insert[{B}]", ("page_insert", B, B)
+                ):
+                    engine.cache = scatter_pages(
+                        engine.cache, kp, vp, pages_np,
+                        out_sharding=self.cache_sharding,
+                    )
+        except PagePoolExhausted:
+            if new_pages:
+                pool.release(new_pages)
+            self._incr("prefix_publish_skipped")
+            return False, None, None, ()
+        pool.retain(base_pages)
+        return True, None, None, base_pages + tuple(new_pages)
 
     def _slice_nbytes(self, engine, P: int) -> int:
         if self.paged:

@@ -223,8 +223,9 @@ def entry_tokens(engine, kind: str, size: int) -> int:
     b = engine.batch
     if kind in ("prefill", "decode", "batch_decode", "verify", "verify_row"):
         return b * size
-    # prefill_row / prefix_extract / prefix_copy(_row) / page_copy: one
-    # row's chunk, one cached slice, or one page worth of positions
+    # prefill_row / prefix_extract / prefix_copy(_row) / page_copy /
+    # page_extract / page_insert: one row's chunk, one cached or shipped
+    # slice, or one page worth of positions
     return size
 
 
@@ -252,7 +253,27 @@ def lower_entry(engine, key):
     if kind == "page_copy":
         from .paged_kv import copy_page
 
-        return copy_page.lower(a_cache, _sds((), jnp.int32), _sds((), jnp.int32))
+        return copy_page.lower(
+            a_cache, _sds((), jnp.int32), _sds((), jnp.int32),
+            out_sharding=engine._cache_sharding,
+        )
+    if kind in ("page_extract", "page_insert"):
+        # the KV movement layer's page-shipping programs
+        # (runtime/kv_transport.py): pool <-> contiguous-slice gathers
+        from .paged_kv import gather_pages, scatter_pages
+
+        n = size // engine.page_size
+        if kind == "page_extract":
+            return gather_pages.lower(
+                a_cache, _sds((n,), jnp.int32),
+                out_sharding=engine.prefix_cache.seg_sharding,
+            )
+        L, _, _, h, d = engine.cache.k.shape
+        seg = _sds((L, size, h, d), engine.cache.k.dtype)
+        return scatter_pages.lower(
+            a_cache, seg, seg, _sds((n,), jnp.int32),
+            out_sharding=engine._cache_sharding,
+        )
     if kind in ("prefill", "verify", "verify_row"):
         mode = "last" if kind == "prefill" else "all"
         per_row = kind == "verify_row"
@@ -262,6 +283,16 @@ def lower_entry(engine, key):
 
             pp = engine.mesh.shape["pp"]
             micro = 1 if per_row else (pp if size % pp == 0 else 1)
+            if paged:
+                fn = lambda params, rope, cache, toks, pos, pt: pipeline_forward(
+                    cfg, engine.mesh, params, rope, cache, toks, pos,
+                    logits_mode=mode, microbatches=micro, kv_len=kvb,
+                    page_table=pt, page_size=ps,
+                )
+                return jax.jit(fn).lower(
+                    a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
+                    pos_sds, pt_sds,
+                )
             fn = lambda params, rope, cache, toks, pos: pipeline_forward(
                 cfg, engine.mesh, params, rope, cache, toks, pos,
                 logits_mode=mode, microbatches=micro, kv_len=kvb,
@@ -287,6 +318,16 @@ def lower_entry(engine, key):
         if engine.use_pipeline:
             from ..parallel.pipeline import pipeline_decode_chunk
 
+            if paged:
+                fn = lambda params, rope, cache, tok, pos, pt: pipeline_decode_chunk(
+                    cfg, engine.mesh, params, rope, cache, tok, pos, key0,
+                    n_steps=size, temperature=0.0, topp=0.9, kv_len=kvb,
+                    page_table=pt, page_size=ps,
+                )
+                return jax.jit(fn).lower(
+                    a_params, a_rope, a_cache, _sds((b,), jnp.int32),
+                    _sds((), jnp.int32), pt_sds,
+                )
             fn = lambda params, rope, cache, tok, pos: pipeline_decode_chunk(
                 cfg, engine.mesh, params, rope, cache, tok, pos, key0,
                 n_steps=size, temperature=0.0, topp=0.9, kv_len=kvb,
@@ -311,6 +352,13 @@ def lower_entry(engine, key):
         if engine.use_pipeline:
             from ..parallel.pipeline import pipeline_batch_decode_chunk as bdc
 
+            if paged:
+                fn = lambda params, rope, cache, tok, pos, keys, temp, topp, pt: bdc(
+                    cfg, engine.mesh, params, rope, cache, tok, pos, keys,
+                    temp, topp, n_steps=size, kv_len=kvb, page_table=pt,
+                    page_size=ps,
+                )
+                return jax.jit(fn).lower(a_params, a_rope, a_cache, *args, pt_sds)
             fn = lambda params, rope, cache, tok, pos, keys, temp, topp: bdc(
                 cfg, engine.mesh, params, rope, cache, tok, pos, keys, temp,
                 topp, n_steps=size, kv_len=kvb,
@@ -326,6 +374,16 @@ def lower_entry(engine, key):
         if engine.use_pipeline:
             from ..parallel.pipeline import pipeline_forward
 
+            if paged:
+                fn = lambda params, rope, cache, toks, pos_vec, pt: pipeline_forward(
+                    cfg, engine.mesh, params, rope, cache, toks, pos_vec,
+                    logits_mode="last", kv_len=kvb, page_table=pt,
+                    page_size=ps,
+                )
+                return jax.jit(fn).lower(
+                    a_params, a_rope, a_cache, _sds((b, size), jnp.int32),
+                    _sds((b,), jnp.int32), pt_sds,
+                )
             fn = lambda params, rope, cache, toks, pos_vec: pipeline_forward(
                 cfg, engine.mesh, params, rope, cache, toks, pos_vec,
                 logits_mode="last", kv_len=kvb,
@@ -811,6 +869,46 @@ def slo_gauges(stats) -> dict:
     return out
 
 
+def slo_class_series(stats) -> dict:
+    """Per-SLO-class attainment rows derived from the labeled
+    ``ttft_ms{slo_class=...}`` / ``tpot_ms{...}`` histograms the serving
+    paths observe (runtime/telemetry.py StepStats.observe(labels=)) —
+    rendered as ``dlt_slo_ttft_attainment{slo_class=...}`` rows, exactly
+    the family the fleet scraper already lifts into
+    ``slo_ttft_attainment_by_class`` and the autoscaler's per-class
+    pressure check reads (server/fleet.py, server/autoscaler.py)."""
+    from .tracing import split_labeled_key
+
+    out: dict = {}
+    hists = stats.hists_snapshot()
+    for base_name, env, default, gauge in (
+        ("ttft_ms", "DLT_SLO_TTFT_MS", 1000.0, "slo_ttft_attainment"),
+        ("tpot_ms", "DLT_SLO_TPOT_MS", 100.0, "slo_tpot_attainment"),
+    ):
+        slo = _slo_ms(env, default)
+        rows = []
+        for key, snap in sorted(hists.items()):
+            base, labels = split_labeled_key(key)
+            if base != base_name or not labels or "slo_class" not in labels:
+                continue
+            if not snap["count"]:
+                continue
+            cum = 0
+            for bound, c in snap["buckets"]:
+                if isinstance(bound, str) or bound > slo:
+                    break
+                cum = c
+            rows.append(
+                (
+                    {"slo_class": labels["slo_class"]},
+                    round(cum / snap["count"], 4),
+                )
+            )
+        if rows:
+            out[gauge] = rows
+    return out
+
+
 def metrics_view(engine):
     """Everything `/metrics` adds on top of StepStats: (flat_gauges,
     labeled_series). One cold-path call per scrape — host metadata reads
@@ -837,7 +935,20 @@ def metrics_view(engine):
         rg, rs = roofline_view(engine, table)
         gauges.update(rg)
         series.update(rs)
-    gauges.update(slo_gauges(engine.stats))
+    # SLO attainment: ONE gauge family per metric — the unlabeled total row
+    # (the shape the fleet table has always lifted) plus the {slo_class}
+    # breakdown rows the autoscaler's per-class pressure check reads (TYPE
+    # declares once — the goodput family's precedent). Targets stay flat.
+    slo_flat = slo_gauges(engine.stats)
+    cls_rows = slo_class_series(engine.stats)
+    for gauge in ("slo_ttft_attainment", "slo_tpot_attainment"):
+        total = slo_flat.pop(gauge, None)
+        rows = ([({}, total)] if total is not None else []) + cls_rows.get(
+            gauge, []
+        )
+        if rows:
+            series[gauge] = rows
+    gauges.update(slo_flat)
     return gauges, series
 
 

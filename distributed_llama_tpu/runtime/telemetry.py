@@ -195,11 +195,22 @@ class StepStats:
         with self._counter_lock:
             self.gauges[name] = float(value)
 
-    def observe(self, name: str, value_ms: float, bounds=None):
+    def observe(self, name: str, value_ms: float, bounds=None, labels=None):
         """Record one observation into the named cumulative histogram
         (created on first use; fixed log-scale ms buckets). Thread-safe;
         exported under ``snapshot()["histograms"]`` and as Prometheus
-        ``_bucket``/``_sum``/``_count`` series on `/metrics`."""
+        ``_bucket``/``_sum``/``_count`` series on `/metrics`. `labels`
+        (e.g. ``{"slo_class": "interactive"}``) keys a SEPARATE labeled
+        histogram rendered as extra rows of the same family — the
+        per-class TTFT/TPOT breakdown (tracing.split_labeled_key is the
+        decoding twin)."""
+        if labels:
+            name = (
+                name
+                + "{"
+                + ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+                + "}"
+            )
         with self._counter_lock:
             h = self.hists.get(name)
             if h is None:
@@ -317,7 +328,7 @@ SLO_CLASSES = ("interactive", "standard", "batch")
 #: the trace, the HTTP payload, and the tests can never disagree on shape
 LEDGER_FIELDS = (
     "queue_us", "prefill_us", "decode_us", "spec_us",
-    "remote_prefill_us", "kv_transfer_us",
+    "remote_prefill_us", "kv_transfer_us", "kv_transfer_path",
     "prompt_tokens", "prefix_hit_tokens", "generated_tokens",
     "spec_accepted_tokens", "discarded_tokens", "retries",
 )
@@ -343,7 +354,11 @@ class GoodputLedger:
     spec_us: int = 0       # speculative draft+verify round walls
     remote_prefill_us: int = 0  # prefill-WORKER wall of a disaggregated
     # request (server/disagg.py; the worker reports it in its KV payload)
-    kv_transfer_us: int = 0     # fetch + device-load wall of the shipped KV
+    kv_transfer_us: int = 0     # fetch wall of the shipped KV, net of the
+    # worker's reported prefill (runtime/kv_transport.py)
+    kv_transfer_path: str = ""  # transport the shipped KV took ("device" |
+    # "http"; "" = no transfer) — the per-request twin of the labeled
+    # dlt_kv_transfer_us series
     prompt_tokens: int = 0
     prefix_hit_tokens: int = 0   # prompt tokens resumed from the radix cache
     generated_tokens: int = 0    # delivered to the client (usage-visible)

@@ -461,13 +461,37 @@ def render_gauges(lines: list, gauges: dict, prefix: str = "dlt") -> None:
         lines.append(prom_line(m, None, gauges[k]))
 
 
-def render_hist(lines: list, name: str, snap: dict) -> None:
-    lines.append(f"# TYPE {name} histogram")
+def render_hist(lines: list, name: str, snap: dict, labels: dict | None = None,
+                type_line: bool = True) -> None:
+    """One histogram series; `labels` (e.g. ``{"slo_class": "batch"}``) ride
+    every ``_bucket``/``_sum``/``_count`` row next to ``le`` — the per-class
+    latency breakdown StepStats.observe(labels=...) produces.
+    ``type_line=False`` skips the ``# TYPE`` header: a family with labeled
+    breakdown series must declare its TYPE exactly once (the exposition
+    format forbids a second TYPE line for the same metric)."""
+    if type_line:
+        lines.append(f"# TYPE {name} histogram")
+    base = dict(labels) if labels else {}
     for le, cum in snap["buckets"]:
         lab = le if isinstance(le, str) else ("%g" % le)
-        lines.append(prom_line(name + "_bucket", {"le": lab}, cum))
-    lines.append(prom_line(name + "_sum", None, snap["sum"]))
-    lines.append(prom_line(name + "_count", None, snap["count"]))
+        lines.append(prom_line(name + "_bucket", dict(base, le=lab), cum))
+    lines.append(prom_line(name + "_sum", base or None, snap["sum"]))
+    lines.append(prom_line(name + "_count", base or None, snap["count"]))
+
+
+_LABELED_KEY_RE = re.compile(r'^([^{]+)\{(.*)\}$')
+_LABEL_PAIR_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def split_labeled_key(key: str):
+    """``'ttft_ms{slo_class="batch"}' -> ("ttft_ms", {"slo_class":
+    "batch"})`` — the encoding StepStats uses to keep labeled histograms in
+    its one flat dict (plain keys pass through with no labels)."""
+    m = _LABELED_KEY_RE.match(key)
+    if not m:
+        return key, None
+    labels = dict(_LABEL_PAIR_RE.findall(m.group(2)))
+    return m.group(1), labels or None
 
 
 def render_step_stats(
@@ -514,8 +538,17 @@ def render_step_stats(
         lines.append(f"# TYPE {mc} counter")
         for kind in sorted(snap):
             lines.append(prom_line(mc, {"kind": kind}, snap[kind]["count"]))
+    seen_hist_families: set = set()
     for hname in sorted(hists):
-        render_hist(lines, f"{prefix}_{_metric(hname)}", hists[hname])
+        base, labels = split_labeled_key(hname)
+        fam = f"{prefix}_{_metric(base)}"
+        render_hist(
+            lines, fam, hists[hname], labels=labels,
+            # ONE TYPE line per family: the unlabeled total and its
+            # {slo_class} breakdown series share the declaration
+            type_line=fam not in seen_hist_families,
+        )
+        seen_hist_families.add(fam)
     return "\n".join(lines) + "\n"
 
 

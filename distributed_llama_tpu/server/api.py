@@ -210,6 +210,9 @@ class _BatchReq:
         # extra chunk before the writer thread's `stopped` flag is seen
         self.eos_ids = frozenset(eos_ids)
         self.stopped = False
+        self.kv_external = None  # deferred disaggregated-KV insert
+        # (server/disagg.PendingExternalKv): the Batcher loop applies it on
+        # the engine thread right before this request's admission
         self.prefilling = False  # admitted, prompt still prefilling in
         # bounded chunks between decode steps (interleaved admission)
         self.out_ids: list = []  # raw token ids delivered to the emit
@@ -574,6 +577,13 @@ class Batcher:
                         req.trace.event(  # dlt: allow(trace-hot-emit)
                             "queue_wait", t0, max(nowu - t0, 0), ("row",), (row,)
                         )
+                    if req.kv_external is not None:
+                        # deferred disaggregated-KV insert: THIS thread owns
+                        # the engine's dispatches, so the paged scatter (or
+                        # contiguous device_put) is race-free here, and the
+                        # begin_admit below then matches the fresh entry
+                        req.kv_external.apply(self.state)
+                        req.kv_external = None
                     key = self._key_for_seed(req.seed) if req.seed is not None else None
                     session.begin_admit(
                         row, req.ids, temperature=req.temperature,
@@ -949,24 +959,44 @@ class ApiState:
                 "⚠️  --host-decode serves requests serialized (batched serving "
                 "samples on-device); concurrent requests will queue"
             )
-        # disaggregated serving (server/disagg.py): role + the decode
-        # worker's prefill-tier client. The client exists only when it can
-        # actually work — decode role, peers named, a prefix cache to land
-        # shipped KV in, contiguous layout (serve() forces it; a library
-        # caller who built a paged engine just gets local prefill).
+        # disaggregated serving (server/disagg.py over the KV movement
+        # layer, runtime/kv_transport.py): role + the decode worker's
+        # prefill-tier client. The client exists only when it can actually
+        # work — decode role, peers named, a prefix cache to land shipped
+        # KV in. Both KV layouts serve both roles now: paged workers
+        # gather/scatter pool pages through the warmed page_extract /
+        # page_insert programs.
         from .disagg import DisaggClient, resolve_peers, resolve_role
 
         self.role = resolve_role(getattr(args, "role", None))
         peers = resolve_peers(getattr(args, "prefill_peer", None))
         self.disagg = None
-        if self.role == "decode" and peers and not engine.paged \
-                and engine.prefix_cache is not None:
+        if self.role == "decode" and peers and engine.prefix_cache is not None:
             self.disagg = DisaggClient(self, peers)
         elif self.role == "decode" and not peers:
             print(
                 "⚠️  --role decode without --prefill-peer serves prompts "
                 "locally (unified behavior)"
             )
+
+    def prefill_extract(self, ids, have_keys=(), trace_id=None):
+        """The same-process device-transport provider contract
+        (runtime/kv_transport.py register_device_peer): run the prefill-
+        worker core and hand the extracted segments over as device arrays —
+        zero host serialization between colocated roles. Raises on
+        non-prefill roles / bad input exactly like the HTTP handler 4xxs."""
+        from .disagg import run_prefill_arrays
+
+        if self.role != "prefill":
+            raise OSError("this replica does not serve role=prefill")
+        header, segments = run_prefill_arrays(
+            self, list(ids), have_keys=tuple(have_keys)
+        )
+        ks = [k for _, k, _ in segments]
+        vs = [v for _, _, v in segments]
+        if len(ks) == 1:
+            return header, ks[0], vs[0]
+        return header, ks, vs
 
     def _record_ledger(
         self, ledger: GoodputLedger, trace, waste_reason=None,
@@ -1027,14 +1057,18 @@ class ApiState:
                 trace,
             )
             raise Overloaded(retry_after_s=1)
+        pending_kv = None
         try:
-            # disaggregated prefill (server/disagg.py): land the prompt's
-            # leading-bucket KV in the prefix cache BEFORE admission, so
-            # begin_admit's ordinary match/splice picks it up. Runs after
-            # the shed check (never burn a prefill worker on a shed
-            # request); degrades to local prefill on any failure — zeros
-            # ride the ledger.
+            # disaggregated prefill (server/disagg.py): fetch the prompt's
+            # leading-bucket KV BEFORE admission; the INSERT is deferred to
+            # the Batcher loop (engine thread — a paged insert donates the
+            # live pool), which applies it right before begin_admit so the
+            # ordinary match/splice picks it up. Runs after the shed check
+            # (never burn a prefill worker on a shed request); degrades to
+            # local prefill on any failure — zeros ride the ledger.
             disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
+            if disagg_walls is not None:
+                pending_kv = disagg_walls.pop("pending_kv", None)
 
             base = []
             if prompt.public_prompt:
@@ -1045,6 +1079,8 @@ class ApiState:
             # on the public-prompt emit): release it, or the class's
             # quota leaks one slot per failed pre-admission step
             self.batcher.release_reservation(klass)
+            if pending_kv is not None:
+                pending_kv.abandon()
             raise
 
         req_box = []
@@ -1100,7 +1136,10 @@ class ApiState:
 
         def fail_ledger(req, outcome):
             """A failed request (or failed attempt): every token it decoded
-            is waste — nothing reached a successful response."""
+            is waste — nothing reached a successful response. Deliberately
+            does NOT touch pending_kv: a stall-retried attempt's deferred
+            insert must survive into attempt 2 (the terminal paths abandon
+            it explicitly)."""
             led = req.ledger
             led.outcome = outcome
             led.generated_tokens = 0
@@ -1115,11 +1154,20 @@ class ApiState:
                     # still ours to give back (attempt 1's was already
                     # consumed by the first attempt's drain)
                     self.batcher.release_reservation(klass)
+                if pending_kv is not None:
+                    pending_kv.abandon()
                 raise
             req.ledger.retries = attempt
             if disagg_walls is not None:
                 req.ledger.remote_prefill_us = disagg_walls["remote_prefill_us"]
                 req.ledger.kv_transfer_us = disagg_walls["kv_transfer_us"]
+                req.ledger.kv_transfer_path = disagg_walls.get(
+                    "kv_transfer_path", ""
+                )
+            # deferred external-KV insert: the Batcher loop applies it on
+            # the engine thread right before this request's admission
+            # (idempotent — a stall retry's second attempt reuses it)
+            req.kv_external = pending_kv
             try:
                 self.batcher.submit(req)
                 break
@@ -1141,6 +1189,8 @@ class ApiState:
                         waste_reason="stall_retry", count_request=False,
                     )
                     continue
+                if pending_kv is not None:
+                    pending_kv.abandon()  # terminal failure: drop the pin
                 self._record_ledger(fail_ledger(req, "error"), trace)
                 raise
             except Overloaded:
@@ -1149,17 +1199,27 @@ class ApiState:
                 # the backlog shed above; a preempted row's decoded tokens
                 # are labeled "preempt" waste so the scheduler's cost is
                 # its own goodput line
+                if pending_kv is not None:
+                    pending_kv.abandon()
                 self._record_ledger(
                     fail_ledger(req, "shed"), trace,
                     waste_reason="preempt" if req.preempted else None,
                 )
                 raise
             except ClientDisconnected:
+                if pending_kv is not None:
+                    pending_kv.abandon()
                 self._record_ledger(fail_ledger(req, "client_gone"), trace)
                 raise
             except Exception:
+                if pending_kv is not None:
+                    pending_kv.abandon()
                 self._record_ledger(fail_ledger(req, "error"), trace)
                 raise
+        if pending_kv is not None:
+            # applied by the Batcher at admission (abandon is then a no-op);
+            # a request retired WITHOUT admission must still drop the pin
+            pending_kv.abandon()
         # n_out counts tokens the writer actually delivered (the EOS token
         # included) — req.n also counts post-stop overrun decoded before the
         # step loop noticed, which must not inflate usage accounting
@@ -1173,13 +1233,20 @@ class ApiState:
         if times[0] is not None:
             # per-request latency histograms: TTFT from request arrival to
             # the first delivered token (queue wait included — the client's
-            # view), per-output-token from the delivery span
+            # view), per-output-token from the delivery span. Observed
+            # twice: the unlabeled fleet-facing totals (unchanged shape)
+            # and the {slo_class} breakdown rows the autoscaler's per-class
+            # attainment reads (server/scheduler.py, PR 12 follow-on)
+            ttft = max((to_us(times[0]) - t_req0) / 1e3, 0.0)
+            self.engine.stats.observe("ttft_ms", ttft)
             self.engine.stats.observe(
-                "ttft_ms", max((to_us(times[0]) - t_req0) / 1e3, 0.0)
+                "ttft_ms", ttft, labels={"slo_class": klass}
             )
             if req.n_out > 1:
+                tpot = (times[1] - times[0]) * 1e3 / (req.n_out - 1)
+                self.engine.stats.observe("tpot_ms", tpot)
                 self.engine.stats.observe(
-                    "tpot_ms", (times[1] - times[0]) * 1e3 / (req.n_out - 1)
+                    "tpot_ms", tpot, labels={"slo_class": klass}
                 )
         return "".join(base + deltas_box[0]), len(ids), req.n_out, led
 
@@ -1273,8 +1340,14 @@ class ApiState:
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
         # disaggregated prefill (server/disagg.py): the fetched KV lands in
         # the prefix cache and engine.generate's ordinary prefill match
-        # splices it; any failure degrades to local prefill (zeros returned)
+        # splices it; any failure degrades to local prefill (zeros
+        # returned). The serialized path runs under self.lock, so the
+        # deferred insert applies inline — this IS the engine thread here.
         disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
+        if disagg_walls is not None:
+            pending_kv = disagg_walls.pop("pending_kv", None)
+            if pending_kv is not None:
+                pending_kv.apply(self)
 
         buffer = []
         if prompt.public_prompt:
@@ -1303,6 +1376,7 @@ class ApiState:
         if disagg_walls is not None:
             led.remote_prefill_us = disagg_walls["remote_prefill_us"]
             led.kv_transfer_us = disagg_walls["kv_transfer_us"]
+            led.kv_transfer_path = disagg_walls.get("kv_transfer_path", "")
         self._inflight_ledger = led
         spec_accept_0 = engine.stats.counters_snapshot().get(
             "spec_accepted_tokens", 0
@@ -1358,12 +1432,17 @@ class ApiState:
             engine.stats.incr("cache_miss")
         engine.stats.incr("requests_completed")
         # per-request latency histograms (the serialized path's twin of the
-        # Batcher observes: GenerationResult already carries the walls)
+        # Batcher observes: GenerationResult already carries the walls) —
+        # unlabeled totals + the {slo_class} breakdown, like the batched path
         engine.stats.observe("ttft_ms", res.ttft_us / 1e3)
+        engine.stats.observe(
+            "ttft_ms", res.ttft_us / 1e3, labels={"slo_class": led.slo_class}
+        )
         if res.n_pred_tokens > 1:
+            tpot = (res.total_us - res.ttft_us) / (res.n_pred_tokens - 1) / 1e3
+            engine.stats.observe("tpot_ms", tpot)
             engine.stats.observe(
-                "tpot_ms",
-                (res.total_us - res.ttft_us) / (res.n_pred_tokens - 1) / 1e3,
+                "tpot_ms", tpot, labels={"slo_class": led.slo_class}
             )
         # finalize + fold the goodput ledger (GenerationResult carries the
         # walls; prefix-hit/spec-accepted from the engine's own accounting)
@@ -1513,9 +1592,26 @@ class Handler(BaseHTTPRequestHandler):
             # (server/fleet.py) lifts both into the per-replica table
             series = dict(prof_series)
             series["goodput_tokens_per_s"] = st.goodput.goodput_series()
+            # KV movement accounting (runtime/kv_transport.py): per-path
+            # transfer-wall quantiles + bytes moved — the device-vs-http
+            # bench bar and any fleet dashboard read these labeled families
+            kvt_rows = []
+            for pth in ("device", "http"):
+                pct = st.engine.stats.percentiles(f"kv_transfer_us[{pth}]")
+                for q, v in sorted(pct.items()):
+                    kvt_rows.append(
+                        ({"path": pth, "quantile": q}, round(v, 1))
+                    )
+            if kvt_rows:
+                series["kv_transfer_us"] = kvt_rows
+            snap_counters = st.engine.stats.counters_snapshot()
             counter_series = {
                 "wasted_tokens": st.goodput.wasted_series()
                 + st.goodput.wasted_by_class_series(),
+                "kv_transfer_bytes": [
+                    ({"path": pth}, snap_counters.get(f"kv_transfer_bytes_{pth}", 0))
+                    for pth in ("device", "http")
+                ],
             }
             if st.batcher is not None:
                 # scheduler decisions by (class, action) — zero-filled so
@@ -1763,6 +1859,14 @@ class Handler(BaseHTTPRequestHandler):
         try:
             params = json.loads(self.rfile.read(length) or b"{}")
             ids = [int(t) for t in params["ids"]]
+            # content-addressed skip claim (runtime/kv_transport.py): the
+            # requester's chained page-key names for the leading pages it
+            # already holds — hex strings on the wire. A malformed claim
+            # degrades to a full send, never an error.
+            try:
+                have = tuple(int(h, 16) for h in params.get("have", ()))
+            except (TypeError, ValueError):
+                have = ()
         except (json.JSONDecodeError, KeyError, TypeError, ValueError):
             self._json(400, b'{"error":"ids (a token id list) required"}')
             return
@@ -1780,7 +1884,7 @@ class Handler(BaseHTTPRequestHandler):
         from .disagg import run_prefill
 
         try:
-            payload = run_prefill(st, ids, trace=tr)
+            payload = run_prefill(st, ids, have=have, trace=tr)
         except ValueError as e:
             self._json(400, json.dumps({"error": str(e)}).encode())
             return
@@ -1963,25 +2067,11 @@ def serve(args) -> HTTPServer:
     from http.server import ThreadingHTTPServer
 
     from ..cli import make_engine
-    from .disagg import resolve_role
 
-    # disaggregated roles (server/disagg.py) force the contiguous KV
-    # layout BEFORE the engine is built: shipped KV travels as host arrays
-    # into the prefix cache, and a paged entry's storage is physical page
-    # ids that mean nothing outside their own pool
-    role = resolve_role(getattr(args, "role", None))
-    if role != "unified":
-        import os as _os_kv
-
-        layout = getattr(args, "kv_layout", None) or _os_kv.environ.get(
-            "DLT_KV_LAYOUT"
-        )
-        if layout == "paged":
-            print(
-                f"⚠️  --role {role} requires the contiguous KV layout; "
-                "overriding --kv-layout paged"
-            )
-        args.kv_layout = "contiguous"
+    # since the KV movement layer (runtime/kv_transport.py), BOTH serving
+    # roles speak both KV layouts: paged workers extract/insert through the
+    # warmed page_extract/page_insert programs, so the old roles-force-
+    # contiguous override is gone and the paged default applies everywhere
     engine = make_engine(args)
     tokenizer = Tokenizer(args.tokenizer)
     import os as _os
@@ -1999,6 +2089,13 @@ def serve(args) -> HTTPServer:
             # lazily on the first /debug/costs hit.
             engine.cost_table()
     state = ApiState(engine, tokenizer, args)
+    # same-process device-path registry (runtime/kv_transport.py): a decode
+    # worker whose --prefill-peer names this port reaches the prefill
+    # engine as device arrays, no socket — DLT_KV_TRANSPORT governs whether
+    # clients actually take it (auto: device whenever registered)
+    from ..runtime.kv_transport import register_device_peer
+
+    register_device_peer(args.port, state)
     # a fresh Handler subclass per server: `state` as a class attribute on
     # the shared Handler would make two in-process replicas (gateway tests,
     # library embedders) clobber each other's engines. Handler.state stays

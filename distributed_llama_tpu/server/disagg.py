@@ -4,48 +4,73 @@ TTFT-heavy and decode-heavy traffic contend for the same chips on a unified
 replica: one long prompt's prefill chunks interleave with — and bound the
 latency of — every co-batched decode stream. DistServe's answer (and ours)
 is to split the roles: **prefill workers** run prompts and ship the finished
-KV; **decode workers** splice it and stream tokens. The split rides this
-repo's existing machinery end to end:
+KV; **decode workers** splice it and stream tokens. Since the KV movement
+layer landed (runtime/kv_transport.py), the split composes with every KV
+subsystem instead of excluding them:
 
 * the prefill worker runs an ordinary ``engine.prefill`` over the prompt's
-  leading ``P`` tokens (``P`` = the prefix cache's bucket_down boundary, so
-  the shipped slice lands exactly on the warm copy-program ladder) and
-  extracts ``[L, P, h, d]`` k/v with the SAME ``extract_prefix_from_row``
-  program a local publish uses (``POST /v1/prefill`` -> one binary payload:
-  length-prefixed JSON header + raw k + raw v);
+  leading ``P`` tokens (``P`` = the prefix cache's bucket_down boundary) and
+  extracts the slice on ITS layout — contiguous workers through the warmed
+  ``prefix_extract`` program, PAGED workers by gathering their pool pages
+  (``page_extract``) — into the one ``[L, n, h, d]`` shape both the wire
+  codec and the device transport speak;
+* **content-addressed page skip**: the decode worker names the leading
+  pages it already holds by their chained token-content hashes
+  (:func:`~..runtime.kv_transport.page_keys`) and the worker ships only the
+  rest — repeated/growing prefixes move only their missing pages
+  (``disagg_pages_skipped``), and a paged entry's identity on the wire is
+  its content, never a pool-local page id;
+* **transport per peer** (``DLT_KV_TRANSPORT`` = auto|device|http): same-
+  process peers (and, on pods, jax-addressable devices) move KV as device
+  arrays with zero host serialization (:class:`DeviceKvTransport`); the
+  PR 10 length-prefixed binary codec stays as the portable HTTP fallback.
+  Per-path walls and bytes land in ``kv_transfer_us[{path}]`` /
+  ``kv_transfer_bytes_{path}`` — the ≥3x device-vs-http cut is the bench
+  bar (bench.py leg_kv_movement);
 * the decode worker inserts the shipped slice into its radix prefix cache
-  (:meth:`~..runtime.prefix_cache.PrefixCache.insert_external`), and the
-  request then takes the UNMODIFIED admission path — match, pin, splice,
-  resume — which is what makes disaggregated output bit-identical to
-  unified serving (the prefix cache's write-before-read invariant already
-  proves splice-then-resume ≡ cold prefill);
+  (:meth:`~..runtime.prefix_cache.PrefixCache.insert_external` — paged
+  engines scatter into freshly allocated pool pages and retain the held
+  base pages), and the request then takes the UNMODIFIED admission path —
+  match, pin, splice, resume — which is what makes disaggregated output
+  bit-identical to unified serving. The insert itself is DEFERRED to the
+  engine's dispatch thread (:class:`PendingExternalKv`): a paged insert
+  donates the live pool, which a handler thread must never race;
 * **degradation, not failure**: a prefill worker dying mid-transfer (the
-  chaos suite kills one mid-KV-body) leaves the decode worker exactly one
-  request-local consequence — no cache entry — so the request cold-prefills
-  locally and completes token-identical. The event is counted
-  (``disagg_degraded``), ledgered (the re-prefilled tokens land in
-  ``dlt_wasted_tokens_total{reason=transfer_retry}`` — the prefill worker's
-  compute for them is lost fleet-wide), and traced (a ``kv_transfer`` event
-  with ``failed=1`` lands even on unsampled traces).
+  chaos suite kills one mid-KV-body; the device path has its own injection
+  hook) leaves the decode worker exactly one request-local consequence —
+  no cache entry — so the request cold-prefills locally and completes
+  token-identical. The event is counted (``disagg_degraded``), ledgered
+  (``dlt_wasted_tokens_total{reason=transfer_retry}``), and traced (a
+  ``kv_transfer`` event with ``failed=1`` lands even on unsampled traces).
 
 Roles are picked with ``--role {prefill,decode,unified}`` (``DLT_ROLE``) on
 the API server; decode workers name their peers with ``--prefill-peer
-host:port`` (repeatable; ``DLT_PREFILL_PEER`` comma-separated). Both
-disaggregated roles force the contiguous KV layout: the wire format is host
-arrays, and a paged entry's storage is physical page ids that mean nothing
-outside their own pool.
+host:port`` (repeatable; ``DLT_PREFILL_PEER`` comma-separated). Both roles
+now serve EITHER KV layout — the paged-pool default included.
 """
 
 from __future__ import annotations
 
-import http.client
-import json
 import os
-import struct
 import threading
 import time
 
 import numpy as np
+
+# the wire codec lives with the rest of the KV movement layer now; these
+# re-exports keep the PR 10 import surface working
+from ..runtime.kv_transport import (  # noqa: F401 — re-exported API
+    KEY_PAGE_TOKENS,
+    TransferResult,
+    build_transports,
+    doubling_segments,
+    kv_payload,
+    matching_pages,
+    page_keys,
+    parse_kv_payload,
+    resolve_transport,
+    transport_for,
+)
 
 ROLES = ("unified", "prefill", "decode")
 
@@ -76,56 +101,6 @@ def resolve_peers(explicit=None) -> list:
     return peers
 
 
-def _np_dtype(name: str):
-    """Dtype-by-name incl. the ml_dtypes extended floats (``np.dtype`` alone
-    does not know ``bfloat16``)."""
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
-
-
-# -- the wire format ----------------------------------------------------------
-#
-# 4-byte big-endian header length | JSON header | raw k bytes | raw v bytes
-# Header: tokens (the P token ids the slice covers), k_shape/v_shape, dtype,
-# prefill_us (the worker's wall — the decode side's ledger field). Raw bytes
-# rather than base64-in-JSON: a 512-token 8B-class slice is tens of MB and
-# the transfer wall is the metric under test.
-
-
-def kv_payload(header: dict, k_np: np.ndarray, v_np: np.ndarray) -> bytes:
-    hjson = json.dumps(header).encode()
-    return struct.pack(">I", len(hjson)) + hjson + k_np.tobytes() + v_np.tobytes()
-
-
-def parse_kv_payload(body: bytes):
-    """``(header, k_np, v_np)`` from one payload; raises ValueError on any
-    truncation or shape/dtype mismatch (the caller's degradation path)."""
-    if len(body) < 4:
-        raise ValueError("kv payload truncated before header length")
-    (hlen,) = struct.unpack(">I", body[:4])
-    if len(body) < 4 + hlen:
-        raise ValueError("kv payload truncated inside header")
-    header = json.loads(body[4 : 4 + hlen])
-    dt = _np_dtype(header["dtype"])
-    k_shape = tuple(header["k_shape"])
-    v_shape = tuple(header["v_shape"])
-    k_bytes = int(np.prod(k_shape)) * dt.itemsize
-    v_bytes = int(np.prod(v_shape)) * dt.itemsize
-    blob = body[4 + hlen :]
-    if len(blob) != k_bytes + v_bytes:
-        raise ValueError(
-            f"kv payload truncated: body {len(blob)} B, "
-            f"header names {k_bytes + v_bytes} B"
-        )
-    k = np.frombuffer(blob[:k_bytes], dtype=dt).reshape(k_shape)
-    v = np.frombuffer(blob[k_bytes:], dtype=dt).reshape(v_shape)
-    return header, k, v
-
-
 # -- the prefill-worker side --------------------------------------------------
 
 
@@ -140,21 +115,24 @@ def prefill_boundary(n_prompt_tokens: int, seq_len: int) -> int:
     return P if P >= PREFIX_MIN_TOKENS else 0
 
 
-def run_prefill(state, ids: list, trace=None) -> bytes:
-    """The ``POST /v1/prefill`` body builder, run on the prefill worker
-    under its serialized engine lock: prefill ``ids[:P]`` (riding the
-    worker's OWN prefix cache, so a repeated shared prefix costs one splice
-    instead of a re-prefill), extract the slice through the warmed
-    ``prefix_extract`` program, and frame it for the wire. Raises ValueError
-    for client errors (too short / too long); engine failures propagate for
-    the handler's recover path."""
+def run_prefill_arrays(state, ids: list, have_keys=(), trace=None):
+    """The prefill-worker core, shared by BOTH transports: prefill
+    ``ids[:P]`` under the serialized engine lock (riding the worker's OWN
+    prefix cache, so a repeated shared prefix costs one splice instead of a
+    re-prefill), skip the leading pages ``have_keys`` proves the requester
+    already holds, and extract the rest as doubling segments.
+
+    Returns ``(header, segments)``: ``segments`` is ``[(start, k, v), ...]``
+    of device (or host) arrays covering tokens ``[S, P)`` — the device
+    transport hands them over as-is (zero host serialization); the HTTP
+    path (:func:`run_prefill`) flattens them into the binary payload.
+    Raises ValueError for client errors (too short / too long); engine
+    failures propagate for the handler's recover path."""
     import jax.numpy as jnp
 
-    from ..runtime.prefix_cache import extract_prefix_from_row
+    from ..runtime.prefix_cache import bucket_down, extract_prefix_from_row
 
     engine = state.engine
-    if engine.paged:
-        raise ValueError("prefill role requires the contiguous KV layout")
     n = len(ids)
     if n >= engine.cfg.seq_len:
         raise ValueError(
@@ -165,6 +143,15 @@ def run_prefill(state, ids: list, trace=None) -> bytes:
         raise ValueError(
             f"prompt ({n} tokens) below the disaggregation floor"
         )
+    expected = page_keys(ids[:P])
+    # content-addressed skip: the longest leading run of the requester's
+    # page names matching ours, floored to a prefix bucket (so the shipped
+    # remainder splits into bucket-length doubling segments) and to the
+    # worker's page granularity
+    S = matching_pages(expected, have_keys) * KEY_PAGE_TOKENS
+    S = bucket_down(S, engine.cfg.seq_len) if S else 0
+    if engine.paged and S % engine.page_size != 0:
+        S = 0
     with state.lock:
         t0 = time.perf_counter()
         engine.trace = trace
@@ -174,53 +161,154 @@ def run_prefill(state, ids: list, trace=None) -> bytes:
             # so the NEXT request sharing this prefix splices instead of
             # re-prefilling — the prefill tier has cache locality too
             engine.prefill(list(ids[:P]))
-            seg_sh = (
-                engine.prefix_cache.seg_sharding
-                if engine.prefix_cache is not None
-                else None
-            )
-            with engine._guard(f"prefix_extract[{P}]", ("prefix_extract", P, P)):
-                k, v = extract_prefix_from_row(
-                    engine.cache, jnp.asarray(0, jnp.int32), length=P,
-                    out_sharding=seg_sh,
+            segments = []
+            if engine.paged:
+                from ..runtime.paged_kv import gather_pages
+
+                ps = engine.page_size
+                pages = engine.page_pool.row_pages(0, P // ps)
+                pc = engine.prefix_cache
+                seg_sh = pc.seg_sharding if pc is not None else None
+                for a, b_ in doubling_segments(S, P):
+                    seg_pages = np.asarray(pages[a // ps : b_ // ps], np.int32)
+                    B = b_ - a
+                    with engine._guard(
+                        f"page_extract[{B}]", ("page_extract", B, B)
+                    ):
+                        k, v = gather_pages(
+                            engine.cache, seg_pages, out_sharding=seg_sh
+                        )
+                    segments.append((a, k, v))
+            else:
+                seg_sh = (
+                    engine.prefix_cache.seg_sharding
+                    if engine.prefix_cache is not None
+                    else None
                 )
-            k_np = np.asarray(k)
-            v_np = np.asarray(v)
+                with engine._guard(
+                    f"prefix_extract[{P}]", ("prefix_extract", P, P)
+                ):
+                    k, v = extract_prefix_from_row(
+                        engine.cache, jnp.asarray(0, jnp.int32), length=P,
+                        out_sharding=seg_sh,
+                    )
+                if S > 0:
+                    # partial send: slice the skipped prefix off HOST-side
+                    # (numpy views off one fetch — a cold path, and never
+                    # an eager device op that could trip the sentinel)
+                    k = np.asarray(k)[:, S:]
+                    v = np.asarray(v)[:, S:]
+                segments.append((S, k, v))
         finally:
             engine.trace = None
         wall_us = int((time.perf_counter() - t0) * 1e6)
     engine.stats.incr("disagg_prefills")
-    engine.stats.incr("disagg_prefill_tokens", P)
+    engine.stats.incr("disagg_prefill_tokens", P - S)
+    if S:
+        engine.stats.incr("disagg_send_pages_skipped", S // KEY_PAGE_TOKENS)
     header = {
         "tokens": [int(t) for t in ids[:P]],
         "p": P,
-        "k_shape": list(k_np.shape),
-        "v_shape": list(v_np.shape),
-        "dtype": str(k_np.dtype),
+        "start": S,
+        "page_tokens": KEY_PAGE_TOKENS,
+        "page_keys": [format(h, "x") for h in expected],
         "prefill_us": wall_us,
     }
+    return header, segments
+
+
+def run_prefill(state, ids: list, have=(), trace=None) -> bytes:
+    """The ``POST /v1/prefill`` body builder — the HTTP transport's worker
+    half: run the shared core and flatten its segments into ONE binary
+    payload (length-prefixed JSON header + raw k + raw v, covering tokens
+    ``[start, P)``)."""
+    header, segments = run_prefill_arrays(
+        state, ids, have_keys=have, trace=trace
+    )
+    ks = [np.asarray(k) for _, k, _ in segments]
+    vs = [np.asarray(v) for _, _, v in segments]
+    k_np = np.concatenate(ks, axis=1) if len(ks) > 1 else ks[0]
+    v_np = np.concatenate(vs, axis=1) if len(vs) > 1 else vs[0]
+    header = dict(
+        header,
+        k_shape=list(k_np.shape),
+        v_shape=list(v_np.shape),
+        dtype=str(k_np.dtype),
+    )
     return kv_payload(header, k_np, v_np)
 
 
 # -- the decode-worker side ---------------------------------------------------
 
 
+class PendingExternalKv:
+    """A fetched-but-not-yet-inserted KV slice. The insert MUST run on the
+    engine's dispatch thread (a paged insert scatters into — donates — the
+    live pool, which a handler thread must never race with the Batcher's
+    dispatches), so the fetch defers it here: the Batcher applies it right
+    before the request's admission; the serialized path applies it inline
+    under the engine lock. ``base_entry`` stays PINNED until applied."""
+
+    def __init__(self, client, tokens, k, v, start, base_entry, path):
+        self.client = client
+        self.tokens = tokens
+        self.k = k  # array or per-segment list (kv_transport doubling order)
+        self.v = v
+        self.start = start
+        self.base_entry = base_entry
+        self.path = path
+        self._applied = False
+
+    def apply(self, state) -> bool:
+        """Insert into the local prefix cache; idempotent. On refusal the
+        request simply cold-prefills (counted; the transferred bytes were
+        wasted — ledgered as transfer_retry so the loss is visible)."""
+        if self._applied:
+            return True
+        self._applied = True
+        engine = state.engine
+        pc = engine.prefix_cache
+        try:
+            ok = pc.insert_external(
+                engine, self.tokens, self.k, self.v, start=self.start,
+                base_entry=self.base_entry,
+            )
+        finally:
+            if self.base_entry is not None:
+                pc.entry_release(self.base_entry)
+            self.base_entry = None
+        if not ok:
+            engine.stats.incr("disagg_insert_failed")
+            state.goodput.add_waste(
+                "transfer_retry", len(self.tokens) - self.start
+            )
+        return ok
+
+    def abandon(self):
+        """Release the pinned base without inserting (failed request path
+        between fetch and admission)."""
+        if self.base_entry is not None:
+            self.client.engine.prefix_cache.entry_release(self.base_entry)
+            self.base_entry = None
+        self._applied = True
+
+
 class DisaggClient:
     """The decode worker's prefill-tier client: one bounded fetch per
-    request, inserted into the local radix cache on success, degraded to
-    local prefill on ANY failure — a dead peer must cost this request one
-    timeout, never an error. Peers rotate round-robin with in-request
-    failover (the next peer is tried before degrading), and a FAILED peer
-    enters a backoff window (``DLT_DISAGG_PEER_BACKOFF_S``, default 10 s)
-    during which requests skip it — without this, a hung worker (accepts
-    TCP, never answers) would add the full fetch timeout to EVERY
-    request's TTFT until an operator intervened. With every peer backing
-    off, requests prefill locally immediately (counted, no waste: no
-    prefill-tier compute was spent). A successful fetch clears the peer's
-    backoff."""
+    request over the per-peer transport (device when reachable, the HTTP
+    codec otherwise — runtime/kv_transport.py), degraded to local prefill
+    on ANY failure — a dead peer must cost this request one timeout, never
+    an error. Peers rotate round-robin with in-request failover (the next
+    peer is tried before degrading), and a FAILED peer enters a backoff
+    window (``DLT_DISAGG_PEER_BACKOFF_S``, default 10 s) during which
+    requests skip it — without this, a hung worker (accepts TCP, never
+    answers) would add the full fetch timeout to EVERY request's TTFT
+    until an operator intervened. With every peer backing off, requests
+    prefill locally immediately (counted, no waste: no prefill-tier
+    compute was spent). A successful fetch clears the peer's backoff."""
 
     def __init__(self, state, peers, timeout_s: float | None = None,
-                 backoff_s: float | None = None):
+                 backoff_s: float | None = None, transport: str | None = None):
         self.state = state
         self.engine = state.engine
         self.peers = list(peers)
@@ -240,6 +328,8 @@ class DisaggClient:
             except ValueError:
                 backoff_s = 10.0
         self.backoff_s = backoff_s
+        self.transport = resolve_transport(transport)
+        self.transports = build_transports(self.timeout_s)
         self._lock = threading.Lock()
         self._rr = 0
         self._backoff_until: dict = {}  # (host, port) -> monotonic deadline
@@ -256,6 +346,13 @@ class DisaggClient:
             "timeout_s": self.timeout_s,
             "peer_backoff_s": self.backoff_s,
             "peers_backing_off": backing_off,
+            "transport": self.transport,
+            "peer_transports": {
+                f"{h}:{p}": transport_for(
+                    self.transport, (h, p), self.transports
+                ).path
+                for h, p in self.peers
+            },
         }
 
     def _peer_usable(self, peer) -> bool:
@@ -270,44 +367,57 @@ class DisaggClient:
         with self._lock:
             self._backoff_until.pop(peer, None)
 
-    def _fetch_one(self, host: str, port: int, ids: list, trace_id=None):
-        from ..runtime.tracing import TRACE_HEADER
+    def _skip_base(self, ids, covered, entry):
+        """(start, base_entry STILL PINNED or None, have_keys) — the
+        content-addressed skip claim from a `match_pinned` result: the
+        verified leading span floored to a prefix bucket of whole
+        key-pages. Releases the pin itself (returning None) when the local
+        cache holds nothing usable as a merge base."""
+        from ..runtime.prefix_cache import bucket_down
 
-        conn = http.client.HTTPConnection(host, port, timeout=self.timeout_s)
-        try:
-            headers = {"Content-Type": "application/json", "Connection": "close"}
-            if trace_id:
-                headers[TRACE_HEADER] = trace_id
-            conn.request(
-                "POST", "/v1/prefill", body=json.dumps({"ids": ids}),
-                headers=headers,
-            )
-            resp = conn.getresponse()
-            body = resp.read()
-            if resp.status != 200:
-                raise OSError(f"/v1/prefill returned {resp.status}")
-            return body
-        finally:
-            conn.close()
-
-    def fetch(self, ids: list, trace=None) -> dict:
-        """Try to land ``ids``' leading-bucket KV in the local prefix cache
-        ahead of admission. Returns the ledger walls
-        ``{remote_prefill_us, kv_transfer_us, transferred_tokens}`` —
-        zeros whenever the request proceeds on local prefill (short prompt,
-        local cache already warm, or a degraded transfer). Never raises."""
-        out = {"remote_prefill_us": 0, "kv_transfer_us": 0, "transferred_tokens": 0}
         engine = self.engine
         pc = engine.prefix_cache
-        if pc is None or engine.paged or not self.peers:
+        if entry is None:
+            return 0, None, ()
+        S = bucket_down(min(covered, entry.length), engine.cfg.seq_len)
+        if engine.paged and engine.page_size and S % engine.page_size != 0:
+            S = 0
+        if S < KEY_PAGE_TOKENS or tuple(entry.tokens[:S]) != tuple(
+            int(t) for t in ids[:S]
+        ):
+            pc.entry_release(entry)
+            return 0, None, ()
+        return S, entry, page_keys(ids[:S])
+
+    def fetch(self, ids: list, trace=None) -> dict:
+        """Try to land ``ids``' leading-bucket KV ahead of admission.
+        Returns the ledger walls ``{remote_prefill_us, kv_transfer_us,
+        kv_transfer_path, transferred_tokens, pages_skipped}`` plus, under
+        ``"pending_kv"``, the deferred insert the engine thread must apply
+        (:class:`PendingExternalKv`; absent on local-hit/degraded paths).
+        Zeros whenever the request proceeds on local prefill (short
+        prompt, local cache already warm, or a degraded transfer). Never
+        raises."""
+        out = {
+            "remote_prefill_us": 0, "kv_transfer_us": 0,
+            "kv_transfer_path": "", "transferred_tokens": 0,
+            "pages_skipped": 0, "pending_kv": None,
+        }
+        engine = self.engine
+        pc = engine.prefix_cache
+        if pc is None or not self.peers:
             return out
         P = prefill_boundary(len(ids), engine.cfg.seq_len)
         if P <= 0:
             return out
-        covered, _entry = pc.match(ids[:P])
-        if covered >= P:
+        # ONE trie walk, entry pinned under the match's own lock hold —
+        # pool pressure must never evict-and-recycle the merge base's
+        # pages between the lookup and the insert that names them
+        covered, matched = pc.match_pinned(ids[:P])
+        if matched is not None and covered >= P:
             # the local cache already holds the span (an earlier transfer,
             # or plain cross-request reuse): nothing to ship
+            pc.entry_release(matched)
             engine.stats.incr("disagg_local_hits")
             return out
         usable = [p for p in self.peers if self._peer_usable(p)]
@@ -315,10 +425,13 @@ class DisaggClient:
             # every peer is in its failure-backoff window: prefill locally
             # NOW instead of burning a timeout per request on known-bad
             # peers. Not waste — no prefill-tier compute was spent.
+            if matched is not None:
+                pc.entry_release(matched)
             engine.stats.incr("disagg_peer_backoff_skips")
             return out
+        S, base_entry, have = self._skip_base(ids, covered, matched)
         t0 = time.perf_counter()
-        body = None
+        result = None
         peer_key = None
         err = None
         with self._lock:
@@ -327,53 +440,86 @@ class DisaggClient:
         for i in range(len(usable)):
             peer = usable[(start + i) % len(usable)]
             host, port = peer
+            tr_impl = transport_for(self.transport, peer, self.transports)
             try:
                 # ship ids[:P+1]: the worker derives the SAME boundary from
                 # the same formula (bucket_down over len-1), so its slice
                 # covers exactly ids[:P] — truncating at P would make the
                 # worker floor one bucket lower
-                body = self._fetch_one(
-                    host, port, ids[: P + 1],
+                result = tr_impl.fetch(
+                    peer, ids[: P + 1], have_keys=have,
                     trace_id=None if trace is None else trace.id,
                 )
                 peer_key = f"{host}:{port}"
                 self._peer_ok(peer)
                 break
-            except (OSError, ValueError, http.client.HTTPException) as e:
-                # OSError: refused/reset/timeout; HTTPException: a mid-body
-                # death that surfaces as IncompleteRead/BadStatusLine — all
-                # the chaos suite's kill shapes land here
+            except Exception as e:
+                # OSError: refused/reset/timeout; HTTPException covers
+                # mid-body deaths; ValueError covers truncated/mis-shaped
+                # payloads; the device path raises the same families. ANY
+                # transfer failure is a peer failure, never a request
+                # failure — the degradation contract (counted below, the
+                # error itself rides the kv_transfer trace event).
                 err = e
                 engine.stats.incr("disagg_peer_errors")
                 self._peer_failed(peer)
-        inserted = False
-        if body is not None:
+        pending = None
+        if result is not None:
             try:
-                header, k_np, v_np = parse_kv_payload(body)
-                tokens = header["tokens"]
+                header = result.header
+                tokens = [int(t) for t in header["tokens"]]
                 if tokens != [int(t) for t in ids[:P]]:
                     raise ValueError("peer returned KV for different tokens")
-                inserted = pc.insert_external(engine, tokens, k_np, v_np)
-                if not inserted:
-                    raise ValueError("local cache refused the external slice")
+                r_start = int(header.get("start", 0))
+                if r_start != S:
+                    # the worker floored differently (defensive path); a
+                    # full send is still insertable, anything else is not
+                    if r_start == 0:
+                        if base_entry is not None:
+                            pc.entry_release(base_entry)
+                        base_entry = None
+                        S = 0
+                    else:
+                        raise ValueError(
+                            f"peer shipped start={r_start}, asked {S}"
+                        )
+                pending = PendingExternalKv(
+                    self, tokens, result.k, result.v, S, base_entry, result.path
+                )
+                base_entry = None  # ownership moved to the pending insert
                 out["remote_prefill_us"] = int(header.get("prefill_us", 0))
-                out["transferred_tokens"] = P
+                out["transferred_tokens"] = P - S
+                out["pages_skipped"] = S // KEY_PAGE_TOKENS
             except (ValueError, KeyError, TypeError) as e:
                 err = e
-                inserted = False
+                pending = None
+        if base_entry is not None:
+            pc.entry_release(base_entry)
         from ..runtime.tracing import to_us
 
         wall_us = int((time.perf_counter() - t0) * 1e6)
-        if inserted:
+        if pending is not None:
             # the transfer share of the wall: the fetch blocks on the
-            # worker's prefill too, which the worker reports separately
-            out["kv_transfer_us"] = max(wall_us - out["remote_prefill_us"], 0)
+            # worker's prefill too, which the worker reports separately.
+            # Per-path accounting: the labeled dlt_kv_transfer_us series
+            # and dlt_kv_transfer_bytes_total{path=...} counters are what
+            # the device-vs-http bench bar reads.
+            path = pending.path
+            transfer_us = max(wall_us - out["remote_prefill_us"], 0)
+            out["kv_transfer_us"] = transfer_us
+            out["kv_transfer_path"] = path
+            out["pending_kv"] = pending
             engine.stats.incr("disagg_kv_fetched")
-            engine.stats.incr("disagg_kv_tokens", P)
+            engine.stats.incr("disagg_kv_tokens", P - S)
+            if out["pages_skipped"]:
+                engine.stats.incr("disagg_pages_skipped", out["pages_skipped"])
+            engine.stats.record(f"kv_transfer_us[{path}]", transfer_us)
+            engine.stats.incr(f"kv_transfer_bytes_{path}", result.nbytes)
             if trace is not None:
                 trace.event(
                     "kv_transfer", to_us(t0), wall_us,
-                    ("peer", "tokens", "failed"), (peer_key, P, 0),
+                    ("peer", "tokens", "failed", "path", "pages_skipped"),
+                    (peer_key, P - S, 0, path, out["pages_skipped"]),
                 )
         else:
             # DEGRADE to local prefill: the request must complete (token-

@@ -35,6 +35,12 @@
 #                            concentration twin; disaggregated serving:
 #                            KV wire codec, token identity vs unified,
 #                            chaos mid-transfer degradation)
+#  10b. kv-movement suite    (runtime/kv_transport.py: content-addressed
+#                            page naming, transport selection + device
+#                            registry, mesh-paged twins pp>1/tp>1 with
+#                            collective-budget parity + zero-recompile
+#                            sanitizer run, device-path disagg identity,
+#                            page-skip re-sends, device chaos degradation)
 #  11. scheduler suite      (SLO-class scheduling: priority queues,
 #                            quotas, preemption observable end to end on
 #                            a live engine; autoscaler tick policy; the
@@ -59,6 +65,11 @@ python -m distributed_llama_tpu.analysis.graph_audit --costs
 echo "== graph audit (paged KV ladder, --costs coverage) =="
 python -m distributed_llama_tpu.analysis.graph_audit --kv-layout paged --costs
 
+echo "== graph audit (MESH-paged ladder, pp=2 x tp=2) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m distributed_llama_tpu.analysis.graph_audit \
+  --kv-layout paged --pp 2 --tp 2 --speculative off
+
 echo "== analysis suite (pytest -m analysis) =="
 python -m pytest tests/ -q -m analysis -p no:cacheprovider
 
@@ -82,6 +93,9 @@ python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovide
 
 echo "== router suite (cache-aware routing + disaggregated serving) =="
 python -m pytest tests/test_router.py tests/test_disagg.py -q -p no:cacheprovider
+
+echo "== kv-movement suite (transports, mesh-paged twins, page shipping) =="
+python -m pytest tests/test_kv_transport.py -q -p no:cacheprovider
 
 echo "== scheduler suite (SLO classes + autoscaler + load twin) =="
 python -m pytest tests/test_scheduler.py tests/test_loadtwin.py -q -p no:cacheprovider

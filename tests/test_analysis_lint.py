@@ -133,6 +133,10 @@ def test_trace_hot_emit_scoped_to_hot_packages():
                 "server/loadtwin.py"):
         assert _rules(in_loop, mod) == ["trace-hot-emit"]
         assert _rules(bound, mod) == []
+    # the KV movement layer (PR 13: transport fetch loops, per-segment
+    # extract/insert loops) rides the runtime-package scope
+    assert _rules(in_loop, "runtime/kv_transport.py") == ["trace-hot-emit"]
+    assert _rules(bound, "runtime/kv_transport.py") == []
     # formats/ops stay out of scope
     assert _rules(in_loop, "formats/x.py") == []
     # non-trace receivers named `event` are not span emits

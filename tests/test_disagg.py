@@ -440,4 +440,7 @@ def test_stats_and_config_surface_roles(stack):
         cfg = json.loads(r.read())
     assert cfg["role"] == "decode"
     assert cfg["disagg"]["peers"]
-    assert cfg["kv"]["layout"] == "contiguous"
+    # paged is the server default now, and disaggregated roles serve it
+    # (the KV movement layer ships pool pages — runtime/kv_transport.py)
+    assert cfg["kv"]["layout"] == "paged"
+    assert cfg["disagg"]["transport"] in ("auto", "device", "http")

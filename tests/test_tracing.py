@@ -171,17 +171,27 @@ _PROM_LINE = re.compile(
 
 def assert_valid_prometheus(body: str):
     """Every non-comment line must parse as `name{labels} value`, and every
-    histogram's cumulative bucket counts must be monotone."""
+    histogram's cumulative bucket counts must be monotone PER LABEL SET —
+    a family may carry labeled breakdown rows next to the unlabeled totals
+    (the per-class TTFT/TPOT histograms), and each series is cumulative
+    independently."""
     hist_buckets: dict = {}
     for line in body.splitlines():
         if not line or line.startswith("#"):
             continue
         assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
         if "_bucket{" in line:
-            name = line.split("{", 1)[0]
-            hist_buckets.setdefault(name, []).append(float(line.rsplit(" ", 1)[1]))
-    for name, cums in hist_buckets.items():
-        assert cums == sorted(cums), f"non-monotone histogram {name}: {cums}"
+            name, _, labels = line.split(" ", 1)[0].partition("{")
+            # the series identity is the name + every label EXCEPT le
+            extra = ",".join(
+                p for p in labels.rstrip("}").split(",")
+                if not p.startswith("le=")
+            )
+            hist_buckets.setdefault((name, extra), []).append(
+                float(line.rsplit(" ", 1)[1])
+            )
+    for key, cums in hist_buckets.items():
+        assert cums == sorted(cums), f"non-monotone histogram {key}: {cums}"
 
 
 def test_render_step_stats_is_valid_prometheus():
