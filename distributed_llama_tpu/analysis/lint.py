@@ -40,7 +40,16 @@ comma-separate for several — the pragma documents WHY at the site):
   runtime/tracing.py Emitter). The server scope exists because the
   Batcher's step loop and the gateway's retry loop are exactly where the
   goodput-ledger and batch-timeline emits live; their sanctioned
-  once-per-request/once-per-decision cold sites carry pragmas.
+  once-per-request/once-per-decision cold sites carry pragmas;
+* **sentinel-release** — a class that subscribes a ``RecompileSentinel``
+  (``self.x = RecompileSentinel(...).start()``) without a
+  ``close``/``stop``/``__exit__`` method that calls ``self.x.stop()``:
+  compile-event subscriptions are PROCESS-global (the jax registry has no
+  unregister), so a teardown path that forgets the release leaks a
+  sealed sentinel past its engine's lifetime — and a leaked SEALED FATAL
+  sentinel kills every later engine build in the process (the
+  cross-suite-pollution class the supervisor's rebuild path releases
+  explicitly; runtime/engine.py ``close()`` is the reference shape).
 
 The CLI lives at ``scripts/dlt_lint.py``; CI runs it over the tree.
 """
@@ -60,6 +69,7 @@ ALL_RULES = (
     "float64",
     "host-sync",
     "trace-hot-emit",
+    "sentinel-release",
 )
 
 _PRAGMA_RE = re.compile(r"#\s*dlt:\s*allow\(([^)]*)\)")
@@ -81,6 +91,23 @@ HOST_SYNC_SCOPE = ("runtime", "parallel")
 #: `runtime` prefix: its transport fetch loops and the per-segment
 #: insert/extract loops are in scope like every other hot path.
 TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
+#: packages whose classes must pair a sentinel subscription with a
+#: teardown release (engine lifecycles live here)
+SENTINEL_SCOPE = ("runtime", "server", "analysis")
+
+
+def _is_sentinel_ctor(call: ast.Call) -> bool:
+    """``RecompileSentinel(...)`` or a ``.start()`` chained onto one."""
+    d = _dotted(call.func)
+    if d.endswith("RecompileSentinel"):
+        return True
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "start"
+        and isinstance(call.func.value, ast.Call)
+    ):
+        return _is_sentinel_ctor(call.func.value)
+    return False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -308,8 +335,69 @@ class _Linter(ast.NodeVisitor):
             _dotted(b) in ("threading.Thread", "Thread") for b in node.bases
         )
         self._thread_classes.append(is_thread)
+        if self._in_scope(SENTINEL_SCOPE):
+            self._check_sentinel_release(node)
         self.generic_visit(node)
         self._thread_classes.pop()
+
+    @staticmethod
+    def _walk_own(node):
+        """ast.walk, but skipping nested ClassDef subtrees — a nested
+        class's sentinel belongs to the nested class (visit_ClassDef
+        checks it on its own visit), not to the enclosing one."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            yield child
+            yield from _Linter._walk_own(child)
+
+    def _check_sentinel_release(self, cls: ast.ClassDef):
+        """sentinel-release: every ``self.<attr> = RecompileSentinel(...)``
+        in this class must have a teardown method (close/stop/__exit__)
+        that calls ``self.<attr>.stop()`` — the subscription is process-
+        global and a leaked sealed sentinel outlives its engine."""
+        holders: list = []
+        for sub in self._walk_own(cls):
+            if not (
+                isinstance(sub, ast.Assign)
+                and isinstance(sub.value, ast.Call)
+                and _is_sentinel_ctor(sub.value)
+            ):
+                continue
+            for tgt in sub.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    holders.append((tgt.attr, sub))
+        if not holders:
+            return
+        released: set = set()
+        for sub in cls.body:
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub.name in ("close", "stop", "__exit__", "__del__")
+            ):
+                for c in ast.walk(sub):
+                    if (
+                        isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr in ("stop", "close")
+                        and isinstance(c.func.value, ast.Attribute)
+                        and isinstance(c.func.value.value, ast.Name)
+                        and c.func.value.value.id == "self"
+                    ):
+                        released.add(c.func.value.attr)
+        for attr, node in holders:
+            if attr not in released:
+                self._flag(
+                    "sentinel-release", node,
+                    f"self.{attr} subscribes a RecompileSentinel but no "
+                    "close/stop/__exit__ method calls "
+                    f"self.{attr}.stop() — a leaked sealed sentinel "
+                    "outlives the engine and kills later engine builds",
+                )
 
 
 def lint_source(source: str, path: str, rel: str | None = None) -> list:

@@ -312,10 +312,17 @@ class StepStats:
 #:                     for them is lost fleet-wide);
 #: * ``preempt``     — decoded for a lower-SLO-class row the scheduler
 #:                     evicted so a waiting higher-class request could take
-#:                     its slot (server/scheduler.py).
+#:                     its slot (server/scheduler.py);
+#: * ``deadline``    — decoded (or queued prompt tokens shed) for a request
+#:                     whose end-to-end deadline (``X-DLT-Deadline-Ms``)
+#:                     passed before delivery — an answer nobody was still
+#:                     waiting for (server/scheduler.py resolve_deadline_ms);
+#: * ``quarantined`` — prompt/decode work burned by a poison request before
+#:                     its fingerprint crossed the quarantine strike limit
+#:                     (server/quarantine.py).
 WASTE_REASONS = (
     "overrun", "shed", "stall_retry", "client_gone", "error",
-    "transfer_retry", "preempt",
+    "transfer_retry", "preempt", "deadline", "quarantined",
 )
 
 #: the SLO classes goodput breaks down by (server/scheduler.py is the

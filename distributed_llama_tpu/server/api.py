@@ -56,11 +56,19 @@ from ..runtime.tracing import (
     trace_payload,
 )
 from . import parse_query
+from .quarantine import (
+    POISON_HEADER,
+    QuarantineLedger,
+    fp_hex,
+    request_fingerprint,
+)
 from .scheduler import (
+    DEADLINE_HEADER,
     DEFAULT_CLASS,
     HotPrefixTracker,
     SLO_CLASS_HEADER,
     SloScheduler,
+    resolve_deadline_ms,
     resolve_slo_class,
 )
 from ..tokenizer import (
@@ -96,6 +104,14 @@ class ClientDisconnected(Exception):
     engine state is fine — distinguished by TYPE from engine failures so
     recovery logic can't confuse the two (an engine error travelling as a
     ConnectionError through the device tunnel must still trigger recovery)."""
+
+
+class DeadlineExceeded(Exception):
+    """The request's end-to-end deadline (``X-DLT-Deadline-Ms``, minted at
+    the gateway — server/scheduler.py ``resolve_deadline_ms``) passed
+    before delivery. Mapped to ``504``; the goodput ledger labels every
+    token it burned ``deadline`` — an answer nobody was still waiting
+    for is pure waste, however correct."""
 
 
 @dataclass
@@ -175,7 +191,8 @@ class _BatchReq:
     EMIT_DEPTH = 8192
 
     def __init__(self, ids, max_new, temperature, topp, seed, on_token,
-                 eos_ids=frozenset(), trace=None, slo_class=DEFAULT_CLASS):
+                 eos_ids=frozenset(), trace=None, slo_class=DEFAULT_CLASS,
+                 deadline=None):
         import queue
 
         self.ids = ids
@@ -184,6 +201,11 @@ class _BatchReq:
         self.topp = topp
         self.seed = seed
         self.on_token = on_token  # on_token(tok) -> None; may set .stopped
+        # end-to-end deadline as a monotonic instant (None = none): the
+        # Batcher sheds this request from the backlog before spending
+        # prefill on it, and retires it at the first decode-chunk boundary
+        # past the deadline — tokens past it are `deadline` waste
+        self.deadline = deadline
         # SLO class (server/scheduler.py): admission priority, shed/preempt
         # eligibility, and the per-class goodput label
         self.slo_class = resolve_slo_class(slo_class)
@@ -224,6 +246,10 @@ class _BatchReq:
         self.error = None
         self.done = threading.Event()
         self.emit: "queue.Queue[int | None]" = queue.Queue(maxsize=self.EMIT_DEPTH)
+
+
+#: queue sentinel waking the Batcher loop for shutdown (never a request)
+_BATCHER_STOP = object()
 
 
 class Batcher:
@@ -320,8 +346,24 @@ class Batcher:
         # readers take racy-but-consistent-enough snapshots
         self.slots: list[_BatchReq | None] = [None] * engine.batch
         self.backlog: "object" = None  # set by the loop (deque)
+        self._stopping = False  # set by stop(); the loop exits at the next
+        # boundary, failing whatever is still in flight — teardown must
+        # release the engine (and its sealed sentinel), not strand it on a
+        # daemon thread forever (the cross-suite sentinel-leak class)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def stop(self, timeout: float = 5.0):
+        """Shut the step loop down: in-flight and queued requests fail with
+        503-shaped errors, the loop thread exits, and the engine is no
+        longer referenced by a live thread — so ``ApiState.close`` can
+        actually release it (sentinel unsubscribed, fetch pool down)."""
+        if self._stopping:
+            self._thread.join(timeout=timeout)
+            return
+        self._stopping = True
+        self.q.put(_BATCHER_STOP)  # wake the idle blocking get
+        self._thread.join(timeout=timeout)
 
     def stats(self) -> dict:
         from .scheduler import SLO_CLASSES
@@ -511,6 +553,32 @@ class Batcher:
             self.queue_depth(),
         )
 
+    def _shed_expired(self, session, slots):
+        """Per-chunk-boundary deadline sweep: a row whose end-to-end
+        deadline passed retires NOW — decode and PREFILL alike are
+        compute for an answer the client stopped waiting for. Tokens it
+        already decoded are labeled `deadline` waste at retirement
+        (complete_batched's ledger path)."""
+        now_mono = time.monotonic()
+        engine = self.state.engine
+        for row, req in enumerate(slots):
+            if (
+                req is None or req.deadline is None
+                or now_mono <= req.deadline
+            ):
+                continue
+            engine.stats.incr("deadline_expired")
+            # timeline mark: once per expiry decision, cold path
+            TRACER.event(  # dlt: allow(trace-hot-emit)
+                "batch_shed", now_us(), 0,
+                ("row", "reason", "slo_class"),
+                (row, "deadline", req.slo_class),
+            )
+            req.error = req.error or DeadlineExceeded(
+                "deadline passed mid-serve"
+            )
+            self._finish(req, session, slots, row)
+
     def _drained(self, req: _BatchReq):
         """One request moved from self.q into the class backlog: its
         quota accounting moves with it (the backlog's own depth counts it
@@ -542,11 +610,34 @@ class Batcher:
         # steps between (the twin's one-outstanding-preemption rule)
 
         while True:
+            if self._stopping:
+                # teardown: fail everything still queued or in flight so
+                # writers unblock, then exit — the engine is now
+                # releasable (ApiState.close owns the actual close)
+                for row, req in enumerate(slots):
+                    if req is not None:
+                        req.error = req.error or Overloaded(retry_after_s=2)
+                        self._finish(req, session, slots, row)
+                for req in list(backlog):
+                    req.error = Overloaded(retry_after_s=2)
+                    req.done.set()
+                while True:
+                    try:
+                        req = self.q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req is _BATCHER_STOP:
+                        continue
+                    req.error = Overloaded(retry_after_s=2)
+                    req.done.set()
+                return
             # drain the queue into the class backlog; block only when fully
             # idle (no active slots and nothing waiting)
             idle = all(s is None for s in slots)
             if idle and not backlog:
                 req = self.q.get()
+                if req is _BATCHER_STOP:
+                    continue
                 self._drained(req)
                 backlog.append(req, req.slo_class)
             while True:
@@ -554,6 +645,8 @@ class Batcher:
                     req = self.q.get_nowait()
                 except queue.Empty:
                     break
+                if req is _BATCHER_STOP:
+                    continue
                 self._drained(req)
                 backlog.append(req, req.slo_class)
             # admit in class-priority order into free slots at this chunk
@@ -567,6 +660,24 @@ class Batcher:
                 if slots[row] is not None or not backlog:
                     continue
                 req = backlog.popleft()
+                if req.deadline is not None and time.monotonic() > req.deadline:
+                    # the deadline passed while the request sat in the
+                    # backlog: shed it BEFORE spending a prefill on an
+                    # answer nobody is waiting for — the cheapest token is
+                    # the one never decoded
+                    engine.stats.incr("deadline_shed")
+                    self.scheduler.record(req.slo_class, "shed_backlog")
+                    # timeline mark: once per shed decision, cold path
+                    TRACER.event(  # dlt: allow(trace-hot-emit)
+                        "batch_shed", now_us(), 0,
+                        ("row", "reason", "slo_class"),
+                        (row, "deadline", req.slo_class),
+                    )
+                    req.error = DeadlineExceeded(
+                        "deadline passed before admission"
+                    )
+                    req.done.set()
+                    continue
                 try:
                     nowu = now_us()
                     t0 = req.t_enqueue_us or nowu
@@ -596,6 +707,15 @@ class Batcher:
                 except Exception as e:
                     req.error = e
                     req.done.set()
+
+            # per-boundary deadline sweep over ALL active rows —
+            # PREFILLING included: a request whose deadline passed must
+            # stop burning prefill chunks exactly as it stops burning
+            # decode chunks (the pre-admission shed above catches only
+            # deadlines that died in the backlog; without this a long
+            # prompt with a short deadline would keep prefilling for
+            # dozens of boundaries after its answer went worthless)
+            self._shed_expired(session, slots)
 
             # class preemption (server/scheduler.py): with every slot held
             # and a higher-class request waiting, evict the lowest-class
@@ -842,13 +962,28 @@ class Batcher:
                 engine.stats.incr("kv_pool_shed_503")
                 continue
             except Exception as e:
-                # engine failure: fail every in-flight request, rebuild the
-                # session on a recovered engine
+                # engine failure: fail every in-flight request, then hand
+                # the failure to the supervised recovery path — a cheap
+                # in-place reset for a first transient stall, a full
+                # teardown-and-rebuild (fresh pool/prefix cache/sentinel,
+                # re-warmed ladder) for sticky stalls, fatal sanitizer
+                # breaches, and unknown engine exceptions
+                # (runtime/supervisor.py). THIS thread owns the engine's
+                # dispatches, so the rebuild is race-free here; while it
+                # runs, /health reports `recovering` (503) and new
+                # admissions shed.
+                # classify + pre-transition FIRST: by the time any failed
+                # request's 500 reaches its client, /health must already
+                # say `recovering` — a client that polls (or instantly
+                # retries) after its 500 must never read a stale `serving`
+                # and then get shed by the rebuild it didn't know about
+                entered = self.state.recover_enter(e)
                 for row, req in enumerate(slots):
                     if req is not None:
                         req.error = e
                         self._finish(req, session, slots, row)
-                self.state.recover()
+                self.state.recover(exc=e, entered=entered)
+                engine = self.state.engine  # a rebuild swaps the object
                 session = BatchSession(engine)
                 continue
             chunk_dur_us = int((time.perf_counter() - t_chunk) * 1e6)
@@ -912,6 +1047,19 @@ class ApiState:
         self.tokenizer = tokenizer
         self.args = args
         self.lock = threading.Lock()
+        self._closed = False
+        # supervised engine lifecycle (runtime/supervisor.py): decides
+        # reset-vs-rebuild per failure, owns the recovering/failed state
+        # /health reports, the restart budget, and the
+        # dlt_supervisor_transitions_total counters
+        from ..runtime.supervisor import EngineSupervisor
+
+        self.supervisor = EngineSupervisor(self._rebuild_engine)
+        # replica-side poison-request quarantine (server/quarantine.py):
+        # strikes fingerprints implicated in engine failures, refuses
+        # quarantined ones with 422 BEFORE they touch the engine, and
+        # reports implications in 5xx headers + /health
+        self.quarantine = QuarantineLedger()
         # per-request goodput rollup (runtime/telemetry.py): every
         # completed, shed, or retried request folds its ledger in —
         # /metrics serves dlt_goodput_tokens_per_s +
@@ -1037,6 +1185,26 @@ class ApiState:
         budget = max_tokens if max_tokens and max_tokens > 0 else seq_len
         budget = max(1, min(budget, seq_len - len(ids)))
         klass = resolve_slo_class(params.get("slo_class"))
+        # supervised-recovery shed (runtime/supervisor.py): while the
+        # engine is being rebuilt (or the restart budget is exhausted) a
+        # request must fail fast — the gateway's breaker is already
+        # routing away on the 503ing /health; queueing here would just rot
+        if self.supervisor.state != "serving":
+            raise Overloaded(retry_after_s=2)
+        # end-to-end deadline (server/scheduler.py resolve_deadline_ms,
+        # threaded by the handler as a monotonic instant): a request whose
+        # budget is already gone must not cost a single prefill token
+        deadline = params.get("_deadline")
+        if deadline is not None and time.monotonic() > deadline:
+            self.engine.stats.incr("deadline_shed")
+            self._record_ledger(
+                GoodputLedger(
+                    prompt_tokens=len(ids), outcome="deadline",
+                    slo_class=klass,
+                ),
+                trace, waste_reason="deadline",
+            )
+            raise DeadlineExceeded("deadline passed before admission")
         # load shedding: past the backlog cap — or past this CLASS's quota
         # share of it (server/scheduler.py) — a request would sit in a
         # queue it will likely rot in: fail fast with 503 + Retry-After
@@ -1128,6 +1296,7 @@ class ApiState:
                 eos_ids=frozenset(tok.eos_token_ids),
                 trace=trace,
                 slo_class=klass,
+                deadline=deadline,
             )
             req_box[:] = [req]
             return req
@@ -1206,6 +1375,17 @@ class ApiState:
                     waste_reason="preempt" if req.preempted else None,
                 )
                 raise
+            except DeadlineExceeded:
+                # the Batcher shed it at a chunk boundary (or pre-prefill):
+                # every token it decoded is `deadline` waste — compute for
+                # an answer nobody was still waiting for
+                if pending_kv is not None:
+                    pending_kv.abandon()
+                self._record_ledger(
+                    fail_ledger(req, "deadline"), trace,
+                    waste_reason="deadline",
+                )
+                raise
             except ClientDisconnected:
                 if pending_kv is not None:
                     pending_kv.abandon()
@@ -1223,6 +1403,7 @@ class ApiState:
         # n_out counts tokens the writer actually delivered (the EOS token
         # included) — req.n also counts post-stop overrun decoded before the
         # step loop noticed, which must not inflate usage accounting
+        self.supervisor.note_ok()  # a served request clears stall strikes
         self.engine.stats.incr("requests_completed")
         led = req.ledger
         led.outcome = "ok"
@@ -1262,6 +1443,10 @@ class ApiState:
         handler, whose emit is a no-op and whose response is built solely
         from the return value) makes the retry unconditionally safe."""
         from ..runtime.telemetry import StallError
+
+        # supervised-recovery shed: same contract as the batched path
+        if self.supervisor.state != "serving":
+            raise Overloaded(retry_after_s=2)
 
         emitted = [False]
 
@@ -1306,6 +1491,11 @@ class ApiState:
                 # error OUTCOME — the batched path records nothing for
                 # these either, and error dashboards must not alarm on it
                 raise
+            except DeadlineExceeded:
+                self._record_ledger(
+                    fail_ledger("deadline"), trace, waste_reason="deadline"
+                )
+                raise
             except ClientDisconnected:
                 self._record_ledger(fail_ledger("client_gone"), trace)
                 raise
@@ -1338,6 +1528,17 @@ class ApiState:
         prompt_end = len(ids) - 1
         max_tokens = params.get("max_tokens", -1)
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
+        # end-to-end deadline: shed BEFORE spending the prefill when the
+        # budget is already gone (the serialized path's queue is the wait
+        # on state.lock — it can eat the whole budget under load)
+        deadline = params.get("_deadline")
+        if deadline is not None and time.monotonic() > deadline:
+            engine.stats.incr("deadline_shed")
+            self._inflight_ledger = GoodputLedger(
+                prompt_tokens=len(ids),
+                slo_class=resolve_slo_class(params.get("slo_class")),
+            )
+            raise DeadlineExceeded("deadline passed before prefill")
         # disaggregated prefill (server/disagg.py): the fetched KV lands in
         # the prefix cache and engine.generate's ordinary prefill match
         # splices it; any failure degrades to local prefill (zeros
@@ -1404,32 +1605,50 @@ class ApiState:
             if eos_type == EOS_FOUND:
                 state["stop"] = True
 
+        def stop_fn(t):
+            if state["stop"]:
+                return True
+            # per-chunk-boundary deadline check (generate consults stop_fn
+            # between decode chunks): tokens past the deadline are waste
+            if deadline is not None and time.monotonic() > deadline:
+                state["deadline_hit"] = True
+                return True
+            return False
+
         try:
             # the engine emits this request's prefill/decode/spec spans
             # through its trace context for the duration of the generate
             engine.trace = trace
             res = engine.generate(
                 ids, max_pred, sampler=self.sampler, pos_start=0,
-                on_token=on_token, stop_fn=lambda t: state["stop"],
+                on_token=on_token, stop_fn=stop_fn,
             )
         except ClientDisconnected:
             # the CLIENT dropped mid-stream (emit raised) — the engine and
             # the published prefixes are fine; this turn was never pushed
             raise
-        except Exception:
+        except Exception as e:
             # an ENGINE failure leaves the KV cache holding a prefix that
             # was never fully written — drop the live cache AND the prefix
             # cache (an in-flight publish may descend from the failed
-            # computation) so the next request starts clean
-            self.recover()
+            # computation) so the next request starts clean; the
+            # supervisor classifies the failure (reset vs full rebuild)
+            self.recover(exc=e)
             raise
         finally:
             engine.trace = None
+        if state.get("deadline_hit"):
+            # generation stopped because the deadline passed mid-decode:
+            # every decoded token is `deadline` waste (the parked ledger
+            # carries them as discarded; complete() finalizes it)
+            engine.stats.incr("deadline_expired")
+            raise DeadlineExceeded("deadline passed mid-decode")
         # the engine published this conversation's KV into the prefix trie
         # itself (generate's post-decode publish); keep the NaiveCache-era
         # miss signal as a counter for dashboards that tracked it
         if engine.prefix_cache is not None and engine.last_prefix_hit_tokens == 0:
             engine.stats.incr("cache_miss")
+        self.supervisor.note_ok()  # a served request clears stall strikes
         engine.stats.incr("requests_completed")
         # per-request latency histograms (the serialized path's twin of the
         # Batcher observes: GenerationResult already carries the walls) —
@@ -1461,13 +1680,38 @@ class ApiState:
         text = "".join(buffer)
         return text, len(ids), res.n_pred_tokens, led
 
-    def recover(self):
-        """Reset engine + prefix cache after a failed generation (the
-        reference instead restarts the whole server loop,
-        dllama-api.cpp:624-636; one engine reset is the cheaper analogue).
-        The prefix cache is cleared too: entries extracted near the failure
-        may hold poisoned/unfinished KV, and a silent splice of one would
-        corrupt a future request."""
+    def recover_enter(self, exc: BaseException) -> str | None:
+        """Classify one engine failure and, on a rebuild verdict,
+        pre-transition the supervisor to ``recovering`` — called by the
+        Batcher BEFORE it fails the in-flight requests, so by the time
+        any client holds its 500, ``/health`` already reports the rebuild
+        (no serving->recovering flap behind the client's back). Returns
+        the action for :meth:`recover`'s ``entered=`` — classification
+        has stall-strike side effects and must run exactly once per
+        failure. None when the replica is already closed."""
+        if self._closed:
+            return None
+        action = self.supervisor.classify(exc)
+        if action == "rebuild":
+            self.supervisor.enter_recovering(type(exc).__name__)
+        return action
+
+    def recover(self, exc: BaseException | None = None,
+                entered: str | None = None):
+        """Supervised recovery after a failed generation. The old one-shot
+        behavior (engine reset + prefix-cache drop) survives as the CHEAP
+        path for transient failures; the supervisor
+        (runtime/supervisor.py) escalates sticky stalls, fatal sanitizer
+        breaches, unknown engine exceptions — and a reset that itself
+        fails — to a full teardown-and-rebuild: fresh engine, fresh
+        pool/prefix cache, re-warmed ladder, freshly sealed sentinel.
+        MUST be called from the engine-owning thread (the Batcher loop /
+        the serialized handler under ``self.lock``): the rebuild swaps
+        ``self.engine`` under live dispatch ownership.
+
+        The prefix cache is always cleared first: entries extracted near
+        the failure may hold poisoned/unfinished KV, and a silent splice
+        of one would corrupt a future request."""
         # post-mortem FIRST: the trace ring still holds the failed
         # request's spans and whatever engine events led up to the failure
         flight_record(
@@ -1475,14 +1719,93 @@ class ApiState:
         )
         if self.engine.prefix_cache is not None:
             self.engine.prefix_cache.clear()
+        if self._closed:
+            return  # teardown raced a final failure: nothing left to heal
+        if entered is not None:
+            action = entered  # recover_enter already classified (and, for
+            # a rebuild, already holds the `recovering` state)
+        else:
+            action = (
+                self.supervisor.classify(exc) if exc is not None else "reset"
+            )
+        reason = type(exc).__name__ if exc is not None else "recover"
+        if action == "reset":
+            try:
+                self.engine.reset()
+                self.supervisor.note_reset(reason)
+                return
+            except Exception:
+                # a reset that fails on an already-wedged engine is the
+                # strongest rebuild signal there is — escalate, and leave
+                # the counter trail (/stats, /health) saying why
+                self.engine.stats.incr("recover_reset_failed")
+                reason = f"reset_failed({reason})"
         try:
-            self.engine.reset()
+            self.supervisor.recover(reason, stats=self.engine.stats)
         except Exception:
-            # a reset that fails on an already-wedged engine must not mask
-            # the original failure, but it must be VISIBLE: the next
-            # request will hit the broken engine, and the operator needs
-            # the counter trail (/stats, /health) to see why
-            self.engine.stats.incr("recover_reset_failed")
+            # the rebuild itself died: the supervisor already transitioned
+            # to `failed` and counted it (supervisor_rebuild_failed) — the
+            # replica reports unhealthy from here on; swallowing keeps the
+            # Batcher loop alive to shed what's still queued
+            pass  # dlt: allow(swallowed-exception) — counted + state=failed; nothing else to do here
+
+    def close(self):
+        """Release the replica's engine-side resources: stop the Batcher
+        loop (failing anything still in flight), then close the engine —
+        which unsubscribes its recompile sentinel. Without this, a
+        server's engine lives forever on the Batcher's daemon thread and
+        its SEALED fatal sentinel keeps killing every later engine build
+        in the process (the cross-suite pollution class). Idempotent;
+        wired to the HTTP server's ``shutdown()``/``server_close()``."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.batcher is not None:
+            self.batcher.stop()
+        self.engine.close()
+
+    def _rebuild_engine(self):
+        """The supervisor's rebuild_fn: tear the old engine down (sentinel
+        unsubscribed — a sealed fatal sentinel must never outlive its
+        engine and condemn the successor's warmup), build a fresh one from
+        the same resolved args (fresh KV pool, fresh prefix cache), re-run
+        the warm ladder (``warmup()`` executes ``warm_plan()`` and
+        re-seals a FRESH sentinel), and swap it in. Counters carry over so
+        the operator trail (/stats, /health, the fleet table) stays
+        monotonic across the swap; latency series and histograms restart
+        (the fleet scraper re-baselines backward counters anyway)."""
+        import os
+
+        from ..cli import make_engine
+
+        old = self.engine
+        # build-then-swap: the NEW engine comes up fully (weights, warm
+        # ladder, sealed sentinel) before the old one is released — a
+        # rebuild that dies mid-build (bad weights path, OOM, a stall
+        # inside warmup) leaves the old engine intact for the supervisor's
+        # failed-state degradation instead of stranding a half-closed one.
+        # Sentinel attribution is safe in the overlap: the new engine's
+        # UNSEALED sentinel claims the build's compiles, so the old sealed
+        # one neither counts nor (fatal) aborts them.
+        engine = make_engine(self.args)
+        for k, v in old.stats.counters_snapshot().items():
+            engine.stats.incr(k, v)
+        if not os.environ.get("DLT_NO_WARMUP"):
+            engine.warmup()
+        if self._closed:
+            # teardown raced the rebuild (close()'s join timed out while
+            # warmup ran): the fresh engine's SEALED sentinel must not
+            # outlive this aborted swap — that leak is the exact class
+            # this lifecycle exists to fix
+            engine.close()
+            raise RuntimeError("replica closed during rebuild")
+        self.engine = engine
+        old.close()
+        if self._closed:
+            # close() ran between the check above and the swap: it closed
+            # the OLD engine; release the fresh one too (engine.close is
+            # idempotent, so a double close from either side is safe)
+            engine.close()
 
 
 def resolved_config(state: "ApiState") -> dict:
@@ -1535,6 +1858,11 @@ def resolved_config(state: "ApiState") -> dict:
         },
         "role": state.role,
         "disagg": None if state.disagg is None else state.disagg.snapshot(),
+        "supervisor": state.supervisor.config.snapshot(),
+        "quarantine": {
+            "limit": state.quarantine.limit,
+            "ttl_s": state.quarantine.ttl_s,
+        },
         "tracing": {
             "ring_capacity": TRACER.ring.capacity,
             "sample_every": TRACER.sample_every(),
@@ -1552,6 +1880,19 @@ class Handler(BaseHTTPRequestHandler):
     state: ApiState = None  # set by serve()
     protocol_version = "HTTP/1.1"
     _trace = None  # per-request Trace (do_POST); _json echoes its id
+    _poison_fp = None  # this chat request's quarantine fingerprint
+
+    def _poison_strike(self) -> dict | None:
+        """An engine failure killed this request: strike its fingerprint
+        (server/quarantine.py) and return the response headers reporting
+        the implication — the gateway's retry ledger and direct clients
+        both read ``X-DLT-Poison-Fp`` off the 5xx."""
+        fp = self._poison_fp
+        if fp is None:
+            return None
+        self.state.quarantine.strike(fp)
+        self.state.engine.stats.incr("poison_strikes")
+        return {POISON_HEADER: fp_hex(fp)}
 
     def log_message(self, fmt, *args):
         pass
@@ -1619,6 +1960,12 @@ class Handler(BaseHTTPRequestHandler):
                 counter_series["scheduler_decisions"] = (
                     st.batcher.scheduler.decisions_series()
                 )
+            # supervisor lifecycle transitions by state (zero-filled):
+            # dlt_supervisor_transitions_total{state=serving|recovering|
+            # failed} — a recovering spike IS the incident timeline
+            counter_series["supervisor_transitions"] = (
+                st.supervisor.transitions_series()
+            )
             body = render_step_stats(
                 st.engine.stats, extra_gauges=extra, extra_series=series,
                 extra_counter_series=counter_series,
@@ -1716,14 +2063,23 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path == "/health":
             # the gateway's active prober reads this: status plus the same
             # robustness counters /stats exports (StepStats counters), so
-            # the two views can never disagree about what the engine saw
+            # the two views can never disagree about what the engine saw.
+            # A replica mid-rebuild (or out of restart budget) answers 503
+            # with its supervisor state — the prober opens the breaker and
+            # the fleet routes away until the rebuild rejoins; the
+            # quarantine's implicated fingerprints ride along so the
+            # gateway (and dashboards) can attribute WHY it went down.
             st = self.state
+            sup = st.supervisor.snapshot()
             payload = {
-                "status": "ok",
+                "status": "ok" if sup["state"] == "serving" else sup["state"],
                 "counters": st.engine.stats.counters_snapshot(),
                 "queue_depth": st.batcher.queue_depth() if st.batcher is not None else 0,
+                "supervisor": sup,
+                "quarantine": st.quarantine.snapshot(),
             }
-            self._json(200, json.dumps(payload).encode())
+            code = 200 if sup["state"] == "serving" else 503
+            self._json(code, json.dumps(payload).encode())
         elif self.path == "/stats":
             # operator view of the serving loop (the reference prints its
             # network perf report only at shutdown, nn-network.cpp:883-1053;
@@ -1772,6 +2128,13 @@ class Handler(BaseHTTPRequestHandler):
                 # per-replica table
                 "role": st.role,
                 "disagg": None if st.disagg is None else st.disagg.snapshot(),
+                # supervised engine lifecycle (runtime/supervisor.py):
+                # state, restart budget, transition counts — the /metrics
+                # twin is dlt_supervisor_transitions_total{state=...}
+                "supervisor": st.supervisor.snapshot(),
+                # poison-request quarantine (server/quarantine.py):
+                # implicated fingerprints + strike counts
+                "quarantine": st.quarantine.snapshot(),
                 "model": MODEL_NAME,
                 "batch": st.engine.batch,
                 "seq_len": st.engine.cfg.seq_len,
@@ -1820,6 +2183,42 @@ class Handler(BaseHTTPRequestHandler):
         prefix_text = messages_prefix_text(params.get("messages"))
         if prefix_text:
             self.state.hot_prefixes.record(prefix_chain(prefix_text))
+
+        # poison-request quarantine (server/quarantine.py): fingerprint the
+        # FULL conversation; a fingerprint already implicated in `limit`
+        # engine failures is refused with a terminal 422 BEFORE it can
+        # touch the engine — one bad request must never take this replica
+        # down twice, however many times the client (or a misconfigured
+        # gateway) replays it
+        self._poison_fp = request_fingerprint(prefix_text)
+        if self.state.quarantine.is_quarantined(self._poison_fp):
+            self.state.engine.stats.incr("quarantined_422")
+            # prompt-token estimate (~4 chars/token, the router's own
+            # approximation): the refused request's parse/route work is
+            # `quarantined` waste — the signal the acceptance bar reads
+            self.state.goodput.add_waste(
+                "quarantined", max(len(prefix_text or "") // 4, 1),
+                params["slo_class"],
+            )
+            self._json(
+                422, json.dumps({
+                    "error": "request quarantined: this conversation has "
+                    "repeatedly crashed or stalled the engine",
+                    "fingerprint": fp_hex(self._poison_fp),
+                }).encode(),
+                headers={POISON_HEADER: fp_hex(self._poison_fp)},
+            )
+            return
+
+        # end-to-end deadline (server/scheduler.py): the gateway mints
+        # X-DLT-Deadline-Ms (re-stamped with the REMAINING budget on every
+        # retry) or a direct client sends it; resolved once here to a
+        # monotonic instant every downstream check compares against
+        deadline_ms = resolve_deadline_ms(
+            params["slo_class"], self.headers.get(DEADLINE_HEADER)
+        )
+        if deadline_ms > 0:
+            params["_deadline"] = time.monotonic() + deadline_ms / 1e3
 
         # request-lifecycle trace: adopt the gateway's X-DLT-Trace-Id (one
         # joinable identity across gateway -> retry -> backend) — and its
@@ -1889,9 +2288,10 @@ class Handler(BaseHTTPRequestHandler):
             self._json(400, json.dumps({"error": str(e)}).encode())
             return
         except Exception as e:
-            # engine failure: recover like the chat path (reset + prefix
-            # cache drop) and report — the decode worker degrades locally
-            st.recover()
+            # engine failure: recover like the chat path (supervised reset/
+            # rebuild + prefix cache drop) and report — the decode worker
+            # degrades locally either way
+            st.recover(exc=e)
             self._json(
                 500, json.dumps({"error": f"prefill failed: {e}"}).encode()
             )
@@ -1964,13 +2364,27 @@ class Handler(BaseHTTPRequestHandler):
                     raise
                 except ClientDisconnected:
                     return  # nothing to send — the socket is gone
-                except Exception as e:
-                    # engine failure before any SSE chunk went out: return a
-                    # clean 500 like the non-stream path; mid-stream the only
-                    # honest signal left is EOF
+                except DeadlineExceeded as e:
+                    # deadline passed before the first SSE byte: a clean
+                    # 504; mid-stream the truncation IS the signal
                     if not started[0]:
                         self._json(
-                            500, json.dumps({"error": f"engine error: {e}"}).encode()
+                            504, json.dumps({"error": str(e)}).encode()
+                        )
+                        return
+                    raise
+                except Exception as e:
+                    # engine failure before any SSE chunk went out: return a
+                    # clean 500 like the non-stream path (the implicated
+                    # fingerprint rides the response — quarantine
+                    # attribution); mid-stream the only honest signal left
+                    # is EOF, but the strike still lands
+                    hdrs = self._poison_strike()
+                    if not started[0]:
+                        self._json(
+                            500,
+                            json.dumps({"error": f"engine error: {e}"}).encode(),
+                            headers=hdrs,
                         )
                         return
                     raise
@@ -1996,9 +2410,16 @@ class Handler(BaseHTTPRequestHandler):
                         headers={"Retry-After": str(e.retry_after_s)},
                     )
                     return
+                except DeadlineExceeded as e:
+                    self._json(504, json.dumps({"error": str(e)}).encode())
+                    return
                 except Exception as e:  # engine failure: recovered by
                     # complete(); report it instead of dropping the socket
-                    self._json(500, json.dumps({"error": f"engine error: {e}"}).encode())
+                    # — with the implicated fingerprint riding the 500
+                    self._json(
+                        500, json.dumps({"error": f"engine error: {e}"}).encode(),
+                        headers=self._poison_strike(),
+                    )
                     return
                 body = json.dumps(
                     {
@@ -2103,7 +2524,25 @@ def serve(args) -> HTTPServer:
     handler_cls = type("Handler", (Handler,), {"state": state})
     Handler.state = state
     cls = ThreadingHTTPServer if state.batcher is not None else HTTPServer
-    return cls(("0.0.0.0", args.port), handler_cls)
+
+    class _ApiServer(cls):
+        # engine lifetime rides the server's: shutdown()/server_close()
+        # also stop the Batcher loop and close the engine — which
+        # unsubscribes its recompile sentinel. Without this, every
+        # torn-down server leaked its engine on the Batcher's daemon
+        # thread, and a leaked SEALED fatal sentinel killed every later
+        # engine build in the process (the cross-suite pollution class).
+        api_state = state
+
+        def shutdown(self):
+            super().shutdown()
+            self.api_state.close()
+
+        def server_close(self):
+            super().server_close()
+            self.api_state.close()
+
+    return _ApiServer(("0.0.0.0", args.port), handler_cls)
 
 
 def main(argv=None) -> int:

@@ -57,6 +57,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import socket
 import threading
 import time
@@ -81,6 +82,13 @@ from ..runtime.tracing import (
     trace_payload,
 )
 from . import parse_query
+
+# stdlib-only siblings (the gateway must run on a jax-free box): the
+# poison-quarantine ledger + fingerprinting, the chat-body hash-text
+# builder, and the deadline resolution the retry loop stamps per attempt
+from .quarantine import QuarantineLedger, fp_hex, request_fingerprint
+from .router import messages_prefix_text
+from .scheduler import DEADLINE_ENVS, DEADLINE_HEADER, resolve_deadline_ms
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -158,6 +166,12 @@ class GatewayConfig:
     # (default cache_aware); "least_inflight" keeps the legacy selection
     # (the A/B arm the routing bench compares against)
     router_policy: str | None = None
+    # poison-request quarantine (server/quarantine.py): strike limit before
+    # a fingerprint stops being retried and 422s terminally. None resolves
+    # DLT_QUARANTINE_STRIKES (default 2); <= 0 disables the ledger entirely
+    # (the fault-injection harness pins 0 — seeded fault plans deliberately
+    # fail the same body many times and must keep their retry semantics)
+    quarantine_strikes: int | None = None
     # goodput-driven autoscaler (server/autoscaler.py): evaluation-tick
     # cadence. None resolves DLT_AUTOSCALE_S (default 0 = OFF — capacity
     # decisions are opt-in); > 0 attaches the control loop that drains /
@@ -204,6 +218,14 @@ class Balancer:
         # per-request gateway wall-time histogram (cumulative log buckets;
         # the /metrics twin of the backend's TTFT/per-token histograms)
         self.request_ms = Hist()
+        # poison-request quarantine (server/quarantine.py): the strike
+        # ledger the retry loop consults before replaying a failed body
+        # into yet another replica. None = disabled (quarantine_strikes<=0).
+        qs = config.quarantine_strikes
+        if qs is not None and qs <= 0:
+            self.quarantine = None
+        else:
+            self.quarantine = QuarantineLedger(limit=qs)
         # gateway-level counters (under the lock)
         self.counters = {
             "requests": 0,
@@ -213,6 +235,9 @@ class Balancer:
             "rejected_429": 0,
             "shed_503": 0,
             "bad_gateway_502": 0,
+            "quarantined_422": 0,   # poison fingerprints refused terminally
+            "poison_strikes": 0,    # implication events the ledger recorded
+            "deadline_504": 0,      # requests whose deadline died in-house
         }
 
     def count(self, name: str, n: int = 1):
@@ -532,11 +557,17 @@ class Balancer:
                         "probes_failed": b.n_probes_failed,
                     }
                 )
-            return {
+            out = {
                 "backends": backends,
                 "queue_depth": len(self._queue),
                 "counters": dict(self.counters),
             }
+        # outside the balancer lock: the ledger has its own (lock-order
+        # discipline — never nest foreign locks under ours)
+        out["quarantine"] = (
+            None if self.quarantine is None else self.quarantine.snapshot()
+        )
+        return out
 
 
 class HealthProber(threading.Thread):
@@ -637,20 +668,41 @@ def _header_value(request: bytes, name: bytes) -> str | None:
     return None
 
 
+def _with_header(request: bytes, name: str, value: str) -> bytes:
+    """Inject (or replace) one header in raw request bytes — the
+    per-attempt re-stamping primitive (trace identity, sampling decision,
+    and the deadline's REMAINING budget all shrink-or-ride per retry)."""
+    head, _, rest = request.partition(b"\r\n\r\n")
+    needle = (name.lower() + ":").encode()
+    lines = [l for l in head.split(b"\r\n") if not l.lower().startswith(needle)]
+    lines.insert(1, f"{name}: {value}".encode())
+    return b"\r\n".join(lines) + b"\r\n\r\n" + rest
+
+
 def _with_trace_header(request: bytes, trace_id: str, sampled: bool) -> bytes:
     """Inject (or replace) the X-DLT-Trace-Id and X-DLT-Trace-Sampled
-    headers in raw request bytes, so the backend sees the SAME id — and the
-    SAME sampling decision — across the gateway's transparent retries: one
+    headers, so the backend sees the SAME id — and the SAME sampling
+    decision — across the gateway's transparent retries: one
     coherently-sampled trace stitches gateway -> retry -> backend
     together (the two processes' 1-in-N counters are never in phase)."""
-    head, _, rest = request.partition(b"\r\n\r\n")
-    lines = [
-        l for l in head.split(b"\r\n")
-        if not l.lower().startswith((b"x-dlt-trace-id:", b"x-dlt-trace-sampled:"))
-    ]
-    lines.insert(1, f"{SAMPLED_HEADER}: {int(sampled)}".encode())
-    lines.insert(1, f"{TRACE_HEADER}: {trace_id}".encode())
-    return b"\r\n".join(lines) + b"\r\n\r\n" + rest
+    request = _with_header(request, SAMPLED_HEADER, str(int(sampled)))
+    return _with_header(request, TRACE_HEADER, trace_id)
+
+
+def _respond_quarantined(client, balancer: Balancer, fp: int, hdrs: dict):
+    """The terminal 422 a quarantined fingerprint earns — shared by the
+    pre-routing check and the mid-retry engagement so the wire contract
+    (and its counter) can never drift between the two sites."""
+    balancer.count("quarantined_422")
+    _plain_response(
+        client, 422, "Unprocessable Entity",
+        json.dumps({
+            "error": "request quarantined: this conversation has "
+            "repeatedly crashed or stalled replicas",
+            "fingerprint": fp_hex(fp),
+        }),
+        headers=hdrs,
+    )
 
 
 def _plain_response(
@@ -864,18 +916,46 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
     _plain_response(client, 404, "Not Found", '{"error":"not found"}')
 
 
-def _proxy_once(client, request, b: Backend, config) -> tuple[bool, bool, bool]:
+def _response_poison_fp(chunk: bytes) -> str | None:
+    """Best-effort ``X-DLT-Poison-Fp`` implication header off the FIRST
+    response chunk (server/quarantine.py) — the quarantine's strike
+    evidence for failures the replica survived well enough to report.
+    None when absent or the chunk isn't a response head."""
+    try:
+        line = chunk[: chunk.index(b"\r\n")].split()
+        if len(line) < 2 or not line[0].startswith(b"HTTP/"):
+            return None
+    except (ValueError, IndexError):
+        return None
+    head = chunk.split(b"\r\n\r\n", 1)[0]
+    for hline in head.split(b"\r\n")[1:]:
+        if hline.lower().startswith(b"x-dlt-poison-fp:"):
+            return hline.split(b":", 1)[1].strip().decode("latin-1")
+    return None
+
+
+def _proxy_once(
+    client, request, b: Backend, config
+) -> tuple[bool, bool, bool, bool, str | None]:
     """Forward `request` to backend `b`, streaming the response to `client`.
-    Returns (failed, forwarded_any, client_gone): `failed` = the UPSTREAM
-    leg errored; `forwarded_any` = at least one response byte reached the
-    client (the zero-byte-retry eligibility bit); `client_gone` = the CLIENT
-    socket died (not the backend's fault — never counts against it)."""
+    Returns (failed, forwarded_any, client_gone, sent, poison_fp):
+    `failed` = the UPSTREAM leg errored; `forwarded_any` = at least one
+    response byte reached the client (the zero-byte-retry eligibility
+    bit); `client_gone` = the CLIENT socket died (not the backend's fault
+    — never counts against it); `sent` = the request bytes actually
+    reached the backend (a connect-level refusal/timeout has `sent`
+    False: the request was never in flight, so a failure there must not
+    poison-strike it); `poison_fp` = the replica's implication header off
+    the response head (quarantine strike evidence; None when absent)."""
     forwarded = False
+    sent = False
+    poison_fp = None
     try:
         with socket.create_connection(
             (b.host, b.port), timeout=config.connect_timeout_s
         ) as upstream:
             upstream.sendall(request)
+            sent = True
             upstream.settimeout(config.upstream_read_timeout_s)
             while True:
                 chunk = upstream.recv(16384)
@@ -885,14 +965,16 @@ def _proxy_once(client, request, b: Backend, config) -> tuple[bool, bool, bool]:
                     # response is never legitimately empty, and treating it
                     # as success would hand the client an empty reply
                     # instead of the zero-byte retry
-                    return not forwarded, forwarded, False
+                    return not forwarded, forwarded, False, sent, poison_fp
+                if not forwarded:
+                    poison_fp = _response_poison_fp(chunk)
                 try:
                     client.sendall(chunk)
                 except OSError:
-                    return False, forwarded, True
+                    return False, forwarded, True, sent, poison_fp
                 forwarded = True
     except OSError:
-        return True, forwarded, False
+        return True, forwarded, False, sent, poison_fp
 
 
 def handle_client(client: socket.socket, balancer: Balancer):
@@ -941,20 +1023,76 @@ def handle_client(client: socket.socket, balancer: Balancer):
         # least-inflight, exactly the legacy behavior.
         plan = None
         router = balancer.router
+        is_chat = method == "POST" and route == "/v1/chat/completions"
         # `routed` gates decision accounting to CHAT traffic: health/debug
         # proxies are not routing decisions, and counting them would dilute
         # the per-reason breakdown dashboards read
-        routed = (
-            router is not None
-            and method == "POST"
-            and route == "/v1/chat/completions"
+        routed = router is not None and is_chat
+        body = request.partition(b"\r\n\r\n")[2] if is_chat else b""
+        # poison-request quarantine + end-to-end deadline + routing plan:
+        # all three identities come off ONE json.loads per request — and
+        # with none of the three enabled, no parse at all (the proxy hot
+        # path must not decode multi-megabyte bodies for nobody)
+        fp = None
+        deadline_mono = None
+        text = None
+        parsed = None
+        dl_client = (
+            _header_value(request, b"x-dlt-deadline-ms") if is_chat else None
         )
+        deadline_possible = is_chat and (
+            dl_client is not None
+            or any(os.environ.get(v) for v in DEADLINE_ENVS)
+        )
+        if routed or deadline_possible or (
+            is_chat and balancer.quarantine is not None
+        ):
+            try:
+                parsed = json.loads(body)
+            except ValueError:
+                parsed = None
+            messages = (
+                parsed.get("messages") if isinstance(parsed, dict) else None
+            )
+            text = (
+                messages_prefix_text(messages) if messages is not None
+                else None
+            )
         if routed:
-            body = request.partition(b"\r\n\r\n")[2]
-            plan = router.plan(body, balancer)
+            plan = router.plan(body, balancer, text=text)
+        if is_chat and balancer.quarantine is not None:
+            fp = request_fingerprint(text)
+            if balancer.quarantine.is_quarantined(fp):
+                # a fingerprint that already took down `limit` replicas is
+                # refused terminally: 422 is a CLIENT error — the request
+                # is the problem, and no amount of retrying will make
+                # these bytes serve
+                outcome = "quarantined_422"
+                _respond_quarantined(client, balancer, fp, hdrs)
+                return
+        if deadline_possible:
+            klass = _header_value(request, b"x-dlt-slo-class")
+            if klass is None and isinstance(parsed, dict):
+                raw = parsed.get("slo_class")
+                klass = raw if isinstance(raw, str) else None
+            ms = resolve_deadline_ms(klass, dl_client)
+            if ms > 0:
+                deadline_mono = time.monotonic() + ms / 1e3
         tried: set[int] = set()
         attempt = 0
         while True:
+            if deadline_mono is not None and time.monotonic() >= deadline_mono:
+                # the budget died in-house (queue wait, failed attempts):
+                # 504 without burning a replica on an answer nobody is
+                # still waiting for — `deadline` waste upstream never
+                # becomes prefill waste downstream
+                balancer.count("deadline_504")
+                outcome = "504"
+                _plain_response(
+                    client, 504, "Gateway Timeout",
+                    '{"error":"deadline exceeded"}', headers=hdrs,
+                )
+                return
             t_acq = time.perf_counter()
             idx = balancer.acquire(
                 exclude=tried, prefer=plan.ranked if plan is not None else None
@@ -1016,8 +1154,19 @@ def handle_client(client: socket.socket, balancer: Balancer):
                         ),
                     ),
                 )
+            request_out = request
+            if deadline_mono is not None:
+                # re-stamp the deadline with the REMAINING budget: one
+                # clock rides routing and every retry, without shipping an
+                # absolute timestamp between unsynchronized hosts
+                remaining_ms = int((deadline_mono - time.monotonic()) * 1e3)
+                request_out = _with_header(
+                    request, DEADLINE_HEADER, str(max(remaining_ms, 1))
+                )
             t_att = time.perf_counter()
-            failed, forwarded, client_gone = _proxy_once(client, request, b, config)
+            failed, forwarded, client_gone, sent, poison_fp = _proxy_once(
+                client, request_out, b, config
+            )
             tr.event(  # dlt: allow(trace-hot-emit)
                 "gw_attempt", to_us(t_att),
                 int((time.perf_counter() - t_att) * 1e6),
@@ -1027,6 +1176,20 @@ def handle_client(client: socket.socket, balancer: Balancer):
             )
             balancer.release(idx, mark_unhealthy=failed)
             held = -1
+            if fp is not None and (
+                (failed and sent) or poison_fp is not None
+            ):
+                # strike the fingerprint: a transport-level death with the
+                # request IN FLIGHT (zero-byte / midstream after sendall)
+                # implicates the bytes the replica was holding; a survived
+                # 5xx implicates only when the replica SAYS so
+                # (X-DLT-Poison-Fp). A connect-level refusal/timeout never
+                # strikes — the request never reached a replica, and two
+                # briefly-down backends must not terminally 422 an
+                # innocent conversation. Nor does a plain 503: landing on
+                # an overloaded replica is not the request's fault.
+                balancer.quarantine.strike(fp)
+                balancer.count("poison_strikes")
             if client_gone:
                 outcome = "client_gone"
                 return
@@ -1045,6 +1208,14 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 # stream; EOF is the only honest signal left — no retry
                 balancer.count("midstream_failures")
                 outcome = "midstream_eof"
+                return
+            if fp is not None and balancer.quarantine.is_quarantined(fp):
+                # the quarantine just engaged mid-retry: STOP. Replaying
+                # these bytes into yet another replica is exactly how one
+                # poison request takes down a fleet — the strike ledger
+                # caps the blast radius at `limit` replicas, terminally.
+                outcome = "quarantined_422"
+                _respond_quarantined(client, balancer, fp, hdrs)
                 return
             # zero bytes reached the client: transparently retry on a
             # DIFFERENT backend (bounded; the failed one is excluded)
@@ -1188,6 +1359,12 @@ def main(argv=None) -> int:
                    "(server/autoscaler.py): drains idle replicas with warm "
                    "prefix handoff, undrains on pressure (default: "
                    "DLT_AUTOSCALE_S or 0 = off)")
+    p.add_argument("--quarantine-strikes", type=int, default=None,
+                   help="poison-request quarantine strike limit "
+                   "(server/quarantine.py): failed attempts implicating "
+                   "the same request fingerprint stop being retried and "
+                   "422 terminally past this count (default: "
+                   "DLT_QUARANTINE_STRIKES or 2; <=0 disables)")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
@@ -1205,6 +1382,7 @@ def main(argv=None) -> int:
         fleet_timeout_s=args.fleet_timeout_s,
         router_policy=args.router,
         autoscale_s=args.autoscale_s,
+        quarantine_strikes=args.quarantine_strikes,
     )
     run(args.port, Balancer(config))
     return 0

@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..runtime.tracing import prom_line as _prom  # stdlib-only: one
 # Prometheus line formatter (escaping included) for the whole serving
 # layer — the twin must emit exactly what the scraper parses
+from .quarantine import request_fingerprint
 from .router import PAGE_CHARS, messages_prefix_text, prefix_chain
 from .scheduler import (
     ClassQueues,
@@ -73,6 +74,14 @@ class StubReplicaConfig:
     prefill_ms_per_token: float = 0.05  # prefill wall per COLD prompt token
     slo_ttft_ms: float = 1000.0   # the TTFT target the attainment gauge uses
     admission_timeout_s: float = 30.0   # slot wait before giving up (503)
+    # chaos: request fingerprints (server/quarantine.py
+    # request_fingerprint over the SAME messages text the gateway hashes)
+    # that CRASH this stub — the connection aborts byte-less (the
+    # gateway's zero-byte-failure shape) and the replica enters a
+    # simulated supervised recovery for `poison_recover_s` (health 503,
+    # chat 503) — the engine-wedged failure mode the quarantine exists for
+    poison_fps: frozenset = frozenset()
+    poison_recover_s: float = 0.3
 
 
 class _Ticket:
@@ -185,7 +194,9 @@ class _StubState:
         self.counters = {
             "requests_completed": 0, "prefix_hit_tokens": 0,
             "prefix_hits": 0, "shed_503": 0, "client_gone": 0,
+            "poison_hits": 0, "supervisor_rebuilds": 0,
         }
+        self.recovering_until = 0.0  # monotonic; > now = twin-recovering
         self.scheduler = SloScheduler()
         self.gate = _SlotGate(cfg, self.scheduler)
         self.hot_prefixes = HotPrefixTracker()
@@ -324,6 +335,17 @@ class StubEngineReplica:
 
             def do_GET(self):
                 route = self.path.partition("?")[0]
+                if route not in ("/metrics",) and time.monotonic() < st.recovering_until:
+                    # the supervised-recovery twin: while "rebuilding" the
+                    # replica answers 503 with its state (the real
+                    # /health contract) — the gateway's breaker and the
+                    # fleet table route away; /metrics keeps answering
+                    # (the real replica's metrics endpoint does too)
+                    self._send(503, json.dumps({
+                        "status": "recovering",
+                        "counters": dict(st.counters),
+                    }).encode())
+                    return
                 if route == "/metrics":
                     self._send(
                         200, _render_stub_metrics(st).encode(),
@@ -380,6 +402,40 @@ class StubEngineReplica:
                 text = messages_prefix_text(messages) or ""
                 chain = prefix_chain(text)
                 st.hot_prefixes.record(chain)
+                # chaos: poison requests CRASH the stub (the wedged-engine
+                # failure mode) — the connection aborts byte-less, so the
+                # gateway sees exactly the zero-byte failure a crashed
+                # replica produces, strikes the fingerprint, and retries
+                # elsewhere; this replica "rebuilds" for poison_recover_s
+                fp = request_fingerprint(text)
+                if fp in st.cfg.poison_fps:
+                    prompt_tokens = max(len(text) // CHARS_PER_TOKEN, 1)
+                    st.incr("poison_hits")
+                    st.incr("supervisor_rebuilds")
+                    st.add_waste("quarantined", klass, prompt_tokens)
+                    with st.lock:
+                        st.recovering_until = (
+                            time.monotonic() + st.cfg.poison_recover_s
+                        )
+                        st.warm_chains.clear()  # the rebuild's cold cache
+                    import socket as _socket
+
+                    try:
+                        self.connection.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return
+                if time.monotonic() < st.recovering_until:
+                    # mid-"rebuild": innocent arrivals shed cleanly (503 is
+                    # never strike evidence — the gateway must not
+                    # quarantine a request for landing on a down replica)
+                    st.incr("shed_503")
+                    self._send(
+                        503, b'{"error":"recovering"}',
+                        headers={"Retry-After": "1"},
+                    )
+                    return
                 # class-aware admission: the REAL policy object's
                 # quota/backlog decision over the gate's real queues —
                 # never a forked copy of the math
@@ -476,7 +532,8 @@ class StubEngineReplica:
                         st.incr("client_gone")
                     st.add_waste(outcome, klass, max(delivered, 1))
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._handler_cls = Handler
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         return self
@@ -485,6 +542,22 @@ class StubEngineReplica:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+            self._httpd = None
+
+    def restart(self) -> "StubEngineReplica":
+        """Revive on the SAME port after a kill — the supervised-rejoin
+        twin: a fresh server process-equivalent whose prefix cache comes
+        back COLD (the real rebuild's fresh radix cache) while the
+        replica's counters continue (the real rebuild carries them over)."""
+        st = self.state
+        with st.lock:
+            st.warm_chains.clear()
+        st.incr("supervisor_rebuilds")
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.port), self._handler_cls
+        )
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
 
 
 # -- scenario traces ----------------------------------------------------------
@@ -629,6 +702,8 @@ class LoadTwin:
         autoscale_s: float | None = None,
         classes_enabled: bool = True,
         max_inflight_per_backend: int = 64,
+        quarantine_strikes: int | None = None,
+        retry_attempts: int = 2,
     ):
         from . import gateway as gw_mod
         from .fleet import FleetScraper
@@ -649,6 +724,8 @@ class LoadTwin:
             probe_interval_s=0, fleet_scrape_s=0,  # scraper driven below
             router_policy=router_policy,
             autoscale_s=0,  # autoscaler built (and ticked) explicitly
+            quarantine_strikes=quarantine_strikes,
+            retry_attempts=retry_attempts,
         )
         self.balancer = Balancer(self.cfg)
         self.scraper = FleetScraper(
@@ -726,7 +803,15 @@ class LoadTwin:
             res.status = resp.status
             if resp.status != 200:
                 resp.read()
-                res.outcome = "shed" if resp.status == 503 else "error"
+                if resp.status == 422:
+                    # quarantined: TERMINAL by contract — a production
+                    # client must not retry a 422 (the request is the
+                    # problem), and the twin's retry loop honors that
+                    res.outcome = "quarantined"
+                elif resp.status == 503:
+                    res.outcome = "shed"
+                else:
+                    res.outcome = "error"
                 return res
             first = resp.read(6)  # the leading b"data: " of the first event
             res.ttft_ms = (time.perf_counter() - t0) * 1e3
@@ -807,11 +892,13 @@ class LoadTwin:
                 continue
             c = per_class.setdefault(r.slo_class, {
                 "n": 0, "ok": 0, "shed": 0, "abandoned": 0, "preempted": 0,
-                "error": 0, "ttfts": [], "tokens": 0, "retries": 0,
+                "quarantined": 0, "error": 0, "ttfts": [], "tokens": 0,
+                "retries": 0,
             })
             c["n"] += 1
             c[r.outcome if r.outcome in
-              ("ok", "shed", "abandoned", "preempted", "error")
+              ("ok", "shed", "abandoned", "preempted", "quarantined",
+               "error")
               else "error"] += 1
             c["retries"] += r.retries
             if r.outcome in ("ok", "abandoned") and r.ttft_ms is not None:
@@ -826,7 +913,8 @@ class LoadTwin:
             out["classes"][k] = {
                 "n": c["n"], "ok": c["ok"], "shed": c["shed"],
                 "abandoned": c["abandoned"], "preempted": c["preempted"],
-                "error": c["error"], "retries": c["retries"],
+                "quarantined": c["quarantined"], "error": c["error"],
+                "retries": c["retries"],
                 "delivered_tokens": c["tokens"],
                 "ttft_p50_ms": self._pct(c["ttfts"], 0.50),
                 "ttft_p95_ms": self._pct(c["ttfts"], 0.95),
@@ -839,6 +927,36 @@ class LoadTwin:
         )
         out["fleet_prefix_hit_tokens"] = self.fleet_prefix_hit_tokens()
         return out
+
+    # -- chaos controls -------------------------------------------------------
+
+    def kill_replica(self, i: int):
+        """Hard-kill one stub mid-run: in-flight streams truncate (the
+        gateway's midstream-failure shape), new connections refuse — the
+        replica-crash chaos scenario."""
+        self.replicas[i].stop()
+
+    def revive_replica(self, i: int):
+        """Bring a killed stub back on its port (supervised rejoin: cold
+        prefix cache, continuing counters). The gateway's breaker
+        re-admits it through the ordinary half-open trial."""
+        self.replicas[i].restart()
+
+    def poisoned_replica_count(self) -> int:
+        """How many replicas a poison request EVER took down — the
+        quarantine acceptance bound (must stay <= the strike limit)."""
+        return sum(
+            1 for r in self.replicas
+            if r.state.counters.get("poison_hits", 0) > 0
+        )
+
+    def quarantined_waste_tokens(self) -> int:
+        return sum(
+            v
+            for r in self.replicas
+            for (reason, _), v in r.state.wasted.items()
+            if reason == "quarantined"
+        )
 
     def fleet_prefix_hit_tokens(self) -> int:
         return sum(

@@ -251,6 +251,10 @@ class RoutePlan:
     scored: list = field(default_factory=list)       # (backend_key, score)
 
 
+#: plan(text=...) sentinel: None is a meaningful value (unparsable body)
+_NO_TEXT = object()
+
+
 class Router:
     """Per-gateway routing state: the locality map, the decision counters,
     and the plan/resolve pair the gateway's request loop calls. Thread-safe
@@ -291,11 +295,17 @@ class Router:
 
     # -- planning ------------------------------------------------------------
 
-    def plan(self, body: bytes | None, balancer) -> RoutePlan | None:
+    def plan(self, body: bytes | None, balancer,
+             text=_NO_TEXT) -> RoutePlan | None:
         """Rank the backends for one request. None = the router abstains
         (non-chat request, unparsable body, or a prompt too short to carry
-        a full hash block) and the decision counts as least_inflight."""
-        text = chat_prefix_text(body) if body else None
+        a full hash block) and the decision counts as least_inflight.
+        ``text`` lets a caller that already parsed the body (the gateway
+        parses once per request — fingerprint, slo_class, and this plan
+        all come off one json.loads) pass the hash text in; omitted, the
+        body is parsed here."""
+        if text is _NO_TEXT:
+            text = chat_prefix_text(body) if body else None
         if text is None:
             return None
         chain = prefix_chain(text)

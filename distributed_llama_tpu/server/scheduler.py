@@ -51,6 +51,54 @@ CLASS_RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
 #: client headers byte-transparently, retries included)
 SLO_CLASS_HEADER = "X-DLT-SLO-Class"
 
+#: end-to-end deadline header: milliseconds of budget remaining, minted at
+#: the gateway (client header or the class default below) and re-stamped
+#: with the REMAINING budget on every retry attempt — so the deadline is
+#: one clock across routing, retries, and the replica's Batcher, without
+#: ever shipping an absolute timestamp between unsynchronized hosts
+DEADLINE_HEADER = "X-DLT-Deadline-Ms"
+
+#: class scaling applied to DLT_DEFAULT_DEADLINE_MS when no per-class env
+#: overrides: an interactive request's answer is worthless sooner than a
+#: batch job's — the deadline composes with the SLO class, it doesn't
+#: flatten it
+DEADLINE_CLASS_SCALE = {"interactive": 0.5, "standard": 1.0, "batch": 4.0}
+
+#: every env that can mint a deadline WITHOUT a client header — the
+#: gateway checks these to skip chat-body parsing entirely when no
+#: consumer (router, quarantine, deadline) is enabled
+DEADLINE_ENVS = ("DLT_DEFAULT_DEADLINE_MS",) + tuple(
+    f"DLT_DEADLINE_MS_{c.upper()}" for c in SLO_CLASSES
+)
+
+
+def resolve_deadline_ms(klass: str, client_value=None) -> int:
+    """The deadline budget (ms) one request rides with; 0 = no deadline
+    (the default — deadlines are opt-in via the client header or
+    ``DLT_DEFAULT_DEADLINE_MS``). Resolution order: the client's own
+    header (clamped positive), then ``DLT_DEADLINE_MS_<CLASS>``, then
+    ``DLT_DEFAULT_DEADLINE_MS`` scaled by the class's
+    :data:`DEADLINE_CLASS_SCALE` factor."""
+    if client_value is not None:
+        try:
+            ms = int(float(client_value))
+            if ms > 0:
+                return ms
+        except (TypeError, ValueError):
+            pass  # a garbage header degrades to the configured default,
+            # never fails the request (the resolve_slo_class discipline)
+    klass = resolve_slo_class(klass)
+    per_class = os.environ.get(f"DLT_DEADLINE_MS_{klass.upper()}")
+    if per_class is not None:
+        try:
+            return max(int(float(per_class)), 0)
+        except ValueError:
+            pass
+    default = _env_float("DLT_DEFAULT_DEADLINE_MS", 0.0)
+    if default <= 0:
+        return 0
+    return max(int(default * DEADLINE_CLASS_SCALE.get(klass, 1.0)), 1)
+
 #: every action ``dlt_scheduler_decisions_total`` is labeled with:
 #: * ``admit``        — a request entered a batch slot;
 #: * ``shed_backlog`` — turned away at admission (total backlog cap or the

@@ -46,6 +46,15 @@
 #                            a live engine; autoscaler tick policy; the
 #                            10-replica load-twin smoke + the mixed-class
 #                            SLO and drain-handoff acceptance twins)
+#  11b. robustness suite     (supervised engine lifecycle: rebuild-in-
+#                            place token identity, recovering/failed
+#                            health states, restart budget; poison-
+#                            request quarantine at gateway + replica;
+#                            end-to-end deadlines; the poison+replica-
+#                            kill fleet chaos twin — plus a cross-suite
+#                            single-process slow pair proving a torn-down
+#                            server's sealed sentinel cannot condemn a
+#                            later suite's engine builds)
 #  12. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
 #                            vs predecessor, tolerance-banded — WARN-ONLY:
 #                            the table is the artifact, the exit code is 0)
@@ -99,6 +108,17 @@ python -m pytest tests/test_kv_transport.py -q -p no:cacheprovider
 
 echo "== scheduler suite (SLO classes + autoscaler + load twin) =="
 python -m pytest tests/test_scheduler.py tests/test_loadtwin.py -q -p no:cacheprovider
+
+echo "== robustness suite (supervisor + quarantine + deadlines + chaos twin) =="
+python -m pytest tests/test_supervisor.py tests/test_quarantine.py \
+  tests/test_deadline.py -q -p no:cacheprovider
+
+echo "== cross-suite sentinel-lifecycle pair (single process, slow-marked) =="
+# two suites whose servers warm + seal fatal-capable sentinels in ONE
+# process: green only while server teardown releases the sentinel
+# (the PR 13 combined-slow-run pollution class; see ApiState.close)
+python -m pytest tests/test_supervisor.py tests/test_speculative.py \
+  -q -m slow -p no:cacheprovider
 
 echo "== scoreboard guard (warn-only) =="
 python scripts/bench_compare.py

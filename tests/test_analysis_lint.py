@@ -167,3 +167,53 @@ def test_repo_tree_is_clean():
     ]
     violations = lint.lint_paths([p for p in paths if p.exists()], root=ROOT)
     assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_sentinel_release_requires_teardown_stop():
+    bad = (
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.sentinel = RecompileSentinel(stats=s).start()\n"
+    )
+    assert _rules(bad) == ["sentinel-release"]
+    # a close() releasing the subscription satisfies the rule
+    ok = bad + (
+        "    def close(self):\n"
+        "        if self.sentinel is not None:\n"
+        "            self.sentinel.stop()\n"
+    )
+    assert _rules(ok) == []
+    # the bare (un-started) constructor is a subscription-to-be: same rule
+    bare = (
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.guard = RecompileSentinel()\n"
+    )
+    assert _rules(bare) == ["sentinel-release"]
+    # releasing a DIFFERENT attribute does not count
+    wrong = bad + (
+        "    def close(self):\n"
+        "        self.other.stop()\n"
+    )
+    assert _rules(wrong) == ["sentinel-release"]
+    # scope: device/server lifecycles only — a scripts/ helper is exempt
+    assert _rules(bad, rel="scripts/x.py") == []
+    # pragma suppresses at the assignment site
+    sup = (
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.sentinel = RecompileSentinel().start()  # dlt: allow(sentinel-release)\n"
+    )
+    assert _rules(sup) == []
+    # a NESTED class's sentinel belongs to the nested class: the outer
+    # class must not be flagged for it (and the inner one, which releases
+    # correctly, is clean on its own visit)
+    nested_ok = (
+        "class Outer:\n"
+        "    class Inner:\n"
+        "        def __init__(self):\n"
+        "            self.s = RecompileSentinel().start()\n"
+        "        def close(self):\n"
+        "            self.s.stop()\n"
+    )
+    assert _rules(nested_ok) == []

@@ -132,6 +132,11 @@ class Stack:
             # seeded fault plans (tests/test_router.py drives the router)
             router_policy="least_inflight",
             retry_attempts=2,
+            # quarantine off: seeded fault plans deliberately fail the SAME
+            # body many times — striking it would 422 mid-plan and perturb
+            # the retry semantics under test (tests/test_quarantine.py
+            # drives the ledger explicitly)
+            quarantine_strikes=0,
         )
         defaults.update(cfg_overrides)
         self.cfg = GatewayConfig(**defaults)
@@ -799,7 +804,10 @@ def test_stall_produces_flight_record_with_request_spans(
     assert data["usage"]["completion_tokens"] > 0
     with _get(port, "/debug/flightrecord") as r:
         rec = json.loads(r.read())
-    assert rec["reason"].startswith(("stall:", "api.recover"))
+    # the supervised-recovery path (runtime/supervisor.py) may dump its own
+    # transition record after the stall/recover pair — any of the three is
+    # the stall incident's post-mortem
+    assert rec["reason"].startswith(("stall:", "api.recover", "supervisor:"))
     names = [e["name"] for e in rec["events"]]
     assert "watchdog_stall" in names, names
     # the stalled request's own spans are in the dump: its admission

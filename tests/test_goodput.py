@@ -45,7 +45,7 @@ def test_aggregator_identity_and_waste_labels():
     )
     assert series == {"overrun": 2, "shed": 7, "stall_retry": 3,
                       "client_gone": 0, "error": 0, "transfer_retry": 0,
-                      "preempt": 0}
+                      "preempt": 0, "deadline": 0, "quarantined": 0}
 
 
 def test_aggregator_per_class_breakdown():

@@ -434,3 +434,93 @@ def test_set_draining_purges_router_locality(monkeypatch):
         bal.set_draining(b.key, True)
     assert len(r._locality) == 0
     assert r.handoff_snapshot()["purged_keys"] > 0
+
+
+# ---- THE replica-crash + poison-request chaos (ISSUE 14) --------------------
+
+
+def test_fleet_chaos_poison_and_replica_kill_holds_goodput():
+    """ISSUE 14 acceptance: with a poison fingerprint in the mixed trace
+    and one replica hard-killed mid-decode (then revived), the fleet
+    holds >= 90% of the no-fault goodput over a common horizon, the
+    quarantine caps the poisoned-replica count at the strike limit,
+    `quarantined` waste is visible, and the killed replica rejoins."""
+    from distributed_llama_tpu.server.loadtwin import TwinRequest
+    from distributed_llama_tpu.server.quarantine import request_fingerprint
+    from distributed_llama_tpu.server.router import messages_prefix_text
+
+    HORIZON_S = 6.0
+    LIMIT = 2
+    base = make_mixed_trace(seed=7, duration_s=2.0)
+
+    poison_system = "P0ISON corpus " * 8
+    poison_user = "the request that wedges engines"
+    poison_fp = request_fingerprint(messages_prefix_text([
+        {"role": "system", "content": poison_system},
+        {"role": "user", "content": poison_user},
+    ]))
+    poison = [
+        TwinRequest(at_s=t, slo_class="standard", system=poison_system,
+                    user=poison_user, max_tokens=12, scenario="poison")
+        for t in (0.3, 0.9, 1.5)
+    ]
+
+    # no-fault arm: same base trace, clean fleet
+    tw = LoadTwin(n_replicas=6, fleet_scrape_s=0.1, retry_attempts=4,
+                  quarantine_strikes=LIMIT)
+    try:
+        base_rep = tw.report(tw.run(base), horizon_s=HORIZON_S)
+    finally:
+        tw.close()
+    assert base_rep["failures"] == 0
+
+    # chaos arm: poison requests in the trace + a mid-run kill/revive
+    cfg = StubReplicaConfig(poison_fps=frozenset({poison_fp}),
+                            poison_recover_s=0.3)
+    tw = LoadTwin(n_replicas=6, replica_cfg=cfg, fleet_scrape_s=0.1,
+                  retry_attempts=4, quarantine_strikes=LIMIT)
+    try:
+        trace = sorted(base + poison, key=lambda r: r.at_s)
+        timers = [
+            threading.Timer(0.8, tw.kill_replica, args=(0,)),
+            threading.Timer(1.6, tw.revive_replica, args=(0,)),
+        ]
+        for t in timers:
+            t.daemon = True
+            t.start()
+        rep = tw.report(tw.run(trace), horizon_s=HORIZON_S)
+        for t in timers:
+            t.join(timeout=5)
+
+        # 1) goodput holds >= 90% of the no-fault arm
+        retention = rep["goodput_tokens_per_s"] / max(
+            base_rep["goodput_tokens_per_s"], 1e-9
+        )
+        assert retention >= 0.9, (retention, rep, base_rep)
+
+        # 2) quarantine engaged: the poison fingerprint took down at most
+        #    LIMIT replicas, ever, and poison requests ended 422-terminal
+        assert tw.poisoned_replica_count() <= LIMIT
+        assert tw.poisoned_replica_count() >= 1  # the chaos actually ran
+        q_outcomes = rep["classes"]["standard"]["quarantined"]
+        assert q_outcomes >= 1, rep
+        assert tw.balancer.stats()["counters"]["quarantined_422"] >= 1
+
+        # 3) quarantined waste is visible: stub ledgers + the federated
+        #    /metrics rollup both carry the labeled rows
+        assert tw.quarantined_waste_tokens() > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.port}/metrics", timeout=30
+        ) as r:
+            body = r.read().decode()
+        assert 'reason="quarantined"' in body
+
+        # 4) the killed replica rejoined and answers health directly
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.replicas[0].port}/health", timeout=5
+        ) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert tw.replicas[0].state.counters["supervisor_rebuilds"] >= 1
+    finally:
+        tw.close()

@@ -490,7 +490,20 @@ def test_http_greedy_identity_and_stats(http_twins):
         with _post(http_twins["off"], payload) as r:
             b = json.loads(r.read())
         assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
-        assert a["usage"] == b["usage"]
+        # token accounting must match EXACTLY; the goodput extension's
+        # WALL fields are timing-dependent (on a loaded 1-core box the
+        # two servers' prefill/decode walls never equate) — bound those
+        # instead of equating the whole usage dict
+        for k in ("prompt_tokens", "completion_tokens", "total_tokens"):
+            assert a["usage"][k] == b["usage"][k]
+        ga, gb = a["usage"]["goodput"], b["usage"]["goodput"]
+        for k in ("prompt_tokens", "generated_tokens", "prefix_hit_tokens",
+                  "retries", "outcome", "slo_class"):
+            assert ga[k] == gb[k], k
+        assert ga["spec_accepted_tokens"] >= gb["spec_accepted_tokens"]
+        for g in (ga, gb):
+            for k in ("queue_us", "prefill_us", "decode_us", "spec_us"):
+                assert 0 <= g[k] < 120_000_000  # a sane wall, not equality
     with urllib.request.urlopen(
         f"http://127.0.0.1:{http_twins['ngram']}/stats", timeout=30
     ) as r:
