@@ -1284,6 +1284,135 @@ def leg_loadtwin():
     }
 
 
+def leg_gateway_chaos():
+    """Gateway failure-domain leg (ISSUE 15, server/peering.py +
+    server/recovery.py): TWO active-active peered gateways over a
+    6-replica stub fleet replaying the seeded mixed trace, with gateway 0
+    hard-killed mid-run and warm-restarted (crash-only recovery from the
+    fleet) — vs the same trace on a fault-free twin. The bars: fleet
+    goodput over a common horizon holds >= 90% of no-fault (clients fail
+    over between gateway addresses; zero failed requests), and a warm-
+    restarted gateway's first post-restart window recovers >= 80% of the
+    pre-kill prefix-hit rate (locality re-learned from the fleet's
+    /debug/hot_prefixes) while the cold baseline re-learns from scratch.
+    Engine-free: this leg measures the control plane's failure domain."""
+    import threading as _threading
+
+    from distributed_llama_tpu.server.loadtwin import (
+        LoadTwin, StubReplicaConfig, TwinRequest, make_mixed_trace,
+    )
+    from distributed_llama_tpu.server.router import (
+        messages_prefix_text, prefix_chain, rendezvous_owner,
+    )
+
+    HORIZON_S = 6.0
+    cfg = StubReplicaConfig(batch_slots=4, token_ms=2.0)
+    trace = make_mixed_trace(seed=23, duration_s=2.0)
+
+    def run_arm(chaos: bool):
+        tw = LoadTwin(
+            n_replicas=6, replica_cfg=cfg, fleet_scrape_s=0.1,
+            n_gateways=2, peer_sync_s=0.1, retry_attempts=3,
+        )
+        try:
+            timers = []
+            if chaos:
+                timers = [
+                    _threading.Timer(0.8, tw.kill_gateway, args=(0,)),
+                    _threading.Timer(1.6, tw.restart_gateway, args=(0,)),
+                ]
+                for t in timers:
+                    t.daemon = True
+                    t.start()
+            results = tw.run(trace)
+            for t in timers:
+                t.join(timeout=10)
+            rep = tw.report(results, horizon_s=HORIZON_S)
+            rep["gateway_failovers"] = sum(
+                r.gateway_failovers for r in results if r is not None
+            )
+            return rep
+        finally:
+            tw.close()
+
+    base = run_arm(chaos=False)
+    chaos = run_arm(chaos=True)
+    assert base["failures"] == 0 and chaos["failures"] == 0, (base, chaos)
+    retention = 100.0 * chaos["goodput_tokens_per_s"] / max(
+        base["goodput_tokens_per_s"], 1e-9
+    )
+
+    # the restart prefix-recovery arm: learned homes that differ from the
+    # rendezvous defaults (drain history), then kill + warm restart vs
+    # kill + cold restart, hits counted over identical request windows
+    SCRAPE_S = 0.25
+    tw = LoadTwin(
+        n_replicas=4,
+        replica_cfg=StubReplicaConfig(batch_slots=8, token_ms=1.0),
+        fleet_scrape_s=SCRAPE_S, quarantine_strikes=0,
+    )
+    apps = [f"benchapp{i} " * 24 for i in range(6)]
+
+    def send_round(tag, per_app=3):
+        for a, system in enumerate(apps):
+            for j in range(per_app):
+                res = tw._client(TwinRequest(
+                    at_s=0.0, system=system, user=f"{tag} q{a}.{j}",
+                    max_tokens=2,
+                ))
+                assert res.outcome == "ok", res
+
+    try:
+        keys = tw.replica_keys()
+        for system in apps:
+            chain = prefix_chain(messages_prefix_text(
+                [{"role": "system", "content": system},
+                 {"role": "user", "content": "x"}]
+            ))
+            owner = rendezvous_owner(chain[0], keys)
+            tw.balancer.set_draining(owner, True)
+            assert tw._client(TwinRequest(
+                at_s=0.0, system=system, user="x", max_tokens=2,
+            )).outcome == "ok"
+            tw.balancer.set_draining(owner, False)
+        send_round("warmup")
+        h0 = tw.fleet_prefix_hit_tokens()
+        send_round("prekill")
+        pre_hits = tw.fleet_prefix_hit_tokens() - h0
+        tw.kill_gateway(0)
+        gw = tw.restart_gateway(0, recover=True)
+        recovered_keys = gw.balancer.recovery["locality_keys"]
+        recovery_wall_ms = gw.balancer.recovery["wall_ms"]
+        h1 = tw.fleet_prefix_hit_tokens()
+        send_round("postwarm")
+        warm_hits = tw.fleet_prefix_hit_tokens() - h1
+        tw.kill_gateway(0)
+        tw.restart_gateway(0, recover=False)
+        h2 = tw.fleet_prefix_hit_tokens()
+        send_round("postcold")
+        cold_hits = tw.fleet_prefix_hit_tokens() - h2
+    finally:
+        tw.close()
+
+    return {
+        "config": "gateway-chaos 2-gw active-active kill/restart + warm recovery",
+        "fleet_goodput_tokens_per_s_nofault": base["goodput_tokens_per_s"],
+        "fleet_goodput_tokens_per_s_chaos": chaos["goodput_tokens_per_s"],
+        "failover_goodput_retention_pct": round(retention, 1),
+        "retention_bar_pct": 90.0,
+        "gateway_failovers": chaos["gateway_failovers"],
+        "restart_prefix_recovery_attainment": round(
+            warm_hits / max(pre_hits, 1), 3
+        ),
+        "restart_prefix_recovery_attainment_cold": round(
+            cold_hits / max(pre_hits, 1), 3
+        ),
+        "recovery_bar_attainment": 0.8,
+        "recovered_locality_keys": recovered_keys,
+        "recovery_wall_ms": recovery_wall_ms,
+    }
+
+
 def leg_perplexity_proxy(path: str):
     """Accuracy proxy: mean next-token logprob delta of the bf16 production
     path vs the f32 reference path on a fixed prompt."""
@@ -1483,6 +1612,13 @@ def main():
         print(f"# load-twin: {lt}", file=sys.stderr)
     except Exception as e:
         print(f"# load-twin leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        gc_leg = leg_gateway_chaos()
+        configs.append(gc_leg)
+        print(f"# gateway-chaos: {gc_leg}", file=sys.stderr)
+    except Exception as e:
+        print(f"# gateway-chaos leg failed: {e!r}", file=sys.stderr)
 
     try:
         l8 = leg_8b()

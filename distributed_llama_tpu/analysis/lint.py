@@ -49,7 +49,17 @@ comma-separate for several — the pragma documents WHY at the site):
   sealed sentinel past its engine's lifetime — and a leaked SEALED FATAL
   sentinel kills every later engine build in the process (the
   cross-suite-pollution class the supervisor's rebuild path releases
-  explicitly; runtime/engine.py ``close()`` is the reference shape).
+  explicitly; runtime/engine.py ``close()`` is the reference shape);
+* **thread-release** — the sentinel-release rule's thread edition: a
+  class holding a gateway-owned background loop (``FleetScraper``,
+  ``Autoscaler``, ``HealthProber``, ``GatewayPeering`` — directly or via
+  a local alias, ``x = FleetScraper(...); self.s = x``) without a
+  ``close``/``stop``/``shutdown``/``server_close``/``__exit__`` method
+  calling ``self.s.stop()``: these loops actuate against the fleet
+  (scrape, drain, gossip), so one leaked past its server's teardown
+  keeps scraping/draining from a gateway that no longer exists — and an
+  in-process gateway restart (the crash-only tests instantiate the
+  server twice) doubles every control loop.
 
 The CLI lives at ``scripts/dlt_lint.py``; CI runs it over the tree.
 """
@@ -70,6 +80,7 @@ ALL_RULES = (
     "host-sync",
     "trace-hot-emit",
     "sentinel-release",
+    "thread-release",
 )
 
 _PRAGMA_RE = re.compile(r"#\s*dlt:\s*allow\(([^)]*)\)")
@@ -94,6 +105,34 @@ TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 #: packages whose classes must pair a sentinel subscription with a
 #: teardown release (engine lifecycles live here)
 SENTINEL_SCOPE = ("runtime", "server", "analysis")
+#: class names whose instances are gateway-owned background loops: held
+#: as a ``self.<attr>`` they must be released by a teardown method
+#: (thread-release); all four expose ``.stop()``
+THREAD_OWNER_CLASSES = (
+    "FleetScraper", "Autoscaler", "HealthProber", "GatewayPeering",
+)
+#: method names that count as a teardown site for thread-release —
+#: sentinel-release's set plus the http.server lifecycle pair the
+#: gateway/api servers implement
+RELEASE_METHODS = (
+    "close", "stop", "shutdown", "server_close", "__exit__", "__del__",
+)
+
+
+def _owner_ctor_name(call: ast.Call) -> str | None:
+    """The THREAD_OWNER_CLASSES class name when ``call`` is its ctor (or
+    a ``.start()`` chained onto one); None otherwise."""
+    d = _dotted(call.func)
+    for name in THREAD_OWNER_CLASSES:
+        if d == name or d.endswith("." + name):
+            return name
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "start"
+        and isinstance(call.func.value, ast.Call)
+    ):
+        return _owner_ctor_name(call.func.value)
+    return None
 
 
 def _is_sentinel_ctor(call: ast.Call) -> bool:
@@ -337,6 +376,7 @@ class _Linter(ast.NodeVisitor):
         self._thread_classes.append(is_thread)
         if self._in_scope(SENTINEL_SCOPE):
             self._check_sentinel_release(node)
+            self._check_thread_release(node)
         self.generic_visit(node)
         self._thread_classes.pop()
 
@@ -397,6 +437,72 @@ class _Linter(ast.NodeVisitor):
                     "close/stop/__exit__ method calls "
                     f"self.{attr}.stop() — a leaked sealed sentinel "
                     "outlives the engine and kills later engine builds",
+                )
+
+    def _check_thread_release(self, cls: ast.ClassDef):
+        """thread-release: every ``self.<attr>`` holding a gateway-owned
+        background loop (THREAD_OWNER_CLASSES, directly or via a local
+        alias) must be released — ``self.<attr>.stop()`` (or
+        ``.close()``/``.join()``) from a RELEASE_METHODS teardown. A
+        leaked scraper/autoscaler/prober/peer-sync loop keeps actuating
+        against the fleet after its gateway is gone — and doubles on an
+        in-process restart."""
+        # local aliases: x = FleetScraper(...), possibly .start()-chained
+        aliases: set = set()
+        for sub in self._walk_own(cls):
+            if (
+                isinstance(sub, ast.Assign)
+                and isinstance(sub.value, ast.Call)
+                and _owner_ctor_name(sub.value)
+            ):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+        holders: list = []
+        for sub in self._walk_own(cls):
+            if not isinstance(sub, ast.Assign):
+                continue
+            value = sub.value
+            owner = None
+            if isinstance(value, ast.Call):
+                owner = _owner_ctor_name(value)
+            elif isinstance(value, ast.Name) and value.id in aliases:
+                owner = value.id
+            if not owner:
+                continue
+            for tgt in sub.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    holders.append((tgt.attr, owner, sub))
+        if not holders:
+            return
+        released: set = set()
+        for sub in cls.body:
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub.name in RELEASE_METHODS
+            ):
+                for c in ast.walk(sub):
+                    if (
+                        isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr in ("stop", "close", "join")
+                        and isinstance(c.func.value, ast.Attribute)
+                        and isinstance(c.func.value.value, ast.Name)
+                        and c.func.value.value.id == "self"
+                    ):
+                        released.add(c.func.value.attr)
+        for attr, owner, node in holders:
+            if attr not in released:
+                self._flag(
+                    "thread-release", node,
+                    f"self.{attr} holds a {owner} background loop but no "
+                    "close/stop/shutdown/server_close method calls "
+                    f"self.{attr}.stop() — a leaked control loop keeps "
+                    "actuating against the fleet after its gateway dies",
                 )
 
 

@@ -1071,6 +1071,13 @@ class ApiState:
         # GET /debug/hot_prefixes so the gateway's autoscaler can re-home
         # affinity BEFORE draining this replica
         self.hot_prefixes = HotPrefixTracker()
+        # crash-safe drain state (server/recovery.py): the gateway that
+        # drains this replica also POSTs /admin/drain_hint so the replica
+        # itself remembers it is draining (and WHO drained it, operator
+        # vs autoscaler); /health carries it back, and a warm-restarting
+        # gateway restores draining flags + autoscaler drain ownership
+        # from there instead of silently re-admitting the replica
+        self.draining_hint: dict | None = None
         # serialized path's in-flight ledger (complete/_complete_once talk
         # through it; the serialized path runs under self.lock)
         self._inflight_ledger: GoodputLedger | None = None
@@ -2040,6 +2047,14 @@ class Handler(BaseHTTPRequestHandler):
             snap["block_chars"] = PAGE_CHARS
             self._json(200, json.dumps(snap).encode())
             return
+        if route == "/debug/quarantine":
+            # crash-only gateway recovery (server/recovery.py): the FULL
+            # fresh strike ledger with per-entry ages — a warm-restarting
+            # gateway re-learns strikes (and in-force 422s) from every
+            # replica, so a gateway crash never refreshes a poison body's
+            # replica-killing budget
+            self._json(200, json.dumps(self.state.quarantine.dump()).encode())
+            return
         if route == "/debug/config":
             self._json(200, json.dumps(resolved_config(self.state)).encode())
             return
@@ -2077,6 +2092,10 @@ class Handler(BaseHTTPRequestHandler):
                 "queue_depth": st.batcher.queue_depth() if st.batcher is not None else 0,
                 "supervisor": sup,
                 "quarantine": st.quarantine.snapshot(),
+                # the drain hint the draining gateway posted — the warm
+                # -restart recovery source for draining flags + autoscaler
+                # drain ownership (server/recovery.py)
+                "draining": st.draining_hint,
             }
             code = 200 if sup["state"] == "serving" else 503
             self._json(code, json.dumps(payload).encode())
@@ -2146,6 +2165,27 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/v1/prefill":
             self._serve_prefill()
+            return
+        if self.path == "/admin/drain_hint":
+            # the gateway's crash-safety hint (Balancer.set_draining):
+            # remember the drain (and its actuator) so a warm-restarting
+            # gateway reads it back from /health (server/recovery.py).
+            # Advisory only — this replica keeps serving whatever arrives;
+            # the gateway owns the actual stop-new-assignments decision.
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                hint = json.loads(self.rfile.read(length) or b"{}")
+                draining = bool(hint.get("draining"))
+                by = str(hint.get("by", "operator"))
+            except (ValueError, AttributeError):
+                self._json(400, b'{"error":"bad json"}')
+                return
+            self.state.draining_hint = (
+                {"draining": True, "by": by} if draining else None
+            )
+            self._json(200, json.dumps(
+                {"draining": self.state.draining_hint}
+            ).encode())
             return
         if self.path != "/v1/chat/completions":
             self._json(404, b'{"error":"not found"}')

@@ -43,8 +43,11 @@ import threading
 import time
 from dataclasses import dataclass
 
-#: every action ``dlt_autoscaler_decisions_total`` is labeled with
-ACTIONS = ("drain", "undrain", "hold")
+#: every action ``dlt_autoscaler_decisions_total`` is labeled with —
+#: ``follower_hold`` is the peered-gateway case (server/peering.py):
+#: exactly one gateway (the lowest live peer id) runs the control loop;
+#: the others count held ticks here so a silent leader is visible
+ACTIONS = ("drain", "undrain", "hold", "follower_hold")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -263,7 +266,7 @@ class Autoscaler:
                 if not b.draining and b.key != victim_key
             ]
         rehomed = self._warm_handoff(victim_key, remaining)
-        self.balancer.set_draining(victim_key, True)
+        self.balancer.set_draining(victim_key, True, by="autoscaler")
         with self._lock:
             self._drained_by_me.add(victim_key)
         return {"victim": victim_key, "rehomed_keys": rehomed}
@@ -275,11 +278,38 @@ class Autoscaler:
         with self._lock:
             self._drained_by_me.discard(key)
 
+    def adopt_drain(self, key: str):
+        """Take ownership of a drain this instance did NOT perform: a
+        warm-restarting gateway re-learning ``by=autoscaler`` drain hints
+        from replica /health (server/recovery.py), or a follower applying
+        a leader's drain event (server/peering.py) — either way the
+        control loop must be able to undrain it on pressure, or the
+        replica is stranded drained forever."""
+        with self._lock:
+            self._drained_by_me.add(key)
+
     def tick(self) -> dict:
         """One control-loop evaluation. Returns (and remembers) the
         decision record; never raises through the loop."""
         cfg = self.config
         now = time.monotonic()
+        # peered gateways elect exactly ONE autoscaler leader (lowest
+        # live peer id, server/peering.py): followers hold their ticks —
+        # two control loops draining independently would double-shrink
+        # the fleet, and their cooldown/low-tick state would diverge
+        peering = getattr(self.balancer, "peering", None)
+        if peering is not None and not peering.is_leader():
+            record = {
+                "action": "follower_hold",
+                "detail": f"leader={peering.leader_id()}",
+                "utilization": None, "pressure": None,
+                "live": 0, "drained": 0, "low_ticks": self._low_ticks,
+            }
+            with self._lock:
+                self.decisions["follower_hold"] += 1
+                self.ticks += 1
+                self.last = record
+            return record
         view = self._fleet_view()
         live = [(k, s) for k, d, s in view if not d]
         drained = [k for k, d, _ in view if d]

@@ -80,6 +80,29 @@ def http_get_text(host: str, port: int, path: str, timeout_s: float) -> tuple:
         conn.close()
 
 
+def http_post_json(
+    host: str, port: int, path: str, payload: dict, timeout_s: float
+) -> tuple:
+    """One bounded JSON POST round trip: ``(status, body_text)``. The
+    gateway-to-gateway peer sync and the replica drain-hint notification
+    both ride this — same transport discipline as the scraper: raises
+    OSError family on failure, callers isolate."""
+    body = json.dumps(payload).encode()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request(
+            "POST", path, body=body,
+            headers={
+                "Content-Type": "application/json",
+                "Connection": "close",
+            },
+        )
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", errors="replace")
+    finally:
+        conn.close()
+
+
 # -- Prometheus text parsing -------------------------------------------------
 
 

@@ -177,6 +177,24 @@ class GatewayConfig:
     # decisions are opt-in); > 0 attaches the control loop that drains /
     # undrains replicas on fleet goodput headroom with warm handoff.
     autoscale_s: float | None = None
+    # active-active gateway peering (server/peering.py): addresses of the
+    # OTHER gateways serving this fleet (--peer-gateway, repeatable; full
+    # mesh — events are not relayed). Empty/None = solo gateway.
+    peer_gateways: list | None = None
+    # gossip tick cadence; None resolves DLT_GW_PEER_SYNC_S (default 2 s);
+    # <= 0 attaches peering (the /gateway/peer/sync endpoint answers, the
+    # receive path applies) without the background push thread
+    peer_sync_s: float | None = None
+    # this gateway's identity for LWW origins + leader election; None
+    # resolves to "<hostname>:<port>" at serve time (stable across a
+    # same-box restart — a restarted gateway re-enters the live set under
+    # its old id instead of minting a zombie elector)
+    gateway_id: str | None = None
+    # crash-only warm restart (server/recovery.py): rebuild the locality
+    # map / quarantine ledger / drain state from the fleet before taking
+    # traffic. None resolves DLT_GW_RECOVER (default on); everything is
+    # best-effort — a fleet that answers nothing yields a cold start.
+    recover_on_start: bool | None = None
 
     def __post_init__(self):
         if self.health_retry_ms is not None:
@@ -206,6 +224,14 @@ class Balancer:
         # attached by run() when autoscale_s > 0 — or directly by tests.
         # None = no capacity control loop (the default).
         self.autoscaler = None
+        # active-active peering (server/peering.py GatewayPeering):
+        # attached by GatewayServer when peer_gateways is non-empty.
+        # None = solo gateway (no gossip, no leader gating).
+        self.peering = None
+        # warm-restart recovery record (server/recovery.py): set once at
+        # startup when recovery ran; rendered as dlt_gateway_recovery_*
+        # and the /gateway/fleet "recovery" section.
+        self.recovery = None
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.rr_cursor = 0
@@ -237,6 +263,10 @@ class Balancer:
             "bad_gateway_502": 0,
             "quarantined_422": 0,   # poison fingerprints refused terminally
             "poison_strikes": 0,    # implication events the ledger recorded
+            # transport deaths NOT struck because the fleet already knew
+            # the backend was sick (breaker open, stale scrape, draining)
+            # — the correlated-death false-positive the discount removes
+            "poison_strikes_discounted": 0,
             "deadline_504": 0,      # requests whose deadline died in-house
         }
 
@@ -500,19 +530,49 @@ class Balancer:
                 return i
         return -1
 
-    def set_draining(self, key: str, draining: bool) -> bool:
+    def set_draining(self, key: str, draining: bool, by: str = "operator",
+                     record: bool = True, notify: bool = True) -> bool:
+        """Flip one backend's draining flag. ``by`` tags the actuator
+        (operator endpoint vs autoscaler — the tag rides the replica's
+        drain hint and the peering event, so a restarted gateway restores
+        the right ownership). ``record=False`` suppresses the peering
+        event (applying a PEER's event must not re-broadcast it);
+        ``notify=False`` suppresses the replica drain-hint POST (recovery
+        just READ the hint it would be posting)."""
         with self.cond:
             idx = self._find(key)
             if idx < 0:
                 return False
-            self.config.backends[idx].draining = draining
+            b = self.config.backends[idx]
+            changed = b.draining != draining
+            b.draining = draining
             remaining = [
                 b.key for b in self.config.backends
                 if not b.draining and b.key != key
             ]
             router = self.router
             autoscaler = self.autoscaler
+            peering = self.peering
             self.cond.notify_all()
+        if changed and record and peering is not None:
+            peering.note_drain(key, draining, by)
+        if changed and notify and self.fleet is not None:
+            # crash-safety hint (server/recovery.py): the replica itself
+            # remembers it is draining (and WHO drained it), so a gateway
+            # restart reads the drain back from /health instead of
+            # silently re-admitting a half-drained replica. Best-effort +
+            # off-thread: a replica that cannot answer still drains here.
+            # Fleet-blind gateways (no scraper) skip the hint — only the
+            # fleet-aware recovery sweep would ever read it back, and the
+            # extra POST would perturb scraping-off harnesses.
+            host, port = self.config.backends[idx].host, \
+                self.config.backends[idx].port
+            threading.Thread(
+                target=_notify_drain_hint,
+                args=(host, port, draining, by,
+                      self.config.probe_timeout_s),
+                daemon=True, name="gateway-drain-hint",
+            ).start()
         if draining and router is not None:
             # locality hygiene (server/router.py): learned chain keys must
             # not keep naming a home acquire() will never hand out again —
@@ -619,6 +679,50 @@ def probe_health(host: str, port: int, timeout_s: float, path: str = "/health") 
             return len(parts) >= 2 and parts[0].startswith(b"HTTP/") and parts[1] == b"200"
     except OSError:
         return False
+
+
+def _notify_drain_hint(host: str, port: int, draining: bool, by: str,
+                       timeout_s: float):
+    """Best-effort ``POST /admin/drain_hint`` to a replica: the replica
+    carries its own drain state (surfaced on ``/health``) so a warm
+    -restarting gateway re-learns drains from the fleet instead of
+    silently re-admitting a half-drained replica (server/recovery.py)."""
+    from .fleet import http_post_json
+
+    try:
+        http_post_json(
+            host, port, "/admin/drain_hint",
+            {"draining": draining, "by": by}, timeout_s,
+        )
+    except Exception:
+        pass  # dlt: allow(swallowed-exception) — the hint is advisory
+        # redundancy for crash recovery; the drain itself already landed
+        # on the gateway and (when peered) gossiped to every peer
+
+
+def _strike_discount_reason(balancer: Balancer, idx: int) -> str | None:
+    """Was this backend ALREADY known-sick when an attempt died on it?
+    Returns the discount reason (or None = the death is honest strike
+    evidence). A transport death on a backend the fleet had marked
+    unhealthy — breaker not closed, fleet-table row stale, or draining
+    (autoscaler/operator rolling restart) — implicates the BACKEND, not
+    the request: striking it is how two correlated replica deaths used
+    to terminally 422 an innocent conversation (the PR 14 documented
+    trade-off, now closed). Checked at FAILURE time, not acquire time:
+    the drain/open that matters is the one that landed while the request
+    was in flight."""
+    b = balancer.config.backends[idx]
+    with balancer.lock:
+        if b.draining:
+            return "draining"
+        if b.breaker != BREAKER_CLOSED:
+            return "breaker"
+    fleet = balancer.fleet
+    if fleet is not None:
+        row = fleet.router_signals().get(b.key)
+        if row is not None and row.get("stale"):
+            return "stale_scrape"
+    return None
 
 
 def _read_http_request(sock: socket.socket) -> bytes | None:
@@ -786,17 +890,50 @@ def render_gateway_metrics(balancer: Balancer) -> str:
             lines.append(prom_line(name, None, h.get(col, 0)))
     if balancer.autoscaler is not None:
         lines.extend(balancer.autoscaler.metrics_lines())
+    if balancer.peering is not None:
+        # dlt_gw_peer_* (server/peering.py): sync outcomes, applied
+        # events by kind, per-peer liveness, leadership
+        lines.extend(balancer.peering.metrics_lines())
+    if balancer.recovery is not None:
+        # dlt_gateway_recovery_* (server/recovery.py): what the warm
+        # restart re-learned from the fleet
+        from .recovery import recovery_metrics_lines
+
+        lines.extend(recovery_metrics_lines(balancer.recovery))
     if balancer.fleet is not None:
         lines.extend(balancer.fleet.federated_lines())
     return "\n".join(lines) + "\n"
 
 
-def _handle_control(client: socket.socket, balancer: Balancer, method: str, path: str):
+def _handle_control(client: socket.socket, balancer: Balancer, method: str,
+                    path: str, request: bytes = b""):
     """The gateway's own control + observability endpoints (never proxied;
     scrape backends' /metrics directly for engine-side numbers)."""
     route, _, query = path.partition("?")
     if route == "/gateway/stats" and method == "GET":
         _plain_response(client, 200, "OK", json.dumps(balancer.stats()))
+        return
+    if route == "/gateway/peer/sync" and method == "POST":
+        # the peering receive path (server/peering.py): a peer gateway's
+        # bounded delta — locality learns, strikes, drain events — applied
+        # with LWW on monotonic event ids; the ack carries our id + clock
+        # (the liveness signal leader election runs on)
+        if balancer.peering is None:
+            _plain_response(
+                client, 404, "Not Found",
+                '{"error":"peering not configured on this gateway"}',
+            )
+            return
+        try:
+            payload = json.loads(request.partition(b"\r\n\r\n")[2])
+            if not isinstance(payload, dict):
+                raise ValueError("not an object")
+        except ValueError:
+            _plain_response(client, 400, "Bad Request", '{"error":"bad json"}')
+            return
+        _plain_response(
+            client, 200, "OK", json.dumps(balancer.peering.apply(payload))
+        )
         return
     if route == "/gateway/fleet" and method == "GET":
         # per-replica signal table (server/fleet.py): routing signals +
@@ -816,6 +953,11 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
                         None if balancer.autoscaler is None
                         else balancer.autoscaler.snapshot()
                     ),
+                    "peering": (
+                        None if balancer.peering is None
+                        else balancer.peering.snapshot()
+                    ),
+                    "recovery": balancer.recovery,
                 }),
             )
             return
@@ -832,6 +974,13 @@ def _handle_control(client: socket.socket, balancer: Balancer, method: str, path
             None if balancer.autoscaler is None
             else balancer.autoscaler.snapshot()
         )
+        # peering view (server/peering.py): self/leader ids, live peers,
+        # clock, pending deltas — and the warm-restart recovery record
+        payload["peering"] = (
+            None if balancer.peering is None
+            else balancer.peering.snapshot()
+        )
+        payload["recovery"] = balancer.recovery
         _plain_response(client, 200, "OK", json.dumps(payload))
         return
     if route == "/debug/config" and method == "GET":
@@ -1000,7 +1149,7 @@ def handle_client(client: socket.socket, balancer: Balancer):
         if route.startswith("/gateway/") or route == "/metrics" or route in (
             "/debug/trace", "/debug/flightrecord", "/debug/config"
         ):
-            _handle_control(client, balancer, method, path)
+            _handle_control(client, balancer, method, path, request)
             return
         # request-lifecycle trace: adopt the client's X-DLT-Trace-Id or
         # mint one; the SAME id rides every retried attempt (injected into
@@ -1174,6 +1323,17 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 (b.key, attempt, int(failed), int(forwarded)),
                 always=failed,  # failed attempts land even when unsampled
             )
+            # snapshot the discount BEFORE release() records this very
+            # failure: release(mark_unhealthy=True) can be the increment
+            # that flips the breaker OPEN, and a backend that was
+            # assignable when the attempt was made must not discount its
+            # own death's strike (drains/opens that landed mid-flight
+            # from OTHER causes are still visible here)
+            discount = (
+                _strike_discount_reason(balancer, idx)
+                if fp is not None and failed and sent and poison_fp is None
+                else None
+            )
             balancer.release(idx, mark_unhealthy=failed)
             held = -1
             if fp is not None and (
@@ -1187,9 +1347,27 @@ def handle_client(client: socket.socket, balancer: Balancer):
                 # strikes — the request never reached a replica, and two
                 # briefly-down backends must not terminally 422 an
                 # innocent conversation. Nor does a plain 503: landing on
-                # an overloaded replica is not the request's fault.
-                balancer.quarantine.strike(fp)
-                balancer.count("poison_strikes")
+                # an overloaded replica is not the request's fault. And a
+                # transport death on a backend the fleet ALREADY marked
+                # unhealthy (breaker open, stale scrape, draining) is
+                # discounted — a rolling drain's correlated deaths
+                # implicate the backend, not the request; a replica
+                # NAMING the fp (poison_fp) is first-hand evidence and
+                # always strikes.
+                if discount is None:
+                    balancer.quarantine.strike(fp)
+                    balancer.count("poison_strikes")
+                    if balancer.peering is not None:
+                        # fleet-wide strike budget: peers learn this
+                        # implication on the next gossip tick
+                        balancer.peering.note_strike(fp)
+                else:
+                    balancer.count("poison_strikes_discounted")
+                    tr.event(  # dlt: allow(trace-hot-emit)
+                        "gw_strike_discounted", now_us(), 0,
+                        ("backend", "reason"), (b.key, discount),
+                        always=True,
+                    )
             if client_gone:
                 outcome = "client_gone"
                 return
@@ -1201,6 +1379,10 @@ def handle_client(client: socket.socket, balancer: Balancer):
                     # prefix's learned home (a zero-byte-failed attempt
                     # must never teach the locality map a dead backend)
                     router.learn(plan, b.key)
+                    if plan is not None and balancer.peering is not None:
+                        # peers learn the same affinity on the next
+                        # gossip tick (LWW-versioned, server/peering.py)
+                        balancer.peering.note_locality(plan.chain, b.key)
                 return
             if forwarded:
                 # mid-stream failure: appending a second status line to a
@@ -1265,56 +1447,205 @@ def serve(port: int, balancer: Balancer) -> socket.socket:
     return srv
 
 
-def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
-    from .fleet import FleetScraper
-    from .router import Router
+class GatewayServer:
+    """The gateway's crash-only lifecycle: ONE object owns the listening
+    socket and every background thread (fleet scraper, autoscaler, health
+    prober, peer sync), so a restart is build-new-instance, not
+    hunt-down-orphans. ``start()`` binds the port FIRST (failover clients
+    connecting mid-restart queue in the listen backlog instead of being
+    refused), runs the warm-restart recovery sweep (server/recovery.py),
+    then starts the threads and the accept loop; ``shutdown()`` /
+    ``server_close()`` stop EVERYTHING they started — the http.server
+    naming contract, so harnesses tear a gateway down exactly like a
+    replica server, and in-process restart tests can instantiate the
+    gateway twice without the first instance's threads scraping on."""
 
-    # cache-aware routing (server/router.py): ON by default (DLT_ROUTER /
-    # --router least_inflight keeps the legacy selection); None means every
-    # routing call below is skipped, not a null-check on the hot path
-    if balancer.router is None:
-        balancer.router = Router.build(balancer.config.router_policy)
-    srv = serve(port, balancer)
-    srv.settimeout(0.5)
-    stop = stop_event if stop_event is not None else threading.Event()
-    prober = None
-    if balancer.config.probe_interval_s > 0:
-        prober = HealthProber(balancer, stop)
-        prober.start()
-    # fleet signal plane: per-replica /metrics + /stats scraper feeding
-    # /gateway/fleet and the federated /metrics rollup (server/fleet.py).
-    # Interval resolves config -> DLT_FLEET_SCRAPE_S -> 2 s; <= 0 disables.
-    scraper = FleetScraper(
-        balancer,
-        interval_s=balancer.config.fleet_scrape_s,
-        timeout_s=balancer.config.fleet_timeout_s,
-    )
-    if scraper.interval_s > 0:
-        balancer.fleet = scraper.start()
-    # goodput-driven autoscaler (server/autoscaler.py): OFF unless the
-    # operator asked (--autoscale-s / DLT_AUTOSCALE_S > 0) — capacity
-    # decisions must be opt-in. It watches the fleet table the scraper
-    # above maintains and drains/undrains via the same set_draining path
-    # the POST /gateway/drain endpoints use, with warm prefix handoff.
-    from .autoscaler import Autoscaler
+    def __init__(self, port: int, balancer: Balancer):
+        self.port = port
+        self.balancer = balancer
+        self._stop = threading.Event()
+        self._srv: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._scraper = None
+        self._autoscaler = None
+        self._peering = None
+        self._prober = None
+        self._closed = False
+        # live client connections, for kill(): handler threads are
+        # daemonic and outlive server_close(), so a crash-shaped teardown
+        # must sever their sockets explicitly
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
-    autoscaler = Autoscaler(balancer, interval_s=balancer.config.autoscale_s)
-    if autoscaler.interval_s > 0:
-        balancer.autoscaler = autoscaler.start()
-    print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
-    try:
-        while not stop.is_set():
+    def start(self) -> "GatewayServer":
+        from .autoscaler import Autoscaler
+        from .fleet import FleetScraper
+        from .peering import GatewayPeering
+        from .recovery import recover_gateway
+        from .router import Router
+
+        bal = self.balancer
+        cfg = bal.config
+        # cache-aware routing (server/router.py): ON by default (DLT_ROUTER
+        # / --router least_inflight keeps the legacy selection); None means
+        # every routing call is skipped, not a null-check on the hot path
+        if bal.router is None:
+            bal.router = Router.build(cfg.router_policy)
+        # bind BEFORE recovery: clients failing over to this address while
+        # recovery runs queue in the listen backlog for its (bounded) wall
+        # instead of getting connection-refused
+        self._srv = serve(self.port, bal)
+        self._srv.settimeout(0.5)
+        # fleet signal plane: ATTACHED before recovery (the synchronous
+        # scrape prime needs it), thread started after. A harness that
+        # pre-attached its own scraper keeps it (manual-drive tests).
+        if bal.fleet is None:
+            scraper = FleetScraper(
+                bal, interval_s=cfg.fleet_scrape_s,
+                timeout_s=cfg.fleet_timeout_s,
+            )
+            if scraper.interval_s > 0:
+                self._scraper = scraper
+                bal.fleet = scraper
+        # goodput-driven autoscaler: OFF unless asked (--autoscale-s /
+        # DLT_AUTOSCALE_S > 0) — capacity decisions must be opt-in
+        if bal.autoscaler is None:
+            autoscaler = Autoscaler(bal, interval_s=cfg.autoscale_s)
+            if autoscaler.interval_s > 0:
+                self._autoscaler = autoscaler
+                bal.autoscaler = autoscaler
+        # active-active peering (server/peering.py): attached whenever
+        # peers are configured (the receive path must answer even when the
+        # push thread is disabled for manual-tick tests)
+        if bal.peering is None and cfg.peer_gateways:
+            self_id = cfg.gateway_id or f"{socket.gethostname()}:{self.port}"
+            self._peering = GatewayPeering(
+                bal, self_id=self_id, peers=list(cfg.peer_gateways),
+                interval_s=cfg.peer_sync_s,
+            )
+            bal.peering = self._peering
+        # crash-only warm restart (server/recovery.py): rebuild the
+        # control-plane state from the fleet BEFORE taking traffic.
+        # Default: recover whenever this gateway is fleet-aware (a scraper
+        # is attached — it reads the same surfaces recovery does); a
+        # fleet-blind gateway (every scraping-off test harness) starts
+        # cold exactly as before. DLT_GW_RECOVER=0/1 overrides either way.
+        recover = cfg.recover_on_start
+        if recover is None:
+            env = os.environ.get("DLT_GW_RECOVER")
+            recover = (
+                env not in ("0", "") if env is not None
+                else bal.fleet is not None
+            )
+        if recover:
+            bal.recovery = recover_gateway(bal)
+        # threads start only now: a scraper racing the recovery sweep
+        # would double-prime rate baselines mid-merge
+        if self._scraper is not None:
+            self._scraper.start()
+        if self._autoscaler is not None:
+            self._autoscaler.start()
+        if self._peering is not None and self._peering.interval_s > 0:
+            self._peering.start()
+        if cfg.probe_interval_s > 0:
+            self._prober = HealthProber(bal, self._stop)
+            self._prober.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="gateway-accept"
+        )
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
             try:
-                client, _ = srv.accept()
+                client, _ = self._srv.accept()
             except socket.timeout:
                 continue
-            threading.Thread(target=handle_client, args=(client, balancer), daemon=True).start()
+            except OSError:
+                return  # socket closed under us (server_close)
+            with self._conns_lock:
+                self._conns.add(client)
+            threading.Thread(
+                target=self._handle_tracked, args=(client,),
+                daemon=True,
+            ).start()
+
+    def _handle_tracked(self, client: socket.socket):
+        try:
+            handle_client(client, self.balancer)
+        except OSError:
+            pass  # dlt: allow(swallowed-exception) — the connection was
+            # severed under the handler (client reset, or kill() aborting
+            # in-flight streams); there is no socket left to answer on
+        finally:
+            with self._conns_lock:
+                self._conns.discard(client)
+
+    def shutdown(self):
+        """Stop accepting AND stop every gateway-owned thread — the
+        restart tests instantiate a second gateway in-process, and a
+        leaked scraper/autoscaler/peer-sync thread from the first would
+        keep actuating against the same fleet (the sentinel-release leak
+        class, thread edition — scripts/dlt_lint.py `thread-release`)."""
+        self._stop.set()
+        if self._peering is not None:
+            self._peering.stop()
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
+        if self._scraper is not None:
+            self._scraper.stop()
+        # the prober shares self._stop; join it so no probe lands after
+        # shutdown() returns
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def server_close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.shutdown()
+        if self._srv is not None:
+            self._srv.close()
+
+    # operator ergonomics: one call tears everything down
+    close = server_close
+
+    def kill(self):
+        """Crash-shaped teardown (chaos harnesses): ``server_close()``
+        PLUS a hard abort of every in-flight proxied connection. A real
+        gateway crash severs mid-stream bytes; the graceful close alone
+        lets the daemonic handler threads finish their streams, which is
+        a strictly softer fault than the one warm-restart recovery
+        exists for."""
+        self.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def run(port: int, balancer: Balancer, stop_event: threading.Event | None = None):
+    """Blocking entry point (the CLI + test harnesses): builds a
+    :class:`GatewayServer`, serves until ``stop_event`` is set, and tears
+    every gateway-owned thread down on the way out."""
+    server = GatewayServer(port, balancer).start()
+    print(f"⚖️ Gateway listening on {port} -> {len(balancer.config.backends)} backends")
+    stop = stop_event if stop_event is not None else threading.Event()
+    try:
+        while not stop.wait(0.2):
+            pass
     finally:
-        if balancer.autoscaler is not None:
-            balancer.autoscaler.stop()
-        if balancer.fleet is not None:
-            balancer.fleet.stop()
-        srv.close()
+        server.server_close()
 
 
 def parse_backend(s: str) -> Backend:
@@ -1365,6 +1696,23 @@ def main(argv=None) -> int:
                    "the same request fingerprint stop being retried and "
                    "422 terminally past this count (default: "
                    "DLT_QUARANTINE_STRIKES or 2; <=0 disables)")
+    p.add_argument("--peer-gateway", action="append", default=None,
+                   help="host:port of ANOTHER gateway serving this fleet "
+                   "(repeatable; configure a full mesh). Peered gateways "
+                   "gossip locality learns, quarantine strikes, and "
+                   "drain events (server/peering.py) and elect one "
+                   "autoscaler leader (lowest live id)")
+    p.add_argument("--peer-sync-s", type=float, default=None,
+                   help="peer gossip tick interval (default: "
+                   "DLT_GW_PEER_SYNC_S or 2.0)")
+    p.add_argument("--gateway-id", default=None,
+                   help="this gateway's identity for peering LWW origins "
+                   "and leader election (default: <hostname>:<port>)")
+    p.add_argument("--no-recover", action="store_true",
+                   help="skip the warm-restart recovery sweep "
+                   "(server/recovery.py): start with a cold control "
+                   "plane instead of rebuilding locality/quarantine/"
+                   "drain state from the fleet")
     args = p.parse_args(argv)
     config = GatewayConfig(
         backends=[parse_backend(b) for b in args.backend],
@@ -1383,6 +1731,10 @@ def main(argv=None) -> int:
         router_policy=args.router,
         autoscale_s=args.autoscale_s,
         quarantine_strikes=args.quarantine_strikes,
+        peer_gateways=args.peer_gateway,
+        peer_sync_s=args.peer_sync_s,
+        gateway_id=args.gateway_id,
+        recover_on_start=False if args.no_recover else None,
     )
     run(args.port, Balancer(config))
     return 0

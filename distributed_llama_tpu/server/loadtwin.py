@@ -46,7 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..runtime.tracing import prom_line as _prom  # stdlib-only: one
 # Prometheus line formatter (escaping included) for the whole serving
 # layer — the twin must emit exactly what the scraper parses
-from .quarantine import request_fingerprint
+from .quarantine import QuarantineLedger, request_fingerprint
 from .router import PAGE_CHARS, messages_prefix_text, prefix_chain
 from .scheduler import (
     ClassQueues,
@@ -82,6 +82,12 @@ class StubReplicaConfig:
     # chat 503) — the engine-wedged failure mode the quarantine exists for
     poison_fps: frozenset = frozenset()
     poison_recover_s: float = 0.3
+    # the stub's OWN strike-ledger limit (the real replica builds its
+    # ledger from DLT_QUARANTINE_STRIKES; the twin pins it so gateway
+    # -restart recovery tests control both tiers): the ledger records
+    # poison incidents and serves /debug/quarantine — the gateway's
+    # warm-restart recovery source (server/recovery.py)
+    quarantine_limit: int = 2
 
 
 class _Ticket:
@@ -197,6 +203,12 @@ class _StubState:
             "poison_hits": 0, "supervisor_rebuilds": 0,
         }
         self.recovering_until = 0.0  # monotonic; > now = twin-recovering
+        # hard-kill flag (StubEngineReplica.stop): active streams abort
+        # their connection at the next token boundary — the wire shape of
+        # a replica dying with requests in flight (midstream EOF at the
+        # gateway), which shutdown() alone does not produce (handler
+        # threads outlive the listening socket)
+        self.dying = False
         self.scheduler = SloScheduler()
         self.gate = _SlotGate(cfg, self.scheduler)
         self.hot_prefixes = HotPrefixTracker()
@@ -205,7 +217,14 @@ class _StubState:
         self.delivered: dict = {c: 0 for c in SLO_CLASSES}
         self._window: deque = deque()      # (t, n, class), 60 s trim
         self.ttft_ms: dict = {c: deque(maxlen=256) for c in SLO_CLASSES}
-        self.draining_hint = False         # set via ?twin drain helpers
+        # crash-safe drain hint (POST /admin/drain_hint, the real
+        # replica's contract): the draining gateway parks its drain state
+        # here; /health carries it back and a warm-restarting gateway
+        # restores draining flags + autoscaler ownership from it
+        self.draining_hint: dict | None = None
+        # the stub's own strike ledger — /debug/quarantine is the
+        # gateway's warm-restart recovery source (server/recovery.py)
+        self.quarantine = QuarantineLedger(limit=cfg.quarantine_limit)
 
     def incr(self, name: str, n: int = 1):
         with self.lock:
@@ -300,6 +319,14 @@ def _render_stub_metrics(st: _StubState) -> str:
     return "\n".join(lines) + "\n"
 
 
+def parse_qs_n(path: str, default: int = 64) -> int:
+    """``?n=`` of a request path (ValueError on garbage, like int())."""
+    for part in path.partition("?")[2].split("&"):
+        if part.startswith("n="):
+            return int(part[2:])
+    return default
+
+
 class StubEngineReplica:
     """One stub replica: start() binds an ephemeral port; the server runs
     a daemon thread per connection (ThreadingHTTPServer) like the real
@@ -366,9 +393,19 @@ class StubEngineReplica:
                     }
                     self._send(200, json.dumps(payload).encode())
                 elif route == "/debug/hot_prefixes":
-                    snap = st.hot_prefixes.snapshot()
+                    # recovery asks for more than the handoff default —
+                    # honor ?n= like the real replica does
+                    try:
+                        n = int(parse_qs_n(self.path))
+                    except ValueError:
+                        n = 64
+                    snap = st.hot_prefixes.snapshot(top_n=max(1, n))
                     snap["block_chars"] = PAGE_CHARS
                     self._send(200, json.dumps(snap).encode())
+                elif route == "/debug/quarantine":
+                    # the gateway's warm-restart recovery source: the
+                    # full fresh ledger with ages (server/recovery.py)
+                    self._send(200, json.dumps(st.quarantine.dump()).encode())
                 elif route == "/debug/config":
                     self._send(200, json.dumps({
                         "model": f"stub-{st.name}",
@@ -377,12 +414,31 @@ class StubEngineReplica:
                 else:  # /health and anything else health-shaped
                     with st.lock:
                         counters = dict(st.counters)
+                        hint = st.draining_hint
                     self._send(200, json.dumps({
                         "status": "ok", "counters": counters,
                         "queue_depth": st.gate.depth(),
+                        "draining": hint,
                     }).encode())
 
             def do_POST(self):
+                if self.path.partition("?")[0] == "/admin/drain_hint":
+                    # the real replica's crash-safety contract: remember
+                    # the drain (and its actuator) for /health readback
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        hint = json.loads(self.rfile.read(length) or b"{}")
+                        draining = bool(hint.get("draining"))
+                        by = str(hint.get("by", "operator"))
+                    except ValueError:
+                        self._send(400, b'{"error":"bad json"}')
+                        return
+                    with st.lock:
+                        st.draining_hint = (
+                            {"draining": True, "by": by} if draining else None
+                        )
+                    self._send(200, b'{"ok": true}')
+                    return
                 if self.path.partition("?")[0] != "/v1/chat/completions":
                     self._send(404, b'{"error":"not found"}')
                     return
@@ -413,6 +469,11 @@ class StubEngineReplica:
                     st.incr("poison_hits")
                     st.incr("supervisor_rebuilds")
                     st.add_waste("quarantined", klass, prompt_tokens)
+                    # the replica-side strike ledger survives the
+                    # simulated rebuild (the real supervisor carries it
+                    # over) — /debug/quarantine serves it to recovering
+                    # gateways
+                    st.quarantine.strike(fp)
                     with st.lock:
                         st.recovering_until = (
                             time.monotonic() + st.cfg.poison_recover_s
@@ -483,6 +544,21 @@ class StubEngineReplica:
                     st.incr("prefix_hit_tokens", hit_tokens)
                 cold = prompt_tokens - hit_tokens
                 time.sleep(cold * st.cfg.prefill_ms_per_token / 1000.0)
+                if st.dying:
+                    # hard-killed DURING prefill: die byte-less — the
+                    # zero-byte failure shape the gateway's strike
+                    # heuristic sees when a replica crashes holding a
+                    # request (the correlated-death false-positive class
+                    # the strike discount exists for)
+                    import socket as _socket
+
+                    st.add_waste("killed", klass, max(cold, 1))
+                    try:
+                        self.connection.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return
                 with st.lock:  # publish: the whole chain is warm now
                     st.warm_chains.update(chain)
                 # SSE decode: one chunk per simulated token
@@ -499,6 +575,19 @@ class StubEngineReplica:
                             st.ttft_ms[klass].append(
                                 (time.perf_counter() - t0) * 1e3
                             )
+                        if st.dying:
+                            # the replica was hard-killed mid-stream:
+                            # abort the connection (the gateway sees a
+                            # midstream EOF; the client a truncated
+                            # stream it retries elsewhere)
+                            import socket as _socket
+
+                            try:
+                                self.connection.shutdown(_socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                            outcome = "killed"
+                            break
                         if ticket.preempt.is_set():
                             # preemption mid-stream: the only honest wire
                             # signal is a truncated stream (no [DONE]) —
@@ -539,6 +628,7 @@ class StubEngineReplica:
         return self
 
     def stop(self):
+        self.state.dying = True  # active streams abort at the next token
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -552,6 +642,7 @@ class StubEngineReplica:
         st = self.state
         with st.lock:
             st.warm_chains.clear()
+        st.dying = False
         st.incr("supervisor_rebuilds")
         self._httpd = ThreadingHTTPServer(
             ("127.0.0.1", self.port), self._handler_cls
@@ -686,12 +777,99 @@ class TwinResult:
     outcome: str = "error"  # ok | shed | abandoned | preempted | error
     retries: int = 0
     error: str = ""
+    gateway_failovers: int = 0  # addresses skipped before one answered
+
+
+class TwinGateway:
+    """One REAL gateway stack (Balancer + router + fleet scraper +
+    optional autoscaler + optional peering) over the twin's stub fleet —
+    built through :class:`~.gateway.GatewayServer`, so the twin's gateway
+    lifecycle IS the production lifecycle (restart = new instance,
+    teardown stops every gateway-owned thread)."""
+
+    def __init__(self, twin: "LoadTwin", index: int, port: int,
+                 recover: bool = False):
+        from .fleet import FleetScraper
+        from .gateway import Backend, Balancer, GatewayConfig, GatewayServer
+
+        self.index = index
+        self.port = port
+        peers = [
+            f"127.0.0.1:{p}" for j, p in enumerate(twin.gateway_ports)
+            if j != index
+        ]
+        self.cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", r.port) for r in twin.replicas],
+            # capacity lives in the replicas' slot gates: the gateway's
+            # per-backend inflight cap must not serialize the twin ahead
+            # of the scheduler under test
+            max_inflight_per_backend=twin.max_inflight_per_backend,
+            queue_size=256, queue_timeout_s=30.0,
+            probe_interval_s=0, fleet_scrape_s=0,  # scraper attached below
+            router_policy=twin.router_policy,
+            autoscale_s=0,  # autoscaler built (and ticked) explicitly
+            quarantine_strikes=twin.quarantine_strikes,
+            retry_attempts=twin.retry_attempts,
+            breaker_failure_threshold=twin.breaker_failure_threshold,
+            peer_gateways=peers or None,
+            peer_sync_s=twin.peer_sync_s,
+            # deterministic election: gw00 < gw01 < ... — the twin's
+            # leader is always the lowest-index LIVE gateway
+            gateway_id=f"gw{index:02d}",
+            recover_on_start=recover,
+        )
+        self.balancer = Balancer(self.cfg)
+        self.scraper = FleetScraper(
+            self.balancer, interval_s=max(twin.fleet_scrape_s, 0.05),
+            timeout_s=1.0,
+        )
+        self.balancer.fleet = self.scraper
+        # autoscaler semantics mirror the real gateway: None = absent,
+        # 0 = built and attached but manually driven (tick()/drain() —
+        # the chaos tests' mode), > 0 = background loop
+        self.autoscaler = None
+        if twin.autoscale_s is not None:
+            from .autoscaler import Autoscaler, AutoscalerConfig
+
+            self.autoscaler = Autoscaler(
+                self.balancer,
+                config=AutoscalerConfig(
+                    interval_s=twin.autoscale_s, cooldown_s=0.0, down_after=2,
+                ),
+            )
+            self.balancer.autoscaler = self.autoscaler
+        self.server = GatewayServer(port, self.balancer).start()
+        if twin.fleet_scrape_s > 0:
+            self.scraper.start()
+        if twin.autoscale_s is not None and twin.autoscale_s > 0:
+            self.autoscaler.start()
+        _wait_listening(port)
+
+    def close(self):
+        # GatewayServer stops the threads IT started; the twin attaches
+        # its own scraper/autoscaler, so it stops them too
+        self.server.server_close()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.scraper.stop()
+
+    def kill(self):
+        """Crash-shaped close: also severs every in-flight proxied
+        stream (GatewayServer.kill), the wire shape of a real gateway
+        process death."""
+        self.server.kill()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.scraper.stop()
 
 
 class LoadTwin:
-    """N stub replicas behind a REAL gateway stack. ``classes_enabled=
-    False`` strips every request to `standard` — the no-class baseline arm
-    the bench leg compares against."""
+    """N stub replicas behind one or more REAL gateway stacks.
+    ``classes_enabled=False`` strips every request to `standard` — the
+    no-class baseline arm the bench leg compares against.
+    ``n_gateways>1`` builds an ACTIVE-ACTIVE pair/mesh (peered via
+    server/peering.py); twin clients spread across the gateways and fail
+    over between addresses like production clients."""
 
     def __init__(
         self,
@@ -704,61 +882,57 @@ class LoadTwin:
         max_inflight_per_backend: int = 64,
         quarantine_strikes: int | None = None,
         retry_attempts: int = 2,
+        n_gateways: int = 1,
+        peer_sync_s: float | None = None,
+        breaker_failure_threshold: int = 3,
     ):
-        from . import gateway as gw_mod
-        from .fleet import FleetScraper
-        from .gateway import Backend, Balancer, GatewayConfig
-
         self.classes_enabled = classes_enabled
+        self.router_policy = router_policy
+        self.fleet_scrape_s = fleet_scrape_s
+        self.autoscale_s = autoscale_s
+        self.max_inflight_per_backend = max_inflight_per_backend
+        self.quarantine_strikes = quarantine_strikes
+        self.retry_attempts = retry_attempts
+        self.breaker_failure_threshold = breaker_failure_threshold
+        # peer gossip cadence for multi-gateway twins: default one tenth
+        # of a second (CI-cheap); pass 0 to attach peering without the
+        # push thread (tests drive sync_round() explicitly)
+        self.peer_sync_s = (
+            peer_sync_s if peer_sync_s is not None
+            else (0.1 if n_gateways > 1 else None)
+        )
         self.replicas = [
             StubEngineReplica(replica_cfg, name=str(i)).start()
             for i in range(n_replicas)
         ]
-        self.cfg = GatewayConfig(
-            backends=[Backend("127.0.0.1", r.port) for r in self.replicas],
-            # capacity lives in the replicas' slot gates: the gateway's
-            # per-backend inflight cap must not serialize the twin ahead
-            # of the scheduler under test
-            max_inflight_per_backend=max_inflight_per_backend,
-            queue_size=256, queue_timeout_s=30.0,
-            probe_interval_s=0, fleet_scrape_s=0,  # scraper driven below
-            router_policy=router_policy,
-            autoscale_s=0,  # autoscaler built (and ticked) explicitly
-            quarantine_strikes=quarantine_strikes,
-            retry_attempts=retry_attempts,
-        )
-        self.balancer = Balancer(self.cfg)
-        self.scraper = FleetScraper(
-            self.balancer, interval_s=max(fleet_scrape_s, 0.05),
-            timeout_s=1.0,
-        )
-        self.balancer.fleet = self.scraper
-        if fleet_scrape_s > 0:
-            self.scraper.start()
-        # autoscaler semantics mirror the real gateway: None = absent
-        # (run() attaches none by default), 0 = built and attached but
-        # manually driven (tick()/drain() — the chaos tests' mode),
-        # > 0 = background loop
-        self.autoscaler = None
-        if autoscale_s is not None:
-            from .autoscaler import Autoscaler, AutoscalerConfig
+        self.gateway_ports = [_free_port() for _ in range(max(n_gateways, 1))]
+        self.gateways = [
+            TwinGateway(self, i, p)
+            for i, p in enumerate(self.gateway_ports)
+        ]
+        self._rr = 0
 
-            self.autoscaler = Autoscaler(
-                self.balancer,
-                config=AutoscalerConfig(
-                    interval_s=autoscale_s, cooldown_s=0.0, down_after=2,
-                ),
-            )
-            self.balancer.autoscaler = self.autoscaler
-            if autoscale_s > 0:
-                self.autoscaler.start()
-        self._stop = threading.Event()
-        self.port = _free_port()
-        threading.Thread(
-            target=gw_mod.run, args=(self.port, self.balancer, self._stop),
-            daemon=True,
-        ).start()
-        _wait_listening(self.port)
+    # -- single-gateway compat aliases (gateway 0 is the primary) ------------
+
+    @property
+    def port(self) -> int:
+        return self.gateway_ports[0]
+
+    @property
+    def cfg(self):
+        return self.gateways[0].cfg
+
+    @property
+    def balancer(self):
+        return self.gateways[0].balancer
+
+    @property
+    def scraper(self):
+        return self.gateways[0].scraper
+
+    @property
+    def autoscaler(self):
+        return self.gateways[0].autoscaler
 
     # -- one client -----------------------------------------------------------
 
@@ -781,6 +955,16 @@ class LoadTwin:
             return res
         return res
 
+    def _gateway_order(self) -> list:
+        """This attempt's gateway address preference: round-robin over
+        the configured addresses (active-active — both gateways serve),
+        with the REST of the list as failover targets. Clients know every
+        gateway address up front, exactly like a production client behind
+        DNS round-robin with client-side failover."""
+        ports = list(self.gateway_ports)
+        self._rr = (self._rr + 1) % len(ports)
+        return ports[self._rr:] + ports[: self._rr]
+
     def _attempt(self, req: TwinRequest) -> TwinResult:
         res = TwinResult(slo_class=req.slo_class, scenario=req.scenario)
         body = json.dumps({
@@ -794,12 +978,37 @@ class LoadTwin:
         headers = {"Content-Type": "application/json"}
         if self.classes_enabled:
             headers[SLO_CLASS_HEADER] = req.slo_class
-        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=60)
+        # client-side gateway failover: an address that cannot even
+        # answer the request line (refused mid-restart, reset before the
+        # status line) fails over to the next gateway — NOTHING was
+        # consumed, so the retry is transparent. Once a status line
+        # arrived, in-request failover is over: a mid-stream death is a
+        # TRUNCATED stream, re-asked through the ordinary retry loop
+        # (which round-robins onto the next address) like a preemption.
+        conn = resp = None
+        t0 = 0.0
+        last_err: OSError | None = None
+        for port in self._gateway_order():
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                t0 = time.perf_counter()
+                conn.request("POST", "/v1/chat/completions", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                break
+            except OSError as e:
+                last_err = e
+                res.gateway_failovers += 1
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = resp = None
+        if resp is None:
+            res.outcome = "error"
+            res.error = repr(last_err)
+            return res
         try:
-            t0 = time.perf_counter()
-            conn.request("POST", "/v1/chat/completions", body=body,
-                         headers=headers)
-            resp = conn.getresponse()
             res.status = resp.status
             if resp.status != 200:
                 resp.read()
@@ -834,7 +1043,12 @@ class LoadTwin:
             res.outcome = "ok" if b"[DONE]" in buf else "preempted"
             return res
         except OSError as e:
-            res.outcome = "error"
+            # a connection that died AFTER the status line is a truncated
+            # stream — the wire shape of a gateway/replica crash mid-body
+            # (kill_gateway severs in-flight sockets). Same retry
+            # contract as a preemption truncation: the work was cut
+            # short, a production SSE client reconnects and re-asks.
+            res.outcome = "preempted"
             res.error = repr(e)
             return res
         finally:
@@ -930,6 +1144,37 @@ class LoadTwin:
 
     # -- chaos controls -------------------------------------------------------
 
+    def kill_gateway(self, i: int):
+        """Hard-kill one gateway mid-run: its socket closes (new
+        connections refuse — twin clients fail over to the next address),
+        every gateway-owned thread stops, AND every in-flight proxied
+        stream is severed mid-body (a process crash takes the handler
+        threads with it) — exactly the crash the warm-restart recovery
+        exists for. Clients see the truncation and retry like any other
+        truncated stream."""
+        self.gateways[i].kill()
+
+    def restart_gateway(self, i: int, recover: bool = True):
+        """Bring a killed gateway back on its port as a FRESH instance —
+        the crash-only restart: a new Balancer (cold breakers), a new
+        router, and (with ``recover=True``, the production default for
+        fleet-aware gateways) the server/recovery.py warm-restart sweep
+        rebuilding locality/quarantine/drain state from the fleet before
+        the first proxied request. ``recover=False`` is the cold-gateway
+        baseline arm the acceptance test compares against."""
+        self.gateways[i] = TwinGateway(
+            self, i, self.gateway_ports[i], recover=recover
+        )
+        return self.gateways[i]
+
+    def sync_gateways(self):
+        """One manual gossip round from every live gateway (tests that
+        attach peering without the push thread drive this)."""
+        for gw in self.gateways:
+            peering = gw.balancer.peering
+            if peering is not None:
+                peering.sync_round()
+
     def kill_replica(self, i: int):
         """Hard-kill one stub mid-run: in-flight streams truncate (the
         gateway's midstream-failure shape), new connections refuse — the
@@ -968,10 +1213,8 @@ class LoadTwin:
         return [b.key for b in self.cfg.backends]
 
     def close(self):
-        self._stop.set()
-        if self.autoscaler is not None:
-            self.autoscaler.stop()
-        self.scraper.stop()
+        for gw in self.gateways:
+            gw.close()
         for r in self.replicas:
             r.stop()
 

@@ -1,0 +1,503 @@
+"""Active-active gateway peering: bounded control-plane gossip between
+gateways serving the same fleet.
+
+One gateway is a failure domain (PR 14 closed the replica domain; the
+gateway itself was still a single process holding the locality map, the
+quarantine ledger, and drain ownership in memory). This module lets two
+or more gateways serve the SAME fleet with consistent routing affinity
+and a fleet-wide poison budget:
+
+* **bounded deltas on a gossip tick** (``DLT_GW_PEER_SYNC_S``): each
+  gateway accumulates its own control-plane events — locality learns
+  (chain key -> learned home), quarantine strikes, drain/undrain
+  events — into a per-peer outbox (dict-merged, so the delta is bounded
+  by DISTINCT keys, capped at ``DLT_GW_PEER_DELTA`` with an explicit
+  dropped-entries counter — no silent truncation) and pushes it to every
+  peer with one stdlib HTTP POST (``POST /gateway/peer/sync``);
+* **last-writer-wins on monotonic event ids**: every locality/drain
+  write is stamped with a Lamport clock + origin id; a received entry
+  applies only when its ``(clock, origin)`` beats the stored version, so
+  two gateways learning different homes for the same chain converge on
+  the later write instead of ping-ponging. Strikes are ADDITIVE, not
+  LWW: each strike is one implication event, delivered at most once
+  (outbox entries clear only on a successful push), so a poison
+  fingerprint's strike budget is fleet-wide — its retries burn
+  ``DLT_QUARANTINE_STRIKES`` replicas total no matter how many gateways
+  they land on;
+* **exactly one autoscaler leader**: the gateway with the LOWEST live
+  peer id (ids exchanged on every sync; live = heard from within
+  ``DLT_GW_PEER_LIVE_S``) runs autoscaler ticks; followers hold
+  (``dlt_autoscaler_decisions_total{action="follower_hold"}``), so two
+  gateways never fight over drain decisions. A dead leader ages out of
+  the live set and the next-lowest id takes over — counted on
+  ``dlt_gw_peer_leadership_transitions_total``.
+
+Peers are configured as a full mesh (every gateway lists every other via
+repeatable ``--peer-gateway``); events are NOT relayed transitively — a
+missing edge partitions state, visible as ``dlt_gw_peer_live 0`` for the
+unreachable peer. Deliberately stdlib-only like the rest of the gateway.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from ..runtime.tracing import TRACER, now_us, prom_line
+from .quarantine import parse_fp_hex
+
+#: gossip cadence (seconds); <= 0 disables the background thread (tests
+#: drive sync_round() explicitly)
+DEFAULT_SYNC_S = 2.0
+#: per-peer outbox cap per kind — past it the OLDEST pending entries drop
+#: (counted on dlt_gw_peer_delta_dropped_total, never silently)
+DEFAULT_DELTA_CAP = 2048
+#: LWW version-map bound (locality keys + drain flags tracked)
+VERSIONS_CAP = 8192
+
+#: delta kinds every sync exchanges (the zero-filled metrics label set)
+KINDS = ("locality", "strike", "drain")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def parse_peer(s: str) -> tuple:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+class GatewayPeering:
+    """One gateway's peering state: the Lamport clock, the per-peer
+    outboxes, the LWW version map, peer liveness, and leader election.
+    Construct and call :meth:`sync_round` / :meth:`apply` directly in
+    tests; :meth:`start` runs the gossip loop."""
+
+    def __init__(
+        self,
+        balancer,
+        self_id: str,
+        peers,
+        interval_s: float | None = None,
+        timeout_s: float | None = None,
+        delta_cap: int | None = None,
+        live_after_s: float | None = None,
+    ):
+        self.balancer = balancer
+        self.self_id = self_id
+        # peer ADDRESSES ("host:port" of the peer gateway's listen port);
+        # peer IDS are learned from sync exchanges — election runs on ids
+        self.peers = [p for p in dict.fromkeys(peers) if p]
+        self.interval_s = (
+            _env_float("DLT_GW_PEER_SYNC_S", DEFAULT_SYNC_S)
+            if interval_s is None else interval_s
+        )
+        self.timeout_s = (
+            _env_float("DLT_GW_PEER_TIMEOUT_S", 2.0)
+            if timeout_s is None else timeout_s
+        )
+        self.delta_cap = (
+            _env_int("DLT_GW_PEER_DELTA", DEFAULT_DELTA_CAP)
+            if delta_cap is None else delta_cap
+        )
+        # a peer id is LIVE while heard from (either direction) within
+        # this window; default 3 gossip ticks — one lost tick must not
+        # flap leadership
+        self.live_after_s = (
+            _env_float(
+                "DLT_GW_PEER_LIVE_S", 3.0 * max(self.interval_s, 0.1)
+            )
+            if live_after_s is None else live_after_s
+        )
+        self._lock = threading.Lock()
+        self._clock = 0
+        # LWW versions: ("loc", chain_key) / ("drain", backend) ->
+        # (clock, origin_id); bounded LRU
+        self._versions: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # per-peer outboxes: addr -> kind -> pending delta (dict-merged)
+        self._out = {
+            p: {
+                "locality": OrderedDict(),  # key_hex -> (backend, c, origin)
+                "strikes": OrderedDict(),   # fp_hex -> n (additive)
+                "drains": OrderedDict(),    # backend -> (draining, by, c, o)
+            }
+            for p in self.peers
+        }
+        self._live_ids: dict = {}       # peer gateway id -> last-heard mono
+        self._peer_id_by_addr: dict = {}
+        self._last_leader: str | None = None
+        self.counters = {
+            "sync_ok": 0,
+            "sync_failed": 0,
+            "events_sent": 0,
+            "applied_locality": 0,
+            "applied_strike": 0,
+            "applied_drain": 0,
+            "stale_dropped": 0,      # LWW losers (older version arrived)
+            "delta_dropped": 0,      # outbox-cap evictions (bounded delta)
+            "leadership_transitions": 0,
+        }
+        self.sync_rounds = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "GatewayPeering":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="gateway-peer-sync"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.sync_round()
+
+    # -- clock ---------------------------------------------------------------
+
+    def _tick_locked(self, seen: int = 0) -> int:
+        self._clock = max(self._clock, int(seen)) + 1
+        return self._clock
+
+    # -- local event capture (the gateway's hooks) ---------------------------
+
+    def _bound_outbox_locked(self, kind: str):
+        for box in self._out.values():
+            d = box[kind]
+            while len(d) > self.delta_cap:
+                d.popitem(last=False)
+                self.counters["delta_dropped"] += 1
+
+    def note_locality(self, chain, backend: str) -> None:
+        """A successful request taught the locality map ``chain -> this
+        backend`` (gateway request loop, next to Router.learn) — version
+        the write and queue it for every peer. One lock hold per REQUEST,
+        never per token (dict merges, bounded)."""
+        if not self.peers or not chain:
+            return
+        with self._lock:
+            for ck in chain:
+                c = self._tick_locked()
+                ver = (c, self.self_id)
+                self._versions[("loc", ck)] = ver
+                self._versions.move_to_end(("loc", ck))
+                hexkey = f"{ck:016x}"
+                for box in self._out.values():
+                    box["locality"][hexkey] = (backend, c, self.self_id)
+            while len(self._versions) > VERSIONS_CAP:
+                self._versions.popitem(last=False)
+            self._bound_outbox_locked("locality")
+
+    def note_strike(self, fp: int, n: int = 1) -> None:
+        """The retry loop recorded ``n`` implication events for ``fp`` —
+        queue the ADDITIVE delta for every peer (each event delivered at
+        most once: cleared only on a successful push)."""
+        if not self.peers or fp is None:
+            return
+        hexfp = f"{fp:016x}"
+        with self._lock:
+            self._tick_locked()
+            for box in self._out.values():
+                box["strikes"][hexfp] = box["strikes"].get(hexfp, 0) + n
+                box["strikes"].move_to_end(hexfp)
+            self._bound_outbox_locked("strikes")
+
+    def note_drain(self, backend: str, draining: bool, by: str) -> None:
+        """A local drain/undrain landed (operator endpoint, autoscaler, or
+        recovery re-broadcast) — version the flag and queue it."""
+        if not self.peers:
+            return
+        with self._lock:
+            c = self._tick_locked()
+            self._versions[("drain", backend)] = (c, self.self_id)
+            self._versions.move_to_end(("drain", backend))
+            for box in self._out.values():
+                box["drains"][backend] = (draining, by, c, self.self_id)
+            while len(self._versions) > VERSIONS_CAP:
+                self._versions.popitem(last=False)
+
+    # -- the gossip tick (sender side) ---------------------------------------
+
+    def sync_round(self) -> dict:
+        """Push each peer its pending delta; returns per-peer outcomes.
+        A failed push re-merges the delta (LWW entries keep the newer
+        version, strikes re-add) so nothing is lost to one dead peer."""
+        out = {}
+        for addr in self.peers:
+            out[addr] = self._sync_peer(addr)
+        self.sync_rounds += 1
+        return out
+
+    def _take_delta_locked(self, addr: str) -> dict:
+        box = self._out[addr]
+        delta = {
+            "locality": {
+                k: {"b": b, "c": c, "o": o}
+                for k, (b, c, o) in box["locality"].items()
+            },
+            "strikes": dict(box["strikes"]),
+            "drains": {
+                k: {"draining": d, "by": by, "c": c, "o": o}
+                for k, (d, by, c, o) in box["drains"].items()
+            },
+        }
+        box["locality"] = OrderedDict()
+        box["strikes"] = OrderedDict()
+        box["drains"] = OrderedDict()
+        return delta
+
+    def _restore_delta_locked(self, addr: str, delta: dict):
+        box = self._out[addr]
+        for k, ent in delta["locality"].items():
+            cur = box["locality"].get(k)
+            if cur is None or (cur[1], cur[2]) < (ent["c"], ent["o"]):
+                box["locality"][k] = (ent["b"], ent["c"], ent["o"])
+        for k, n in delta["strikes"].items():
+            box["strikes"][k] = box["strikes"].get(k, 0) + n
+        for k, ent in delta["drains"].items():
+            cur = box["drains"].get(k)
+            if cur is None or (cur[2], cur[3]) < (ent["c"], ent["o"]):
+                box["drains"][k] = (
+                    ent["draining"], ent["by"], ent["c"], ent["o"]
+                )
+        self._bound_outbox_locked("locality")
+        self._bound_outbox_locked("strikes")
+
+    def _sync_peer(self, addr: str) -> dict:
+        from .fleet import http_post_json
+
+        with self._lock:
+            delta = self._take_delta_locked(addr)
+            clock = self._clock
+        n_events = sum(len(delta[k]) for k in delta)
+        payload = dict(delta, id=self.self_id, clock=clock)
+        try:
+            host, port = addr.rsplit(":", 1)
+            status, body = http_post_json(
+                host, int(port), "/gateway/peer/sync", payload,
+                self.timeout_s,
+            )
+            if status != 200:
+                raise OSError(f"peer sync returned {status}")
+            ack = json.loads(body)
+        except Exception as e:
+            with self._lock:
+                self._restore_delta_locked(addr, delta)
+                self.counters["sync_failed"] += 1
+            TRACER.event(
+                "gw_peer_sync_failed", now_us(), 0,
+                ("peer", "error"), (addr, repr(e)),
+            )
+            return {"ok": False, "error": repr(e)}
+        peer_id = ack.get("id")
+        with self._lock:
+            self._tick_locked(ack.get("clock", 0))
+            self.counters["sync_ok"] += 1
+            self.counters["events_sent"] += n_events
+            if isinstance(peer_id, str) and peer_id:
+                self._live_ids[peer_id] = time.monotonic()
+                self._peer_id_by_addr[addr] = peer_id
+        return {"ok": True, "peer_id": peer_id, "events": n_events}
+
+    # -- the receive path (POST /gateway/peer/sync) --------------------------
+
+    def apply(self, payload: dict) -> dict:
+        """Apply one peer's delta; returns the ack body. LWW entries apply
+        only when their version beats the stored one; strikes are additive
+        into the gateway's own ledger (fleet-wide budget)."""
+        origin = payload.get("id")
+        applied = {"locality": 0, "strike": 0, "drain": 0}
+        router = getattr(self.balancer, "router", None)
+        with self._lock:
+            self._tick_locked(payload.get("clock", 0))
+            if isinstance(origin, str) and origin:
+                self._live_ids[origin] = time.monotonic()
+            loc_wins = []
+            for hexkey, ent in (payload.get("locality") or {}).items():
+                try:
+                    ck = int(hexkey, 16)
+                    ver = (int(ent["c"]), str(ent["o"]))
+                    backend = str(ent["b"])
+                except (TypeError, ValueError, KeyError):
+                    continue
+                cur = self._versions.get(("loc", ck))
+                if cur is not None and cur >= ver:
+                    self.counters["stale_dropped"] += 1
+                    continue
+                self._versions[("loc", ck)] = ver
+                self._versions.move_to_end(("loc", ck))
+                loc_wins.append((ck, backend))
+            drain_wins = []
+            for backend, ent in (payload.get("drains") or {}).items():
+                try:
+                    ver = (int(ent["c"]), str(ent["o"]))
+                    draining = bool(ent["draining"])
+                    by = str(ent.get("by", "operator"))
+                except (TypeError, ValueError, KeyError):
+                    continue
+                cur = self._versions.get(("drain", backend))
+                if cur is not None and cur >= ver:
+                    self.counters["stale_dropped"] += 1
+                    continue
+                self._versions[("drain", backend)] = ver
+                self._versions.move_to_end(("drain", backend))
+                drain_wins.append((backend, draining, by))
+            while len(self._versions) > VERSIONS_CAP:
+                self._versions.popitem(last=False)
+            clock = self._clock
+        # writes land OUTSIDE our lock: the router/balancer/ledger own
+        # their own locks (lock-order discipline — never nest theirs
+        # under ours)
+        if router is not None:
+            for ck, backend in loc_wins:
+                router.set_owner(ck, backend)
+                applied["locality"] += 1
+        ledger = getattr(self.balancer, "quarantine", None)
+        if ledger is not None:
+            for hexfp, n in (payload.get("strikes") or {}).items():
+                fp = parse_fp_hex(hexfp)
+                try:
+                    n = int(n)
+                except (TypeError, ValueError):
+                    continue
+                if fp is None or n <= 0:
+                    continue
+                ledger.strike(fp, n)
+                applied["strike"] += n
+        for backend, draining, by in drain_wins:
+            # record=False: applying a peer's event must not re-broadcast
+            # it (ping-pong); notify=False: the origin gateway already
+            # hinted the replica
+            if self.balancer.set_draining(
+                backend, draining, by=by, record=False, notify=False
+            ):
+                applied["drain"] += 1
+                if draining and by == "autoscaler":
+                    a = getattr(self.balancer, "autoscaler", None)
+                    if a is not None:
+                        a.adopt_drain(backend)
+        with self._lock:
+            for k, n in applied.items():
+                self.counters[f"applied_{k}"] += n
+        return {"id": self.self_id, "clock": clock, "applied": applied}
+
+    # -- leader election -----------------------------------------------------
+
+    def _live_ids_now_locked(self, now: float) -> list:
+        live = [self.self_id]
+        for pid, seen in self._live_ids.items():
+            if pid != self.self_id and now - seen <= self.live_after_s:
+                live.append(pid)
+        return sorted(live)
+
+    def leader_id(self) -> str:
+        """The current leader: LOWEST live gateway id (self always counts
+        as live). Deterministic — every gateway with the same live set
+        elects the same leader without a round of consensus."""
+        now = time.monotonic()
+        with self._lock:
+            leader = self._live_ids_now_locked(now)[0]
+            if leader != self._last_leader:
+                if self._last_leader is not None:
+                    self.counters["leadership_transitions"] += 1
+                    TRACER.event(
+                        "gw_peer_leadership", now_us(), 0,
+                        ("from", "to", "self"),
+                        (self._last_leader, leader, self.self_id),
+                    )
+                self._last_leader = leader
+        return leader
+
+    def is_leader(self) -> bool:
+        return self.leader_id() == self.self_id
+
+    # -- views ---------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``peering`` section of ``GET /gateway/fleet``."""
+        leader = self.leader_id()
+        now = time.monotonic()
+        with self._lock:
+            pending = {
+                addr: {k: len(v) for k, v in box.items()}
+                for addr, box in self._out.items()
+            }
+            live = self._live_ids_now_locked(now)
+            return {
+                "self_id": self.self_id,
+                "peers": list(self.peers),
+                "peer_ids": dict(self._peer_id_by_addr),
+                "live_ids": live,
+                "leader": leader,
+                "is_leader": leader == self.self_id,
+                "interval_s": self.interval_s,
+                "clock": self._clock,
+                "sync_rounds": self.sync_rounds,
+                "pending": pending,
+                "counters": dict(self.counters),
+            }
+
+    def metrics_lines(self) -> list:
+        leader = self.leader_id()
+        now = time.monotonic()
+        with self._lock:
+            c = dict(self.counters)
+            live_by_addr = {
+                addr: (
+                    pid in self._live_ids
+                    and now - self._live_ids[pid] <= self.live_after_s
+                )
+                for addr, pid in self._peer_id_by_addr.items()
+            }
+            for addr in self.peers:
+                live_by_addr.setdefault(addr, False)
+        lines = []
+        for name, key in (
+            ("dlt_gw_peer_sync_total", "sync_ok"),
+            ("dlt_gw_peer_sync_failures_total", "sync_failed"),
+            ("dlt_gw_peer_events_sent_total", "events_sent"),
+            ("dlt_gw_peer_stale_dropped_total", "stale_dropped"),
+            ("dlt_gw_peer_delta_dropped_total", "delta_dropped"),
+            ("dlt_gw_peer_leadership_transitions_total",
+             "leadership_transitions"),
+        ):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(prom_line(name, None, c.get(key, 0)))
+        lines.append("# TYPE dlt_gw_peer_events_applied_total counter")
+        for kind in KINDS:
+            lines.append(
+                prom_line(
+                    "dlt_gw_peer_events_applied_total", {"kind": kind},
+                    c.get(f"applied_{kind}", 0),
+                )
+            )
+        lines.append("# TYPE dlt_gw_peer_live gauge")
+        for addr, live in sorted(live_by_addr.items()):
+            lines.append(
+                prom_line("dlt_gw_peer_live", {"peer": addr}, int(live))
+            )
+        lines.append("# TYPE dlt_gw_peer_leader gauge")
+        lines.append(
+            prom_line("dlt_gw_peer_leader", None, int(leader == self.self_id))
+        )
+        return lines

@@ -40,16 +40,19 @@ expiry (``DLT_QUARANTINE_TTL_S``): a fingerprint that stops failing ages
 out — a once-bad request must not be damned forever (the engine rebuild
 that fixed the ladder hole also un-poisons the request).
 
-Known trade-off: strike evidence is a heuristic. A request in flight on a
-replica that dies for UNRELATED reasons (hard kill, OOM from a
-co-tenant) is struck — at the gateway, a crash-during-my-request and a
-crash-because-of-my-request are indistinguishable. Two correlated
-replica deaths (an undrained rolling restart) can therefore 422 an
-innocent conversation for one TTL window. That is the accepted price:
-the TTL bounds the harm to minutes, a drain-first deploy never hard
--kills in-flight work, and the alternative — no strike ledger — is a
-poison request taking the whole fleet down. Stdlib-only: the gateway
-imports this on jax-free boxes.
+Strike evidence is a heuristic — at the gateway, a crash-during-my-request
+and a crash-because-of-my-request are indistinguishable from the wire
+alone. The gateway therefore DISCOUNTS transport-death evidence from a
+backend the fleet already knew was sick when the attempt died: breaker
+not closed, fleet-table row gone stale, or the backend draining
+(autoscaler or operator). Correlated replica deaths during a rolling
+drain or a partial outage no longer terminally 422 an innocent
+conversation (the PR 14 documented trade-off, resolved); a replica
+NAMING the fingerprint (``X-DLT-Poison-Fp``) always strikes — that is
+first-hand engine evidence, not a wire guess. The residual exposure —
+two UNcorrelated hard kills of fresh, healthy replicas inside one TTL
+window with the same innocent body in flight — is bounded by the TTL.
+Stdlib-only: the gateway imports this on jax-free boxes.
 """
 
 from __future__ import annotations
@@ -178,6 +181,48 @@ class QuarantineLedger:
             return 0
         with self._lock:
             return self._fresh_locked(fp, time.monotonic())
+
+    # -- crash-only recovery (server/recovery.py) ---------------------------
+
+    def dump(self) -> dict:
+        """The ``GET /debug/quarantine`` payload: EVERY fresh entry (not
+        just the snapshot's top-N) with its age, so a warm-restarting
+        gateway can re-learn strike ledgers — and in-force 422s — from the
+        fleet with TTL-correct remaining lifetimes."""
+        now = time.monotonic()
+        with self._lock:
+            entries = [
+                {"fp": fp_hex(fp), "strikes": s, "age_s": round(now - last, 3)}
+                for fp, (s, last) in self._strikes.items()
+                if now - last <= self.ttl_s
+            ]
+        return {"limit": self.limit, "ttl_s": self.ttl_s, "entries": entries}
+
+    def prime(self, fp: int | None, strikes: int, age_s: float = 0.0) -> None:
+        """Seed one recovered entry: the count becomes ``max(existing,
+        strikes)`` (idempotent — recovery may merge several sources) and
+        the strike clock is backdated by ``age_s`` so a recovered entry
+        expires when the original would have, not TTL-from-restart."""
+        if fp is None or strikes <= 0:
+            return
+        now = time.monotonic()
+        at = now - max(age_s, 0.0)
+        if now - at > self.ttl_s:
+            return  # already expired at the source — nothing to recover
+        with self._lock:
+            existing = self._fresh_locked(fp, now)
+            crossed = (
+                self.limit > 0 and strikes >= self.limit
+                and existing < self.limit
+            )
+            if strikes <= existing:
+                return
+            self._strikes[fp] = (strikes, at)
+            self._strikes.move_to_end(fp)
+            while len(self._strikes) > self.size:
+                self._strikes.popitem(last=False)
+            if crossed:
+                self.quarantined_total += 1
 
     def snapshot(self, top_n: int = 16) -> dict:
         """The operator view (``/stats`` quarantine section; ``/health``

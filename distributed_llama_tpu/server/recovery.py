@@ -1,0 +1,276 @@
+"""Crash-only gateway recovery: rebuild control-plane state from the fleet.
+
+A gateway restart used to be a silent control-plane wipe: the signal
+table re-filled only after a scrape interval, the router's learned
+prefix-locality map came back EMPTY (every shared-prefix request
+re-cold-prefilled somewhere — the PR 10 routing leg's ~3x TTFT gap,
+re-paid on every deploy), the quarantine ledger forgot every in-force
+422 (a poison body got a fresh replica-killing budget), and replicas the
+autoscaler had drained were stranded — draining flags live on the
+gateway, so a fresh gateway neither knew about the drain nor owned it.
+
+The fix is the crash-only discipline the replica tier got in PR 14: the
+authoritative state never lived only in the gateway — the FLEET holds
+it, and startup reads it back before the first client request:
+
+* **signal table** — one synchronous :meth:`FleetScraper.scrape_once`
+  sweep primes every replica's row (rate fields need a SECOND scrape for
+  a baseline; the router's scoring degrades to headroom/affinity for
+  that one interval — see ``score_backend``, which never reads rates);
+* **locality map** — every replica's ``GET /debug/hot_prefixes`` (the
+  PR 12 warm-handoff surface, reused verbatim) is merged: each chain key
+  re-homes to the replica that reports it HOTTEST, rendezvous-hashing
+  breaking ties, then bulk-loaded via ``Router.prime_locality``;
+* **quarantine ledger** — every replica's ``GET /debug/quarantine``
+  dump is merged: strikes SUM across replicas (each incident burned one
+  replica, so the fleet-wide count is the sum) with TTL-correct ages, so
+  in-force 422s stay in force across the restart;
+* **drain state** — every replica's ``GET /health`` carries the drain
+  hint the draining gateway posted (``POST /admin/drain_hint``):
+  ``draining`` flags are restored, and hints stamped ``by=autoscaler``
+  re-enter the autoscaler's ``_drained_by_me`` ownership so the control
+  loop can still undrain what it drained.
+
+Everything is best-effort and bounded (one thread per backend, one
+timeout): a dead replica contributes nothing, a half-answering one
+contributes what it has, and the result counters land on ``/metrics``
+as the ``dlt_gateway_recovery_*`` family plus a ``recovery`` section in
+``GET /gateway/fleet``. Stdlib-only, like the rest of the gateway.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..runtime.tracing import TRACER, now_us
+from .quarantine import parse_fp_hex
+from .router import rendezvous_owner
+
+#: how many hot chains to ask each replica for (the autoscaler's warm
+#: handoff asks for 64; recovery rebuilds the WHOLE map, so it asks for
+#: more — still one bounded response per replica)
+RECOVERY_HOT_N = 512
+
+#: per-GET timeout AND the overall recovery budget anchor: every backend
+#: is swept in its own thread and the join is bounded at timeout + 0.5 s,
+#: so a fleet of black-holing sockets delays serving by ~this much, not
+#: backends x surfaces x timeout (the gateway must come up promptly even
+#: when the whole fleet is hung — it will shed honestly, not hang)
+DEFAULT_RECOVER_TIMEOUT_S = 2.0
+
+
+def _recover_timeout_s() -> float:
+    try:
+        return float(os.environ.get(
+            "DLT_GW_RECOVER_TIMEOUT_S", DEFAULT_RECOVER_TIMEOUT_S
+        ))
+    except ValueError:
+        return DEFAULT_RECOVER_TIMEOUT_S
+
+
+def _fetch_backend_state(host: str, port: int, timeout_s: float) -> dict:
+    """One backend's recovery sources, best-effort: ``{"health": ...,
+    "hot_prefixes": ..., "quarantine": ...}`` with None for any surface
+    that failed (older replicas without /debug/quarantine just miss it)."""
+    from .fleet import http_get_text
+
+    out = {"health": None, "hot_prefixes": None, "quarantine": None}
+    for key, path, ok_codes in (
+        # a recovering replica answers /health 503 WITH its payload —
+        # drain hints must survive a concurrent engine rebuild
+        ("health", "/health", (200, 503)),
+        ("hot_prefixes", f"/debug/hot_prefixes?n={RECOVERY_HOT_N}", (200,)),
+        ("quarantine", "/debug/quarantine", (200,)),
+    ):
+        try:
+            status, body = http_get_text(host, port, path, timeout_s)
+            if status in ok_codes:
+                payload = json.loads(body)
+                if isinstance(payload, dict):
+                    out[key] = payload
+        except Exception:
+            pass  # dlt: allow(swallowed-exception) — recovery is
+            # best-effort by contract: a dead/garbled replica contributes
+            # nothing and is counted in replicas_failed by the caller
+    return out
+
+
+def merge_hot_prefixes(per_backend: dict) -> dict:
+    """``{chain_key_int: backend_key}`` from per-replica hot-prefix
+    snapshots: each chain key goes to the replica reporting it HOTTEST
+    (its cache most certainly holds it); ties rendezvous-hash over the
+    tied replicas so every recovering gateway picks the SAME home."""
+    best: dict = {}  # ck -> (hits, [backend_keys])
+    for backend_key, snap in per_backend.items():
+        for ent in (snap or {}).get("chains") or []:
+            try:
+                ck = int(ent["key"], 16)
+                hits = int(ent.get("hits", 1))
+            except (TypeError, ValueError, KeyError):
+                continue
+            cur = best.get(ck)
+            if cur is None or hits > cur[0]:
+                best[ck] = (hits, [backend_key])
+            elif hits == cur[0]:
+                cur[1].append(backend_key)
+    owners = {}
+    for ck, (_, keys) in best.items():
+        owners[ck] = keys[0] if len(keys) == 1 else rendezvous_owner(ck, keys)
+    return owners
+
+
+def merge_quarantine(per_backend: dict) -> dict:
+    """``{fp_int: (strikes, min_age_s)}`` summed across replicas: each
+    strike was one incident on one replica, so the fleet-wide count is
+    the sum; the youngest age keeps the TTL honest (the entry lives as
+    long as its most recent incident would have)."""
+    merged: dict = {}
+    for snap in per_backend.values():
+        for ent in (snap or {}).get("entries") or []:
+            fp = parse_fp_hex(ent.get("fp"))
+            if fp is None:
+                continue
+            try:
+                strikes = int(ent.get("strikes", 0))
+                age = float(ent.get("age_s", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if strikes <= 0:
+                continue
+            cur = merged.get(fp)
+            merged[fp] = (
+                (strikes, age) if cur is None
+                else (cur[0] + strikes, min(cur[1], age))
+            )
+    return merged
+
+
+def recover_gateway(balancer, timeout_s: float | None = None) -> dict:
+    """The warm-restart sweep. Returns (and the caller publishes) the
+    recovery record; never raises — a fleet that answers nothing yields a
+    cold start, exactly the pre-recovery behavior."""
+    t0 = time.monotonic()
+    fleet = getattr(balancer, "fleet", None)
+    if timeout_s is None:
+        timeout_s = _recover_timeout_s()
+    # ONE bounded worker per backend does everything for that backend —
+    # the synchronous scrape prime (the first routed request must score
+    # against a populated table, not a never-scraped one) AND the three
+    # recovery fetches. The join is bounded by the recovery budget, so a
+    # fleet of hung sockets delays serving by ~timeout_s, never
+    # backends x surfaces x timeout; a worker finishing late still lands
+    # its scrape in the fleet table (the scraper owns that state), it
+    # just misses this recovery record.
+    backends = list(balancer.config.backends)
+    raw: dict = {}
+
+    def fetch(b):
+        if fleet is not None:
+            try:
+                fleet._scrape_backend(b)
+            except Exception:
+                pass  # dlt: allow(swallowed-exception) — the scraper's
+                # own contract is never-raise; this is belt over it so a
+                # scrape bug cannot void the rest of the recovery sweep
+        raw[b.key] = _fetch_backend_state(b.host, b.port, timeout_s)
+
+    threads = [
+        threading.Thread(target=fetch, args=(b,), daemon=True)
+        for b in backends
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout_s + 0.5
+    for t in threads:
+        t.join(timeout=max(deadline - time.monotonic(), 0.05))
+    answered = {
+        k: v for k, v in raw.items()
+        if any(v[s] is not None for s in v)
+    }
+    # 3) locality: hottest-reporter wins, rendezvous ties
+    owners = merge_hot_prefixes(
+        {k: v["hot_prefixes"] for k, v in answered.items()}
+    )
+    router = getattr(balancer, "router", None)
+    locality_keys = 0
+    if router is not None and owners:
+        locality_keys = router.prime_locality(owners)
+    # 4) quarantine: summed strikes, TTL-correct ages
+    merged = merge_quarantine(
+        {k: v["quarantine"] for k, v in answered.items()}
+    )
+    ledger = getattr(balancer, "quarantine", None)
+    quarantine_fps = in_force = 0
+    if ledger is not None:
+        for fp, (strikes, age) in merged.items():
+            ledger.prime(fp, strikes, age)
+            quarantine_fps += 1
+            if ledger.is_quarantined(fp):
+                in_force += 1
+    # 5) drain state: restore flags + autoscaler ownership from the
+    # replicas' drain hints (record=False: a restored drain is not a new
+    # event to gossip as ours with a fresh clock — peers that saw the
+    # original still hold it; notify=False: the replica ALREADY carries
+    # the hint we just read)
+    drains_restored = drains_adopted = 0
+    autoscaler = getattr(balancer, "autoscaler", None)
+    for key, v in answered.items():
+        hint = (v["health"] or {}).get("draining")
+        if not isinstance(hint, dict) or not hint.get("draining"):
+            continue
+        by = str(hint.get("by", "operator"))
+        if balancer.set_draining(key, True, by=by, record=False, notify=False):
+            drains_restored += 1
+            if by == "autoscaler" and autoscaler is not None:
+                autoscaler.adopt_drain(key)
+                drains_adopted += 1
+    record = {
+        "runs": 1,
+        "replicas_polled": len(backends),
+        "replicas_answered": len(answered),
+        "replicas_failed": len(backends) - len(answered),
+        "locality_keys": locality_keys,
+        "quarantine_fps": quarantine_fps,
+        "quarantine_in_force": in_force,
+        "drains_restored": drains_restored,
+        "drains_adopted": drains_adopted,
+        "wall_ms": round((time.monotonic() - t0) * 1e3, 1),
+    }
+    TRACER.event(
+        "gw_recovery", now_us(), int(record["wall_ms"] * 1e3),
+        ("answered", "locality_keys", "quarantine_fps", "drains_restored"),
+        (record["replicas_answered"], locality_keys, quarantine_fps,
+         drains_restored),
+    )
+    return record
+
+
+def recovery_metrics_lines(record: dict | None) -> list:
+    """``dlt_gateway_recovery_*`` exposition — zero-filled when recovery
+    was disabled, so dashboards can tell "recovered nothing" from "never
+    ran" via dlt_gateway_recovery_runs_total."""
+    from ..runtime.tracing import prom_line
+
+    rec = record or {}
+    lines = []
+    for name, key, kind in (
+        ("dlt_gateway_recovery_runs_total", "runs", "counter"),
+        ("dlt_gateway_recovery_replicas_answered", "replicas_answered",
+         "gauge"),
+        ("dlt_gateway_recovery_replicas_failed", "replicas_failed", "gauge"),
+        ("dlt_gateway_recovery_locality_keys_total", "locality_keys",
+         "counter"),
+        ("dlt_gateway_recovery_quarantine_fps_total", "quarantine_fps",
+         "counter"),
+        ("dlt_gateway_recovery_quarantine_in_force", "quarantine_in_force",
+         "gauge"),
+        ("dlt_gateway_recovery_drains_restored_total", "drains_restored",
+         "counter"),
+        ("dlt_gateway_recovery_wall_ms", "wall_ms", "gauge"),
+    ):
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(prom_line(name, None, rec.get(key, 0)))
+    return lines

@@ -469,6 +469,38 @@ class Router:
         with self._lock:
             return dict(self.handoff)
 
+    # -- crash-only recovery + peering (server/recovery.py, peering.py) ------
+
+    def set_owner(self, key: int, backend: str) -> None:
+        """Write one locality entry WITHOUT handoff accounting — the
+        peer-sync / warm-restart write path (a re-learned entry is not a
+        drain event; counting it would make the handoff counters lie
+        about what the autoscaler did)."""
+        with self._lock:
+            self._locality[key] = backend
+            self._locality.move_to_end(key)
+            while len(self._locality) > self.cfg.locality_size:
+                self._locality.popitem(last=False)
+
+    def prime_locality(self, owners: dict) -> int:
+        """Warm-restart repopulation (server/recovery.py): bulk-load
+        ``{chain_key_int: backend_key}`` re-learned from the fleet's
+        ``/debug/hot_prefixes`` snapshots. Returns the entries written."""
+        with self._lock:
+            for ck, backend in owners.items():
+                self._locality[ck] = backend
+                self._locality.move_to_end(ck)
+            while len(self._locality) > self.cfg.locality_size:
+                self._locality.popitem(last=False)
+        return len(owners)
+
+    def owner_of(self, key: int) -> str | None:
+        """The learned home of one chain key (None when unknown) — the
+        peering LWW apply reads this to report, never to decide (versions
+        live in server/peering.py)."""
+        with self._lock:
+            return self._locality.get(key)
+
     # -- views ---------------------------------------------------------------
 
     def decisions_snapshot(self) -> dict:

@@ -55,9 +55,19 @@
 #                            single-process slow pair proving a torn-down
 #                            server's sealed sentinel cannot condemn a
 #                            later suite's engine builds)
+#  11c. gateway-ha suite     (gateway failure domain: warm-restart
+#                            recovery of locality/quarantine/drain state
+#                            from the fleet, active-active peering with
+#                            LWW deltas + leader election, the strike
+#                            discount, GatewayServer thread lifecycle,
+#                            and the twin failover/restart chaos proofs)
 #  12. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
-#                            vs predecessor, tolerance-banded — WARN-ONLY:
-#                            the table is the artifact, the exit code is 0)
+#                            vs predecessor, tolerance-banded — STRICT in
+#                            this preflight since r08 (direction bands
+#                            held three rounds); the in-CI ci.yml stage
+#                            stays warn-only so bench noise cannot block
+#                            a PR, while local preflight catches real
+#                            regressions before push)
 #
 # Pass --full to also run the tier-1 fast subset (-m 'not slow').
 set -euo pipefail
@@ -113,6 +123,9 @@ echo "== robustness suite (supervisor + quarantine + deadlines + chaos twin) =="
 python -m pytest tests/test_supervisor.py tests/test_quarantine.py \
   tests/test_deadline.py -q -p no:cacheprovider
 
+echo "== gateway-ha suite (recovery + peering + failover chaos) =="
+python -m pytest tests/test_gateway_ha.py -q -p no:cacheprovider
+
 echo "== cross-suite sentinel-lifecycle pair (single process, slow-marked) =="
 # two suites whose servers warm + seal fatal-capable sentinels in ONE
 # process: green only while server teardown releases the sentinel
@@ -120,8 +133,8 @@ echo "== cross-suite sentinel-lifecycle pair (single process, slow-marked) =="
 python -m pytest tests/test_supervisor.py tests/test_speculative.py \
   -q -m slow -p no:cacheprovider
 
-echo "== scoreboard guard (warn-only) =="
-python scripts/bench_compare.py
+echo "== scoreboard guard (STRICT preflight; ci.yml stays warn-only) =="
+python scripts/bench_compare.py --strict
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "== tier-1 fast subset =="

@@ -217,3 +217,61 @@ def test_sentinel_release_requires_teardown_stop():
         "            self.s.stop()\n"
     )
     assert _rules(nested_ok) == []
+
+
+def test_thread_release_covers_gateway_owned_loops():
+    """The sentinel-release rule's thread edition (ISSUE 15): a class
+    holding a FleetScraper/Autoscaler/HealthProber/GatewayPeering without
+    a teardown releasing it is the exact leak class the gateway restart
+    tests would instantiate twice."""
+    bad = (
+        "class Gw:\n"
+        "    def __init__(self, bal):\n"
+        "        self.scraper = FleetScraper(bal).start()\n"
+    )
+    assert _rules(bad, rel="server/x.py") == ["thread-release"]
+    # releasing from any teardown name (incl. the http.server pair)
+    ok = bad + (
+        "    def server_close(self):\n"
+        "        self.scraper.stop()\n"
+    )
+    assert _rules(ok, rel="server/x.py") == []
+    # the local-alias form must not evade the rule (the GatewayServer
+    # shape: build first, attach conditionally)
+    aliased_bad = (
+        "class Gw:\n"
+        "    def start(self, bal):\n"
+        "        scraper = FleetScraper(bal)\n"
+        "        self._scraper = scraper\n"
+    )
+    assert _rules(aliased_bad, rel="server/x.py") == ["thread-release"]
+    aliased_ok = aliased_bad + (
+        "    def shutdown(self):\n"
+        "        if self._scraper is not None:\n"
+        "            self._scraper.stop()\n"
+    )
+    assert _rules(aliased_ok, rel="server/x.py") == []
+    # a prober joined (its loop stops via a shared event) counts released
+    prober = (
+        "class Gw:\n"
+        "    def __init__(self, bal, stop):\n"
+        "        self._prober = HealthProber(bal, stop)\n"
+        "    def shutdown(self):\n"
+        "        self._prober.join(timeout=5)\n"
+    )
+    assert _rules(prober, rel="server/x.py") == []
+    # releasing a DIFFERENT attribute does not count
+    wrong = bad + (
+        "    def close(self):\n"
+        "        self.other.stop()\n"
+    )
+    assert _rules(wrong, rel="server/x.py") == ["thread-release"]
+    # scope: server/runtime lifecycles — a scripts/ helper is exempt
+    assert _rules(bad, rel="scripts/x.py") == []
+    # pragma suppresses at the assignment site
+    sup = (
+        "class Gw:\n"
+        "    def __init__(self, bal):\n"
+        "        self.a = Autoscaler(bal)  # dlt: allow(thread-release)\n"
+    )
+    assert _rules(sup, rel="server/x.py") == []

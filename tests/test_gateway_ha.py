@@ -1,0 +1,926 @@
+"""Gateway failure domain (ISSUE 15): crash-safe control-plane state,
+active-active peering, and fleet failover chaos proofs.
+
+Three layers:
+
+* units — quarantine dump/prime, hot-prefix/quarantine recovery merges,
+  router prime, peering LWW/liveness/leader election, the strike
+  discount, and the restart-safe rate derivation (empty scraper
+  baselines must degrade scoring, never NaN-poison it);
+* lifecycle — GatewayServer start/stop twice in-process with zero leaked
+  control-loop threads (the thread-release leak class, live);
+* chaos twins — gateway kill/restart under shared-prefix traffic
+  (prefix-reuse recovery >= 80% of pre-kill, vs the cold baseline that
+  re-learns from scratch), active-active failover holding >= 90% of
+  no-fault goodput, and a poison body capped at the global strike limit
+  across two peered gateways AND across a gateway restart."""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_llama_tpu.server.autoscaler import Autoscaler, AutoscalerConfig
+from distributed_llama_tpu.server.gateway import (
+    BREAKER_OPEN,
+    Backend,
+    Balancer,
+    GatewayConfig,
+    GatewayServer,
+    _strike_discount_reason,
+)
+from distributed_llama_tpu.server.peering import GatewayPeering
+from distributed_llama_tpu.server.quarantine import (
+    QuarantineLedger,
+    fp_hex,
+    request_fingerprint,
+)
+from distributed_llama_tpu.server.recovery import (
+    merge_hot_prefixes,
+    merge_quarantine,
+    recover_gateway,
+)
+from distributed_llama_tpu.server.router import (
+    Router,
+    RouterConfig,
+    messages_prefix_text,
+    prefix_chain,
+    rendezvous_owner,
+)
+
+from fleet_stub import LoadTwin, StubReplicaConfig, TwinRequest, make_mixed_trace
+
+
+# ---- quarantine dump/prime --------------------------------------------------
+
+
+def test_ledger_dump_prime_roundtrip_keeps_in_force_and_ttl():
+    led = QuarantineLedger(limit=2, ttl_s=0.5)
+    fp_hot = request_fingerprint("poison body")
+    fp_warm = request_fingerprint("one strike only")
+    led.strike(fp_hot, n=2)
+    led.strike(fp_warm)
+    dump = led.dump()
+    assert {e["fp"] for e in dump["entries"]} == {fp_hex(fp_hot), fp_hex(fp_warm)}
+    # a fresh (restarted-gateway) ledger re-learns the dump: the in-force
+    # 422 stays in force, the single strike stays one short
+    led2 = QuarantineLedger(limit=2, ttl_s=0.5)
+    for e in dump["entries"]:
+        led2.prime(int(e["fp"], 16), e["strikes"], e["age_s"])
+    assert led2.is_quarantined(fp_hot)
+    assert not led2.is_quarantined(fp_warm)
+    assert led2.quarantined_total == 1
+    # prime is idempotent (recovery may merge several sources)
+    led2.prime(fp_hot, 2, 0.0)
+    assert led2.quarantined_total == 1
+    # TTL is backdated, not restarted: an aged entry expires when the
+    # ORIGINAL would have
+    led3 = QuarantineLedger(limit=2, ttl_s=0.2)
+    led3.prime(fp_hot, 2, age_s=0.15)
+    assert led3.is_quarantined(fp_hot)
+    time.sleep(0.08)
+    assert not led3.is_quarantined(fp_hot)
+    # an entry already past its TTL at the source never revives
+    led4 = QuarantineLedger(limit=2, ttl_s=0.2)
+    led4.prime(fp_hot, 2, age_s=5.0)
+    assert not led4.is_quarantined(fp_hot)
+
+
+# ---- recovery merges --------------------------------------------------------
+
+
+def test_merge_hot_prefixes_hottest_wins_rendezvous_ties():
+    snaps = {
+        "a:1": {"chains": [{"key": f"{7:016x}", "hits": 9},
+                           {"key": f"{8:016x}", "hits": 3}]},
+        "b:2": {"chains": [{"key": f"{7:016x}", "hits": 2},
+                           {"key": f"{8:016x}", "hits": 3}]},
+        "c:3": None,  # a dead replica contributes nothing
+    }
+    owners = merge_hot_prefixes(snaps)
+    assert owners[7] == "a:1"  # hottest reporter wins
+    # the tie is broken by rendezvous — deterministic across gateways
+    assert owners[8] == rendezvous_owner(8, ["a:1", "b:2"])
+    assert merge_hot_prefixes({"a:1": {"chains": [{"key": "zz"}]}}) == {}
+
+
+def test_merge_quarantine_sums_strikes_keeps_youngest_age():
+    fp = request_fingerprint("bad")
+    snaps = {
+        "a:1": {"entries": [{"fp": fp_hex(fp), "strikes": 1, "age_s": 9.0}]},
+        "b:2": {"entries": [{"fp": fp_hex(fp), "strikes": 1, "age_s": 2.0}]},
+        "c:3": {},
+    }
+    merged = merge_quarantine(snaps)
+    # one incident per replica -> the fleet-wide budget is the SUM
+    assert merged[fp] == (2, 2.0)
+
+
+def test_router_prime_does_not_count_handoff():
+    r = Router(RouterConfig())
+    assert r.prime_locality({11: "a:1", 12: "b:2"}) == 2
+    assert r.owner_of(11) == "a:1"
+    assert r.handoff_snapshot() == {
+        "rehomed_keys": 0, "purged_keys": 0, "drain_events": 0,
+    }
+    r.set_owner(11, "b:2")
+    assert r.owner_of(11) == "b:2"
+
+
+# ---- peering units ----------------------------------------------------------
+
+
+def _balancer(n=3, **kw):
+    kw.setdefault("probe_interval_s", 0)
+    kw.setdefault("fleet_scrape_s", 0)
+    return Balancer(GatewayConfig(
+        backends=[Backend("h", i + 1) for i in range(n)], **kw,
+    ))
+
+
+def test_peering_lww_applies_newer_drops_older():
+    bal = _balancer(2)
+    bal.router = Router(RouterConfig())
+    p = GatewayPeering(bal, self_id="gwB", peers=[], interval_s=0)
+    key = f"{41:016x}"
+    ack = p.apply({"id": "gwA", "clock": 10, "locality": {
+        key: {"b": "h:1", "c": 10, "o": "gwA"},
+    }})
+    assert ack["applied"]["locality"] == 1
+    assert bal.router.owner_of(41) == "h:1"
+    # an OLDER version for the same key loses (stale_dropped), even from
+    # another origin
+    p.apply({"id": "gwC", "clock": 3, "locality": {
+        key: {"b": "h:2", "c": 3, "o": "gwC"},
+    }})
+    assert bal.router.owner_of(41) == "h:1"
+    assert p.counters["stale_dropped"] == 1
+    # a newer one wins
+    p.apply({"id": "gwC", "clock": 99, "locality": {
+        key: {"b": "h:2", "c": 99, "o": "gwC"},
+    }})
+    assert bal.router.owner_of(41) == "h:2"
+    # the receive path advanced the lamport clock past every sender's
+    assert p.snapshot()["clock"] > 99
+
+
+def test_peering_strikes_apply_to_ledger_and_drains_adopt():
+    bal = _balancer(2, quarantine_strikes=2)
+    a = Autoscaler(bal, config=AutoscalerConfig(interval_s=0))
+    bal.autoscaler = a
+    p = GatewayPeering(bal, self_id="gwB", peers=[], interval_s=0)
+    bal.peering = p
+    fp = request_fingerprint("fleet-wide poison")
+    # one local strike + one gossiped strike = quarantined HERE, though
+    # this gateway only ever saw one failure
+    bal.quarantine.strike(fp)
+    p.apply({"id": "gwA", "clock": 5, "strikes": {fp_hex(fp): 1}})
+    assert bal.quarantine.is_quarantined(fp)
+    # a leader's autoscaler drain applies AND transfers undrain ownership
+    key = bal.config.backends[1].key
+    p.apply({"id": "gwA", "clock": 6, "drains": {
+        key: {"draining": True, "by": "autoscaler", "c": 6, "o": "gwA"},
+    }})
+    assert bal.config.backends[1].draining is True
+    assert key in a._drained_by_me
+    # applying must NOT re-broadcast: nothing pending in any outbox
+    assert all(
+        not any(box.values()) for box in p._out.values()
+    )
+
+
+def test_peering_failed_push_restores_delta():
+    bal = _balancer(1)
+    # port 1: nothing listens — the push fails, the delta must survive
+    p = GatewayPeering(
+        bal, self_id="gwA", peers=["127.0.0.1:1"], interval_s=0,
+        timeout_s=0.2,
+    )
+    fp = request_fingerprint("poison")
+    p.note_strike(fp)
+    p.note_locality([41, 42], "h:1")
+    out = p.sync_round()
+    assert out["127.0.0.1:1"]["ok"] is False
+    assert p.counters["sync_failed"] == 1
+    box = p._out["127.0.0.1:1"]
+    assert box["strikes"][fp_hex(fp)] == 1  # at-most-once: still pending
+    assert len(box["locality"]) == 2
+
+
+def test_peering_leader_is_lowest_live_id_and_ages_out():
+    bal = _balancer(1)
+    p = GatewayPeering(
+        bal, self_id="gwB", peers=[], interval_s=0, live_after_s=0.15,
+    )
+    assert p.is_leader()  # alone -> leader
+    p.apply({"id": "gwA", "clock": 1})  # a lower id appears
+    assert p.leader_id() == "gwA" and not p.is_leader()
+    assert p.counters["leadership_transitions"] == 1
+    # a HIGHER id never takes leadership from us
+    p.apply({"id": "gwZ", "clock": 2})
+    assert p.leader_id() == "gwA"
+    time.sleep(0.2)  # gwA (and gwZ) age out -> leadership returns
+    assert p.is_leader()
+    assert p.counters["leadership_transitions"] == 2
+
+
+def test_follower_autoscaler_holds_ticks():
+    bal = _balancer(2)
+    p = GatewayPeering(bal, self_id="gwB", peers=[], interval_s=0)
+    bal.peering = p
+    a = Autoscaler(bal, config=AutoscalerConfig(interval_s=0))
+    bal.autoscaler = a
+    p.apply({"id": "gwA", "clock": 1})  # gwA leads
+    rec = a.tick()
+    assert rec["action"] == "follower_hold"
+    assert "gwA" in rec["detail"]
+    assert a.snapshot()["decisions"]["follower_hold"] == 1
+
+
+# ---- the strike discount (satellite: quarantine false positive) -------------
+
+
+class _FakeFleet:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def router_signals(self):
+        return self.rows
+
+
+def test_strike_discount_reasons():
+    bal = _balancer(2)
+    # healthy, fresh, undrained -> honest evidence (no discount)
+    bal.fleet = _FakeFleet({
+        b.key: {"stale": False, "age_s": 0.1, "signals": {}}
+        for b in bal.config.backends
+    })
+    assert _strike_discount_reason(bal, 0) is None
+    # draining (the rolling-drain correlated-death class)
+    bal.config.backends[0].draining = True
+    assert _strike_discount_reason(bal, 0) == "draining"
+    bal.config.backends[0].draining = False
+    # breaker already open: the fleet knew
+    bal.config.backends[0].breaker = BREAKER_OPEN
+    assert _strike_discount_reason(bal, 0) == "breaker"
+    bal.config.backends[0].breaker = "closed"
+    # stale scrape: the replica went silent before this death
+    bal.fleet = _FakeFleet({
+        bal.config.backends[0].key: {"stale": True, "age_s": 99, "signals": {}},
+    })
+    assert _strike_discount_reason(bal, 0) == "stale_scrape"
+    # no fleet table at all -> no discount (the pre-ISSUE-15 behavior)
+    bal.fleet = None
+    assert _strike_discount_reason(bal, 0) is None
+
+
+def test_rolling_drain_death_does_not_quarantine_innocent_twin():
+    """Chaos arm of the satellite: an innocent conversation is mid-
+    prefill on a replica when a rolling drain hard-kills it — the
+    zero-byte death (exactly the strike heuristic's trigger shape) must
+    NOT strike the innocent fingerprint because the backend was
+    draining, and the transparent retry serves the request elsewhere."""
+    tw = LoadTwin(
+        n_replicas=3,
+        # slow prefill: the innocent's cold prompt takes ~200 ms, a wide
+        # deterministic window for the drain+kill to land mid-request
+        replica_cfg=StubReplicaConfig(
+            batch_slots=4, token_ms=1.0, prefill_ms_per_token=2.0,
+        ),
+        fleet_scrape_s=0.05, quarantine_strikes=2, retry_attempts=2,
+        autoscale_s=0,
+    )
+    try:
+        shared = "innocent rolling drain " * 16
+        innocent = TwinRequest(
+            at_s=0.0, system=shared, user="long answer please", max_tokens=4,
+        )
+        msgs = [
+            {"role": "system", "content": shared},
+            {"role": "user", "content": "long answer please"},
+        ]
+        fp = request_fingerprint(messages_prefix_text(msgs))
+        # the cold placement is deterministic: rendezvous owner of the
+        # chain head — the replica this first-contact prefix lands on
+        home_key = rendezvous_owner(
+            prefix_chain(messages_prefix_text(msgs))[0], tw.replica_keys()
+        )
+        home = tw.replica_keys().index(home_key)
+        time.sleep(0.12)  # two scrapes: rows fresh before the chaos
+        done = {}
+
+        def client():
+            done["res"] = tw._client(innocent)
+
+        th = threading.Thread(target=client, daemon=True)
+        th.start()
+        time.sleep(0.06)  # the request is mid-prefill on the home
+        # the rolling restart: drain, then hard-kill before it finishes
+        tw.autoscaler.drain(home_key)
+        time.sleep(0.05)
+        tw.kill_replica(home)
+        th.join(timeout=30)
+        # the gateway transparently retried the zero-byte death onto a
+        # surviving replica — the client saw ONE clean answer
+        assert done["res"].outcome == "ok", done["res"]
+        assert tw.replicas[home].state.wasted  # the death really hit home
+        # the innocent fingerprint was NEVER struck: the death happened
+        # on a DRAINING backend (the fleet already knew)
+        assert not tw.balancer.quarantine.is_quarantined(fp)
+        assert tw.balancer.quarantine.strikes(fp) == 0
+        stats = tw.balancer.stats()
+        assert stats["counters"]["poison_strikes"] == 0
+        assert stats["counters"]["poison_strikes_discounted"] >= 1
+        # and a replay of the SAME conversation still serves (no 422)
+        replay = tw._client(TwinRequest(
+            at_s=0.0, system=shared, user="long answer please", max_tokens=2,
+        ))
+        assert replay.outcome == "ok"
+    finally:
+        tw.close()
+
+
+def test_poison_death_that_opens_breaker_still_strikes():
+    """Regression (review): the discount must be computed BEFORE
+    ``release()`` records the failing attempt. With breaker threshold 1
+    the poison death itself flips the breaker OPEN — under the old order
+    (release first, discount after) every strike was discounted as
+    "breaker", the body never quarantined, and the advertised
+    at-most-``DLT_QUARANTINE_STRIKES`` replica budget was unbounded.
+    Replica-side ledgers are disabled (limit 0) so the 422 can ONLY come
+    from gateway strikes."""
+    LIMIT = 2
+    poison_sys = "breaker self implication poison " * 8
+    fp = request_fingerprint(messages_prefix_text([
+        {"role": "system", "content": poison_sys},
+        {"role": "user", "content": "boom"},
+    ]))
+    tw = LoadTwin(
+        n_replicas=4,
+        replica_cfg=StubReplicaConfig(
+            poison_fps=frozenset({fp}), poison_recover_s=0.2,
+            quarantine_limit=0,  # replica ledger OFF: gateway-only proof
+        ),
+        fleet_scrape_s=0.05, quarantine_strikes=LIMIT, retry_attempts=0,
+        breaker_failure_threshold=1,
+    )
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{tw.port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [
+                    {"role": "system", "content": poison_sys},
+                    {"role": "user", "content": "boom"},
+                ],
+                "max_tokens": 4, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+        except OSError:
+            return -1
+
+    try:
+        time.sleep(0.12)  # rows fresh: no stale_scrape discounts in play
+        codes = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            codes.append(post())
+            assert tw.poisoned_replica_count() <= LIMIT, codes
+            if codes[-1] == 422:
+                break
+            time.sleep(0.12)
+        assert codes[-1] == 422, codes
+        stats = tw.balancer.stats()
+        # both deaths were honest strike evidence — the breaker each one
+        # opened is an EFFECT of the death, not prior fleet knowledge
+        assert stats["counters"]["poison_strikes"] == LIMIT
+        assert stats["counters"]["poison_strikes_discounted"] == 0
+        assert 1 <= tw.poisoned_replica_count() <= LIMIT
+    finally:
+        tw.close()
+
+
+# ---- restart-safe rate derivation (satellite) -------------------------------
+
+
+def test_first_scrape_has_no_rates_and_router_scores_stay_finite():
+    """After a gateway restart the scraper's counter baselines are empty:
+    rate fields (prefix_hit_tokens_per_s, shed_per_s) are undefined for
+    one interval. The router must degrade to headroom/affinity scoring —
+    finite scores, never NaN/zero-poisoned — and the autoscaler must not
+    read the missing rates as evidence either way."""
+    tw = LoadTwin(n_replicas=2, fleet_scrape_s=0.0, autoscale_s=0)
+    try:
+        # one request so /metrics carries non-zero counters, then ONE
+        # scrape — the restarted-gateway state: fresh row, no baselines
+        assert tw._client(TwinRequest(
+            at_s=0.0, system="rates " * 40, user="q", max_tokens=2,
+        )).outcome == "ok"
+        tw.scraper.scrape_once()
+        rows = tw.scraper.router_signals()
+        assert len(rows) == 2
+        for row in rows.values():
+            assert row["stale"] is False
+            assert "prefix_hit_tokens_per_s" not in row["signals"]
+            assert "shed_per_s" not in row["signals"]
+            # the gauge signals ARE there — scoring has inputs
+            assert "batcher_batch_slots" in row["signals"]
+        body = json.dumps({"messages": [
+            {"role": "system", "content": "rates " * 40},
+            {"role": "user", "content": "q2"},
+        ]}).encode()
+        plan = tw.balancer.router.plan(body, tw.balancer)
+        assert plan is not None and plan.fresh
+        assert len(plan.ranked) == 2
+        for _, score in plan.scored:
+            assert math.isfinite(score)
+        # affinity still dominates: the learned home ranks first
+        assert tw.cfg.backends[plan.ranked[0]].key == plan.affinity_key
+        # the autoscaler sees no rates as no pressure — and real
+        # utilization evidence from the gauges (not None)
+        rec = tw.autoscaler.tick()
+        assert rec["action"] == "hold"
+        assert rec["pressure"] is None
+        assert rec["utilization"] is not None
+        # the SECOND scrape establishes baselines: rates appear
+        time.sleep(0.05)
+        tw.scraper.scrape_once()
+        rows = tw.scraper.router_signals()
+        assert all(
+            "prefix_hit_tokens_per_s" in row["signals"]
+            for row in rows.values()
+        )
+    finally:
+        tw.close()
+
+
+# ---- GatewayServer lifecycle (satellite: thread leak) -----------------------
+
+
+def test_gateway_server_lifecycle_stops_every_owned_thread():
+    """Instantiate the gateway TWICE in-process on the same port (the
+    restart tests' shape): server_close() must stop the scraper,
+    autoscaler, prober, and peer-sync threads the instance started — a
+    leaked loop from the first instance would keep scraping/draining
+    against the fleet under the second."""
+    tw = LoadTwin(n_replicas=2, fleet_scrape_s=0.0)
+    try:
+        cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", r.port) for r in tw.replicas],
+            probe_interval_s=0.05, fleet_scrape_s=0.05,
+            autoscale_s=0.05,
+            peer_gateways=["127.0.0.1:1"], peer_sync_s=0.05,
+            gateway_id="gw-lifecycle",
+            recover_on_start=False,
+        )
+        bal = Balancer(cfg)
+        from fleet_stub import free_port
+
+        port = free_port()
+        srv = GatewayServer(port, bal).start()
+        assert bal.fleet is not None and bal.autoscaler is not None
+        assert bal.peering is not None
+        time.sleep(0.2)
+        assert bal.fleet.scrape_rounds >= 1
+        srv.server_close()
+        rounds = bal.fleet.scrape_rounds
+        ticks = bal.autoscaler.snapshot()["ticks"]
+        sync_rounds = bal.peering.sync_rounds
+        time.sleep(0.25)
+        # every loop stopped: no thread advanced after server_close()
+        assert bal.fleet.scrape_rounds == rounds
+        assert bal.autoscaler.snapshot()["ticks"] == ticks
+        assert bal.peering.sync_rounds == sync_rounds
+        # the port is free: a second instance binds and serves
+        bal2 = Balancer(GatewayConfig(
+            backends=[Backend("127.0.0.1", r.port) for r in tw.replicas],
+            probe_interval_s=0, fleet_scrape_s=0, recover_on_start=False,
+        ))
+        srv2 = GatewayServer(port, bal2).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/gateway/stats", timeout=10
+            ) as r:
+                assert json.loads(r.read())["queue_depth"] == 0
+        finally:
+            srv2.server_close()
+    finally:
+        tw.close()
+
+
+# ---- warm-restart recovery over the twin fleet ------------------------------
+
+
+def test_recovery_restores_drains_and_quarantine_from_replicas():
+    """A drained replica + an in-force quarantine survive a gateway
+    crash: the restarted gateway reads drain hints from /health (and
+    adopts autoscaler ownership) and re-learns strike ledgers from
+    /debug/quarantine."""
+    poison_sys = "killer body " * 8
+    poison_fp = request_fingerprint(messages_prefix_text([
+        {"role": "system", "content": poison_sys},
+        {"role": "user", "content": "boom"},
+    ]))
+    cfg = StubReplicaConfig(
+        poison_fps=frozenset({poison_fp}), poison_recover_s=0.2,
+        quarantine_limit=2,
+    )
+    tw = LoadTwin(
+        n_replicas=4, replica_cfg=cfg, fleet_scrape_s=0.05,
+        quarantine_strikes=2, retry_attempts=3, autoscale_s=0,
+    )
+    try:
+        # burn the poison budget: 2 replicas struck, then terminal 422
+        res = tw._client(TwinRequest(
+            at_s=0.0, system=poison_sys, user="boom", max_tokens=4,
+        ))
+        assert res.outcome == "quarantined"
+        assert tw.poisoned_replica_count() == 2
+        # autoscaler-drain one healthy replica (hint posted to the stub)
+        victim = next(
+            k for i, k in enumerate(tw.replica_keys())
+            if tw.replicas[i].state.counters.get("poison_hits", 0) == 0
+        )
+        tw.autoscaler.drain(victim)
+        deadline = time.monotonic() + 5
+        vi = tw.replica_keys().index(victim)
+        while time.monotonic() < deadline:
+            if tw.replicas[vi].state.draining_hint is not None:
+                break
+            time.sleep(0.02)
+        assert tw.replicas[vi].state.draining_hint == {
+            "draining": True, "by": "autoscaler",
+        }
+        # CRASH the gateway; restart warm
+        tw.kill_gateway(0)
+        gw = tw.restart_gateway(0, recover=True)
+        rec = gw.balancer.recovery
+        assert rec["replicas_answered"] == 4
+        assert rec["drains_restored"] == 1 and rec["drains_adopted"] == 1
+        assert rec["quarantine_fps"] >= 1 and rec["quarantine_in_force"] >= 1
+        # the drain survived, WITH ownership
+        assert gw.balancer.config.backends[vi].draining is True
+        assert victim in gw.autoscaler._drained_by_me
+        # the poison body is still 422 on the fresh gateway — zero
+        # additional replicas burned
+        res = tw._client(TwinRequest(
+            at_s=0.0, system=poison_sys, user="boom", max_tokens=4,
+        ))
+        assert res.outcome == "quarantined"
+        assert tw.poisoned_replica_count() == 2
+        # the recovery counters are on /metrics
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{tw.port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert "dlt_gateway_recovery_runs_total 1" in body
+        assert "dlt_gateway_recovery_drains_restored_total 1" in body
+    finally:
+        tw.close()
+
+
+def test_gateway_restart_recovers_prefix_affinity_vs_cold():
+    """THE restart acceptance: under shared-prefix traffic whose learned
+    homes differ from rendezvous (the drain->rehome->undrain history
+    every long-lived fleet accumulates), a warm-restarted gateway holds
+    >= 80% of the pre-kill prefix-hit rate in the first post-restart
+    window — while the cold baseline re-learns from scratch and pays a
+    cold prefill per chain."""
+    SCRAPE_S = 0.25
+    tw = LoadTwin(
+        n_replicas=4,
+        replica_cfg=StubReplicaConfig(batch_slots=8, token_ms=1.0),
+        fleet_scrape_s=SCRAPE_S, quarantine_strikes=0,
+    )
+    apps = [f"restartapp{i} " * 24 for i in range(6)]
+
+    def send_round(tag, per_app=3):
+        for a, system in enumerate(apps):
+            for j in range(per_app):
+                res = tw._client(TwinRequest(
+                    at_s=0.0, system=system, user=f"{tag} q{a}.{j}",
+                    max_tokens=2,
+                ))
+                assert res.outcome == "ok", res
+
+    try:
+        keys = tw.replica_keys()
+        # accumulate drain history: each app first lands while its
+        # rendezvous owner is drained, so the LEARNED home differs from
+        # the rendezvous default a cold gateway would fall back to
+        for system in apps:
+            chain = prefix_chain(messages_prefix_text(
+                [{"role": "system", "content": system},
+                 {"role": "user", "content": "x"}]
+            ))
+            owner = rendezvous_owner(chain[0], keys)
+            assert tw.balancer.set_draining(owner, True)
+            assert tw._client(TwinRequest(
+                at_s=0.0, system=system, user="x", max_tokens=2,
+            )).outcome == "ok"
+            assert tw.balancer.set_draining(owner, False)
+        # pre-kill window: the steady-state hit rate
+        send_round("warmup")
+        h0 = tw.fleet_prefix_hit_tokens()
+        send_round("prekill")
+        pre_hits = tw.fleet_prefix_hit_tokens() - h0
+        assert pre_hits > 0
+        # kill + WARM restart; the measured window must fit inside 3
+        # scrape intervals (recovery is synchronous, so the first request
+        # already routes on the recovered map)
+        tw.kill_gateway(0)
+        gw = tw.restart_gateway(0, recover=True)
+        assert gw.balancer.recovery["locality_keys"] > 0
+        h1 = tw.fleet_prefix_hit_tokens()
+        t0 = time.monotonic()
+        send_round("postwarm")
+        warm_window_s = time.monotonic() - t0
+        warm_hits = tw.fleet_prefix_hit_tokens() - h1
+        assert warm_window_s <= 3 * SCRAPE_S, warm_window_s
+        assert warm_hits >= 0.8 * pre_hits, (warm_hits, pre_hits)
+        # kill + COLD restart (the baseline): the empty locality map
+        # falls back to rendezvous homes that never served these chains
+        # -> one cold prefill per app inside the same window
+        tw.kill_gateway(0)
+        tw.restart_gateway(0, recover=False)
+        h2 = tw.fleet_prefix_hit_tokens()
+        send_round("postcold")
+        cold_hits = tw.fleet_prefix_hit_tokens() - h2
+        assert cold_hits < warm_hits, (cold_hits, warm_hits)
+    finally:
+        tw.close()
+
+
+# ---- active-active failover chaos (the loadtwin leg) ------------------------
+
+
+def test_active_active_gateway_kill_restart_holds_goodput():
+    """THE failover acceptance: two active-active gateways over one
+    fleet; one is hard-killed mid-trace and warm-restarted — clients
+    fail over between gateway addresses, goodput holds >= 90% of the
+    no-fault arm over a common horizon, with zero failed requests."""
+    HORIZON_S = 6.0
+    cfg = StubReplicaConfig(batch_slots=4, token_ms=2.0)
+    trace = make_mixed_trace(seed=23, duration_s=2.0)
+
+    def run_arm(chaos: bool) -> dict:
+        tw = LoadTwin(
+            n_replicas=6, replica_cfg=cfg, fleet_scrape_s=0.1,
+            n_gateways=2, peer_sync_s=0.1, retry_attempts=3,
+        )
+        try:
+            timers = []
+            if chaos:
+                timers = [
+                    threading.Timer(0.8, tw.kill_gateway, args=(0,)),
+                    threading.Timer(
+                        1.6, tw.restart_gateway, args=(0,),
+                    ),
+                ]
+                for t in timers:
+                    t.daemon = True
+                    t.start()
+            results = tw.run(trace)
+            for t in timers:
+                t.join(timeout=10)
+            rep = tw.report(results, horizon_s=HORIZON_S)
+            rep["gateway_failovers"] = sum(
+                r.gateway_failovers for r in results if r is not None
+            )
+            return rep
+        finally:
+            tw.close()
+
+    base = run_arm(chaos=False)
+    assert base["failures"] == 0
+    chaos = run_arm(chaos=True)
+    # zero failed client requests through the kill/restart: every
+    # refused connection failed over to the surviving gateway
+    assert chaos["failures"] == 0
+    assert chaos["gateway_failovers"] >= 1  # the chaos actually bit
+    retention = chaos["goodput_tokens_per_s"] / max(
+        base["goodput_tokens_per_s"], 1e-9
+    )
+    assert retention >= 0.9, (retention, chaos, base)
+
+
+def test_poison_budget_is_fleet_wide_across_peered_gateways():
+    """THE quarantine continuity acceptance: a replica-killing poison
+    body retried across two peered gateways (and across one gateway
+    restart) burns at most DLT_QUARANTINE_STRIKES replicas TOTAL, then
+    422s on every gateway."""
+    LIMIT = 2
+    poison_sys = "cross gateway poison " * 8
+    poison_fp = request_fingerprint(messages_prefix_text([
+        {"role": "system", "content": poison_sys},
+        {"role": "user", "content": "boom"},
+    ]))
+    tw = LoadTwin(
+        n_replicas=5,
+        replica_cfg=StubReplicaConfig(
+            poison_fps=frozenset({poison_fp}), poison_recover_s=0.2,
+            quarantine_limit=LIMIT,
+        ),
+        fleet_scrape_s=0.05,
+        n_gateways=2, peer_sync_s=0,  # gossip driven manually
+        quarantine_strikes=LIMIT,
+        retry_attempts=0,  # each gateway tries ONCE per client attempt
+    )
+
+    def post(port):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [
+                    {"role": "system", "content": poison_sys},
+                    {"role": "user", "content": "boom"},
+                ],
+                "max_tokens": 4, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+        except OSError:
+            return -1
+
+    try:
+        p0, p1 = tw.gateway_ports
+        # the client re-sends the poison body alternating gateways (the
+        # production failure-churn shape). Without peering each gateway
+        # would burn its OWN strike budget — up to 2*LIMIT replicas; with
+        # strikes gossiped, the budget is GLOBAL. Along the way the body
+        # may also meet 502s (its own crash) and 503s (a still-recovering
+        # replica — never strike evidence); it must go terminally 422 on
+        # BOTH gateways without ever burning more than LIMIT replicas.
+        codes = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            codes.append((post(p0), post(p1)))
+            tw.sync_gateways()
+            assert tw.poisoned_replica_count() <= LIMIT, codes
+            if codes[-1] == (422, 422):
+                break
+            time.sleep(0.12)
+        assert codes[-1] == (422, 422), codes
+        assert 1 <= tw.poisoned_replica_count() <= LIMIT
+        burned = tw.poisoned_replica_count()
+        # terminal on BOTH gateways, no further replica touched
+        for port in (p0, p1, p0, p1):
+            assert post(port) == 422
+        assert tw.poisoned_replica_count() == burned
+        # and across a RESTART: the fresh gateway re-learns the in-force
+        # quarantine from the replicas' ledgers before its first request
+        tw.kill_gateway(0)
+        tw.restart_gateway(0, recover=True)
+        assert post(p0) == 422
+        assert tw.poisoned_replica_count() == burned
+    finally:
+        tw.close()
+
+
+# ---- the LIVE restart proof (real engines) ----------------------------------
+
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+@pytest.mark.slow
+def test_live_gateway_restart_recovers_affinity_over_real_replicas(
+    tmp_path_factory, monkeypatch,
+):
+    """ISSUE 15 live acceptance: kill and restart a gateway over 4 REAL
+    engine replicas under shared-prefix traffic — the warm-restarted
+    gateway recovers fleet-wide prefix reuse to >= 80% of the pre-kill
+    window within 3 scrape intervals, with zero failed client requests."""
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.formats.mfile import ArchType
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import (
+        tiny_header, write_tiny_model, write_tiny_tokenizer,
+    )
+    from fleet_stub import free_port
+
+    monkeypatch.setenv("DLT_COST_TABLE", "0")
+    monkeypatch.setenv("DLT_NO_WARMUP", "1")
+    d = tmp_path_factory.mktemp("hafleet")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+        seq_len=256, vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    servers, ports = [], []
+    for i in range(4):
+        p = build_arg_parser()
+        p.add_argument("--port", type=int, default=0)
+        port = free_port()
+        args = p.parse_args([
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.0",
+            "--port", str(port),
+        ])
+        httpd = api_mod.serve(args)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        ports.append(port)
+
+    SCRAPE_S = 2.0  # the production default cadence
+
+    def make_gateway(gw_port, recover):
+        cfg = GatewayConfig(
+            backends=[Backend("127.0.0.1", p) for p in ports],
+            probe_interval_s=0, fleet_scrape_s=SCRAPE_S,
+            router_policy="cache_aware", recover_on_start=recover,
+        )
+        bal = Balancer(cfg)
+        return GatewayServer(gw_port, bal).start(), bal
+
+    def ask(port, system, user):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [
+                    {"role": "system", "content": system},
+                    {"role": "user", "content": user},
+                ],
+                "max_tokens": 4,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+
+    def fleet_hits() -> int:
+        total = 0
+        for p in ports:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{p}/health", timeout=30
+            ) as r:
+                total += json.loads(
+                    r.read()
+                )["counters"].get("prefix_hit_tokens", 0)
+        return total
+
+    apps = [f"liveapp{i:02d} " * 15 for i in range(3)]
+    gw_port = free_port()
+    srv, bal = make_gateway(gw_port, recover=False)
+    try:
+        # drain history: each app first lands while its rendezvous owner
+        # is drained, so the learned home differs from the cold fallback
+        keys = [b.key for b in bal.config.backends]
+        for system in apps:
+            chain = prefix_chain(messages_prefix_text(
+                [{"role": "system", "content": system},
+                 {"role": "user", "content": "x"}]
+            ))
+            owner = rendezvous_owner(chain[0], keys)
+            assert bal.set_draining(owner, True)
+            ask(gw_port, system, "x")
+            assert bal.set_draining(owner, False)
+        for a, system in enumerate(apps):  # steady state
+            for j in range(2):
+                ask(gw_port, system, f"warm {a}.{j}")
+        h0 = fleet_hits()
+        for a, system in enumerate(apps):  # the pre-kill window
+            for j in range(2):
+                ask(gw_port, system, f"pre {a}.{j}")
+        pre_hits = fleet_hits() - h0
+        assert pre_hits > 0
+        # CRASH the gateway, warm-restart it on the same port
+        srv.server_close()
+        srv, bal = make_gateway(gw_port, recover=True)
+        rec = bal.recovery
+        assert rec["replicas_answered"] == 4
+        assert rec["locality_keys"] > 0
+        h1 = fleet_hits()
+        t0 = time.monotonic()
+        for a, system in enumerate(apps):  # the post-restart window —
+            for j in range(2):             # zero failed requests
+                ask(gw_port, system, f"post {a}.{j}")
+        window_s = time.monotonic() - t0
+        warm_hits = fleet_hits() - h1
+        assert window_s <= 3 * SCRAPE_S, window_s
+        assert warm_hits >= 0.8 * pre_hits, (warm_hits, pre_hits)
+    finally:
+        srv.server_close()
+        for s in servers:
+            s.shutdown()

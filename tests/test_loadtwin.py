@@ -78,7 +78,7 @@ def test_twin_smoke_ten_replicas_zero_failures():
         assert len(fleet["replicas"]) == 10
         assert fleet["router"]["policy"] == "cache_aware"
         assert fleet["autoscaler"]["decisions"] == {
-            "drain": 0, "undrain": 0, "hold": 0,
+            "drain": 0, "undrain": 0, "hold": 0, "follower_hold": 0,
         }
         with urllib.request.urlopen(
             f"http://127.0.0.1:{tw.port}/metrics", timeout=30
