@@ -1221,6 +1221,155 @@ def leg_kv_movement():
     }
 
 
+def leg_kv_integrity():
+    """Data-plane integrity leg (ISSUE 16, runtime/kv_transport.py +
+    server/chaos.py): the same prefill->decode disagg pair as the KV
+    movement leg, but the decode worker reaches the prefill worker through
+    a ChaosProxy flipping one bit in ~10% of responses (seeded). Two arms
+    over identical fresh-prefix traffic on the forced-HTTP wire: no-fault
+    vs corrupted. Every corrupted transfer must be REJECTED by the
+    checksum gate and degrade to local prefill — zero failed requests —
+    and goodput must hold >= 90% of the no-fault arm (the corruption tax
+    is a re-prefill, never a retry storm or a poisoned cache)."""
+    import json as _json
+    import socket as _socket
+    import threading
+    import time as _time
+    import urllib.request
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.server.chaos import (
+        BITFLIP, ChaosProxy, Fault, FaultPlan,
+    )
+    from distributed_llama_tpu.server.disagg import DisaggClient
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    model = build_model(
+        "llama_routing_q40_v1",
+        dim=512, hidden_dim=1536, n_layers=8, n_heads=8, n_kv_heads=4,
+        vocab_size=4096, seq_len=2048,
+    )
+    tok_path = os.path.join(CACHE_DIR, "routing_tok_v1.t")
+    if not os.path.exists(tok_path):
+        write_tiny_tokenizer(
+            tok_path, pad_to=4096,
+            chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+        )
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    os.environ["DLT_COST_TABLE"] = "0"
+    servers = []
+    proxy = None
+    try:
+        def start(extra):
+            p = build_arg_parser()
+            p.add_argument("--port", type=int, default=0)
+            port = free_port()
+            args = p.parse_args(
+                [
+                    "inference", "--model", model, "--tokenizer", tok_path,
+                    "--steps", "0", "--temperature", "0.0",
+                    "--port", str(port),
+                ] + extra
+            )
+            httpd = api_mod.serve(args)
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            servers.append(httpd)
+            return port, httpd
+
+        pf_port, _pf = start(["--role", "prefill"])
+        dec_port, dec = start(
+            ["--role", "decode", "--prefill-peer", f"127.0.0.1:{pf_port}"]
+        )
+        state = dec.RequestHandlerClass.state
+        proxy = ChaosProxy(
+            "127.0.0.1", pf_port,
+            FaultPlan(random_mix=[(0.10, Fault(BITFLIP))], seed=16),
+        ).start()
+
+        def ask(system, user):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dec_port}/v1/chat/completions",
+                data=_json.dumps(
+                    {
+                        "messages": [
+                            {"role": "system", "content": system},
+                            {"role": "user", "content": user},
+                        ],
+                        "max_tokens": 8,
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return _json.loads(r.read())
+
+        def run_arm(peer_port, tag, n=12):
+            # generous strike budget: this arm measures the per-transfer
+            # corruption tax, not the quarantine cutoff (that proof lives
+            # in tests/test_kv_integrity.py)
+            state.disagg = DisaggClient(
+                state, [("127.0.0.1", peer_port)], transport="http",
+                integrity_strikes=10_000,
+            )
+            c0 = state.engine.stats.counters_snapshot()
+            delivered = 0
+            failures = 0
+            t0 = _time.perf_counter()
+            for i in range(n):
+                try:
+                    r = ask(f"{tag}{i}" + "x" * 508, f"question {i}")
+                    delivered += r["usage"]["completion_tokens"]
+                except Exception:
+                    failures += 1
+            wall = _time.perf_counter() - t0
+            c1 = state.engine.stats.counters_snapshot()
+            return {
+                "goodput_tokens_per_s": delivered / max(wall, 1e-9),
+                "failures": failures,
+                "rejected": c1.get("kv_integrity_rejected", 0)
+                - c0.get("kv_integrity_rejected", 0),
+                "verified": c1.get("kv_integrity_verified", 0)
+                - c0.get("kv_integrity_verified", 0),
+            }
+
+        run_arm(pf_port, "W", n=2)  # warm the ladders off the clock
+        base = run_arm(pf_port, "B")
+        chaos = run_arm(proxy.port, "C")
+    finally:
+        os.environ.pop("DLT_COST_TABLE", None)
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            s.shutdown()
+    assert base["failures"] == 0 and chaos["failures"] == 0, (base, chaos)
+    assert chaos["rejected"] > 0, chaos  # the 10% mix must actually bite
+    retention = 100.0 * chaos["goodput_tokens_per_s"] / max(
+        base["goodput_tokens_per_s"], 1e-9
+    )
+    return {
+        "config": "kv-integrity http disagg, 10% bitflipped transfers",
+        "goodput_tokens_per_s_nofault": round(
+            base["goodput_tokens_per_s"], 1
+        ),
+        "goodput_tokens_per_s_corrupted": round(
+            chaos["goodput_tokens_per_s"], 1
+        ),
+        "corruption_goodput_retention_pct": round(retention, 1),
+        "retention_bar_pct": 90.0,
+        "transfers_rejected": chaos["rejected"],
+        "transfers_verified": base["verified"] + chaos["verified"],
+        "failed_requests": base["failures"] + chaos["failures"],
+    }
+
+
 def leg_loadtwin():
     """Fleet-control-plane leg (server/loadtwin.py + server/scheduler.py):
     the ISSUE-12 mixed-class SLO twin. One seeded bursty mixed-class trace
@@ -1605,6 +1754,13 @@ def main():
         print(f"# kv-movement: {kvm}", file=sys.stderr)
     except Exception as e:
         print(f"# kv-movement leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        kvi = leg_kv_integrity()
+        configs.append(kvi)
+        print(f"# kv-integrity: {kvi}", file=sys.stderr)
+    except Exception as e:
+        print(f"# kv-integrity leg failed: {e!r}", file=sys.stderr)
 
     try:
         lt = leg_loadtwin()

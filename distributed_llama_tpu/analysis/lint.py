@@ -99,8 +99,11 @@ HOST_SYNC_SCOPE = ("runtime", "parallel")
 #: load twin's stub decode loop (server/loadtwin.py) — the goodput-ledger
 #: /batch-timeline/gw_route/kv_transfer/scheduler-decision emission
 #: sites). The KV movement layer (runtime/kv_transport.py) rides the
-#: `runtime` prefix: its transport fetch loops and the per-segment
-#: insert/extract loops are in scope like every other hot path.
+#: `runtime` prefix: its transport fetch loops, the per-segment
+#: insert/extract loops, AND the receipt-verification checksum loop
+#: (verify_transfer's per-doubling-segment pass — emit-free by design:
+#: the one `kv_integrity` event per fetch lands in DisaggClient.fetch
+#: AFTER the peer loop) are in scope like every other hot path.
 TRACE_EMIT_SCOPE = ("runtime", "parallel", "server")
 #: packages whose classes must pair a sentinel subscription with a
 #: teardown release (engine lifecycles live here)

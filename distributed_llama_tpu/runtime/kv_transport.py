@@ -31,7 +31,19 @@ point; ROADMAP item 2:
   grew ``start`` (the token offset of the shipped slice — partial sends
   ship only the pages the requester is missing) and ``page_keys`` (the
   content names of the covered span, so the receiver can verify the
-  naming agreement instead of trusting it).
+  naming agreement instead of trusting it);
+* **data-plane integrity** — wire version :data:`WIRE_VERSION` carries
+  per-doubling-segment byte checksums (``k_sums``/``v_sums``) next to the
+  token-derived ``page_keys`` echo, and :func:`verify_transfer` checks
+  BOTH on receipt, before anything can touch the receiver's prefix cache:
+  a flipped bit, a truncated-but-parseable body, or a stale page from a
+  port-reused peer is a :class:`KvIntegrityError` — the caller's existing
+  degrade path (reject, cold-prefill locally, token-identical output) —
+  never a poisoned cache entry. The device path verifies the cheap
+  metadata half (token chain, segment shapes, dtype, entry bounds): the
+  bytes never leave the process. An unknown wire version is the separate
+  :class:`KvVersionError` (skip the peer, never strike it — rolling
+  deploys mix versions without quarantining innocents).
 
 Every transfer is accounted per path: the ``kv_transfer_us[{path}]``
 StepStats series (rendered as the labeled ``dlt_kv_transfer_us`` family)
@@ -46,9 +58,11 @@ unit tests must not drag jax in.
 from __future__ import annotations
 
 import json
+import math
 import os
 import struct
 import threading
+import zlib
 
 import numpy as np
 
@@ -59,8 +73,36 @@ KEY_PAGE_TOKENS = 16
 
 KV_TRANSPORTS = ("auto", "device", "http")
 
+#: wire codec version. v1 (PR 10) shipped bytes untagged and unchecked;
+#: v2 adds the version field itself plus per-segment checksums and the
+#: page_keys echo that verify_transfer checks before any cache insert.
+WIRE_VERSION = 2
+
 _FNV64_OFFSET = 0xCBF29CE484222325
 _FNV64_PRIME = 0x100000001B3
+
+
+class KvCodecError(ValueError):
+    """A wire payload this build cannot use: truncation, garbage header,
+    shape/dtype nonsense. A ValueError subclass so every pre-existing
+    ``except ValueError`` degrade path keeps catching it — but the
+    DisaggClient can now tell a complete-but-wrong response (this family:
+    strike the peer) from a transport death (OSError: back off the peer)."""
+
+
+class KvIntegrityError(KvCodecError):
+    """The payload parsed but its content is wrong: checksum mismatch,
+    page_keys echo disagreeing with the token chain, tokens that are not
+    the ones asked for, shapes that do not cover the slice. The one
+    corruption signal — the receiver rejects BEFORE the cache is touched
+    and strikes the peer (corrupt-peer quarantine)."""
+
+
+class KvVersionError(KvCodecError):
+    """The peer speaks a different wire version. Rejected cleanly at the
+    header — never mid-body as a generic parse error — and NEVER a strike:
+    a mixed-version fleet mid-rolling-deploy is healthy, just incompatible;
+    the client skips the peer (``disagg_peer_version_mismatch``)."""
 
 
 def resolve_transport(explicit: str | None = None) -> str:
@@ -137,16 +179,29 @@ def matching_pages(expected_keys, have_keys) -> int:
 # -- the wire format ----------------------------------------------------------
 #
 # 4-byte big-endian header length | JSON header | raw k bytes | raw v bytes
-# Header: tokens (ALL P token ids the boundary covers), p, start (token
-# offset of the shipped slice — 0 for a full send, a page multiple when the
-# requester already held the leading pages), page_keys (content names of the
-# full span, hex strings), k_shape/v_shape (of the SHIPPED slice), dtype,
-# prefill_us (the worker's wall — the decode side's ledger field). Raw bytes
-# rather than base64-in-JSON: a 512-token 8B-class slice is tens of MB and
-# the transfer wall is the metric under test.
+# Header: v (wire version), tokens (ALL P token ids the boundary covers),
+# p, start (token offset of the shipped slice — 0 for a full send, a page
+# multiple when the requester already held the leading pages), page_keys
+# (content names of the full span, hex strings), k_shape/v_shape (of the
+# SHIPPED slice), dtype, k_sums/v_sums (per-doubling-segment byte
+# checksums, hex strings), prefill_us (the worker's wall — the decode
+# side's ledger field). Raw bytes rather than base64-in-JSON: a 512-token
+# 8B-class slice is tens of MB and the transfer wall is the metric under
+# test — which is also why the checksum is crc32 (C speed, stdlib,
+# xxhash-style cost) and not the pure-python FNV loop that names pages:
+# page_keys hash a few hundred token ids, the sums hash the multi-MB body.
+
+
+def segment_checksum(data: bytes) -> int:
+    """Byte checksum of ONE doubling segment's raw k (or v) bytes."""
+    return zlib.crc32(data) & 0xFFFFFFFF
 
 
 def kv_payload(header: dict, k_np: np.ndarray, v_np: np.ndarray) -> bytes:
+    # the codec owns the version field: every payload this build emits is
+    # stamped, so a v3 receiver can reject it at the header
+    if "v" not in header:
+        header = dict(header, v=WIRE_VERSION)
     hjson = json.dumps(header).encode()
     return struct.pack(">I", len(hjson)) + hjson + k_np.tobytes() + v_np.tobytes()
 
@@ -163,28 +218,175 @@ def _np_dtype(name: str):
 
 
 def parse_kv_payload(body: bytes):
-    """``(header, k_np, v_np)`` from one payload; raises ValueError on any
-    truncation or shape/dtype mismatch (the caller's degradation path)."""
+    """``(header, k_np, v_np)`` from one payload.
+
+    Raises :class:`KvVersionError` on a wire-version mismatch (decided at
+    the header, before any body work) and :class:`KvCodecError` — both
+    ValueError subclasses — on EVERYTHING else a hostile or truncated body
+    can be: fuzz-hardened, so a handler thread never sees a KeyError /
+    TypeError / AttributeError escape from a garbage payload."""
+    if not isinstance(body, (bytes, bytearray, memoryview)):
+        raise KvCodecError(f"kv payload is {type(body).__name__}, not bytes")
+    body = bytes(body)
     if len(body) < 4:
-        raise ValueError("kv payload truncated before header length")
+        raise KvCodecError("kv payload truncated before header length")
     (hlen,) = struct.unpack(">I", body[:4])
-    if len(body) < 4 + hlen:
-        raise ValueError("kv payload truncated inside header")
-    header = json.loads(body[4 : 4 + hlen])
-    dt = _np_dtype(header["dtype"])
-    k_shape = tuple(header["k_shape"])
-    v_shape = tuple(header["v_shape"])
-    k_bytes = int(np.prod(k_shape)) * dt.itemsize
-    v_bytes = int(np.prod(v_shape)) * dt.itemsize
+    if hlen > len(body) - 4:
+        raise KvCodecError("kv payload truncated inside header")
+    try:
+        header = json.loads(body[4 : 4 + hlen])
+    except ValueError as e:  # JSONDecodeError and bad-encoding both
+        raise KvCodecError(f"kv header is not JSON: {e}") from None
+    if not isinstance(header, dict):
+        raise KvCodecError("kv header is not a JSON object")
+    try:
+        ver = int(header.get("v", 1))
+    except (TypeError, ValueError):
+        raise KvCodecError(f"unreadable kv wire version {header.get('v')!r}") from None
+    if ver != WIRE_VERSION:
+        raise KvVersionError(
+            f"kv wire version {ver}; this build speaks {WIRE_VERSION}"
+        )
+    try:
+        dt = _np_dtype(header["dtype"])
+        k_shape = tuple(int(d) for d in header["k_shape"])
+        v_shape = tuple(int(d) for d in header["v_shape"])
+    except Exception as e:
+        # KeyError (field missing), TypeError (np.dtype on garbage),
+        # AttributeError (unknown ml_dtypes name), ValueError (non-int
+        # dims) — all the same clean rejection
+        raise KvCodecError(f"kv header unusable: {type(e).__name__}: {e}") from None
+    if any(d < 0 for d in k_shape + v_shape):
+        raise KvCodecError("negative dimension in kv header shapes")
+    # math.prod, not np.prod: exact python ints — a garbage header naming
+    # absurd dims must mismatch the body length, never wrap an int64
+    k_bytes = math.prod(k_shape) * dt.itemsize
+    v_bytes = math.prod(v_shape) * dt.itemsize
     blob = body[4 + hlen :]
     if len(blob) != k_bytes + v_bytes:
-        raise ValueError(
+        raise KvCodecError(
             f"kv payload truncated: body {len(blob)} B, "
             f"header names {k_bytes + v_bytes} B"
         )
-    k = np.frombuffer(blob[:k_bytes], dtype=dt).reshape(k_shape)
-    v = np.frombuffer(blob[k_bytes:], dtype=dt).reshape(v_shape)
+    try:
+        k = np.frombuffer(blob[:k_bytes], dtype=dt).reshape(k_shape)
+        v = np.frombuffer(blob[k_bytes:], dtype=dt).reshape(v_shape)
+    except (ValueError, TypeError) as e:  # e.g. object dtype in the header
+        raise KvCodecError(f"kv body unusable: {e}") from None
     return header, k, v
+
+
+# -- receipt verification -----------------------------------------------------
+#
+# THE data-plane integrity gate: every fetched transfer passes through
+# verify_transfer BEFORE insert_external/scatter_pages can touch the
+# receiver's cache. Deliberately emit-free: this loop walks a multi-MB
+# body (TRACE_EMIT_SCOPE's trace-hot-emit lint guards it) — the caller
+# lands ONE kv_integrity event per rejection, outside any loop.
+
+
+def verify_transfer(result, ids, P: int, page_tokens: int = KEY_PAGE_TOKENS):
+    """Verify one fetched transfer against the tokens the CLIENT asked for.
+
+    Checks, in cost order: wire version (:class:`KvVersionError` on
+    mismatch), the token echo (the returned chain must be ``ids[:P]``
+    exactly), slice bounds (``start`` aligned and inside ``[0, P)``), the
+    ``page_keys`` echo against a local recomputation of the chained token
+    hashes, and then the path-specific half: the HTTP path recomputes the
+    per-doubling-segment byte checksums over the received k/v bytes; the
+    device path — whose bytes never left the process — checks segment
+    count, per-segment shapes, and k/v dtype agreement. Any content
+    mismatch raises :class:`KvIntegrityError`; returns None on success."""
+    header = result.header
+    if not isinstance(header, dict):
+        raise KvIntegrityError(f"kv header is {type(header).__name__}, not a dict")
+    try:
+        ver = int(header.get("v", 1))
+    except (TypeError, ValueError):
+        raise KvVersionError(f"unreadable kv wire version {header.get('v')!r}") from None
+    if ver != WIRE_VERSION:
+        raise KvVersionError(
+            f"kv wire version {ver}; this build speaks {WIRE_VERSION}"
+        )
+    try:
+        tokens = [int(t) for t in header["tokens"]]
+        start = int(header.get("start", 0))
+        p = int(header.get("p", len(tokens)))
+        pt = int(header.get("page_tokens", page_tokens))
+        echoed = tuple(int(h, 16) for h in header["page_keys"])
+    except Exception as e:
+        raise KvIntegrityError(
+            f"kv header unusable: {type(e).__name__}: {e}"
+        ) from None
+    if tokens != [int(t) for t in ids[:P]]:
+        raise KvIntegrityError("peer returned KV for different tokens")
+    if p != P:
+        raise KvIntegrityError(f"peer names boundary p={p}, asked {P}")
+    if pt != page_tokens:
+        raise KvIntegrityError(f"peer names page granularity {pt}, not {page_tokens}")
+    if start < 0 or start >= P or start % page_tokens:
+        raise KvIntegrityError(f"kv slice start {start} out of bounds for p={P}")
+    if echoed != page_keys(tokens, page_tokens):
+        raise KvIntegrityError("page_keys echo does not match the token chain")
+    if result.path == "http":
+        k, v = result.k, result.v
+        spans = doubling_segments(start, P)
+        try:
+            k_sums = [int(s, 16) for s in header["k_sums"]]
+            v_sums = [int(s, 16) for s in header["v_sums"]]
+        except Exception as e:
+            raise KvIntegrityError(
+                f"v{ver} payload carries no usable checksums: "
+                f"{type(e).__name__}: {e}"
+            ) from None
+        if len(k_sums) != len(spans) or len(v_sums) != len(spans):
+            raise KvIntegrityError(
+                f"{len(k_sums)}/{len(v_sums)} checksums do not cover "
+                f"{len(spans)} doubling segments"
+            )
+        if k.ndim != 4 or k.shape[1] != P - start or v.shape != k.shape:
+            raise KvIntegrityError(
+                f"kv shapes {tuple(k.shape)}/{tuple(v.shape)} do not cover "
+                f"tokens [{start}, {P})"
+            )
+        for i, (a, b) in enumerate(spans):
+            ks = segment_checksum(k[:, a - start : b - start].tobytes())
+            vs = segment_checksum(v[:, a - start : b - start].tobytes())
+            if ks != k_sums[i] or vs != v_sums[i]:
+                raise KvIntegrityError(
+                    f"segment [{a}, {b}) checksum mismatch "
+                    f"(k {ks:#x} vs {k_sums[i]:#x}, v {vs:#x} vs {v_sums[i]:#x})"
+                )
+    else:
+        # device path: the arrays are the sender's own device buffers —
+        # byte-hashing them would force a device->host sync for data that
+        # never crossed a wire. Verify the metadata half instead.
+        if isinstance(result.k, (list, tuple)):
+            ks_list = list(result.k)
+            vs_list = list(result.v) if isinstance(result.v, (list, tuple)) else []
+            spans = doubling_segments(start, P)
+            if len(ks_list) != len(spans) or len(vs_list) != len(spans):
+                raise KvIntegrityError(
+                    f"{len(ks_list)}/{len(vs_list)} device segments do not "
+                    f"cover {len(spans)} doubling segments"
+                )
+        else:
+            # contiguous extract ships tokens [start, P) as one segment
+            ks_list, vs_list = [result.k], [result.v]
+            spans = [(start, P)]
+        for (a, b), ka, va in zip(spans, ks_list, vs_list):
+            ksh = tuple(getattr(ka, "shape", ()))
+            vsh = tuple(getattr(va, "shape", ()))
+            if len(ksh) != 4 or ksh[1] != b - a or vsh != ksh:
+                raise KvIntegrityError(
+                    f"device segment [{a}, {b}) shapes {ksh}/{vsh} do not "
+                    f"cover its {b - a} tokens"
+                )
+            if getattr(ka, "dtype", None) != getattr(va, "dtype", None):
+                raise KvIntegrityError(
+                    f"device segment [{a}, {b}) k/v dtype mismatch"
+                )
+    return None
 
 
 # -- the same-process peer registry -------------------------------------------
@@ -200,10 +402,15 @@ def parse_kv_payload(body: bytes):
 _registry_lock = threading.Lock()
 _device_peers: dict = {}  # port -> weakref.ref(provider)
 
-#: test hook: when set, DeviceKvTransport.fetch raises it once per fetch —
-#: the chaos twin proves a device-path failure degrades exactly like a
-#: dead HTTP peer (see tests/test_kv_transport.py)
+#: test hook: one-shot device-path faults — ("raise", exc) makes the next
+#: fetch die like a dead HTTP peer; ("corrupt", mode) lets the fetch
+#: complete and then corrupts its result the way a buggy/stale provider
+#: would, so the chaos twin proves the metadata verifier rejects it
+#: (see tests/test_kv_transport.py and tests/test_kv_integrity.py)
 _device_chaos: list = []
+
+#: corrupt modes: the three metadata surfaces the device verifier covers
+DEVICE_CORRUPT_MODES = ("page_keys", "tokens", "shape")
 
 
 def register_device_peer(port: int, provider) -> None:
@@ -233,9 +440,42 @@ def device_peer(port: int):
         return provider
 
 
-def set_device_chaos(exc: BaseException | None) -> None:
-    """Arm (or clear, with None) a one-shot device-path failure."""
-    _device_chaos[:] = [exc] if exc is not None else []
+def set_device_chaos(exc: BaseException | None = None,
+                     corrupt: str | None = None) -> None:
+    """Arm a one-shot device-path fault: ``exc`` raises it mid-fetch
+    (fail-stop twin), ``corrupt`` completes the fetch and then mangles one
+    metadata surface of the result (:data:`DEVICE_CORRUPT_MODES` — the
+    wrong-data twin). ``set_device_chaos(None)`` / no args clears."""
+    if exc is not None:
+        _device_chaos[:] = [("raise", exc)]
+    elif corrupt is not None:
+        if corrupt not in DEVICE_CORRUPT_MODES:
+            raise ValueError(
+                f"unknown corrupt mode {corrupt!r} (one of {DEVICE_CORRUPT_MODES})"
+            )
+        _device_chaos[:] = [("corrupt", corrupt)]
+    else:
+        _device_chaos[:] = []
+
+
+def _corrupt_device_result(result: "TransferResult", mode: str) -> None:
+    """Mutate a completed device fetch the way a wrong-data provider
+    would: a stale page_keys chain, a token echo for someone else's
+    prompt, or a slice that does not cover its claimed span."""
+    header = result.header
+    if mode == "page_keys" and header.get("page_keys"):
+        keys = list(header["page_keys"])
+        keys[-1] = format(int(keys[-1], 16) ^ 0x1, "x")
+        header["page_keys"] = keys
+    elif mode == "tokens" and header.get("tokens"):
+        toks = list(header["tokens"])
+        toks[-1] = int(toks[-1]) ^ 0x1
+        header["tokens"] = toks
+    elif mode == "shape":
+        if isinstance(result.k, (list, tuple)):
+            result.k = list(result.k)[:-1]  # one segment short
+        else:
+            result.k = result.k[:, :-1]  # one token short
 
 
 # -- transports ---------------------------------------------------------------
@@ -285,9 +525,9 @@ class DeviceKvTransport(KvTransport):
     path = "device"
 
     def fetch(self, peer, ids, have_keys=(), trace_id=None) -> TransferResult:
-        if _device_chaos:
-            exc = _device_chaos.pop()
-            raise exc
+        chaos = _device_chaos.pop() if _device_chaos else None
+        if chaos is not None and chaos[0] == "raise":
+            raise chaos[1]
         host, port = peer
         provider = device_peer(port)
         if provider is None:
@@ -299,7 +539,10 @@ class DeviceKvTransport(KvTransport):
             list(ids), have_keys=tuple(have_keys), trace_id=trace_id
         )
         nbytes = _arrays_nbytes(k) + _arrays_nbytes(v)
-        return TransferResult(header, k, v, self.path, nbytes)
+        result = TransferResult(header, k, v, self.path, nbytes)
+        if chaos is not None and chaos[0] == "corrupt":
+            _corrupt_device_result(result, chaos[1])
+        return result
 
 
 class HttpKvTransport(KvTransport):

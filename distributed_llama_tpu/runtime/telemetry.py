@@ -319,10 +319,16 @@ class StepStats:
 #:                     waiting for (server/scheduler.py resolve_deadline_ms);
 #: * ``quarantined`` — prompt/decode work burned by a poison request before
 #:                     its fingerprint crossed the quarantine strike limit
-#:                     (server/quarantine.py).
+#:                     (server/quarantine.py);
+#: * ``integrity``   — prompt tokens re-prefilled locally because the
+#:                     fetched KV arrived complete but WRONG (checksum /
+#:                     page_keys mismatch — runtime/kv_transport.py
+#:                     verify_transfer rejected it before the cache was
+#:                     touched); split from ``transfer_retry`` so corrupt
+#:                     peers and dead peers are separate lines.
 WASTE_REASONS = (
     "overrun", "shed", "stall_retry", "client_gone", "error",
-    "transfer_retry", "preempt", "deadline", "quarantined",
+    "transfer_retry", "preempt", "deadline", "quarantined", "integrity",
 )
 
 #: the SLO classes goodput breaks down by (server/scheduler.py is the

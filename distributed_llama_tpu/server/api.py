@@ -1960,6 +1960,13 @@ class Handler(BaseHTTPRequestHandler):
                     ({"path": pth}, snap_counters.get(f"kv_transfer_bytes_{pth}", 0))
                     for pth in ("device", "http")
                 ],
+                # data-plane integrity outcomes, zero-filled: the corruption
+                # dashboard (and its alert) exists before the first corrupt
+                # transfer ever lands — dlt_kv_integrity_total{outcome=...}
+                "kv_integrity": [
+                    ({"outcome": oc}, snap_counters.get(f"kv_integrity_{oc}", 0))
+                    for oc in ("verified", "rejected")
+                ],
             }
             if st.batcher is not None:
                 # scheduler decisions by (class, action) — zero-filled so

@@ -26,6 +26,23 @@ Fault modes (each maps to a distinct real-world failure):
                       network; request still succeeds);
 * ``pass``          — transparent proxy.
 
+Corruption modes (the WRONG-DATA faults — delivered complete, so only a
+content check can catch them; the data-plane integrity layer's chaos twin,
+runtime/kv_transport.py verify_transfer):
+
+* ``bitflip``       — flip one bit of the response body at offset
+                      ``after_bytes`` (0 = the middle) — a bad NIC/DMA;
+* ``truncate_body`` — keep ``after_bytes`` of the body (0 = half) and
+                      REWRITE Content-Length to match, so the truncation
+                      parses as a complete response instead of dying as an
+                      IncompleteRead — a buggy sender, not a dead one;
+* ``garbage_header`` — overwrite the body's leading bytes (the KV codec's
+                      length prefix + JSON header region) with garbage —
+                      a stale/foreign payload on a reused port.
+
+Corrupting faults buffer the whole upstream response (they must parse and
+rewrite it) instead of streaming it chunk-by-chunk.
+
 Faults are scheduled by a `FaultPlan`: explicit per-connection rules keyed
 on the proxy's accept counter, an optional default, and an optional seeded
 random mix. Connection indices are assigned in accept order under a single
@@ -63,14 +80,22 @@ RESET_ON_ACCEPT = "reset_on_accept"
 MIDSTREAM_RESET = "midstream_reset"
 STALL = "stall"
 LATENCY = "latency"
+BITFLIP = "bitflip"
+TRUNCATE_BODY = "truncate_body"
+GARBAGE_HEADER = "garbage_header"
 
-_KINDS = {PASS, REFUSE, RESET_ON_ACCEPT, MIDSTREAM_RESET, STALL, LATENCY}
+_CORRUPT_KINDS = {BITFLIP, TRUNCATE_BODY, GARBAGE_HEADER}
+_KINDS = {
+    PASS, REFUSE, RESET_ON_ACCEPT, MIDSTREAM_RESET, STALL, LATENCY,
+} | _CORRUPT_KINDS
 
 
 @dataclass(frozen=True)
 class Fault:
     kind: str = PASS
-    after_bytes: int = 0  # midstream_reset: response bytes forwarded before RST
+    after_bytes: int = 0  # midstream_reset: response bytes forwarded before
+    # RST; bitflip: body offset of the flipped bit (0 = middle);
+    # truncate_body: body bytes kept (0 = half)
     delay_s: float = 0.0  # stall: silence duration; latency: added delay
 
     def __post_init__(self):
@@ -113,6 +138,35 @@ class FaultPlan:
             if draw < acc:
                 return fault
         return self.default
+
+
+def _set_content_length(head: bytes, n: int) -> bytes:
+    """Rewrite the Content-Length line of a buffered response head — a
+    corrupted body must still FRAME as a complete response (the wrong-data
+    contract: the transport delivers, only the content check can object)."""
+    lines = head.split(b"\r\n")
+    for i, ln in enumerate(lines):
+        if ln.lower().startswith(b"content-length:"):
+            lines[i] = b"Content-Length: " + str(n).encode()
+    return b"\r\n".join(lines)
+
+
+def _corrupt_response(raw: bytes, fault: Fault) -> bytes:
+    """Apply one wrong-data fault to a fully buffered HTTP response."""
+    head, sep, body = raw.partition(b"\r\n\r\n")
+    if not sep or not body:
+        return raw  # nothing corruptible; deliver as-is
+    if fault.kind == BITFLIP:
+        off = fault.after_bytes or len(body) // 2
+        off = min(max(off, 0), len(body) - 1)
+        body = body[:off] + bytes([body[off] ^ 0x01]) + body[off + 1 :]
+    elif fault.kind == TRUNCATE_BODY:
+        keep = fault.after_bytes or len(body) // 2
+        body = body[: max(keep, 0)]
+    elif fault.kind == GARBAGE_HEADER:
+        n = min(len(body), 64)
+        body = b"\xff" * n + body[n:]
+    return _set_content_length(head, len(body)) + sep + body
 
 
 def _rst_close(sock: socket.socket):
@@ -260,6 +314,9 @@ class ChaosProxy:
                 pass
 
     def _proxy(self, client: socket.socket, request: bytes, fault: Fault):
+        if fault.kind in _CORRUPT_KINDS:
+            self._proxy_corrupt(client, request, fault)
+            return
         budget = fault.after_bytes if fault.kind == MIDSTREAM_RESET else None
         sent = 0
         try:
@@ -276,6 +333,27 @@ class ChaosProxy:
                         return
                     client.sendall(chunk)
                     sent += len(chunk)
+        except OSError:
+            pass
+        try:
+            client.close()
+        except OSError:
+            pass
+
+    def _proxy_corrupt(self, client: socket.socket, request: bytes, fault: Fault):
+        """Buffer the full upstream response, mangle it, deliver it whole:
+        the client sees a CLEAN transport carrying WRONG bytes."""
+        chunks = []
+        try:
+            with socket.create_connection(self.upstream, timeout=10) as upstream:
+                upstream.sendall(request)
+                upstream.settimeout(60)
+                while True:
+                    chunk = upstream.recv(16384)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+            client.sendall(_corrupt_response(b"".join(chunks), fault))
         except OSError:
             pass
         try:

@@ -61,6 +61,10 @@ import numpy as np
 # re-exports keep the PR 10 import surface working
 from ..runtime.kv_transport import (  # noqa: F401 — re-exported API
     KEY_PAGE_TOKENS,
+    WIRE_VERSION,
+    KvCodecError,
+    KvIntegrityError,
+    KvVersionError,
     TransferResult,
     build_transports,
     doubling_segments,
@@ -69,7 +73,9 @@ from ..runtime.kv_transport import (  # noqa: F401 — re-exported API
     page_keys,
     parse_kv_payload,
     resolve_transport,
+    segment_checksum,
     transport_for,
+    verify_transfer,
 )
 
 ROLES = ("unified", "prefill", "decode")
@@ -207,6 +213,7 @@ def run_prefill_arrays(state, ids: list, have_keys=(), trace=None):
     if S:
         engine.stats.incr("disagg_send_pages_skipped", S // KEY_PAGE_TOKENS)
     header = {
+        "v": WIRE_VERSION,
         "tokens": [int(t) for t in ids[:P]],
         "p": P,
         "start": S,
@@ -229,11 +236,24 @@ def run_prefill(state, ids: list, have=(), trace=None) -> bytes:
     vs = [np.asarray(v) for _, _, v in segments]
     k_np = np.concatenate(ks, axis=1) if len(ks) > 1 else ks[0]
     v_np = np.concatenate(vs, axis=1) if len(vs) > 1 else vs[0]
+    # per-doubling-segment checksums over the CONCATENATED slice: layout-
+    # independent (contiguous extract ships one segment, paged ships the
+    # ladder — the receiver recomputes the same spans either way)
+    S = int(header["start"])
+    spans = doubling_segments(S, int(header["p"]))
     header = dict(
         header,
         k_shape=list(k_np.shape),
         v_shape=list(v_np.shape),
         dtype=str(k_np.dtype),
+        k_sums=[
+            format(segment_checksum(k_np[:, a - S : b - S].tobytes()), "x")
+            for a, b in spans
+        ],
+        v_sums=[
+            format(segment_checksum(v_np[:, a - S : b - S].tobytes()), "x")
+            for a, b in spans
+        ],
     )
     return kv_payload(header, k_np, v_np)
 
@@ -305,10 +325,28 @@ class DisaggClient:
     answers) would add the full fetch timeout to EVERY request's TTFT
     until an operator intervened. With every peer backing off, requests
     prefill locally immediately (counted, no waste: no prefill-tier
-    compute was spent). A successful fetch clears the peer's backoff."""
+    compute was spent). A successful fetch clears the peer's backoff.
+
+    **Corrupt-peer quarantine** (the poison-request idiom rotated 90°):
+    a transfer that arrives complete but WRONG — checksum mismatch,
+    page_keys echo disagreement, garbage codec — is an integrity
+    rejection, not a transport failure: the slice never touches the
+    cache, the request degrades (or fails over) exactly as above, and
+    the PEER takes a strike. ``DLT_KV_INTEGRITY_STRIKES`` strikes inside
+    the ``DLT_KV_INTEGRITY_TTL_S`` redemption window drop the peer from
+    rotation (composing with the fail-stop backoff — a peer can be both);
+    the TTL expiring redeems it, so a transient corruptor (bad NIC since
+    replaced, one stale process since restarted) is not banned forever.
+    The ledger rides :meth:`snapshot` into ``/stats`` and — via the fleet
+    scraper — ``/gateway/fleet``, so operators see WHICH replica emits
+    garbage. A peer speaking an unknown wire version is skipped without a
+    strike (``disagg_peer_version_mismatch``): mixed versions mean a
+    rolling deploy, not corruption."""
 
     def __init__(self, state, peers, timeout_s: float | None = None,
-                 backoff_s: float | None = None, transport: str | None = None):
+                 backoff_s: float | None = None, transport: str | None = None,
+                 integrity_strikes: int | None = None,
+                 strike_ttl_s: float | None = None):
         self.state = state
         self.engine = state.engine
         self.peers = list(peers)
@@ -328,11 +366,31 @@ class DisaggClient:
             except ValueError:
                 backoff_s = 10.0
         self.backoff_s = backoff_s
+        if integrity_strikes is None:
+            try:
+                integrity_strikes = int(
+                    os.environ.get("DLT_KV_INTEGRITY_STRIKES", 3)
+                )
+            except ValueError:
+                integrity_strikes = 3
+        self.integrity_strikes = max(integrity_strikes, 1)
+        if strike_ttl_s is None:
+            try:
+                strike_ttl_s = float(
+                    os.environ.get("DLT_KV_INTEGRITY_TTL_S", 300.0)
+                )
+            except ValueError:
+                strike_ttl_s = 300.0
+        self.strike_ttl_s = strike_ttl_s
         self.transport = resolve_transport(transport)
         self.transports = build_transports(self.timeout_s)
         self._lock = threading.Lock()
         self._rr = 0
         self._backoff_until: dict = {}  # (host, port) -> monotonic deadline
+        # the integrity strike ledger: (host, port) -> (count, ttl deadline).
+        # Bounded by construction — keys come from self.peers only, and an
+        # expired entry is pruned on its next read (TTL redemption).
+        self._strikes: dict = {}
 
     def snapshot(self) -> dict:
         now = time.monotonic()
@@ -340,6 +398,15 @@ class DisaggClient:
             backing_off = [
                 f"{h}:{p}" for (h, p), t in self._backoff_until.items()
                 if t > now
+            ]
+            peer_strikes = {
+                f"{h}:{p}": c
+                for (h, p), (c, ttl) in self._strikes.items() if ttl > now
+            }
+            struck_out = [
+                f"{h}:{p}"
+                for (h, p), (c, ttl) in self._strikes.items()
+                if ttl > now and c >= self.integrity_strikes
             ]
         return {
             "peers": [f"{h}:{p}" for h, p in self.peers],
@@ -353,15 +420,43 @@ class DisaggClient:
                 ).path
                 for h, p in self.peers
             },
+            "integrity": {
+                "strikes_limit": self.integrity_strikes,
+                "strike_ttl_s": self.strike_ttl_s,
+                "peer_strikes": peer_strikes,
+                "peers_struck_out": struck_out,
+            },
         }
 
     def _peer_usable(self, peer) -> bool:
+        now = time.monotonic()
         with self._lock:
-            return self._backoff_until.get(peer, 0.0) <= time.monotonic()
+            if self._backoff_until.get(peer, 0.0) > now:
+                return False
+            entry = self._strikes.get(peer)
+            if entry is None:
+                return True
+            count, ttl = entry
+            if ttl <= now:  # TTL redemption: the ban (and count) expires
+                del self._strikes[peer]
+                return True
+            return count < self.integrity_strikes
 
     def _peer_failed(self, peer):
         with self._lock:
             self._backoff_until[peer] = time.monotonic() + self.backoff_s
+
+    def _peer_strike(self, peer) -> int:
+        """One integrity rejection = one strike; the TTL window restarts
+        with each strike, so a steadily corrupting peer stays out."""
+        now = time.monotonic()
+        with self._lock:
+            count, ttl = self._strikes.get(peer, (0, 0.0))
+            if ttl <= now:
+                count = 0
+            count += 1
+            self._strikes[peer] = (count, now + self.strike_ttl_s)
+            return count
 
     def _peer_ok(self, peer):
         with self._lock:
@@ -434,6 +529,8 @@ class DisaggClient:
         result = None
         peer_key = None
         err = None
+        rejected_peer = None  # last integrity-rejected peer (one trace event)
+        rejected_err = ""
         with self._lock:
             start = self._rr
             self._rr = (self._rr + 1) % len(usable)
@@ -446,20 +543,41 @@ class DisaggClient:
                 # the same formula (bucket_down over len-1), so its slice
                 # covers exactly ids[:P] — truncating at P would make the
                 # worker floor one bucket lower
-                result = tr_impl.fetch(
+                got = tr_impl.fetch(
                     peer, ids[: P + 1], have_keys=have,
                     trace_id=None if trace is None else trace.id,
                 )
+                # THE integrity gate: checksums + page_keys echo (http) /
+                # metadata (device) verified BEFORE the slice can reach
+                # insert_external — a passing result is the only kind the
+                # rest of this function ever sees
+                verify_transfer(got, ids, P)
+                result = got
                 peer_key = f"{host}:{port}"
                 self._peer_ok(peer)
+                engine.stats.incr("kv_integrity_verified")
                 break
+            except KvVersionError as e:
+                # the peer is healthy, just mid-rolling-deploy on another
+                # wire version: skip it for this request — no strike, no
+                # backoff (it would quarantine an innocent replica)
+                err = e
+                engine.stats.incr("disagg_peer_version_mismatch")
+            except KvCodecError as e:
+                # complete response, wrong content: corruption. Reject
+                # before the cache is touched and strike the PEER — enough
+                # strikes inside the TTL drop it from rotation entirely.
+                err = e
+                engine.stats.incr("kv_integrity_rejected")
+                rejected_peer = f"{host}:{port}"
+                rejected_err = f"{type(e).__name__}: {e}"
+                self._peer_strike(peer)
             except Exception as e:
                 # OSError: refused/reset/timeout; HTTPException covers
-                # mid-body deaths; ValueError covers truncated/mis-shaped
-                # payloads; the device path raises the same families. ANY
-                # transfer failure is a peer failure, never a request
-                # failure — the degradation contract (counted below, the
-                # error itself rides the kv_transfer trace event).
+                # mid-body deaths; the device path raises the same
+                # families. A fail-stop transfer failure is a peer failure,
+                # never a request failure — the degradation contract
+                # (counted below, the error rides the kv_transfer event).
                 err = e
                 engine.stats.incr("disagg_peer_errors")
                 self._peer_failed(peer)
@@ -498,6 +616,17 @@ class DisaggClient:
         from ..runtime.tracing import to_us
 
         wall_us = int((time.perf_counter() - t0) * 1e6)
+        if rejected_peer is not None and trace is not None:
+            # ONE event per fetch, outside the peer loop (trace-hot-emit
+            # lint), landed even unsampled AND even when failover to a
+            # clean peer saved the request: a corrupting replica must be
+            # reconstructable from any trace that touched it
+            trace.event(
+                "kv_integrity", to_us(t0), wall_us,
+                ("peer", "outcome", "error"),
+                (rejected_peer, "rejected", rejected_err),
+                always=True,
+            )
         if pending is not None:
             # the transfer share of the wall: the fetch blocks on the
             # worker's prefill too, which the worker reports separately.
@@ -524,12 +653,21 @@ class DisaggClient:
         else:
             # DEGRADE to local prefill: the request must complete (token-
             # identical — it simply takes the unified path). Counted,
-            # ledgered as transfer_retry waste (the P tokens the prefill
-            # tier computed — or would have — now re-prefill locally), and
-            # traced even unsampled so a chaos kill is reconstructable.
+            # ledgered as waste (the P tokens the prefill tier computed —
+            # or would have — now re-prefill locally), and traced even
+            # unsampled so a chaos kill is reconstructable. The waste
+            # reason splits the why: `integrity` when the last failure was
+            # a complete-but-corrupt response, `transfer_retry` for the
+            # fail-stop families (dead peer, version skew, mid-body death).
             engine.stats.incr("disagg_degraded")
             engine.stats.incr("disagg_degraded_tokens", P)
-            self.state.goodput.add_waste("transfer_retry", P)
+            reason = (
+                "integrity"
+                if isinstance(err, KvCodecError)
+                and not isinstance(err, KvVersionError)
+                else "transfer_retry"
+            )
+            self.state.goodput.add_waste(reason, P)
             if trace is not None:
                 trace.event(
                     "kv_transfer", to_us(t0), wall_us,

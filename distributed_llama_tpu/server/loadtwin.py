@@ -1175,6 +1175,22 @@ class LoadTwin:
             if peering is not None:
                 peering.sync_round()
 
+    def partition_gateways(self):
+        """Split-brain chaos: drop gossip posts between ALL gateways, both
+        directions (each side keeps serving and accumulating deltas — the
+        at-most-once proof runs across the healed merge)."""
+        for gw in self.gateways:
+            peering = gw.balancer.peering
+            if peering is not None:
+                peering.partition()
+
+    def heal_gateways(self):
+        """End the split: the next sync round delivers each side's backlog."""
+        for gw in self.gateways:
+            peering = gw.balancer.peering
+            if peering is not None:
+                peering.heal()
+
     def kill_replica(self, i: int):
         """Hard-kill one stub mid-run: in-flight streams truncate (the
         gateway's midstream-failure shape), new connections refuse — the

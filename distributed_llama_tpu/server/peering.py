@@ -152,6 +152,11 @@ class GatewayPeering:
             "leadership_transitions": 0,
         }
         self.sync_rounds = 0
+        # chaos hook: peer addrs whose sync posts are dropped on the floor
+        # (a network partition twin). The drop happens INSIDE _sync_peer's
+        # failure path, so a partitioned push behaves exactly like a dead
+        # network — delta restored, sync_failed counted, at-most-once kept.
+        self._partitioned: set = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -172,6 +177,20 @@ class GatewayPeering:
     def _run(self):
         while not self._stop.wait(self.interval_s):
             self.sync_round()
+
+    # -- chaos: network partition --------------------------------------------
+
+    def partition(self, addrs=None) -> None:
+        """Drop sync posts to ``addrs`` (default: every peer) — the
+        split-brain chaos twin. Inbound applies are NOT blocked here; a
+        symmetric partition partitions BOTH sides' peerings."""
+        with self._lock:
+            self._partitioned = set(self.peers if addrs is None else addrs)
+
+    def heal(self) -> None:
+        """End the partition: the next sync round delivers the backlog."""
+        with self._lock:
+            self._partitioned = set()
 
     # -- clock ---------------------------------------------------------------
 
@@ -292,6 +311,9 @@ class GatewayPeering:
         n_events = sum(len(delta[k]) for k in delta)
         payload = dict(delta, id=self.self_id, clock=clock)
         try:
+            with self._lock:
+                if addr in self._partitioned:
+                    raise OSError("chaos: partitioned")
             host, port = addr.rsplit(":", 1)
             status, body = http_post_json(
                 host, int(port), "/gateway/peer/sync", payload,

@@ -61,6 +61,11 @@
 #                            LWW deltas + leader election, the strike
 #                            discount, GatewayServer thread lifecycle,
 #                            and the twin failover/restart chaos proofs)
+#  11d. kv-integrity suite   (data-plane integrity: checksummed KV wire
+#                            codec + receipt verification, seeded codec
+#                            fuzz, corruption chaos trio + device corrupt
+#                            modes degrading token-identical, corrupt-
+#                            peer quarantine, wire-version skip-peer)
 #  12. scoreboard guard     (scripts/bench_compare.py: newest BENCH round
 #                            vs predecessor, tolerance-banded — STRICT in
 #                            this preflight since r08 (direction bands
@@ -125,6 +130,9 @@ python -m pytest tests/test_supervisor.py tests/test_quarantine.py \
 
 echo "== gateway-ha suite (recovery + peering + failover chaos) =="
 python -m pytest tests/test_gateway_ha.py -q -p no:cacheprovider
+
+echo "== kv-integrity suite (checksummed transfers + corrupt-peer quarantine) =="
+python -m pytest tests/test_kv_integrity.py -q -p no:cacheprovider
 
 echo "== cross-suite sentinel-lifecycle pair (single process, slow-marked) =="
 # two suites whose servers warm + seal fatal-capable sentinels in ONE

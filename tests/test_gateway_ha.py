@@ -796,6 +796,125 @@ def test_poison_budget_is_fleet_wide_across_peered_gateways():
         tw.close()
 
 
+def test_split_brain_partition_heals_with_at_most_once_merge():
+    """ISSUE 16 satellite: partition the two peered gateways (gossip
+    dropped both directions), keep BOTH sides serving — poison traffic
+    burns strikes on one side, locality + drain deltas pile up behind the
+    partition, and each isolated side elects ITSELF leader (the split
+    brain, observed). Heal: the backlog merges EXACTLY ONCE — strikes
+    at-most-once (the fleet-wide replica budget holds and re-syncs apply
+    zero more), exactly one autoscaler leader remains, and no locality
+    entry queued during the split is lost."""
+    LIMIT = 2
+    poison_sys = "split brain poison " * 8
+    poison_fp = request_fingerprint(messages_prefix_text([
+        {"role": "system", "content": poison_sys},
+        {"role": "user", "content": "boom"},
+    ]))
+    tw = LoadTwin(
+        n_replicas=5,
+        replica_cfg=StubReplicaConfig(
+            poison_fps=frozenset({poison_fp}), poison_recover_s=0.2,
+            quarantine_limit=LIMIT,
+        ),
+        fleet_scrape_s=0.05,
+        n_gateways=2, peer_sync_s=0,  # gossip driven manually
+        quarantine_strikes=LIMIT,
+        retry_attempts=0,
+    )
+
+    def post(port):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [
+                    {"role": "system", "content": poison_sys},
+                    {"role": "user", "content": "boom"},
+                ],
+                "max_tokens": 4, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+        except OSError:
+            return -1
+
+    try:
+        p0, p1 = tw.gateway_ports
+        pr0 = tw.gateways[0].balancer.peering
+        pr1 = tw.gateways[1].balancer.peering
+        tw.sync_gateways()  # both sides learn the other is live
+        assert pr0.is_leader() and not pr1.is_leader()
+
+        tw.partition_gateways()
+        # side 0 keeps serving the poison through the split: its LOCAL
+        # budget burns <= LIMIT replicas and goes terminal 422. Every
+        # gossip push in between fails — deltas restored, never dropped.
+        codes = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            codes.append(post(p0))
+            tw.sync_gateways()
+            if codes[-1] == 422:
+                break
+            time.sleep(0.12)
+        assert codes[-1] == 422, codes
+        burned = tw.poisoned_replica_count()
+        assert 1 <= burned <= LIMIT
+        assert pr0.counters["sync_failed"] > 0  # the drops were real
+        # side 1 queues its own control-plane writes behind the partition
+        drain_addr = f"127.0.0.1:{tw.replicas[4].port}"
+        # locality points at a DIFFERENT backend than the drained one —
+        # draining a backend deliberately re-homes its locality entries
+        loc_addr = f"127.0.0.1:{tw.replicas[3].port}"
+        pr1.note_locality([0xABC1, 0xABC2], loc_addr)
+        pr1.note_drain(drain_addr, True, by="operator")
+        pr0.note_locality([0xDEF1], f"127.0.0.1:{tw.replicas[0].port}")
+        # split brain observed: once the liveness window lapses, BOTH
+        # sides believe they lead the fleet (and would both autoscale)
+        time.sleep(0.45)  # > live_after_s (0.3s at interval 0)
+        assert pr0.is_leader() and pr1.is_leader()
+
+        tw.heal_gateways()
+        tw.sync_gateways()
+        # exactly one autoscaler leader after re-merge (lowest live id)
+        leaders = [p.is_leader() for p in (pr0, pr1)]
+        assert leaders == [True, False]
+        # strikes merged at-most-once: gw1 terminally 422s the poison
+        # WITHOUT touching any replica beyond what the split burned
+        assert post(p1) == 422
+        assert tw.poisoned_replica_count() == burned
+        assert pr1.counters["applied_strike"] >= 1
+        # no locality entry lost: each side's queued writes landed on the
+        # other side's router despite every pre-heal push having failed
+        assert tw.gateways[0].balancer.router.owner_of(0xABC1) == loc_addr
+        assert tw.gateways[0].balancer.router.owner_of(0xABC2) == loc_addr
+        assert tw.gateways[1].balancer.router.owner_of(0xDEF1) == (
+            f"127.0.0.1:{tw.replicas[0].port}"
+        )
+        # ... and the drain flag crossed too
+        assert pr0.counters["applied_drain"] >= 1
+        # idempotence across the merge: further rounds re-apply NOTHING
+        settled = (
+            pr0.counters["applied_strike"], pr1.counters["applied_strike"],
+            pr0.counters["applied_locality"], pr1.counters["applied_locality"],
+        )
+        tw.sync_gateways()
+        tw.sync_gateways()
+        assert settled == (
+            pr0.counters["applied_strike"], pr1.counters["applied_strike"],
+            pr0.counters["applied_locality"], pr1.counters["applied_locality"],
+        )
+    finally:
+        tw.close()
+
+
 # ---- the LIVE restart proof (real engines) ----------------------------------
 
 

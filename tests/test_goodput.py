@@ -45,7 +45,8 @@ def test_aggregator_identity_and_waste_labels():
     )
     assert series == {"overrun": 2, "shed": 7, "stall_retry": 3,
                       "client_gone": 0, "error": 0, "transfer_retry": 0,
-                      "preempt": 0, "deadline": 0, "quarantined": 0}
+                      "preempt": 0, "deadline": 0, "quarantined": 0,
+                      "integrity": 0}
 
 
 def test_aggregator_per_class_breakdown():
@@ -225,8 +226,15 @@ def test_metrics_and_stats_expose_goodput(goodput_server):
         body = r.read().decode()
     assert "# TYPE dlt_goodput_tokens_per_s gauge" in body
     assert "# TYPE dlt_wasted_tokens_total counter" in body
-    for reason in ("overrun", "shed", "stall_retry", "client_gone", "error"):
+    for reason in ("overrun", "shed", "stall_retry", "client_gone", "error",
+                   "integrity"):
         assert f'dlt_wasted_tokens_total{{reason="{reason}"}}' in body
+    # the data-plane integrity family renders zero-filled even on a server
+    # that never saw a disaggregated transfer (ISSUE 16): dashboards can
+    # alert on outcome="rejected" going nonzero without a first event
+    assert "# TYPE dlt_kv_integrity_total counter" in body
+    assert 'dlt_kv_integrity_total{outcome="verified"} 0' in body
+    assert 'dlt_kv_integrity_total{outcome="rejected"} 0' in body
     stats = _get_json(port, "/stats")
     g = stats["goodput"]
     assert g["delivered_tokens"] > 0

@@ -1,0 +1,467 @@
+"""Data-plane integrity tests (ISSUE 16) — corruption is a survivable,
+quarantinable fault.
+
+Unit layer: verify_transfer rejects each tampered surface of a valid
+transfer (version, token echo, page_keys chain, per-segment checksums,
+slice bounds); the seeded codec fuzz drives ~1k truncations / mutations /
+garbage prefixes through parse + verify and asserts every one of them is a
+clean KvCodecError — never a KeyError/TypeError/AttributeError escaping
+into a handler thread.
+
+Serving layer (the chaos proofs): BITFLIP / TRUNCATE_BODY / GARBAGE_HEADER
+on the HTTP path and every corrupt-mode device fault each degrade to local
+prefill with output BIT-IDENTICAL to unified serving and zero failed
+requests — the rejection visible in counters (`kv_integrity_rejected`),
+waste (`dlt_wasted_tokens_total{reason="integrity"}`), and the always-
+landed `kv_integrity` trace event. A peer corrupting every response is
+struck out of rotation within DLT_KV_INTEGRITY_STRIKES fetches while a
+clean peer keeps serving; an unknown wire version is skipped WITHOUT a
+strike (mixed-version fleets mid-rolling-deploy degrade, never quarantine
+innocents).
+
+The waste-series / zero-filled-metrics halves of the telemetry ride
+tests/test_goodput.py.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.runtime.kv_transport import (
+    KEY_PAGE_TOKENS,
+    WIRE_VERSION,
+    KvCodecError,
+    KvIntegrityError,
+    KvVersionError,
+    TransferResult,
+    doubling_segments,
+    kv_payload,
+    page_keys,
+    parse_kv_payload,
+    segment_checksum,
+    set_device_chaos,
+    verify_transfer,
+)
+from test_kv_transport import DeviceStack, _ask, _counters, free_port
+
+
+# -- unit: verify_transfer rejects every tampered surface ---------------------
+
+
+def _valid_transfer(n_tokens=64, start=0):
+    """A wire-faithful (header, k, v) the worker side would emit."""
+    toks = [(i * 7) % 250 + 1 for i in range(n_tokens)]
+    k = np.arange(2 * (n_tokens - start) * 2 * 4, dtype=np.float32).reshape(
+        2, n_tokens - start, 2, 4
+    )
+    v = k + 1.0
+    spans = doubling_segments(start, n_tokens)
+    header = {
+        "v": WIRE_VERSION,
+        "tokens": toks,
+        "p": n_tokens,
+        "start": start,
+        "page_tokens": KEY_PAGE_TOKENS,
+        "page_keys": [format(h, "x") for h in page_keys(toks)],
+        "k_shape": list(k.shape),
+        "v_shape": list(v.shape),
+        "dtype": "float32",
+        "k_sums": [
+            format(segment_checksum(k[:, a - start : b - start].tobytes()), "x")
+            for a, b in spans
+        ],
+        "v_sums": [
+            format(segment_checksum(v[:, a - start : b - start].tobytes()), "x")
+            for a, b in spans
+        ],
+        "prefill_us": 5,
+    }
+    return header, k, v, toks
+
+
+def _res(header, k, v, path="http"):
+    nb = sum(a.nbytes for a in (k if isinstance(k, list) else [k]))
+    nb += sum(a.nbytes for a in (v if isinstance(v, list) else [v]))
+    return TransferResult(header, k, v, path, nb)
+
+
+def test_verify_transfer_accepts_valid_http_and_partial():
+    for start in (0, 32):
+        h, k, v, toks = _valid_transfer(64, start=start)
+        assert verify_transfer(_res(h, k, v), toks, 64) is None
+
+
+def test_verify_transfer_rejects_each_tampered_surface():
+    h, k, v, toks = _valid_transfer(64)
+    # flipped payload byte -> checksum mismatch
+    kk = k.copy()
+    kk.flat[100] += 1
+    with pytest.raises(KvIntegrityError, match="checksum"):
+        verify_transfer(_res(h, kk, v), toks, 64)
+    # page_keys echo disagreeing with the token chain
+    h2 = dict(h, page_keys=list(h["page_keys"]))
+    h2["page_keys"][-1] = format(int(h2["page_keys"][-1], 16) ^ 1, "x")
+    with pytest.raises(KvIntegrityError, match="page_keys"):
+        verify_transfer(_res(h2, k, v), toks, 64)
+    # token echo for someone else's prompt
+    with pytest.raises(KvIntegrityError, match="different tokens"):
+        verify_transfer(_res(h, k, v), [t + 1 for t in toks], 64)
+    # out-of-bounds / misaligned slice start
+    with pytest.raises(KvIntegrityError, match="out of bounds"):
+        verify_transfer(_res(dict(h, start=7), k, v), toks, 64)
+    # missing checksums on a v2 payload
+    h3 = {kk_: vv for kk_, vv in h.items() if kk_ not in ("k_sums", "v_sums")}
+    with pytest.raises(KvIntegrityError, match="checksum"):
+        verify_transfer(_res(h3, k, v), toks, 64)
+    # unknown wire version: the DISTINCT error class (skip-peer, no strike)
+    with pytest.raises(KvVersionError):
+        verify_transfer(_res(dict(h, v=WIRE_VERSION + 1), k, v), toks, 64)
+    # shapes that do not cover the slice
+    with pytest.raises(KvIntegrityError, match="do not cover"):
+        verify_transfer(_res(h, k[:, :-1], v[:, :-1]), toks, 64)
+
+
+def test_verify_transfer_device_metadata_half():
+    h, k, v, toks = _valid_transfer(64)
+    # the device path never byte-hashes: a valid result passes on shapes
+    assert verify_transfer(_res(h, k, v, path="device"), toks, 64) is None
+    # ... and catches the metadata faults the corrupt modes inject
+    with pytest.raises(KvIntegrityError):
+        verify_transfer(_res(h, k[:, :-1], v, path="device"), toks, 64)
+    with pytest.raises(KvIntegrityError):
+        verify_transfer(
+            _res(h, [k, k], [v, v], path="device"), toks, 64
+        )  # segment count vs the doubling ladder
+    with pytest.raises(KvIntegrityError):
+        verify_transfer(
+            _res(h, k, v.astype(np.float16), path="device"), toks, 64
+        )
+
+
+def test_parse_rejects_unknown_version_at_the_header():
+    """Forward compat: a future wire version dies CLEANLY at the header,
+    before any body work — never as a generic mid-body parse error."""
+    h, k, v, _ = _valid_transfer(64)
+    body = kv_payload(dict(h, v=WIRE_VERSION + 7), k, v)
+    with pytest.raises(KvVersionError):
+        parse_kv_payload(body)
+    # ... even when the body would not parse at all (the satellite's bug:
+    # version skew used to surface as whatever shape error came first)
+    junk = kv_payload({"v": WIRE_VERSION + 7}, np.zeros(3, np.float32), k)
+    with pytest.raises(KvVersionError):
+        parse_kv_payload(junk)
+
+
+def test_codec_fuzz_clean_errors_only():
+    """Satellite: ~1k seeded truncations / mutations / garbage prefixes of
+    a valid payload through parse + verify. Every outcome must be either a
+    clean pass (the mutation hit a don't-care byte) or KvCodecError — any
+    KeyError / TypeError / AttributeError escaping fails this test by
+    propagating."""
+    h, k, v, toks = _valid_transfer(64)
+    body = kv_payload(h, k, v)
+    rng = random.Random(0xD17)
+    rejected = 0
+    for i in range(1000):
+        mode = rng.randrange(4)
+        if mode == 0:  # truncate anywhere
+            mut = body[: rng.randrange(len(body))]
+        elif mode == 1:  # flip one byte anywhere (header OR payload)
+            off = rng.randrange(len(body))
+            mut = body[:off] + bytes([body[off] ^ (1 << rng.randrange(8))]) + body[off + 1 :]
+        elif mode == 2:  # garbage prefix
+            mut = rng.randbytes(rng.randrange(1, 64)) + body
+        else:  # pure garbage
+            mut = rng.randbytes(rng.randrange(0, 256))
+        try:
+            hdr, kk, vv = parse_kv_payload(mut)
+            verify_transfer(
+                TransferResult(hdr, kk, vv, "http", len(mut)), toks, 64
+            )
+        except KvCodecError:  # KvIntegrityError / KvVersionError included
+            rejected += 1
+    assert rejected > 900, rejected  # near-every mutation must be caught
+
+
+# -- the serving stack (prefill + decode + unified twin) ----------------------
+
+
+@pytest.fixture(scope="module")
+def istack(tmp_path_factory):
+    st = DeviceStack(tmp_path_factory.mktemp("kvintegrity"))
+    yield st
+    st.stop()
+
+
+def _reset_client(state):
+    state.disagg._backoff_until.clear()
+    state.disagg._strikes.clear()
+
+
+def _metrics(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as r:
+        return r.read().decode()
+
+
+class FakeTrace:
+    id = "t-fake"
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, t_us, dur_us, keys, vals, always=False):
+        self.events.append((name, dict(zip(keys, vals)), always))
+
+
+def test_http_corruption_trio_degrades_token_identical(istack):
+    """THE corruption chaos proof, HTTP path: each wrong-data fault yields
+    output bit-identical to unified serving (cold local prefill) with zero
+    failed requests — rejection visible in counters, waste, and metrics."""
+    from distributed_llama_tpu.server.chaos import (
+        BITFLIP, GARBAGE_HEADER, TRUNCATE_BODY, ChaosProxy, Fault, FaultPlan,
+    )
+    from distributed_llama_tpu.server.disagg import DisaggClient
+
+    state = istack.dec.RequestHandlerClass.state
+    old = state.disagg
+    before = _counters(istack.dec_port)
+    n_faults = 0
+    try:
+        for kind in (BITFLIP, TRUNCATE_BODY, GARBAGE_HEADER):
+            proxy = ChaosProxy(
+                "127.0.0.1", istack.pf_port, FaultPlan(default=Fault(kind))
+            ).start()
+            try:
+                state.disagg = DisaggClient(
+                    state, [("127.0.0.1", proxy.port)], transport="http"
+                )
+                shared = f"corrupt-{kind}-prefix " * 8
+                r = _ask(istack.dec_port, shared, "still served")
+                r_uni = _ask(istack.uni_port, shared, "still served")
+                assert (
+                    r["choices"][0]["message"]["content"]
+                    == r_uni["choices"][0]["message"]["content"]
+                ), kind
+                # degraded: no transfer landed for this request
+                assert r["usage"]["goodput"]["kv_transfer_path"] == "", kind
+                n_faults += 1
+            finally:
+                proxy.stop()
+    finally:
+        state.disagg = old
+        _reset_client(state)
+    after = _counters(istack.dec_port)
+    assert (
+        after.get("kv_integrity_rejected", 0)
+        >= before.get("kv_integrity_rejected", 0) + n_faults
+    )
+    assert (
+        after.get("disagg_degraded", 0)
+        >= before.get("disagg_degraded", 0) + n_faults
+    )
+    body = _metrics(istack.dec_port)
+    # the integrity waste reason and the labeled outcome family both render
+    for line in body.splitlines():
+        if line.startswith('dlt_wasted_tokens_total{reason="integrity"}'):
+            assert int(line.rsplit(" ", 1)[1]) > 0
+            break
+    else:
+        pytest.fail("no integrity waste row on /metrics")
+    for line in body.splitlines():
+        if line.startswith('dlt_kv_integrity_total{outcome="rejected"}'):
+            assert int(line.rsplit(" ", 1)[1]) >= n_faults
+            break
+    else:
+        pytest.fail("no kv_integrity rejected row on /metrics")
+
+
+def test_device_corrupt_modes_degrade_token_identical(istack):
+    """The corruption chaos proof, device path: every corrupt mode the
+    metadata verifier covers degrades to token-identical local prefill."""
+    state = istack.dec.RequestHandlerClass.state
+    for mode in ("page_keys", "tokens", "shape"):
+        before = _counters(istack.dec_port)
+        set_device_chaos(corrupt=mode)
+        try:
+            shared = f"device-corrupt-{mode}-prefix " * 8
+            r = _ask(istack.dec_port, shared, "still served")
+        finally:
+            set_device_chaos(None)
+            _reset_client(state)
+        r_uni = _ask(istack.uni_port, shared, "still served")
+        assert (
+            r["choices"][0]["message"]["content"]
+            == r_uni["choices"][0]["message"]["content"]
+        ), mode
+        after = _counters(istack.dec_port)
+        assert (
+            after.get("kv_integrity_rejected", 0)
+            == before.get("kv_integrity_rejected", 0) + 1
+        ), mode
+        assert r["usage"]["goodput"]["kv_transfer_path"] == "", mode
+
+
+def test_integrity_rejection_lands_trace_event_and_strike(istack):
+    """One corrupt fetch = one always-landed kv_integrity trace event +
+    one strike in the peer ledger (surfaced via snapshot -> /stats; the
+    fleet scraper lifts the same section into /gateway/fleet)."""
+    from distributed_llama_tpu.server.disagg import DisaggClient
+
+    state = istack.dec.RequestHandlerClass.state
+    client = DisaggClient(state, [("127.0.0.1", istack.pf_port)])
+    ids = [(i * 7) % 250 + 1 for i in range(140)]
+    tr = FakeTrace()
+    set_device_chaos(corrupt="page_keys")
+    try:
+        out = client.fetch(ids, trace=tr)
+    finally:
+        set_device_chaos(None)
+    assert out["pending_kv"] is None
+    events = [e for e in tr.events if e[0] == "kv_integrity"]
+    assert len(events) == 1
+    name, fields, always = events[0]
+    assert always, "kv_integrity must land even unsampled"
+    assert fields["outcome"] == "rejected"
+    assert fields["peer"] == f"127.0.0.1:{istack.pf_port}"
+    assert "KvIntegrityError" in fields["error"]
+    snap = client.snapshot()["integrity"]
+    assert snap["peer_strikes"] == {f"127.0.0.1:{istack.pf_port}": 1}
+    assert snap["peers_struck_out"] == []
+    # /stats surfaces the ledger (the decode server's own client)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{istack.dec_port}/stats", timeout=30
+    ) as r:
+        stats = json.loads(r.read())
+    assert "integrity" in stats["disagg"]
+
+
+def test_corrupt_peer_struck_out_while_clean_peer_serves(istack):
+    """Quarantine acceptance: a peer corrupting EVERY response is dropped
+    from rotation within DLT_KV_INTEGRITY_STRIKES fetches; the clean peer
+    keeps serving every request."""
+    from distributed_llama_tpu.server.chaos import (
+        BITFLIP, ChaosProxy, Fault, FaultPlan,
+    )
+    from distributed_llama_tpu.server.disagg import DisaggClient
+
+    state = istack.dec.RequestHandlerClass.state
+    proxy = ChaosProxy(
+        "127.0.0.1", istack.pf_port, FaultPlan(default=Fault(BITFLIP))
+    ).start()
+    strikes = 2
+    client = DisaggClient(
+        state,
+        [("127.0.0.1", proxy.port), ("127.0.0.1", istack.pf_port)],
+        transport="http",
+        integrity_strikes=strikes,
+    )
+    bad = f"127.0.0.1:{proxy.port}"
+    try:
+        rejected = 0
+        for i in range(8):
+            ids = [(i * 31 + j * 7) % 250 + 1 for j in range(140)]
+            out = client.fetch(ids)
+            # EVERY fetch lands KV: in-request failover covers the rounds
+            # where round-robin tried the corrupt peer first
+            assert out["pending_kv"] is not None, i
+            out["pending_kv"].abandon()  # unit-level: skip the insert
+        snap = client.snapshot()["integrity"]
+        assert snap["peers_struck_out"] == [bad]
+        # dropped WITHIN the strike budget: once out, no more rejections
+        assert snap["peer_strikes"][bad] == strikes
+    finally:
+        proxy.stop()
+
+
+def test_unknown_wire_version_skips_peer_without_strike(istack):
+    """Satellite: a v!=WIRE_VERSION peer is rejected cleanly at the header
+    with its own counter — skip-peer, NOT strike — so a mixed-version
+    fleet mid-rolling-deploy degrades instead of quarantining innocents."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from distributed_llama_tpu.server.disagg import DisaggClient
+
+    payload = kv_payload(
+        {"v": WIRE_VERSION + 1}, np.zeros(4, np.float32), np.zeros(4, np.float32)
+    )
+
+    class OldPeer(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    port = free_port()
+    httpd = HTTPServer(("127.0.0.1", port), OldPeer)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    state = istack.dec.RequestHandlerClass.state
+    client = DisaggClient(state, [("127.0.0.1", port)], transport="http")
+    before = _counters(istack.dec_port)
+    try:
+        out = client.fetch([(i * 11) % 250 + 1 for i in range(140)])
+    finally:
+        httpd.shutdown()
+    after = _counters(istack.dec_port)
+    assert out["pending_kv"] is None
+    assert (
+        after.get("disagg_peer_version_mismatch", 0)
+        == before.get("disagg_peer_version_mismatch", 0) + 1
+    )
+    # no strike, no integrity rejection: the peer is innocent
+    assert (
+        after.get("kv_integrity_rejected", 0)
+        == before.get("kv_integrity_rejected", 0)
+    )
+    snap = client.snapshot()["integrity"]
+    assert snap["peer_strikes"] == {} and snap["peers_struck_out"] == []
+
+
+def test_corrupted_partial_send_releases_base_pin(istack):
+    """Fuzz-hardening's integration half: a corrupted transfer on a GROWN
+    prefix (base entry pinned for the merge) must release the pin on the
+    degrade path — the grown request re-serves cleanly afterwards and no
+    cache entry stays pinned at rest."""
+    state = istack.dec.RequestHandlerClass.state
+    pc = state.engine.prefix_cache
+    base = "pin-release-prefix " * 8
+    _ask(istack.dec_port, base, "seed the base")  # base entry published
+
+    def resting_refs():
+        with pc._lock:
+            return sorted(e.refs for e in pc._entries.values())
+
+    before_refs = resting_refs()
+    set_device_chaos(corrupt="tokens")
+    try:
+        r = _ask(
+            istack.dec_port, base + "grown well past the base " * 8, "grown"
+        )
+    finally:
+        set_device_chaos(None)
+        _reset_client(state)
+    assert r["choices"][0]["message"]["content"]  # served, degraded
+    # the same grown prompt serves cleanly (and transfers) afterwards
+    r2 = _ask(
+        istack.dec_port, base + "grown well past the base " * 8, "again"
+    )
+    assert r2["choices"][0]["message"]["content"]
+    # no pin leaked: resting refcounts return to the pre-corruption
+    # baseline (poll briefly — the engine thread applies/abandons inserts)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if sum(resting_refs()) <= sum(before_refs):
+            break
+        time.sleep(0.05)
+    assert sum(resting_refs()) <= sum(before_refs)
