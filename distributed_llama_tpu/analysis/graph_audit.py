@@ -352,7 +352,10 @@ def trace_entry(engine, entry: LadderEntry):
             )
             return jax.make_jaxpr(fn)(_sds((n,), jnp.int32))
         L, _, _, h, d = engine.cache.k.shape
-        seg = _sds((L, entry.size, h, d), engine.cache.k.dtype)
+        # wire segments are FLOAT even over int8 pools (gather_pages
+        # dequantizes on extract; scatter_pages requantizes on insert)
+        wire = jnp.float32 if engine.cfg.kv_quantized else engine.cache.k.dtype
+        seg = _sds((L, entry.size, h, d), wire)
         fn = lambda k, v, pages: scatter_pages(
             engine.cache, k, v, pages, out_sharding=engine._cache_sharding
         )
@@ -730,7 +733,10 @@ def donation_problems(engine) -> list:
         if P0:
             n = P0 // engine.page_size
             L, _, _, h, d = engine.cache.k.shape
-            seg = jnp.zeros((L, P0, h, d), engine.cache.k.dtype)
+            wire = (
+                jnp.float32 if engine.cfg.kv_quantized else engine.cache.k.dtype
+            )
+            seg = jnp.zeros((L, P0, h, d), wire)
             check(
                 "scatter_pages",
                 scatter_pages.lower(
@@ -899,6 +905,13 @@ def main(argv=None) -> int:
         "the contiguous one (runtime/paged_kv.py, runtime/kv_transport.py)",
     )
     p.add_argument(
+        "--kv-dtype", choices=["bfloat16", "float32", "int8"], default=None,
+        help="audit the quantized-KV program ladder (int8 payload + f32 "
+        "scale sidecars, ops/kv_quant.py): the paged arm must lower the "
+        "fused page-table-aware decode kernel and the collective budgets "
+        "must match the float twin's (default: the compute-dtype default)",
+    )
+    p.add_argument(
         "--pp", type=int, default=1,
         help="audit on a pipeline-parallel mesh of this extent (needs that "
         "many devices — CI uses xla_force_host_platform_device_count); "
@@ -945,6 +958,7 @@ def main(argv=None) -> int:
             prefix_cache_mb=args.prefix_cache_mb,
             speculative=args.speculative, draft_k=args.draft_k,
             kv_layout=args.kv_layout, mesh=mesh,
+            cache_dtype=args.kv_dtype,
         )
         try:
             reports = audit_engine(engine)

@@ -46,7 +46,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--chat-template", default=None)
     # TPU-native knobs (replace --nthreads/--workers/--gpu-index):
     p.add_argument("--compute-dtype", choices=["bfloat16", "float32"], default="bfloat16")
-    p.add_argument("--cache-dtype", choices=["bfloat16", "float32"], default=None)
+    p.add_argument(
+        "--cache-dtype", "--kv-dtype", dest="cache_dtype",
+        choices=["bfloat16", "float32", "int8"], default=None,
+        help="KV cache storage dtype (default DLT_KV_DTYPE env, else the "
+        "compute-dtype default): 'int8' stores quantized KV with f32 "
+        "per-(token, head) scale sidecars — half the decode KV traffic "
+        "(ops/kv_quant.py; single-chip only, meshes fall back to float; "
+        "docs/SERVING.md 'Quantized KV cache')",
+    )
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel mesh size")
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel mesh size (long context)")

@@ -86,6 +86,13 @@ class ModelConfig:
     def kv_dtype(self):
         return jnp.dtype(self.cache_dtype)
 
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the KV cache stores int8 with a f32 scale sidecar
+        (ops/kv_quant.py). bf16/f32 caches store raw values and keep the
+        pre-quantization program graphs bit-identical."""
+        return self.cache_dtype == "int8"
+
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
 

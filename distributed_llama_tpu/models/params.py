@@ -102,6 +102,12 @@ class KVCache:
 
     k: jnp.ndarray
     v: jnp.ndarray
+    # int8 KV arm (cache_dtype="int8"): per-(token, head) f32 dequant scales,
+    # shaped like k/v minus the trailing head_dim axis. None on bf16/f32
+    # engines — None children flatten away, so the float arms' leaf set (and
+    # every donation/sharding contract over it) is unchanged.
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def batch(self) -> int:
@@ -111,8 +117,12 @@ class KVCache:
     def seq_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
-_register(KVCache, ["k", "v"])
+
+_register(KVCache, ["k", "v", "k_scale", "v_scale"])
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None) -> KVCache:
@@ -123,9 +133,15 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None) -> K
         cfg.n_kv_heads,
         cfg.head_dim,
     )
-    return KVCache(
-        k=jnp.zeros(shape, dtype=cfg.kv_dtype), v=jnp.zeros(shape, dtype=cfg.kv_dtype)
-    )
+    k = jnp.zeros(shape, dtype=cfg.kv_dtype)
+    v = jnp.zeros(shape, dtype=cfg.kv_dtype)
+    if cfg.kv_quantized:
+        return KVCache(
+            k=k, v=v,
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
+    return KVCache(k=k, v=v)
 
 
 # ---------------------------------------------------------------------------

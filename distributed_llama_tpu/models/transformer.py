@@ -161,6 +161,21 @@ def _attention_auto(cfg, q, k_view, v_view, positions, pos_start):
     return gqa_attention(q, k_view, v_view, positions)
 
 
+def _fused_paged_eligible(cfg, q, t: int, ps: int) -> bool:
+    """Gate for the fused page-table-aware int8 decode kernel: Pallas
+    enabled, decode-sized q blocks (one page of queries at most — solo
+    decode t=1, batch decode t=1, speculative verify t=k+1 all qualify;
+    prefill chunks take the gather+dequant view, which stays
+    flash-eligible), and uniform lane-aligned head grouping."""
+    n_heads, head_dim = q.shape[2], q.shape[3]
+    return (
+        _pallas_enabled(cfg)
+        and t <= ps
+        and n_heads % cfg.n_kv_heads == 0
+        and head_dim % 8 == 0
+    )
+
+
 def _n_local_experts(w: Any, stacked: bool = False) -> int:
     """Expert count of an expert weight — `stacked`: w carries a leading
     all-layers axis ([L, E, ...] rather than [E, ...])."""
@@ -339,11 +354,23 @@ def _layer(
     # entries are unmapped: their writes DROP, their reads clamp to page 0
     # and are causally masked. None = contiguous layout (unchanged).
     page_size=None,  # static page length in tokens (paged layout only)
+    k_scale=None,  # int8 KV arm (cfg.kv_quantized): the f32 per-(token,
+    # head) scale sidecars riding the scan carry next to k_cache/v_cache
+    # ([L, P, ps, h] paged / [L, b, S, h] contiguous). None on float caches
+    # — every branch below is then BYTE-IDENTICAL to the pre-quantization
+    # graph (the bf16 A/B bit-identity contract). When present, writes
+    # quantize (ops/kv_quant.py) and the return grows to a 5-tuple.
+    v_scale=None,
 ):
     if reduce_fn is None:
         reduce_fn = lambda z: z
     if cache_layer is None:
         cache_layer = layer_idx
+    if k_scale is not None and (sp_ctx is not None or not (stacked_cache or page_table is not None)):
+        raise NotImplementedError(
+            "int8 KV is supported on the stacked-contiguous and paged arms "
+            "only (the engine forces a float cache on sp/pipeline meshes)"
+        )
     b, t, _ = x.shape
     q80 = cfg.q80_activations
 
@@ -402,24 +429,68 @@ def _layer(
         b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
         col = jnp.arange(t, dtype=jnp.int32)[None, :]
         phys = jnp.where(invalid, n_pool + b_idx * t + col, phys)
-        k_cache = k_cache.at[li, phys, offset].set(
-            k.astype(k_cache.dtype), mode="drop", unique_indices=True
-        )
-        v_cache = v_cache.at[li, phys, offset].set(
-            v.astype(v_cache.dtype), mode="drop", unique_indices=True
-        )
+        if k_scale is not None:
+            # int8 pool: QUANTIZE-ON-WRITE, fused into the same scatter —
+            # the scale sidecars take the identical (phys, offset) indices
+            # and drop with their payloads
+            from ..ops.kv_quant import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = k_cache.at[li, phys, offset].set(
+                kq, mode="drop", unique_indices=True
+            )
+            v_cache = v_cache.at[li, phys, offset].set(
+                vq, mode="drop", unique_indices=True
+            )
+            k_scale = k_scale.at[li, phys, offset].set(
+                ks, mode="drop", unique_indices=True
+            )
+            v_scale = v_scale.at[li, phys, offset].set(
+                vs, mode="drop", unique_indices=True
+            )
+        else:
+            k_cache = k_cache.at[li, phys, offset].set(
+                k.astype(k_cache.dtype), mode="drop", unique_indices=True
+            )
+            v_cache = v_cache.at[li, phys, offset].set(
+                v.astype(v_cache.dtype), mode="drop", unique_indices=True
+            )
         # read: gather the first kv_len/ps page entries per row into the
         # contiguous [b, n*ps, h, d] view the attention math consumes —
         # this gather is the layout's whole read cost (the cost model
         # counts it; analysis/profiling.py). Unmapped entries clamp to
         # page 0: garbage, causally masked like any junk past a row's pos.
         n_read = max_slots if kv_len is None else min(-(-kv_len // ps), max_slots)
-        pages = jnp.maximum(
-            jax.lax.slice_in_dim(page_table, 0, n_read, axis=1), 0
-        )  # [b, n_read]
-        k_view = k_cache[li, pages].reshape(b, n_read * ps, -1, cfg.head_dim)
-        v_view = v_cache[li, pages].reshape(b, n_read * ps, -1, cfg.head_dim)
-        a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
+        if k_scale is not None and _fused_paged_eligible(cfg, q, t, ps):
+            # int8 decode: the FUSED kernel reads the pool through the page
+            # table (scalar-prefetch operand) and dequantizes in VMEM — no
+            # materialized page gather, no dequantized KV view in HBM
+            # (ops/pallas_attention.paged_flash_attention)
+            from ..ops.pallas_attention import paged_flash_attention
+
+            a = paged_flash_attention(
+                q, k_cache, v_cache, k_scale, v_scale,
+                jnp.asarray(li, jnp.int32), positions[:, 0], page_table,
+                n_read=n_read, page_size=ps,
+                interpret=cfg.pallas_interpret,
+            )
+        else:
+            pages = jnp.maximum(
+                jax.lax.slice_in_dim(page_table, 0, n_read, axis=1), 0
+            )  # [b, n_read]
+            k_view = k_cache[li, pages]
+            v_view = v_cache[li, pages]
+            if k_scale is not None:
+                # int8 prefill / no-Pallas fallback: dequantize the gathered
+                # view to the compute dtype (prefill stays flash-eligible)
+                from ..ops.kv_quant import dequantize_kv
+
+                k_view = dequantize_kv(k_view, k_scale[li, pages], cfg.dtype)
+                v_view = dequantize_kv(v_view, v_scale[li, pages], cfg.dtype)
+            k_view = k_view.reshape(b, n_read * ps, -1, cfg.head_dim)
+            v_view = v_view.reshape(b, n_read * ps, -1, cfg.head_dim)
+            a = _attention_auto(cfg, q, k_view, v_view, positions, pos_start)
     elif sp_ctx is None:
         if stacked_cache:
             # in-place update of this layer's rows inside the full carried
@@ -430,24 +501,41 @@ def _layer(
             li = cache_layer
             S = k_cache.shape[2]
             nh, hd = k_cache.shape[3], k_cache.shape[4]
+            if k_scale is not None:
+                # int8 contiguous arm: quantize-on-write into the stacked
+                # slab, scale sidecars at the same (layer, row, pos) indices
+                from ..ops.kv_quant import quantize_kv
+
+                kw, ks = quantize_kv(k)
+                vw, vs = quantize_kv(v)
+            else:
+                kw, vw = k.astype(k_cache.dtype), v.astype(v_cache.dtype)
+                ks = vs = None
             if jnp.ndim(pos_start) == 0:
                 start = (li, 0, pos_start, 0, 0)
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype)[None], start
-                )
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype)[None], start
-                )
+                k_cache = jax.lax.dynamic_update_slice(k_cache, kw[None], start)
+                v_cache = jax.lax.dynamic_update_slice(v_cache, vw[None], start)
+                if k_scale is not None:
+                    sstart = (li, 0, pos_start, 0)
+                    k_scale = jax.lax.dynamic_update_slice(k_scale, ks[None], sstart)
+                    v_scale = jax.lax.dynamic_update_slice(v_scale, vs[None], sstart)
             else:
                 # per-row positions: OOB-DROP scatter (see the unstacked
                 # branch below for why drop is load-bearing)
                 b_idx = jnp.arange(b, dtype=jnp.int32)[:, None]
                 k_cache = k_cache.at[li, b_idx, positions].set(
-                    k.astype(k_cache.dtype), mode="drop", unique_indices=True
+                    kw, mode="drop", unique_indices=True
                 )
                 v_cache = v_cache.at[li, b_idx, positions].set(
-                    v.astype(v_cache.dtype), mode="drop", unique_indices=True
+                    vw, mode="drop", unique_indices=True
                 )
+                if k_scale is not None:
+                    k_scale = k_scale.at[li, b_idx, positions].set(
+                        ks, mode="drop", unique_indices=True
+                    )
+                    v_scale = v_scale.at[li, b_idx, positions].set(
+                        vs, mode="drop", unique_indices=True
+                    )
             view_len = min(kv_len, S) if kv_len is not None else S
             k_view = jax.lax.dynamic_slice(
                 k_cache, (li, 0, 0, 0, 0), (1, b, view_len, nh, hd)
@@ -455,6 +543,19 @@ def _layer(
             v_view = jax.lax.dynamic_slice(
                 v_cache, (li, 0, 0, 0, 0), (1, b, view_len, nh, hd)
             )[0]
+            if k_scale is not None:
+                # dequantize the bucketed read view to the compute dtype
+                # (flash stays eligible on the bf16 path)
+                from ..ops.kv_quant import dequantize_kv
+
+                ks_view = jax.lax.dynamic_slice(
+                    k_scale, (li, 0, 0, 0), (1, b, view_len, nh)
+                )[0]
+                vs_view = jax.lax.dynamic_slice(
+                    v_scale, (li, 0, 0, 0), (1, b, view_len, nh)
+                )[0]
+                k_view = dequantize_kv(k_view, ks_view, cfg.dtype)
+                v_view = dequantize_kv(v_view, vs_view, cfg.dtype)
         else:
             if jnp.ndim(pos_start) == 0:
                 k_cache = jax.lax.dynamic_update_slice_in_dim(
@@ -551,6 +652,8 @@ def _layer(
         else _dense_ffn(cfg, y, lp, layer_idx)
     )
     x = x + reduce_fn(ff).astype(x.dtype)
+    if k_scale is not None:
+        return x, k_cache, v_cache, k_scale, v_scale
     return x, k_cache, v_cache
 
 
@@ -591,7 +694,20 @@ def forward_uncompiled(
     # through xs/ys instead re-stacked the whole allocation every call —
     # measured at ~0.64 ms/token on a 134 MB cache, the dominant term of the
     # round-3 small-model and 32k-context decode floors.
+    quantized = cache.k_scale is not None
+
     def body(carry, li):
+        if quantized:
+            # int8 arm: the f32 scale sidecars ride the carry beside their
+            # pools and update in place exactly like them
+            x, k_c, v_c, ks_c, vs_c = carry
+            x, k_c, v_c, ks_c, vs_c = _layer(
+                cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
+                layer_idx=li, kv_len=kv_len, stacked_cache=True,
+                page_table=page_table, page_size=page_size,
+                k_scale=ks_c, v_scale=vs_c,
+            )
+            return (x, k_c, v_c, ks_c, vs_c), None
         x, k_c, v_c = carry
         x, k_c, v_c = _layer(
             cfg, rope, x, positions, pos_start, params.layers, k_c, v_c,
@@ -601,13 +717,20 @@ def forward_uncompiled(
         return (x, k_c, v_c), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, new_k, new_v), _ = jax.lax.scan(body, (x, cache.k, cache.v), layer_ids)
+    if quantized:
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            body, (x, cache.k, cache.v, cache.k_scale, cache.v_scale), layer_ids
+        )
+        new_cache = KVCache(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+    else:
+        (x, new_k, new_v), _ = jax.lax.scan(body, (x, cache.k, cache.v), layer_ids)
+        new_cache = KVCache(k=new_k, v=new_v)
 
     x = rms_norm(x, params.final_norm, cfg.norm_epsilon)
     if logits_mode == "last":
         x = x[:, -1, :]
     logits = linear(x, params.wcls, cfg.dtype, cfg.pallas_arg, cfg.q80_activations)
-    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
+    return logits.astype(jnp.float32), new_cache
 
 
 # The jit entry point: cache is donated (updated in place in HBM); one

@@ -1,0 +1,50 @@
+"""Symmetric per-(token, head) int8 quantization for the KV cache.
+
+The source engine keeps every resident tensor block-quantized (Q40 weights,
+Q80 activations on the wire) because distributed inference is bandwidth
+bound; the KV cache is the last bf16-resident tensor on our decode hot
+path. This module owns the one quantization scheme both KV layouts use:
+
+* granularity: ONE f32 scale per (token, kv-head) vector of `head_dim`
+  elements — i.e. per row of the innermost axis. Per-page/per-block scales
+  would be cheaper (one scalar per page) but break under partial-page
+  writes: a page is written one token at a time across many decode steps,
+  and tokens quantized under an older (smaller) running max would silently
+  dequantize wrong once a later token grows the block scale. Per-token
+  scales make every write self-contained — exactly the property the
+  OOB-drop scatter semantics (runtime/paged_kv.py) rely on. Overhead:
+  4 bytes per head_dim int8 bytes (~3% at head_dim=128).
+* mapping: symmetric absmax -> [-127, 127]; the scale is clamped away from
+  zero so an all-zero vector (freshly allocated pages, parked rows) round
+  trips to exact zeros instead of NaN.
+* idempotence: re-quantizing a dequantized vector reproduces the same int8
+  payload (absmax maps back to +-127 exactly), so requant-on-insert along
+  the KV transport path (bf16 wire segments scattered into an int8 pool)
+  is lossless after the first quantization.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: floor for the per-vector scale: keeps 0-vectors exact and the dequant
+#: multiply finite. f32 min normal is ~1.2e-38; 1e-30 is far above denormal
+#: territory while being unreachably small for real bf16 activations.
+KV_SCALE_FLOOR = 1e-30
+
+
+def quantize_kv(x: jnp.ndarray):
+    """float[..., head_dim] -> (int8[..., head_dim], f32 scale[...]).
+
+    The scale is absmax/127 over the trailing axis, floored at
+    KV_SCALE_FLOOR. Round-to-nearest-even (jnp.round) in f32.
+    """
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, KV_SCALE_FLOOR)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
+    """int8[..., head_dim] + f32 scale[...] -> dtype[..., head_dim]."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)

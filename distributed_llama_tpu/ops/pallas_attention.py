@@ -229,6 +229,171 @@ def flash_attention(
     )
 
 
+# -- fused page-table-aware int8 decode attention ---------------------------
+#
+# The paged decode arm used to materialize an HLO gather of the row's
+# kv_len/ps pages into a [b, n_read*ps, h, d] bf16 view every step — the
+# single biggest HBM stream on the decode hot path. This kernel reads the
+# pool DIRECTLY: the row's int32 page table rides the scalar-prefetch
+# operand, the KV block index map resolves (row, kv-step) -> physical page
+# on the scalar core, and the int8 payload dequantizes against its f32
+# per-(token, head) scale in VMEM (the same place pallas_q40.py unpacks
+# weight nibbles) — so HBM sees int8 + scale bytes only, and the jaxpr
+# carries NO page-view gather (profiling.assert_gather_free pins this).
+#
+# Hardware note: one KV block is one page — (ps, hd) int8 tiles with
+# ps=16 under-fill the int8 sublane tile (32); fine in interpret mode
+# (CI) and correct on hardware, with a packing follow-up recorded in
+# PERF.md before hardware rounds chase peak.
+
+
+def _paged_kernel(
+    m_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_sref, l_sref, acc_ref,
+    *, scale, g, ps, n_read, n_kv,
+):
+    """One page's online-softmax update. m_ref (scalar prefetch) carries
+    [layer, pos_base[b], page_table[b*n_read]]; pos_base is each row's
+    FIRST query position (per-row — batch decode's unequal rows share the
+    program). Clamped-page garbage is causally masked for live rows and
+    discarded host-side for parked rows, the XLA paged arm's semantics."""
+    si = pl.program_id(2)
+    ti = pl.program_id(1)
+    bk = pl.program_id(0)
+
+    _, bt, _, hd = q_ref.shape
+    rows = bt * g
+    pos_base = m_ref[1 + bk // n_kv]
+
+    @pl.when(si == 0)
+    def _():
+        m_sref[...] = jnp.full_like(m_sref, NEG_INF)
+        l_sref[...] = jnp.zeros_like(l_sref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # page si holds positions [si*ps, (si+1)*ps): visible iff its first
+    # position is <= the row's last query position
+    last_pos = pos_base + ti * bt + (bt - 1)
+
+    @pl.when(si * ps <= last_pos)
+    def _():
+        q = q_ref[0].reshape(rows, hd).astype(jnp.float32)
+        # in-VMEM dequant: int8 payload x f32 per-(token, head) scale
+        k = k_ref[0, 0, :, 0, :].astype(jnp.float32) * ks_ref[0, 0, :, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [rows, ps]
+
+        row_pos = pos_base + ti * bt + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, ps), 0
+        ) // g
+        col_pos = si * ps + jax.lax.broadcasted_iota(jnp.int32, (rows, ps), 1)
+        s = jnp.where(col_pos <= row_pos, s, NEG_INF)
+
+        m_prev = m_sref[...][:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        m_safe = jnp.maximum(m_cur, NEG_INF / 2)
+        corr = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(col_pos <= row_pos, p, 0.0)
+        l_sref[...] = l_sref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0, :, 0, :].astype(jnp.float32) * vs_ref[0, 0, :, 0][:, None]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_sref[...] = jnp.broadcast_to(m_safe, m_sref.shape)
+
+    @pl.when(si == n_read - 1)
+    def _():
+        l = jnp.maximum(l_sref[...][:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).reshape(bt, g, hd).astype(o_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("n_read", "page_size", "scale", "interpret"))
+def paged_flash_attention(
+    q: jnp.ndarray,  # [b, t, n_heads, head_dim]
+    k_pool: jnp.ndarray,  # [L, n_pages, ps, n_kv, head_dim] int8
+    v_pool: jnp.ndarray,
+    k_scale: jnp.ndarray,  # [L, n_pages, ps, n_kv] f32
+    v_scale: jnp.ndarray,
+    layer_idx: jnp.ndarray,  # traced scalar int32 — one program for all layers
+    pos_base: jnp.ndarray,  # [b] int32: each row's first query position
+    page_table: jnp.ndarray,  # [b, >=n_read] int32 (-1 = unmapped)
+    n_read: int,  # static page count per row (kv_len / page_size bucket)
+    page_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused page-table-aware int8 GQA decode attention over the pool.
+
+    Reads the first `n_read` table entries per row THROUGH the scalar
+    prefetch operand — no materialized page gather, no dequantized KV view;
+    per-row positions make solo decode, batch decode, and the speculative
+    verify block all one kernel shape family. Returns [b, t, h, hd] in
+    q.dtype."""
+    b, t, n_heads, hd = q.shape
+    n_kv = k_pool.shape[3]
+    ps = page_size
+    g = n_heads // n_kv
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    bt = t  # decode-sized q blocks: one t block per grid row
+
+    q4 = (
+        q.reshape(b, t, n_kv, g, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * n_kv, t, g, hd)
+    )
+    meta = jnp.concatenate(
+        [
+            jnp.asarray(layer_idx, jnp.int32).reshape(1),
+            jnp.asarray(pos_base, jnp.int32).reshape(b),
+            jnp.maximum(
+                jax.lax.slice_in_dim(page_table, 0, n_read, axis=1), 0
+            ).astype(jnp.int32).reshape(b * n_read),
+        ]
+    )
+
+    def kv_map(bk, ti, si, m):
+        return (m[0], m[1 + b + (bk // n_kv) * n_read + si], 0, bk % n_kv, 0)
+
+    def scale_map(bk, ti, si, m):
+        return (m[0], m[1 + b + (bk // n_kv) * n_read + si], 0, bk % n_kv)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * n_kv, t // bt, n_read),
+        in_specs=[
+            pl.BlockSpec((1, bt, g, hd), lambda bk, ti, si, m: (bk, ti, 0, 0)),
+            pl.BlockSpec((1, 1, ps, 1, hd), kv_map),
+            pl.BlockSpec((1, 1, ps, 1, hd), kv_map),
+            pl.BlockSpec((1, 1, ps, 1), scale_map),
+            pl.BlockSpec((1, 1, ps, 1), scale_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bt, g, hd), lambda bk, ti, si, m: (bk, ti, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bt * g, 128), jnp.float32),  # running row max
+            pltpu.VMEM((bt * g, 128), jnp.float32),  # running exp-sum
+            pltpu.VMEM((bt * g, hd), jnp.float32),  # weighted-V accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        partial(
+            _paged_kernel, scale=scale, g=g, ps=ps, n_read=n_read, n_kv=n_kv
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n_kv, t, g, hd), q.dtype),
+        interpret=interpret,
+    )(meta, q4, k_pool, v_pool, k_scale, v_scale)
+    return (
+        out.reshape(b, n_kv, t, g, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, t, n_heads, hd)
+    )
+
+
 @partial(jax.jit, static_argnames=("scale", "block_t", "block_s", "interpret"))
 def flash_attention_partial(
     q: jnp.ndarray,  # [b, t, n_heads, head_dim]

@@ -115,13 +115,31 @@ def prefill_row(
     b-1 parked rows — multiplies the prefill matmul FLOPs by the batch."""
     k_row = jax.lax.dynamic_slice_in_dim(cache.k, row, 1, axis=1)
     v_row = jax.lax.dynamic_slice_in_dim(cache.v, row, 1, axis=1)
+    row_cache = KVCache(k=k_row, v=v_row)
+    if cache.k_scale is not None:
+        # int8 arm: the row's scale sidecars slice/unslice with the payload
+        row_cache = KVCache(
+            k=k_row, v=v_row,
+            k_scale=jax.lax.dynamic_slice_in_dim(cache.k_scale, row, 1, axis=1),
+            v_scale=jax.lax.dynamic_slice_in_dim(cache.v_scale, row, 1, axis=1),
+        )
     _, rc = forward_uncompiled(
-        cfg, params, rope, KVCache(k=k_row, v=v_row), tokens, pos_start,
+        cfg, params, rope, row_cache, tokens, pos_start,
         logits_mode="last", kv_len=kv_len,
     )
     k = jax.lax.dynamic_update_slice_in_dim(cache.k, rc.k, row, axis=1)
     v = jax.lax.dynamic_update_slice_in_dim(cache.v, rc.v, row, axis=1)
-    return KVCache(k=k, v=v)
+    if cache.k_scale is None:
+        return KVCache(k=k, v=v)
+    return KVCache(
+        k=k, v=v,
+        k_scale=jax.lax.dynamic_update_slice_in_dim(
+            cache.k_scale, rc.k_scale, row, axis=1
+        ),
+        v_scale=jax.lax.dynamic_update_slice_in_dim(
+            cache.v_scale, rc.v_scale, row, axis=1
+        ),
+    )
 
 
 class BatchSession:

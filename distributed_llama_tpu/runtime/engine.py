@@ -228,6 +228,26 @@ class InferenceEngine:
         maybe_enable_compilation_cache()
         self.reader = MFileReader(model_path, max_seq_len=max_seq_len)
         self.header = self.reader.header
+        # KV storage dtype knob (--kv-dtype / DLT_KV_DTYPE): "int8" turns on
+        # the quantized KV cache (ops/kv_quant.py — int8 payload + f32
+        # per-(token, kv-head) scale sidecars). None keeps the compute-dtype
+        # default; bf16/f32 caches stay byte-identical to pre-quantization.
+        from .paged_kv import resolve_kv_dtype
+
+        cache_dtype = resolve_kv_dtype(cache_dtype)
+        if cache_dtype == "int8" and mesh is not None:
+            # int8 KV is single-chip for now: the pipeline scan carries and
+            # the GSPMD cache shardings don't thread the scale sidecars.
+            # Fall back to the float default rather than fail — the knob is
+            # a perf hint, not a topology contract (docs/SERVING.md).
+            import warnings
+
+            warnings.warn(
+                "kv_dtype='int8' is single-chip only; mesh engine falls "
+                "back to the default float KV cache",
+                stacklevel=2,
+            )
+            cache_dtype = None
         self.cfg = config_from_header(
             self.header, compute_dtype=compute_dtype, cache_dtype=cache_dtype
         )
@@ -344,6 +364,8 @@ class InferenceEngine:
             self.page_pool = PagePool(
                 n_pages, ps, self.batch, self.cfg.seq_len, stats=self.stats,
                 reclaim=self._reclaim_pages,
+                page_bytes=page_pool_bytes(self.cfg, 1, ps),
+                kv_dtype=self.cfg.cache_dtype,
             )
         self.cache = self._new_cache()
         if verbose:
@@ -640,6 +662,8 @@ class InferenceEngine:
 
             pool = init_kv_pool(self.cfg, self.page_pool.n_pages, self.page_size)
             if self._cache_sharding is not None:
+                # int8 is single-chip (ctor gate), so mesh pools never carry
+                # scale sidecars — sharding only the payload is exhaustive
                 pool = KVCache(
                     k=jax.device_put(pool.k, self._cache_sharding),
                     v=jax.device_put(pool.v, self._cache_sharding),
@@ -974,8 +998,12 @@ class InferenceEngine:
                 # numpy operands on purpose: the runtime insert path
                 # (prefix_cache.insert_external) feeds host arrays, and the
                 # jit cache keys committed shardings — warming with device
-                # operands would leave the np-operand signature cold
-                seg = np.zeros((L, size, h, d), self.cache.k.dtype)
+                # operands would leave the np-operand signature cold.
+                # Wire segments are FLOAT even over int8 pools: gather_pages
+                # dequantizes on extract and scatter_pages requantizes on
+                # insert, so the transport dtype is f32, not the pool dtype
+                wire = np.float32 if self.cfg.kv_quantized else self.cache.k.dtype
+                seg = np.zeros((L, size, h, d), wire)
                 # pairwise-distinct dropped indices past the pool (colliding
                 # dropped indices would be undefined scatter behavior — the
                 # same discipline the forward's paged write path uses)

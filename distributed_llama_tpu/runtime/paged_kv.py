@@ -105,6 +105,31 @@ def resolve_page_size(explicit: int | None = None) -> int:
     return v
 
 
+KV_DTYPES = ("bfloat16", "float32", "int8")
+
+
+def resolve_kv_dtype(explicit: str | None = None) -> str | None:
+    """THE one resolver of the KV storage dtype: an explicit value wins;
+    otherwise ``DLT_KV_DTYPE``; unset means None — the engine then keeps
+    its compute-dtype default (bf16 cache for bf16 compute, f32 for f32,
+    models/config.config_from_header). ``"int8"`` selects the quantized
+    arm (ops/kv_quant.py: int8 payload + f32 per-(token, head) scale
+    sidecar); the float dtypes keep the pre-quantization programs
+    bit-identical."""
+    v = explicit
+    if v is None:
+        raw = (os.environ.get("DLT_KV_DTYPE") or "").strip()
+        v = raw or None
+    if v is None:
+        return None
+    v = v.strip().lower()
+    if v == "bf16":
+        v = "bfloat16"
+    if v not in KV_DTYPES:
+        raise ValueError(f"unknown kv dtype {v!r} (choose from {KV_DTYPES})")
+    return v
+
+
 def resolve_pool_pages(
     explicit_mb: int | None, page_bytes: int, parity_pages: int
 ) -> int:
@@ -125,27 +150,31 @@ def resolve_pool_pages(
 
 
 def page_pool_bytes(cfg, n_pages: int, page_size: int) -> int:
-    """Device bytes of a pool's k+v tensors."""
-    return (
-        2
-        * cfg.n_layers
-        * n_pages
-        * page_size
-        * cfg.n_kv_heads
-        * cfg.head_dim
-        * jnp.dtype(cfg.kv_dtype).itemsize
-    )
+    """Device bytes of a pool's k+v tensors (+ the f32 scale sidecars on the
+    int8 arm — capacity math, /stats, and the cost model must all price the
+    STORED width, including the 4 scale bytes per head_dim payload bytes)."""
+    per_vector = cfg.head_dim * jnp.dtype(cfg.kv_dtype).itemsize
+    if cfg.kv_quantized:
+        per_vector += 4  # one f32 scale per (token, kv-head) vector
+    return 2 * cfg.n_layers * n_pages * page_size * cfg.n_kv_heads * per_vector
 
 
 def init_kv_pool(cfg, n_pages: int, page_size: int) -> KVCache:
     """The device page pool, riding the existing :class:`KVCache` pytree so
     every jit entry point's ``donate_argnames=("cache",)`` keeps working:
-    ``k``/``v`` are ``[L, n_pages, page_size, n_kv, head_dim]``."""
+    ``k``/``v`` are ``[L, n_pages, page_size, n_kv, head_dim]``; the int8
+    arm adds ``[L, n_pages, page_size, n_kv]`` f32 scale sidecars that page
+    ops move with the SAME page indices as their payloads."""
     shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-    return KVCache(
-        k=jnp.zeros(shape, dtype=cfg.kv_dtype),
-        v=jnp.zeros(shape, dtype=cfg.kv_dtype),
-    )
+    k = jnp.zeros(shape, dtype=cfg.kv_dtype)
+    v = jnp.zeros(shape, dtype=cfg.kv_dtype)
+    if cfg.kv_quantized:
+        return KVCache(
+            k=k, v=v,
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
+    return KVCache(k=k, v=v)
 
 
 # -- the jitted copy-on-write program ----------------------------------------
@@ -168,7 +197,18 @@ def copy_page(cache: KVCache, src, dst, out_sharding=None) -> KVCache:
     if out_sharding is not None:
         k = jax.lax.with_sharding_constraint(k, out_sharding)
         v = jax.lax.with_sharding_constraint(v, out_sharding)
-    return KVCache(k=k, v=v)
+    if cache.k_scale is None:
+        return KVCache(k=k, v=v)
+    # int8 arm: the scale sidecars move with the SAME page indices — a COW
+    # copy that left scales behind would dequantize the moved payload with
+    # the destination page's stale scales (int8 is single-chip, no sharding)
+    ks_seg = jax.lax.dynamic_slice(cache.k_scale, (0, src, 0, 0), (L, 1, ps, h))
+    vs_seg = jax.lax.dynamic_slice(cache.v_scale, (0, src, 0, 0), (L, 1, ps, h))
+    return KVCache(
+        k=k, v=v,
+        k_scale=jax.lax.dynamic_update_slice(cache.k_scale, ks_seg, (0, dst, 0, 0)),
+        v_scale=jax.lax.dynamic_update_slice(cache.v_scale, vs_seg, (0, dst, 0, 0)),
+    )
 
 
 # -- page movement programs (the KV movement layer, runtime/kv_transport.py) --
@@ -194,6 +234,17 @@ def gather_pages(cache: KVCache, pages, out_sharding=None):
     k = cache.k[:, pages]  # [L, n, ps, h, d]
     v = cache.v[:, pages]
     L, n, ps, h, d = k.shape
+    if cache.k_scale is not None:
+        # int8 pool: DEQUANT-ON-EXTRACT — the contiguous [L, n*ps, h, d]
+        # slice every consumer of this shape shares (prefix segments, the
+        # disagg wire codec, the device transport) stays a float tensor, so
+        # cross-dtype peers interoperate for free; the insert path
+        # (scatter_pages) re-quantizes, which is lossless after the first
+        # quantization (ops/kv_quant.py idempotence note)
+        from ..ops.kv_quant import dequantize_kv
+
+        k = dequantize_kv(k, cache.k_scale[:, pages], jnp.float32)
+        v = dequantize_kv(v, cache.v_scale[:, pages], jnp.float32)
     k = k.reshape(L, n * ps, h, d)
     v = v.reshape(L, n * ps, h, d)
     if out_sharding is not None:
@@ -211,14 +262,33 @@ def scatter_pages(cache: KVCache, k_seg, v_seg, pages, out_sharding=None) -> KVC
     Donated cache: in-place in HBM."""
     L, n = cache.k.shape[0], pages.shape[0]
     ps, h, d = cache.k.shape[2], cache.k.shape[3], cache.k.shape[4]
-    k_seg = k_seg.reshape(L, n, ps, h, d).astype(cache.k.dtype)
-    v_seg = v_seg.reshape(L, n, ps, h, d).astype(cache.v.dtype)
+    k_seg = k_seg.reshape(L, n, ps, h, d)
+    v_seg = v_seg.reshape(L, n, ps, h, d)
+    if cache.k_scale is not None:
+        # int8 pool: QUANTIZE the float segment here — a bare .astype would
+        # silently truncate bf16/f32 values into int8 garbage. The scale
+        # sidecars scatter with the same indices (and the same drop mode:
+        # a padded write that drops its payload must drop its scale too).
+        from ..ops.kv_quant import quantize_kv
+
+        k_seg, ks_seg = quantize_kv(k_seg)
+        v_seg, vs_seg = quantize_kv(v_seg)
+        k_scale = cache.k_scale.at[:, pages].set(
+            ks_seg, mode="drop", unique_indices=True
+        )
+        v_scale = cache.v_scale.at[:, pages].set(
+            vs_seg, mode="drop", unique_indices=True
+        )
+    else:
+        k_seg = k_seg.astype(cache.k.dtype)
+        v_seg = v_seg.astype(cache.v.dtype)
+        k_scale = v_scale = None
     k = cache.k.at[:, pages].set(k_seg, mode="drop", unique_indices=True)
     v = cache.v.at[:, pages].set(v_seg, mode="drop", unique_indices=True)
     if out_sharding is not None:
         k = jax.lax.with_sharding_constraint(k, out_sharding)
         v = jax.lax.with_sharding_constraint(v, out_sharding)
-    return KVCache(k=k, v=v)
+    return KVCache(k=k, v=v, k_scale=k_scale, v_scale=v_scale)
 
 
 # -- host-side pool ----------------------------------------------------------
@@ -246,11 +316,16 @@ class PagePool:
         stats=None,
         reclaim=None,  # () -> bool: try to free pages (prefix-cache LRU
         # eviction); True = progress was made, retry the allocation
+        page_bytes: int = 0,  # device bytes per page incl. scale sidecars
+        # (page_pool_bytes(cfg, 1, ps)) — /stats capacity truthing
+        kv_dtype: str | None = None,  # storage dtype label for /stats
     ):
         if n_pages <= 0:
             raise ValueError("page pool needs at least one page")
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
+        self.page_bytes = int(page_bytes)
+        self.kv_dtype = kv_dtype
         self.n_rows = int(n_rows)
         self.seq_len = int(seq_len)
         self.max_slots = -(-seq_len // page_size)  # ceil
@@ -290,6 +365,14 @@ class PagePool:
                 "free_pages": self.free_pages,
                 "max_slots": self.max_slots,
                 "shared_pages": int(np.sum(self.refs > 1)),
+                # capacity truthing: STORED bytes (int8 payload + f32 scale
+                # sidecars on the quantized arm), so equal-MB budgets show
+                # their real token capacity — ~2x pages under int8
+                "kv_dtype": self.kv_dtype,
+                "page_bytes": self.page_bytes,
+                "pool_bytes": self.page_bytes * self.n_pages,
+                "used_bytes": self.page_bytes * self.used_pages,
+                "tokens_capacity": self.n_pages * self.page_size,
             }
 
     # -- allocation ----------------------------------------------------------

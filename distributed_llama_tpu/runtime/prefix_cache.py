@@ -252,6 +252,12 @@ class PrefixCache:
             return None
         if engine.mesh is not None and engine.mesh.shape.get("sp", 1) > 1:
             return None
+        if engine.cfg.kv_quantized and not engine.paged:
+            # contiguous int8: the extract/splice copy programs would need
+            # scale-sidecar twins for marginal benefit — the paged layout is
+            # the int8 serving shape (zero-copy page sharing needs no dtype
+            # awareness at all), so the contiguous arm disables itself here
+            return None
         if not prefix_buckets(engine.cfg.seq_len):
             return None  # context too small for a publishable prefix
         seg_sh = None

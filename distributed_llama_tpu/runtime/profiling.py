@@ -269,7 +269,10 @@ def lower_entry(engine, key):
                 out_sharding=engine.prefix_cache.seg_sharding,
             )
         L, _, _, h, d = engine.cache.k.shape
-        seg = _sds((L, size, h, d), engine.cache.k.dtype)
+        # wire segments are FLOAT even over int8 pools (dequant-on-extract /
+        # requant-on-insert, runtime/paged_kv.py)
+        wire = jnp.float32 if cfg.kv_quantized else engine.cache.k.dtype
+        seg = _sds((L, size, h, d), wire)
         return scatter_pages.lower(
             a_cache, seg, seg, _sds((n,), jnp.int32),
             out_sharding=engine._cache_sharding,
@@ -501,6 +504,53 @@ def _dot_flops(eqn, mult: float) -> float:
     return 2.0 * k * _aval_elems(out) * mult
 
 
+def _paged_kernel_census(eqn, in_hbm):
+    """Recognize the fused page-table-aware decode kernel
+    (ops/pallas_attention.paged_flash_attention) by operand signature — the
+    ONE pallas_call whose HBM reads happen *inside* the kernel (the HLO page
+    gather the fusion removed) — and price them at STORED width: per grid
+    cell one (page, kv-head) tile of int8 payload plus its f32 scale row,
+    for K and V. Returns ``(bytes, body_grid_mult)`` or None (any other
+    pallas_call keeps the generic sub-jaxpr handling). Without this the
+    fused program's KV reads would census as ZERO bytes — the quantized
+    roofline would flatter itself by exactly the traffic it claims to save."""
+    import numpy as np
+
+    pools = [
+        v
+        for v, res in zip(eqn.invars, in_hbm)
+        if res
+        and getattr(v.aval, "ndim", 0) == 5
+        and v.aval.dtype == np.int8
+    ]
+    if len(pools) != 2:
+        return None
+    meta = next(
+        (
+            v
+            for v in eqn.invars
+            if getattr(v.aval, "ndim", 0) == 1 and v.aval.dtype == np.int32
+        ),
+        None,
+    )
+    q4 = next(
+        (
+            v
+            for v in eqn.invars
+            if getattr(v.aval, "ndim", 0) == 4 and v.aval.dtype.kind == "f"
+        ),
+        None,
+    )
+    if meta is None or q4 is None:
+        return None
+    _, _, ps, n_kv, hd = pools[0].aval.shape
+    bn = q4.aval.shape[0]  # b * n_kv grid rows
+    b = bn // n_kv
+    n_read = (int(meta.aval.size) - 1 - b) // b
+    # K + V: int8 payload (ps*hd) and the f32 scale sidecar (ps*4) per cell
+    return 2 * bn * n_read * (ps * hd + ps * 4), bn * n_read
+
+
 def _census_walk(jaxpr, mult: float, hbm: dict, acc: dict) -> None:
     from ..analysis.graph_audit import _sub_jaxprs
 
@@ -518,6 +568,17 @@ def _census_walk(jaxpr, mult: float, hbm: dict, acc: dict) -> None:
                 inner[id(bv)] = hbm.get(id(ov), False)
             _census_walk(body, mult * length, inner, acc)
             continue
+        if name == "pallas_call":
+            in_hbm = [hbm.get(id(v), False) for v in eqn.invars]
+            pk = _paged_kernel_census(eqn, in_hbm)
+            if pk is not None:
+                pool_bytes, grid = pk
+                acc["bytes"] += pool_bytes * mult
+                # kernel body flops run once per grid cell (refs carry no
+                # residency — bytes are fully owned by the pricing above)
+                for sub in _sub_jaxprs(eqn):
+                    _census_walk(sub, mult * grid, {}, acc)
+                continue
         subs = list(_sub_jaxprs(eqn))
         if subs:
             # pjit / cond / while / custom_* bodies: trip count unknown or 1

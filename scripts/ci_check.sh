@@ -26,6 +26,12 @@
 #                            HTTP levels, zero-copy prefix sharing,
 #                            exhaustion park/shed, sanitizer acceptance,
 #                            the fatal-sanitizer /v1/chat regression)
+#   8b. kv-quant suite       (int8 KV: quantization laws, f32 wire through
+#                            gather/scatter, fused page-table-aware decode
+#                            kernel numerics + the gather-free jaxpr pin,
+#                            stored-width census/ledger honesty, equal-
+#                            budget capacity, int8 ladder audit, sanitizer
+#                            acceptance, --kv-dtype over HTTP)
 #   9. fleet suite          (gateway federation scraper under the chaos
 #                            harness, per-replica signal table + staleness,
 #                            federated /metrics format, goodput-ledger
@@ -89,6 +95,13 @@ python -m distributed_llama_tpu.analysis.graph_audit --costs
 echo "== graph audit (paged KV ladder, --costs coverage) =="
 python -m distributed_llama_tpu.analysis.graph_audit --kv-layout paged --costs
 
+echo "== graph audit (int8 paged ladder, fused decode kernel) =="
+# interpret mode makes the fused page-table-aware kernel trace-eligible on
+# CPU so the audited ladder IS the int8 serving shape (zero pool gathers)
+DLT_PALLAS_INTERPRET=1 \
+  python -m distributed_llama_tpu.analysis.graph_audit \
+  --kv-layout paged --kv-dtype int8 --costs
+
 echo "== graph audit (MESH-paged ladder, pp=2 x tp=2) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m distributed_llama_tpu.analysis.graph_audit \
@@ -111,6 +124,9 @@ python -m pytest tests/test_profiling.py -q -p no:cacheprovider
 
 echo "== paged-kv suite =="
 python -m pytest tests/test_paged_kv.py -q -p no:cacheprovider
+
+echo "== kv-quant suite (int8 KV + fused paged decode attention) =="
+python -m pytest tests/test_kv_quant.py -q -p no:cacheprovider
 
 echo "== fleet suite (federation + goodput + timeline) =="
 python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovider

@@ -1,0 +1,620 @@
+"""Quantized KV cache tests (ops/kv_quant.py + the fused page-table-aware
+Pallas decode kernel): quantization unit laws (roundtrip bound, zero-vector
+floor, idempotence), the f32 wire through gather/scatter, fused-kernel
+numerics vs the XLA reference, int8 token identity across layouts at engine /
+BatchSession / HTTP level, equal-budget pool capacity truthing (~2x tokens),
+stored-width HBM accounting (ledger + census), the gather-free jaxpr pin with
+its planted census failure, graph-audit coverage of the int8 ladder (the dot
+census sees INSIDE pallas_call), and the DLT_SANITIZERS=1 zero-post-warmup-
+recompile sweep on the int8 paged arm."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.config import config_from_header
+from distributed_llama_tpu.ops.attention import gqa_attention
+from distributed_llama_tpu.ops.kv_quant import (
+    KV_SCALE_FLOOR,
+    dequantize_kv,
+    quantize_kv,
+)
+from distributed_llama_tpu.ops.pallas_attention import paged_flash_attention
+from distributed_llama_tpu.runtime.batch_session import BatchSession
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.runtime.paged_kv import (
+    gather_pages,
+    init_kv_pool,
+    page_pool_bytes,
+    resolve_kv_dtype,
+    scatter_pages,
+)
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+from distributed_llama_tpu.tokenizer import Sampler
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kvquant")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=256), seed=7)
+    return path
+
+
+def _engine(path, layout, **kw):
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("max_chunk", 16)
+    kw.setdefault("decode_chunk_size", 8)
+    kw.setdefault("prefix_cache_mb", 0)
+    kw.setdefault("speculative", "off")
+    return InferenceEngine(path, kv_layout=layout, **kw)
+
+
+# -- quantization unit laws ---------------------------------------------------
+
+
+def test_quantize_roundtrip_floor_and_idempotence():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 7, 16), np.float32) * 3.0)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert s.shape == x.shape[:-1]
+    # symmetric absmax: error per element bounded by half a quantization step
+    err = np.abs(np.asarray(dequantize_kv(q, s)) - np.asarray(x))
+    assert (err <= np.asarray(s)[..., None] * 0.5 + 1e-7).all()
+    # all-zero vectors (fresh pages, parked rows) round trip to EXACT zeros
+    qz, sz = quantize_kv(jnp.zeros((3, 16)))
+    assert (np.asarray(qz) == 0).all()
+    assert np.allclose(np.asarray(sz), KV_SCALE_FLOOR)
+    assert (np.asarray(dequantize_kv(qz, sz)) == 0.0).all()
+    # idempotence: requantizing a dequantized vector reproduces the payload
+    # bit for bit — the requant-on-insert transport path is lossless
+    q2, s2 = quantize_kv(dequantize_kv(q, s))
+    assert (np.asarray(q2) == np.asarray(q)).all()
+    assert np.allclose(np.asarray(s2), np.asarray(s), rtol=1e-6)
+
+
+def test_resolve_kv_dtype(monkeypatch):
+    monkeypatch.delenv("DLT_KV_DTYPE", raising=False)
+    assert resolve_kv_dtype(None) is None  # engine keeps its compute default
+    monkeypatch.setenv("DLT_KV_DTYPE", "bf16")
+    assert resolve_kv_dtype(None) == "bfloat16"
+    assert resolve_kv_dtype("int8") == "int8"  # explicit wins over env
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("int4")
+
+
+def test_pool_wire_roundtrip_f32():
+    """gather_pages dequantizes on extract (f32 wire), scatter_pages
+    requantizes on insert; a scatter -> gather -> scatter round trip is
+    exact after the first quantization, and the scale sidecars move with
+    their payload pages."""
+    cfg = config_from_header(tiny_header(), cache_dtype="int8")
+    pool = init_kv_pool(cfg, n_pages=6, page_size=16)
+    assert pool.k_scale is not None and pool.v_scale is not None
+    rng = np.random.default_rng(1)
+    L, h, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    seg_k = jnp.asarray(rng.standard_normal((L, 32, h, d), np.float32))
+    seg_v = jnp.asarray(rng.standard_normal((L, 32, h, d), np.float32))
+    pages = jnp.asarray([4, 1], jnp.int32)
+    pool = scatter_pages(pool, seg_k, seg_v, pages)
+    k1, v1 = gather_pages(pool, pages)
+    assert k1.dtype == jnp.float32 and v1.dtype == jnp.float32
+    # extract returns the quantize->dequantize image of the insert: per
+    # element the error is bounded by half a step of the row's scale (the
+    # jitted scatter may round one ulp apart from an eager reference, so
+    # the LAW is asserted, not a bit pattern)
+    _, sk = quantize_kv(seg_k)
+    err = np.abs(np.asarray(k1) - np.asarray(seg_k))
+    assert (err <= np.asarray(sk)[..., None] * 0.51 + 1e-6).all()
+    # second trip through the wire: the int8 PAYLOAD is bit-stable
+    # (idempotent requant); the f32 scale may wobble one ulp (127*s/127
+    # under fused XLA math), so the wire floats get an ulp-scale tolerance
+    payload1 = np.asarray(pool.k).copy()
+    pool = scatter_pages(pool, k1, v1, pages)
+    k2, v2 = gather_pages(pool, pages)
+    assert np.array_equal(payload1, np.asarray(pool.k))
+    np.testing.assert_allclose(np.asarray(k1), np.asarray(k2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-6)
+    # untouched pages stayed zero — the scatter wrote ONLY its pages
+    other = np.asarray(pool.k[:, 0])
+    assert (other == 0).all()
+
+
+# -- fused kernel numerics ----------------------------------------------------
+
+
+def _build_pool(rng, k_lin, v_lin, tables, L, n_pages, ps, layer):
+    """Quantize linear [b, S, h, d] KV and place it page by page at the
+    physical slots `tables` names (the pool rows OTHER layers/pages hold
+    garbage, which the layer index / causal mask must ignore)."""
+    b, S, n_kv, hd = k_lin.shape
+    kq, ks = quantize_kv(jnp.asarray(k_lin))
+    vq, vs = quantize_kv(jnp.asarray(v_lin))
+    kp = rng.integers(-127, 127, (L, n_pages, ps, n_kv, hd)).astype(np.int8)
+    vp = rng.integers(-127, 127, (L, n_pages, ps, n_kv, hd)).astype(np.int8)
+    ksp = rng.random((L, n_pages, ps, n_kv), np.float32)
+    vsp = rng.random((L, n_pages, ps, n_kv), np.float32)
+    for row in range(b):
+        for si in range(S // ps):
+            pg = tables[row, si]
+            if pg < 0:
+                continue
+            sl = slice(si * ps, (si + 1) * ps)
+            kp[layer, pg] = np.asarray(kq)[row, sl]
+            vp[layer, pg] = np.asarray(vq)[row, sl]
+            ksp[layer, pg] = np.asarray(ks)[row, sl]
+            vsp[layer, pg] = np.asarray(vs)[row, sl]
+    ref_k = np.asarray(dequantize_kv(kq, ks))
+    ref_v = np.asarray(dequantize_kv(vq, vs))
+    return kp, vp, ksp, vsp, ref_k, ref_v
+
+
+@pytest.mark.parametrize("t,pos0", [(1, (37, 50)), (4, (16, 33))],
+                         ids=["decode_t1", "verify_t4"])
+def test_paged_flash_attention_matches_reference(t, pos0):
+    """The fused kernel over a shuffled page table + garbage-filled pool
+    equals gqa_attention over the dequantized contiguous view, for solo
+    decode (t=1, unequal row positions) and the verify block shape."""
+    rng = np.random.default_rng(2)
+    L, n_pages, ps, n_kv, hd, heads, b, n_read = 2, 8, 16, 2, 32, 4, 2, 4
+    S = n_read * ps
+    k_lin = rng.standard_normal((b, S, n_kv, hd)).astype(np.float32)
+    v_lin = rng.standard_normal((b, S, n_kv, hd)).astype(np.float32)
+    q = rng.standard_normal((b, t, heads, hd)).astype(np.float32)
+    tables = np.array([[3, 0, 5, 2], [1, 6, 4, 7]], np.int32)
+    kp, vp, ksp, vsp, ref_k, ref_v = _build_pool(
+        rng, k_lin, v_lin, tables, L, n_pages, ps, layer=1)
+    out = paged_flash_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(ksp),
+        jnp.asarray(vsp), jnp.int32(1), jnp.asarray(pos0, jnp.int32),
+        jnp.asarray(tables), n_read=n_read, page_size=ps, interpret=True,
+    )
+    positions = np.asarray(pos0)[:, None] + np.arange(t)[None, :]
+    ref = gqa_attention(
+        jnp.asarray(q), jnp.asarray(ref_k), jnp.asarray(ref_v),
+        jnp.asarray(positions, jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_paged_flash_attention_masks_unmapped_pages():
+    """Unmapped (-1) table entries clamp to physical page 0 — which here
+    holds GARBAGE — and must contribute nothing: every clamped page sits
+    beyond the row's last position, so the causal mask discards it (the XLA
+    paged arm's exact semantics)."""
+    rng = np.random.default_rng(3)
+    L, n_pages, ps, n_kv, hd, heads, n_read = 2, 8, 16, 2, 32, 4, 4
+    pos0 = (24,)  # last visible position 24 -> only pages 0 and 1 live
+    S = 2 * ps
+    k_lin = rng.standard_normal((1, S, n_kv, hd)).astype(np.float32)
+    v_lin = rng.standard_normal((1, S, n_kv, hd)).astype(np.float32)
+    q = rng.standard_normal((1, 1, heads, hd)).astype(np.float32)
+    tables = np.array([[2, 5, -1, -1]], np.int32)
+    kp, vp, ksp, vsp, ref_k, ref_v = _build_pool(
+        rng, k_lin, v_lin, tables[:, :2], L, n_pages, ps, layer=0)
+    out = paged_flash_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(ksp),
+        jnp.asarray(vsp), jnp.int32(0), jnp.asarray(pos0, jnp.int32),
+        jnp.asarray(tables), n_read=n_read, page_size=ps, interpret=True,
+    )
+    ref = gqa_attention(
+        jnp.asarray(q), jnp.asarray(ref_k), jnp.asarray(ref_v),
+        jnp.asarray([[24]], jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+# -- engine-level identity and quality ----------------------------------------
+
+
+def test_int8_layout_parity_and_float_overlap(model_path, monkeypatch):
+    """int8 paged (fused kernel, interpret mode) and int8 contiguous are
+    token-identical — greedy AND seeded-sampled — and the int8 chain tracks
+    the float chain closely on the tiny model (quantization is a quality
+    knob, not a correctness one)."""
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    prompt = [3, 7, 11, 2, 9, 4, 8, 5, 6, 10, 12, 13]
+    ec = _engine(model_path, "contiguous", cache_dtype="int8")
+    ep = _engine(model_path, "paged", cache_dtype="int8")
+    ef = _engine(model_path, "contiguous")
+    try:
+        assert ec.cfg.kv_quantized and ep.cfg.kv_quantized
+        assert ep.cache.k_scale is not None
+        rc = ec.generate(prompt, 24)
+        rp = ep.generate(prompt, 24)
+        assert rc.tokens == rp.tokens
+        rf = ef.generate(prompt, 24)
+        overlap = sum(a == b for a, b in zip(rp.tokens, rf.tokens))
+        assert overlap >= int(0.75 * len(rf.tokens)), (rp.tokens, rf.tokens)
+        sc = Sampler(ec.cfg.vocab_size, 0.8, 0.9, 42)
+        sp = Sampler(ep.cfg.vocab_size, 0.8, 0.9, 42)
+        ec.reset(), ep.reset()
+        assert (ec.generate(prompt, 24, sampler=sc).tokens
+                == ep.generate(prompt, 24, sampler=sp).tokens)
+    finally:
+        ec.close(), ep.close(), ef.close()
+
+
+def test_int8_batch_session_parity(model_path, monkeypatch):
+    """BatchSession (mixed greedy + seeded sampled rows) is step-identical
+    across int8 layouts — the batch_decode arm of the fused kernel."""
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    prompts = [[3, 7, 11, 2, 9, 4, 8, 5], [5, 4, 3, 2, 1]]
+    ec = _engine(model_path, "contiguous", cache_dtype="int8", batch=2)
+    ep = _engine(model_path, "paged", cache_dtype="int8", batch=2)
+    try:
+        scs, sps = BatchSession(ec), BatchSession(ep)
+        for s in (scs, sps):
+            s.admit(0, prompts[0], temperature=0.0)
+            s.admit(1, prompts[1], temperature=0.7, key_data=(123, 456))
+        for _ in range(3):
+            assert np.array_equal(scs.step(8), sps.step(8))
+    finally:
+        ec.close(), ep.close()
+
+
+def test_int8_speculative_verify_parity(model_path, monkeypatch):
+    """Speculative ngram decode on the int8 paged arm (the verify block
+    rides the fused kernel at t=k+1) equals plain int8 contiguous decode."""
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    rep = [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2]
+    ec = _engine(model_path, "contiguous", cache_dtype="int8")
+    ep = _engine(model_path, "paged", cache_dtype="int8", speculative="ngram")
+    try:
+        rc = ec.generate(rep, 40)
+        rp = ep.generate(rep, 40)
+        assert rc.tokens == rp.tokens
+        assert ep.stats.counters_snapshot().get("spec_rounds", 0) >= 1
+    finally:
+        ec.close(), ep.close()
+
+
+def test_int8_prefix_cache_paged_works_contiguous_disabled(model_path):
+    """The contiguous int8 arm disables the prefix cache (its extract/
+    splice copies would need scale-sidecar twins); the PAGED int8 arm keeps
+    zero-copy sharing — a warm hit replays the cold reply exactly."""
+    ec = _engine(model_path, "contiguous", cache_dtype="int8",
+                 prefix_cache_mb=64)
+    ep = _engine(model_path, "paged", cache_dtype="int8", prefix_cache_mb=64)
+    try:
+        assert ec.prefix_cache is None
+        assert ep.prefix_cache is not None
+        prompt = list(range(1, 48))
+        cold = ep.generate(prompt, 40)
+        ep.reset()
+        warm = ep.generate(prompt, 40)
+        assert cold.tokens == warm.tokens
+        assert ep.stats.counters_snapshot().get("prefix_hits", 0) >= 1
+    finally:
+        ec.close(), ep.close()
+
+
+def test_int8_mesh_engine_falls_back_with_warning(tmp_path):
+    """kv_dtype='int8' is single-chip only: a mesh engine warns and keeps
+    the float default (no scale sidecars anywhere in the sharded cache)."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+
+    path = str(tmp_path / "m.m")
+    write_tiny_model(
+        path,
+        tiny_header(seq_len=128, dim=128, n_heads=4, n_kv_heads=4,
+                    hidden_dim=128, n_layers=2),
+        seed=5,
+    )
+    with pytest.warns(UserWarning, match="single-chip"):
+        eng = InferenceEngine(
+            path, mesh=make_mesh(tp=2), compute_dtype="float32",
+            cache_dtype="int8", batch=2, max_chunk=16, decode_chunk_size=8,
+        )
+    try:
+        assert not eng.cfg.kv_quantized
+        assert eng.cache.k_scale is None
+    finally:
+        eng.close()
+
+
+# -- capacity and byte truthing -----------------------------------------------
+
+
+def test_equal_budget_pool_admits_more_int8_tokens(model_path):
+    """PagePool byte truthing: page_bytes prices the STORED width (int8
+    payload + f32 scale sidecar), so an equal-MB budget admits
+    2*hd/(hd+4) more pages — ~2x at serving head_dim (1.94x at hd=128),
+    1.6x at the tiny model's hd=16 — and the snapshot exposes it."""
+    h = tiny_header(seq_len=256)
+    cfg8 = config_from_header(h, compute_dtype="bfloat16", cache_dtype="int8")
+    cfgb = config_from_header(h, compute_dtype="bfloat16")
+    hd = cfgb.head_dim
+    assert page_pool_bytes(cfgb, 1, 16) / page_pool_bytes(cfg8, 1, 16) == (
+        pytest.approx((2 * hd) / (hd + 4)))
+    # the formula at the serving shape: head_dim 128 -> 1.94x
+    assert (2 * 128) / (128 + 4) == pytest.approx(1.94, abs=0.01)
+    e8 = _engine(model_path, "paged", compute_dtype="bfloat16",
+                 cache_dtype="int8", kv_pool_mb=1)
+    eb = _engine(model_path, "paged", compute_dtype="bfloat16", kv_pool_mb=1)
+    try:
+        s8, sb = e8.page_pool.snapshot(), eb.page_pool.snapshot()
+        assert s8["kv_dtype"] == "int8" and sb["kv_dtype"] == "bfloat16"
+        assert s8["page_bytes"] == page_pool_bytes(cfg8, 1, e8.page_size)
+        assert sb["page_bytes"] == page_pool_bytes(cfgb, 1, eb.page_size)
+        assert s8["pool_bytes"] == s8["n_pages"] * s8["page_bytes"]
+        assert s8["pool_bytes"] <= 1024 * 1024 < s8["pool_bytes"] + s8["page_bytes"]
+        assert s8["tokens_capacity"] == s8["n_pages"] * e8.page_size
+        ratio = s8["n_pages"] / sb["n_pages"]
+        assert ratio == pytest.approx((2 * hd) / (hd + 4), rel=0.02)
+        e8.generate([1, 2, 3, 4, 5], 12)
+        s8 = e8.page_pool.snapshot()
+        assert s8["used_bytes"] == s8["used_pages"] * s8["page_bytes"] > 0
+    finally:
+        e8.close(), eb.close()
+
+
+@pytest.mark.analysis
+def test_hbm_ledger_prices_stored_width(model_path):
+    """The ledger's kv_cache component on an int8 paged engine equals the
+    scale-aware pool bytes exactly — the sidecars are never free."""
+    from distributed_llama_tpu.runtime.profiling import hbm_ledger
+
+    eng = _engine(model_path, "paged", cache_dtype="int8", kv_pool_mb=1)
+    try:
+        led = hbm_ledger(eng)
+        want = page_pool_bytes(eng.cfg, eng.page_pool.n_pages, eng.page_size)
+        assert led["components"]["kv_cache"] == want
+        # and the sidecar share is visible: payload alone would be smaller
+        payload = 2 * eng.cfg.n_layers * eng.page_pool.n_pages * \
+            eng.page_size * eng.cfg.n_kv_heads * eng.cfg.head_dim
+        assert led["components"]["kv_cache"] > payload
+    finally:
+        eng.close()
+
+
+# -- the gather-free pin and census honesty -----------------------------------
+
+
+def _count_pool_ops(jaxpr, pool_shape, acc):
+    from distributed_llama_tpu.analysis.graph_audit import _sub_jaxprs
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            acc["pallas"] += 1
+        if name == "gather" and any(
+            tuple(getattr(v.aval, "shape", ())) == pool_shape
+            for v in eqn.invars
+        ):
+            acc["pool_gather"] += 1
+        for sub in _sub_jaxprs(eqn):
+            _count_pool_ops(sub, pool_shape, acc)
+
+
+@pytest.mark.analysis
+def test_int8_decode_is_gather_free_and_census_prices_it(model_path,
+                                                          monkeypatch):
+    """THE roofline pin: the int8 paged decode program carries ZERO
+    materialized pool gathers (the page table rides the kernel's scalar
+    prefetch) while the float twin gathers its page view; the census prices
+    the fused kernel's pool reads at STORED width (int8+scale < float), and
+    a planted removal of the census special case is caught — the kernel's
+    bytes would silently drop out of the roofline."""
+    from distributed_llama_tpu.analysis import graph_audit as ga
+    from distributed_llama_tpu.runtime import profiling
+
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    e8 = _engine(model_path, "paged", cache_dtype="int8")
+    ef = _engine(model_path, "paged")
+    try:
+        ent8 = [e for e in ga.warm_key_ladder(e8) if e.kind == "decode"][0]
+        entf = [e for e in ga.warm_key_ladder(ef) if e.kind == "decode"][0]
+        j8 = ga.trace_entry(e8, ent8)
+        jf = ga.trace_entry(ef, entf)
+        acc8 = {"pallas": 0, "pool_gather": 0}
+        accf = {"pallas": 0, "pool_gather": 0}
+        _count_pool_ops(j8.jaxpr, tuple(e8.cache.k.shape), acc8)
+        _count_pool_ops(jf.jaxpr, tuple(ef.cache.k.shape), accf)
+        assert acc8["pallas"] >= 1 and acc8["pool_gather"] == 0, acc8
+        assert accf["pool_gather"] >= 1, accf
+        # census honesty: stored width makes the int8 decode strictly
+        # cheaper in modeled bytes than the float twin of the same shape
+        b8 = profiling.jaxpr_census(j8)["bytes"]
+        bf = profiling.jaxpr_census(jf)["bytes"]
+        assert b8 < bf
+        # planted failure: without the fused-kernel census case the pool
+        # reads vanish from the model entirely
+        monkeypatch.setattr(profiling, "_paged_kernel_census",
+                            lambda eqn, in_hbm: None)
+        assert profiling.jaxpr_census(j8)["bytes"] < b8
+    finally:
+        e8.close(), ef.close()
+
+
+@pytest.mark.analysis
+def test_dot_census_sees_inside_fused_kernel():
+    """graph_audit's dot census descends into pallas_call: the fused kernel
+    contributes exactly its qk^T and pV dots, and a planted extra dot next
+    to it is visible (the f32_dot_budget regression class)."""
+    from distributed_llama_tpu.analysis import graph_audit as ga
+
+    rng = np.random.default_rng(4)
+    L, n_pages, ps, n_kv, hd, heads, b, n_read = 1, 4, 16, 2, 16, 4, 1, 2
+    q = jnp.asarray(rng.standard_normal((b, 1, heads, hd)), jnp.float32)
+    kp = jnp.zeros((L, n_pages, ps, n_kv, hd), jnp.int8)
+    sc = jnp.zeros((L, n_pages, ps, n_kv), jnp.float32)
+    tab = jnp.asarray([[0, 1]], jnp.int32)
+
+    def run(q):
+        return paged_flash_attention(
+            q, kp, kp, sc, sc, jnp.int32(0), jnp.asarray([0], jnp.int32),
+            tab, n_read=n_read, page_size=ps, interpret=True)
+
+    dots = ga.dot_input_census(jax.make_jaxpr(run)(q))
+    assert sum(dots.values()) == 2, dots
+
+    def planted(q):
+        o = run(q)
+        extra = jnp.einsum("bthd,bshd->bths", q, q)  # the sneaked-in dot
+        return o + jnp.sum(extra) * 0
+
+    dots = ga.dot_input_census(jax.make_jaxpr(planted)(q))
+    assert sum(dots.values()) == 3, dots
+
+
+# -- analysis integration: audit, costs, sanitizer ----------------------------
+
+
+@pytest.mark.analysis
+def test_graph_audit_int8_paged_ladder_clean(model_path, monkeypatch):
+    """The full int8 paged ladder (fused decode + page_copy + verify)
+    audits clean, and every entry's collective budget is IDENTICAL to the
+    float twin's — quantization must not change the communication shape."""
+    from distributed_llama_tpu.analysis import graph_audit as ga
+
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    e8 = _engine(model_path, "paged", cache_dtype="int8", batch=2,
+                 prefix_cache_mb=32, speculative="ngram")
+    ef = _engine(model_path, "paged", batch=2, prefix_cache_mb=32,
+                 speculative="ngram")
+    try:
+        reports = ga.audit_engine(e8)
+        ga.assert_clean(reports)
+        kinds = {r.entry.kind for r in reports}
+        assert "page_copy" in kinds and "decode" in kinds
+        for r in reports:
+            assert r.collectives == {}, r.entry
+            assert ga.expected_collectives(e8, r.entry) == (
+                ga.expected_collectives(ef, r.entry))
+    finally:
+        e8.close(), ef.close()
+
+
+@pytest.mark.analysis
+@pytest.mark.slow
+def test_cost_table_covers_int8_ladder(model_path, monkeypatch):
+    """graph_audit --costs contract on the int8 arm: every warm-plan
+    program gets a cost entry, and the decode's modeled bytes still grow
+    with the kv bucket (the quantized pool traffic is priced, not free)."""
+    from distributed_llama_tpu.runtime.profiling import (
+        build_cost_table,
+        cost_problems,
+    )
+
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    eng = _engine(model_path, "paged", cache_dtype="int8", batch=2)
+    try:
+        table = build_cost_table(eng)
+        assert cost_problems(eng, table) == []
+        deep = [e for (k, s, kv), e in table.entries.items()
+                if k == "decode" and s == 8]
+        deep.sort(key=lambda e: e.kv_len)
+        if len(deep) >= 2:
+            assert deep[-1].bytes_accessed > deep[0].bytes_accessed
+    finally:
+        eng.close()
+
+
+@pytest.mark.analysis
+@pytest.mark.slow
+def test_zero_post_warmup_recompiles_int8_paged(model_path, monkeypatch):
+    """DLT_SANITIZERS=1 acceptance on the int8 paged arm: a WARMED engine
+    serves solo greedy, sampled, prefix-hit, speculative, and BatchSession
+    traffic with zero post-warmup recompiles — the quantized programs are
+    in the warm plan, not beside it."""
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    eng = _engine(model_path, "paged", cache_dtype="int8", batch=2,
+                  prefix_cache_mb=32, speculative="ngram")
+    try:
+        eng.warmup()
+        eng.generate(list(range(1, 40)), 64)
+        eng.reset()
+        eng.generate(list(range(1, 40)), 64)  # prefix hit (zero-copy share)
+        s = Sampler(eng.cfg.vocab_size, 0.8, 0.9, 42)
+        eng.reset()
+        eng.generate([1, 2, 3, 4, 5, 6, 7], 40, sampler=s)
+        sess = BatchSession(eng)
+        sess.admit(0, [1] * 20)
+        sess.admit(1, [2] * 9, temperature=0.6, key_data=(7, 9))
+        sess.step(8)
+        sess.release(0), sess.release(1)
+        c = eng.stats.counters_snapshot()
+        assert c.get("sanitizer_recompiles", 0) == 0, c
+    finally:
+        eng.close()
+
+
+# -- HTTP level ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_http_int8_twin_identity_and_stats(tmp_path, monkeypatch):
+    """`--kv-dtype int8` end to end over HTTP: the int8 paged server's
+    replies equal the int8 contiguous twin's byte for byte, and /stats
+    kv_pool reports the stored-width capacity fields."""
+    import socket
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import write_tiny_tokenizer
+
+    h = tiny_header(seq_len=256, vocab_size=288)
+    mp, tp = str(tmp_path / "m.m"), str(tmp_path / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(
+        tp, pad_to=288,
+        chat_template="{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+    monkeypatch.setenv("DLT_NO_WARMUP", "1")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    servers, ports = [], []
+    for layout in ("paged", "contiguous"):
+        p = build_arg_parser()
+        p.add_argument("--port", type=int, default=0)
+        port = free_port()
+        args = p.parse_args([
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.0",
+            "--port", str(port), "--kv-layout", layout,
+            "--kv-dtype", "int8", "--prefix-cache-mb", "0",
+        ])
+        httpd = api_mod.serve(args)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        ports.append(port)
+    try:
+        def chat(port):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [{"role": "user", "content": "hi there"}],
+                    "max_tokens": 8,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())["choices"][0]["message"]["content"]
+
+        assert chat(ports[0]) == chat(ports[1])
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ports[0]}/stats", timeout=30
+        ) as r:
+            pool = json.loads(r.read())["kv_pool"]
+        assert pool["kv_dtype"] == "int8"
+        assert pool["pool_bytes"] == pool["n_pages"] * pool["page_bytes"] > 0
+        assert pool["tokens_capacity"] == pool["n_pages"] * pool["page_size"]
+    finally:
+        for s in servers:
+            s.shutdown()
