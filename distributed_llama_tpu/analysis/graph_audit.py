@@ -47,99 +47,26 @@ except ImportError:
     from jax.core import ClosedJaxpr, Jaxpr  # type: ignore
 
 
+from .jaxpr_tools import (  # noqa: F401  (re-exported: the walking layer
+    COLLECTIVE_PRIMS,  # lived here before analysis/jaxpr_tools.py split out)
+    _dtype_name,
+    _sub_jaxprs,
+    collective_counts,
+    dot_input_census,
+    dtype_census,
+    iter_eqns,
+    pool_gather_count,
+)
+
+
 class GraphAuditError(AssertionError):
     """One or more audited programs violated a graph contract."""
 
-
-#: primitive names that are explicit cross-device collectives
-COLLECTIVE_PRIMS = frozenset(
-    {
-        "psum",
-        "pmax",
-        "pmin",
-        "all_gather",
-        "all_to_all",
-        "ppermute",
-        "pshuffle",
-        "reduce_scatter",
-        "psum_scatter",
-    }
-)
 
 #: MLIR attributes jax emits on donated arguments: `tf.aliasing_output`
 #: when the input/output aliasing is resolved at lowering (single-device),
 #: `jax.buffer_donor` when it is deferred to compile (sharded programs)
 DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
-
-
-# -- jaxpr walking ----------------------------------------------------------
-
-
-def _sub_jaxprs(eqn):
-    """Yield every jaxpr nested in an eqn's params (pjit/scan/while/cond/
-    custom_* / pallas_call bodies), each exactly once."""
-    for v in eqn.params.values():
-        vals = v if isinstance(v, (list, tuple)) else (v,)
-        for x in vals:
-            if isinstance(x, ClosedJaxpr):
-                yield x.jaxpr
-            elif isinstance(x, Jaxpr):
-                yield x
-
-
-def iter_eqns(jaxpr):
-    """Depth-first walk over every equation, descending into sub-jaxprs.
-
-    Each sub-jaxpr is visited ONCE regardless of how many times it executes
-    (a `lax.scan` body counts once) — the resulting census is a *structural
-    fingerprint* of the program, which is exactly what a regression check
-    wants: inserting one collective into a scan body changes the count by
-    one, not by n_steps."""
-    if isinstance(jaxpr, ClosedJaxpr):
-        jaxpr = jaxpr.jaxpr
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for sub in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub)
-
-
-def collective_counts(jaxpr) -> dict:
-    """Structural count of explicit collective primitives."""
-    c: Counter = Counter()
-    for eqn in iter_eqns(jaxpr):
-        name = eqn.primitive.name
-        if name in COLLECTIVE_PRIMS:
-            c[name] += 1
-    return dict(c)
-
-
-def _dtype_name(dtype) -> str:
-    try:
-        return np.dtype(dtype).name
-    except TypeError:
-        return str(dtype)  # extended dtypes (PRNG keys) have no numpy twin
-
-
-def dtype_census(jaxpr) -> set:
-    """Set of dtypes appearing on any equation output."""
-    out = set()
-    for eqn in iter_eqns(jaxpr):
-        for var in eqn.outvars:
-            aval = getattr(var, "aval", None)
-            if aval is not None and hasattr(aval, "dtype"):
-                out.add(_dtype_name(aval.dtype))
-    return out
-
-
-def dot_input_census(jaxpr) -> Counter:
-    """Counter of (lhs_dtype, rhs_dtype) pairs over every dot_general."""
-    c: Counter = Counter()
-    for eqn in iter_eqns(jaxpr):
-        if eqn.primitive.name != "dot_general":
-            continue
-        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
-        c[(_dtype_name(lhs.dtype), _dtype_name(rhs.dtype))] += 1
-    return c
 
 
 # -- warm-key ladder --------------------------------------------------------
@@ -507,46 +434,192 @@ def f32_dot_budget(engine, entry: LadderEntry) -> int:
     return 2 * attention_sites(engine, entry)
 
 
-# -- checks -----------------------------------------------------------------
+# -- the declarative contract registry --------------------------------------
+#
+# Every warm-ladder program kind carries ONE declarative contract built
+# from the engine's topology and KV configuration; `audit_engine` (and the
+# graph-contract CI stage, analysis/graph_diff.py) enforce contracts — the
+# former hardcoded per-check functions below are thin views over them, so
+# a new program kind that lands on warm_plan() without a registry row
+# fails the coverage gate instead of silently auditing nothing.
+
+#: kind -> registry row. `copy_program`: a pure slice/gather/scatter
+#: KV-movement program (zero explicit collectives on EVERY topology);
+#: `fused_decode`: eligible for the fused page-table-aware int8 decode
+#: kernel, whose contract pins pool gathers to zero (PR 17).
+KIND_REGISTRY = {
+    "prefill": dict(copy_program=False, fused_decode=False),
+    "decode": dict(copy_program=False, fused_decode=True),
+    "prefill_row": dict(copy_program=False, fused_decode=False),
+    "batch_decode": dict(copy_program=False, fused_decode=True),
+    "verify": dict(copy_program=False, fused_decode=False),
+    "verify_row": dict(copy_program=False, fused_decode=False),
+    "prefix_extract": dict(copy_program=True, fused_decode=False),
+    "prefix_copy": dict(copy_program=True, fused_decode=False),
+    "prefix_copy_row": dict(copy_program=True, fused_decode=False),
+    "page_copy": dict(copy_program=True, fused_decode=False),
+    "page_extract": dict(copy_program=True, fused_decode=False),
+    "page_insert": dict(copy_program=True, fused_decode=False),
+}
 
 
-def dtype_problems(engine, entry: LadderEntry, jaxpr) -> list:
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    """The declared graph invariants of ONE warm-ladder program.
+
+    * `forbid_f64` — no float64 output or dot input anywhere (always on);
+    * `f32_dot_budget` — max sanctioned f32-touching dot_generals (the
+      attention softmax-side products); None = unbudgeted (f32 engines,
+      where every dot legitimately touches f32);
+    * `collectives` — the EXACT expected collective multiset for this
+      topology, or None when the topology has no manifest (MoE/sp/ep);
+    * `forbid_pool_gather` — the KV pool's shape when this program must
+      not materialize pool gathers (the fused int8 paged decode pin);
+      None = unpinned.
+    """
+
+    entry: LadderEntry
+    forbid_f64: bool = True
+    f32_dot_budget: int | None = None
+    collectives: dict | None = None
+    forbid_pool_gather: tuple | None = None
+
+
+def contract_for(engine, entry: LadderEntry) -> ProgramContract:
+    """Build `entry`'s declarative contract from the registry + the
+    engine's topology/KV configuration. Raises GraphAuditError for a kind
+    with no registry row — the coverage gate's teeth: warm_plan() growth
+    without a declared contract is a failure, not a silent hole."""
+    row = KIND_REGISTRY.get(entry.kind)
+    if row is None:
+        raise GraphAuditError(
+            f"no declared contract for warm-ladder kind {entry.kind!r} — "
+            "add a KIND_REGISTRY row (and a golden fingerprint) for it"
+        )
+    budget = (
+        f32_dot_budget(engine, entry)
+        if engine.cfg.dtype == jnp.bfloat16 and not row["copy_program"]
+        else None
+    )
+    pool = None
+    if (
+        row["fused_decode"]
+        and getattr(engine, "paged", False)
+        and engine.cfg.kv_quantized
+        and _fused_kernel_active(engine)
+    ):
+        pool = tuple(engine.cache.k.shape)
+    return ProgramContract(
+        entry=entry,
+        f32_dot_budget=budget,
+        collectives=expected_collectives(engine, entry),
+        forbid_pool_gather=pool,
+    )
+
+
+def _fused_kernel_active(engine) -> bool:
+    """True when the int8 paged decode programs trace the fused
+    page-table-aware Pallas kernel (models/transformer.py
+    _fused_paged_eligible at decode's t=1): pallas enabled for this config
+    and uniform lane-aligned head grouping."""
+    from ..models.transformer import _pallas_enabled
+
+    cfg = engine.cfg
+    return (
+        _pallas_enabled(cfg)
+        and cfg.n_heads % cfg.n_kv_heads == 0
+        and cfg.head_dim % 8 == 0
+    )
+
+
+def contract_problems(engine, contract: ProgramContract, jaxpr) -> list:
+    """Check one traced program against its declared contract; every
+    problem line names the offending primitive."""
     problems = []
-    dtypes = dtype_census(jaxpr)
-    if "float64" in dtypes:
-        problems.append("float64 appears in the traced program")
-    dots = dot_input_census(jaxpr)
-    for (l, r), cnt in dots.items():
-        if "float64" in (l, r):
-            problems.append(f"float64 dot_general inputs ({l} x {r}) x{cnt}")
-    if engine.cfg.dtype == jnp.bfloat16:
+    entry = contract.entry
+    if contract.forbid_f64:
+        dtypes = dtype_census(jaxpr)
+        if "float64" in dtypes:
+            problems.append("float64 appears in the traced program")
+        for (l, r), cnt in dot_input_census(jaxpr).items():
+            if "float64" in (l, r):
+                problems.append(
+                    f"float64 dot_general inputs ({l} x {r}) x{cnt}"
+                )
+    if contract.f32_dot_budget is not None:
+        dots = dot_input_census(jaxpr)
         f32_dots = sum(
             cnt for (l, r), cnt in dots.items() if "float32" in (l, r)
         )
-        budget = f32_dot_budget(engine, entry)
-        if f32_dots > budget:
+        if f32_dots > contract.f32_dot_budget:
             problems.append(
                 f"{f32_dots} f32-input dot_generals exceed the sanctioned "
-                f"budget of {budget} (attention softmax-side products) — an "
-                "accidental f32 upcast in a quantized matmul path"
+                f"budget of {contract.f32_dot_budget} (attention "
+                "softmax-side products) — an accidental f32 upcast in a "
+                "quantized matmul path"
+            )
+    if contract.collectives is not None:
+        got = collective_counts(jaxpr)
+        for name in sorted(set(contract.collectives) | set(got)):
+            e, g = contract.collectives.get(name, 0), got.get(name, 0)
+            if e != g:
+                problems.append(
+                    f"collective budget violated: {name} x{g} traced, "
+                    f"x{e} expected for this topology"
+                )
+    if contract.forbid_pool_gather is not None:
+        n = pool_gather_count(jaxpr, contract.forbid_pool_gather)
+        if n:
+            problems.append(
+                f"gather x{n} materializes the int8 KV pool in "
+                f"{entry.kind} — the fused page-table-aware decode kernel "
+                "contract requires ZERO pool gathers (page tables ride "
+                "the kernel's scalar prefetch; ops/pallas_attention.py)"
             )
     return problems
+
+
+# -- checks (contract views) -------------------------------------------------
+
+
+def dtype_problems(engine, entry: LadderEntry, jaxpr) -> list:
+    """The contract's dtype clauses alone (f64 ban + f32 dot budget)."""
+    budget = (
+        f32_dot_budget(engine, entry)
+        if engine.cfg.dtype == jnp.bfloat16
+        else None
+    )
+    return contract_problems(
+        engine,
+        ProgramContract(entry=entry, f32_dot_budget=budget, collectives=None),
+        jaxpr,
+    )
 
 
 def collective_problems(engine, entry: LadderEntry, jaxpr) -> list:
-    expected = expected_collectives(engine, entry)
-    if expected is None:
-        return []
-    got = collective_counts(jaxpr)
-    problems = []
-    for name in sorted(set(expected) | set(got)):
-        e, g = expected.get(name, 0), got.get(name, 0)
-        if e != g:
-            problems.append(
-                f"collective budget violated: {name} x{g} traced, "
-                f"x{e} expected for this topology"
-            )
-    return problems
+    """The contract's collective-budget clause alone."""
+    return contract_problems(
+        engine,
+        ProgramContract(
+            entry=entry,
+            forbid_f64=False,
+            collectives=expected_collectives(engine, entry),
+        ),
+        jaxpr,
+    )
+
+
+def donation_check(name: str, lowered) -> list:
+    """The one donation predicate: `lowered` (a jax Lowered or its MLIR
+    text) must carry a buffer-alias marker, or the cache donation was lost
+    — the clause the planted de-donation mutation test drives directly."""
+    txt = lowered if isinstance(lowered, str) else lowered.as_text()
+    if not any(m in txt for m in DONATION_MARKERS):
+        return [
+            f"{name}: KV cache donation lost (no "
+            f"{'/'.join(DONATION_MARKERS)} marker in the lowered program)"
+        ]
+    return []
 
 
 def donation_problems(engine) -> list:
@@ -565,12 +638,7 @@ def donation_problems(engine) -> list:
     problems = []
 
     def check(name, lowered):
-        txt = lowered.as_text()
-        if not any(m in txt for m in DONATION_MARKERS):
-            problems.append(
-                f"{name}: KV cache donation lost (no "
-                f"{'/'.join(DONATION_MARKERS)} marker in the lowered program)"
-            )
+        problems.extend(donation_check(name, lowered))
 
     if engine.use_pipeline:
         from ..parallel import pipeline as pl
@@ -808,6 +876,7 @@ class AuditReport:
     collectives: dict
     dtypes: set
     problems: list
+    contract: ProgramContract | None = None
 
     @property
     def ok(self) -> bool:
@@ -815,21 +884,23 @@ class AuditReport:
 
 
 def audit_engine(engine, ladder=None) -> list:
-    """Audit every warm-ladder program plus the engine-wide donation and
-    sharding contracts; returns one AuditReport per ladder entry (engine-
-    wide problems ride the first report)."""
+    """Audit every warm-ladder program against its DECLARED contract
+    (contract_for — the registry is the single source of per-program
+    invariants) plus the engine-wide donation and sharding contracts;
+    returns one AuditReport per ladder entry (engine-wide problems ride
+    the first report)."""
     ladder = warm_key_ladder(engine) if ladder is None else ladder
     reports = []
     for entry in ladder:
+        contract = contract_for(engine, entry)
         jaxpr = trace_entry(engine, entry)
-        problems = dtype_problems(engine, entry, jaxpr)
-        problems += collective_problems(engine, entry, jaxpr)
         reports.append(
             AuditReport(
                 entry=entry,
                 collectives=collective_counts(jaxpr),
                 dtypes=dtype_census(jaxpr),
-                problems=problems,
+                problems=contract_problems(engine, contract, jaxpr),
+                contract=contract,
             )
         )
     engine_wide = donation_problems(engine) + sharding_problems(engine)
@@ -871,13 +942,10 @@ def format_reports(reports) -> str:
     return "\n".join(lines)
 
 
-def main(argv=None) -> int:
-    """CLI: audit a model file's engine, or (default) a tiny synthetic
-    model — the CI smoke path."""
-    import argparse
-    import tempfile
-
-    p = argparse.ArgumentParser(prog="dlt-graph-audit")
+def add_engine_args(p) -> None:
+    """The shared engine-config flags of the graph CLIs (this auditor and
+    analysis/graph_diff.py): ONE flag surface so a blessed golden config
+    and the audited config can never drift apart syntactically."""
     p.add_argument("--model", default=None, help=".m file (default: tiny synthetic)")
     p.add_argument("--compute-dtype", default="float32")
     p.add_argument("--batch", type=int, default=2)
@@ -922,6 +990,50 @@ def main(argv=None) -> int:
         "--tp", type=int, default=1,
         help="tensor-parallel mesh extent (composes with --pp)",
     )
+
+
+def engine_from_args(args, workdir: str):
+    """Build the engine the parsed `add_engine_args` flags describe
+    (writing a tiny synthetic model into `workdir` when no --model)."""
+    from ..runtime.engine import InferenceEngine
+
+    mesh = None
+    if args.pp > 1 or args.tp > 1:
+        from ..parallel import make_mesh
+
+        mesh = make_mesh(pp=args.pp, tp=args.tp)
+    model = args.model
+    if model is None:
+        from ..testing import tiny_header, write_tiny_model
+
+        model = workdir + "/tiny.m"
+        if mesh is not None:
+            # layer/head counts must divide over the mesh axes
+            hdr = tiny_header(
+                seq_len=128, dim=128, hidden_dim=128, n_layers=4,
+                n_heads=4, n_kv_heads=4,
+            )
+        else:
+            hdr = tiny_header(seq_len=128)
+        write_tiny_model(model, hdr, seed=0)
+    return InferenceEngine(
+        model, compute_dtype=args.compute_dtype, batch=args.batch,
+        max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
+        prefix_cache_mb=args.prefix_cache_mb,
+        speculative=args.speculative, draft_k=args.draft_k,
+        kv_layout=args.kv_layout, mesh=mesh,
+        cache_dtype=args.kv_dtype,
+    )
+
+
+def main(argv=None) -> int:
+    """CLI: audit a model file's engine, or (default) a tiny synthetic
+    model — the CI smoke path."""
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(prog="dlt-graph-audit")
+    add_engine_args(p)
     p.add_argument(
         "--costs", action="store_true",
         help="also build the warm-ladder cost/memory table "
@@ -930,36 +1042,8 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
-    from ..runtime.engine import InferenceEngine
-
-    mesh = None
-    if args.pp > 1 or args.tp > 1:
-        from ..parallel import make_mesh
-
-        mesh = make_mesh(pp=args.pp, tp=args.tp)
     with tempfile.TemporaryDirectory() as d:
-        model = args.model
-        if model is None:
-            from ..testing import tiny_header, write_tiny_model
-
-            model = d + "/tiny.m"
-            if mesh is not None:
-                # layer/head counts must divide over the mesh axes
-                hdr = tiny_header(
-                    seq_len=128, dim=128, hidden_dim=128, n_layers=4,
-                    n_heads=4, n_kv_heads=4,
-                )
-            else:
-                hdr = tiny_header(seq_len=128)
-            write_tiny_model(model, hdr, seed=0)
-        engine = InferenceEngine(
-            model, compute_dtype=args.compute_dtype, batch=args.batch,
-            max_chunk=args.max_chunk, decode_chunk_size=args.decode_chunk_size,
-            prefix_cache_mb=args.prefix_cache_mb,
-            speculative=args.speculative, draft_k=args.draft_k,
-            kv_layout=args.kv_layout, mesh=mesh,
-            cache_dtype=args.kv_dtype,
-        )
+        engine = engine_from_args(args, d)
         try:
             reports = audit_engine(engine)
             cost_issues: list = []

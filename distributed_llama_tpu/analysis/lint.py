@@ -50,6 +50,14 @@ comma-separate for several — the pragma documents WHY at the site):
   sentinel kills every later engine build in the process (the
   cross-suite-pollution class the supervisor's rebuild path releases
   explicitly; runtime/engine.py ``close()`` is the reference shape);
+* **env-surface** — an ``os.environ`` / ``getenv`` read of a ``DLT_*``
+  variable whose name is missing from ``server/api.py``'s
+  ``DLT_ENV_SURFACE`` registry (the ``/debug/config`` payload's declared
+  knob surface) or from README/docs: every env knob the package reads
+  must be debuggable from a running replica and documented, or it is
+  config-surface drift — a flag operators cannot discover. The rule only
+  fires when lint runs with repo-root context (``lint_paths``/CLI; plain
+  ``lint_source`` has no cross-file registry to check against);
 * **thread-release** — the sentinel-release rule's thread edition: a
   class holding a gateway-owned background loop (``FleetScraper``,
   ``Autoscaler``, ``HealthProber``, ``GatewayPeering`` — directly or via
@@ -81,6 +89,7 @@ ALL_RULES = (
     "trace-hot-emit",
     "sentinel-release",
     "thread-release",
+    "env-surface",
 )
 
 _PRAGMA_RE = re.compile(r"#\s*dlt:\s*allow\(([^)]*)\)")
@@ -120,6 +129,46 @@ THREAD_OWNER_CLASSES = (
 RELEASE_METHODS = (
     "close", "stop", "shutdown", "server_close", "__exit__", "__del__",
 )
+#: packages whose DLT_* env reads must be declared + documented
+#: (env-surface); scripts/ are operator-side and read what they document
+#: themselves
+ENV_SURFACE_SCOPE = ("distributed_llama_tpu",)
+#: DLT_* names in markdown docs count as documented wherever they appear
+_DOC_ENV_RE = re.compile(r"\bDLT_[A-Z0-9_]+\b")
+
+
+def declared_env_surface(root) -> set | None:
+    """The ``DLT_ENV_SURFACE`` registry tuple from server/api.py (the
+    /debug/config declared knob surface), parsed statically; None when the
+    file or registry is absent (rule degrades to docs-only)."""
+    api = Path(root) / "distributed_llama_tpu" / "server" / "api.py"
+    if not api.exists():
+        return None
+    try:
+        tree = ast.parse(api.read_text())
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "DLT_ENV_SURFACE"
+            for t in node.targets
+        ):
+            try:
+                return set(ast.literal_eval(node.value))
+            except (ValueError, SyntaxError):
+                return None
+    return None
+
+
+def documented_env_vars(root) -> set | None:
+    """Every DLT_* name mentioned anywhere in README.md / docs/*.md; None
+    when no docs exist to check against."""
+    root = Path(root)
+    files = [root / "README.md"] + sorted((root / "docs").glob("*.md"))
+    texts = [f.read_text() for f in files if f.exists()]
+    if not texts:
+        return None
+    return set(_DOC_ENV_RE.findall("\n".join(texts)))
 
 
 def _owner_ctor_name(call: ast.Call) -> str | None:
@@ -193,13 +242,16 @@ def _dotted(node: ast.AST) -> str:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, rel: str, source: str):
+    def __init__(self, path: str, rel: str, source: str, env_surface=None):
         self.path = path
         self.rel = rel  # repo-relative path, for scope decisions
         self.pragmas = _pragmas(source)
         self.violations: list = []
         self._thread_classes: list = []  # ClassDef stack: is-Thread-subclass
         self._loop_depth = 0  # for/while nesting (trace-hot-emit)
+        # (declared, documented) DLT_* name sets for env-surface, or None
+        # when lint runs without repo-root context (rule off)
+        self.env_surface = env_surface
 
     # -- plumbing -----------------------------------------------------------
 
@@ -353,7 +405,48 @@ class _Linter(ast.NodeVisitor):
                     "dict construction in a span emit call — pass scalar "
                     "vals against pre-bound keys instead",
                 )
+        # env-surface: DLT_* env reads must be on the declared /debug/config
+        # surface and documented
+        if dotted in ("os.environ.get", "environ.get", "os.getenv", "getenv"):
+            if (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("DLT_")
+            ):
+                self._check_env_surface(node.args[0].value, node)
         self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # env-surface: os.environ["DLT_X"] subscript reads
+        if (
+            _dotted(node.value) in ("os.environ", "environ")
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+            and node.slice.value.startswith("DLT_")
+        ):
+            self._check_env_surface(node.slice.value, node)
+        self.generic_visit(node)
+
+    def _check_env_surface(self, var: str, node: ast.AST):
+        if self.env_surface is None or not self._in_scope(ENV_SURFACE_SCOPE):
+            return
+        declared, documented = self.env_surface
+        missing = []
+        if declared is not None and var not in declared:
+            missing.append(
+                "api.py's DLT_ENV_SURFACE registry (the /debug/config "
+                "declared knob surface)"
+            )
+        if documented is not None and var not in documented:
+            missing.append("README/docs")
+        if missing:
+            self._flag(
+                "env-surface", node,
+                f"{var} is read here but missing from "
+                f"{' and from '.join(missing)} — every DLT_* knob must be "
+                "discoverable from a running replica and documented",
+            )
 
     def _visit_loop(self, node):
         self._loop_depth += 1
@@ -509,21 +602,32 @@ class _Linter(ast.NodeVisitor):
                 )
 
 
-def lint_source(source: str, path: str, rel: str | None = None) -> list:
+def lint_source(
+    source: str, path: str, rel: str | None = None, env_surface=None
+) -> list:
     tree = ast.parse(source, filename=path)
-    linter = _Linter(path, rel if rel is not None else path, source)
+    linter = _Linter(
+        path, rel if rel is not None else path, source, env_surface=env_surface
+    )
     linter.visit(tree)
     return linter.violations
 
 
-def lint_file(path, root=None) -> list:
+def lint_file(path, root=None, env_surface=None) -> list:
     p = Path(path)
     rel = str(p.relative_to(root)) if root else str(p)
-    return lint_source(p.read_text(), str(p), rel)
+    if env_surface is None and root is not None:
+        env_surface = (declared_env_surface(root), documented_env_vars(root))
+    return lint_source(p.read_text(), str(p), rel, env_surface=env_surface)
 
 
 def lint_paths(paths, root=None, exclude=("tests", "__pycache__")) -> list:
-    """Lint every .py under `paths` (files or directories)."""
+    """Lint every .py under `paths` (files or directories). With a repo
+    `root`, the cross-file env-surface context (DLT_ENV_SURFACE registry +
+    docs) is resolved ONCE and shared across every file."""
+    env_surface = None
+    if root is not None:
+        env_surface = (declared_env_surface(root), documented_env_vars(root))
     out: list = []
     for path in paths:
         p = Path(path)
@@ -531,7 +635,7 @@ def lint_paths(paths, root=None, exclude=("tests", "__pycache__")) -> list:
         for f in files:
             if any(part in exclude for part in f.parts):
                 continue
-            out.extend(lint_file(f, root=root))
+            out.extend(lint_file(f, root=root, env_surface=env_surface))
     return out
 
 

@@ -351,8 +351,18 @@ class BatchSession:
                 # dispatch through the ONE owner of the admission-prefill
                 # chunk program (engine._dispatch_prefill_row: pipeline /
                 # paged / contiguous-row arms — warmup's ladder fill and
-                # the session must compile the same shapes)
-                eng._dispatch_prefill_row(row, chunk, done, kv_len)
+                # the session must compile the same shapes), under a
+                # watchdog keyed on THIS chunk's full (size, kv_bucket)
+                # pair — the same keys warmup's ladder fill seeds. A
+                # prefix-cache resume at a deeper position can make an
+                # intermediate bucket a genuine first compile; keying
+                # anything coarser would run it under the narrow stall
+                # threshold and trip a false EXEC_STALL
+                with eng._guard(
+                    f"prefill_row[{size}|kv{kv_len}]",
+                    ("prefill_row", size, kv_len),
+                ):
+                    eng._dispatch_prefill_row(row, chunk, done, kv_len)
                 if em_chunk is not None:
                     # dispatch wall of this admission-prefill chunk (the
                     # dispatch is async; completion is observed by the next

@@ -243,6 +243,11 @@ class _BatchReq:
         self.n = 0  # tokens decoded into this row (budget accounting)
         self.n_out = 0  # tokens actually delivered to on_token (usage
         # accounting: excludes post-stop overrun the writer drains away)
+        self.n_overrun = 0  # chunk-tail tokens the engine decoded PAST
+        # this row's stop point (EOS / max_new / writer stop): real decode
+        # compute that is never delivered and never enters req.n — counted
+        # into the goodput ledger's discarded ("overrun") waste at
+        # retirement so the burned chunk tail is visible, not vanished
         self.error = None
         self.done = threading.Event()
         self.emit: "queue.Queue[int | None]" = queue.Queue(maxsize=self.EMIT_DEPTH)
@@ -857,9 +862,14 @@ class Batcher:
             # the Batcher directly; the HTTP path's budget clamp never gets
             # here. Prefilling rows are parked at seq_len by construction and
             # must NOT be swept up by this check.
+            # ... and a row whose writer thread set `stopped` between
+            # chunks (client gone, stream cancelled) retires HERE, at the
+            # chunk boundary, instead of decoding up to a full extra chunk
+            # before the consume loop sees the flag — post-stop tokens are
+            # pure overrun waste
             for row in list(decode_rows):
                 req = slots[row]
-                if session.seq_len - 1 - int(session.pos[row]) <= 0:
+                if req.stopped or session.seq_len - 1 - int(session.pos[row]) <= 0:
                     self._finish(req, session, slots, row)
                     decode_rows.remove(row)
             if not decode_rows:
@@ -1013,7 +1023,8 @@ class Batcher:
                     req.ledger.decode_us += chunk_dur_us
                     if req._em_decode is not None:
                         req._em_decode(t_chunk_us, chunk_dur_us, len(per_row[row]))
-                for t in per_row[row]:
+                row_toks = per_row[row]
+                for i, t in enumerate(row_toks):
                     req.n += 1
                     req.out_ids.append(t)
                     try:
@@ -1034,7 +1045,11 @@ class Batcher:
                         # check is the row-local EOS signal: without it the
                         # loop decodes up to a full extra chunk before the
                         # writer thread's `stopped` flag is visible,
-                        # inflating req.n and burning decode compute
+                        # inflating req.n and burning decode compute. The
+                        # chunk tail past the stop WAS decoded by the
+                        # engine — without this count it would appear in
+                        # neither generated nor discarded tokens
+                        req.n_overrun += len(row_toks) - i - 1
                         self._finish(req, session, slots, row)
                         break
 
@@ -1319,7 +1334,7 @@ class ApiState:
             led = req.ledger
             led.outcome = outcome
             led.generated_tokens = 0
-            led.discarded_tokens += req.n
+            led.discarded_tokens += req.n + req.n_overrun
             return led
 
         for attempt in range(2):
@@ -1415,7 +1430,11 @@ class ApiState:
         led = req.ledger
         led.outcome = "ok"
         led.generated_tokens = req.n_out
-        led.discarded_tokens += max(req.n - req.n_out, 0)
+        # discarded = decoded-but-undelivered (n - n_out) PLUS the chunk
+        # tail the engine decoded past the stop point (n_overrun, which
+        # never entered req.n) — both fold into the aggregate's "overrun"
+        # waste reason for ok outcomes (runtime/telemetry.py)
+        led.discarded_tokens += max(req.n - req.n_out, 0) + req.n_overrun
         self._record_ledger(led, trace)
         times = times_box[0]
         if times[0] is not None:
@@ -1815,6 +1834,54 @@ class ApiState:
             engine.close()
 
 
+#: THE declared DLT_* knob surface: every environment variable the package
+#: reads, whether or not it is set on this replica. `/debug/config` serves
+#: it (`env_surface`) so operators can discover every knob from a running
+#: box, and the `env-surface` lint rule (analysis/lint.py) statically
+#: proves the list complete — an os.environ/getenv read of a DLT_* name
+#: missing here (or from the docs) fails lint. Keep alphabetized.
+DLT_ENV_SURFACE = (
+    "DLT_BATCH_TIMELINE",
+    "DLT_BATCH_TIMELINE_SAMPLE",
+    "DLT_COMPILE_CACHE",
+    "DLT_COMPILE_LOG_MS",
+    "DLT_COST_TABLE",
+    "DLT_DISAGG_PEER_BACKOFF_S",
+    "DLT_DISAGG_TIMEOUT_S",
+    "DLT_DRAFT_K",
+    "DLT_FLIGHTREC_DIR",
+    "DLT_GW_RECOVER",
+    "DLT_GW_RECOVER_TIMEOUT_S",
+    "DLT_HBM_DRIFT_MB",
+    "DLT_I8_DIMSEM",
+    "DLT_KV_DTYPE",
+    "DLT_KV_INTEGRITY_STRIKES",
+    "DLT_KV_INTEGRITY_TTL_S",
+    "DLT_KV_LAYOUT",
+    "DLT_KV_PAGE",
+    "DLT_KV_POOL_MB",
+    "DLT_KV_TRANSPORT",
+    "DLT_MOE_LAYER_FOLD",
+    "DLT_NO_NATIVE",
+    "DLT_NO_PALLAS",
+    "DLT_NO_WARMUP",
+    "DLT_PALLAS_INTERPRET",
+    "DLT_PEAK_HBM_GBS",
+    "DLT_PEAK_TFLOPS",
+    "DLT_PREFILL_PEER",
+    "DLT_PREFILL_PIPELINE",
+    "DLT_PREFIX_CACHE_MB",
+    "DLT_PROFILE_DIR",
+    "DLT_ROLE",
+    "DLT_ROUTER",
+    "DLT_SANITIZERS",
+    "DLT_SANITIZERS_FATAL",
+    "DLT_SLO_PREEMPT",
+    "DLT_SPECULATIVE",
+    "DLT_STALL_LOG_MS",
+)
+
+
 def resolved_config(state: "ApiState") -> dict:
     """The ``GET /debug/config`` payload: the RESOLVED runtime
     configuration this replica is actually serving with — after env vars,
@@ -1880,6 +1947,11 @@ def resolved_config(state: "ApiState") -> dict:
         },
         "goodput_window_s": state.goodput.window_s,
         "env": env,
+        # the DECLARED knob surface (every DLT_* var the package reads,
+        # set here or not) — `env` above shows only what this replica has
+        # set; this shows what COULD be set, statically lint-proven
+        # complete (analysis/lint.py env-surface)
+        "env_surface": list(DLT_ENV_SURFACE),
     }
 
 

@@ -6,6 +6,13 @@
 #   2. graph audit          (tiny config, full warm-key ladder incl. the
 #                            prefix-cache copy/extract programs: dtypes,
 #                            collective budgets, KV donation, shardings)
+#   2b. graph contracts      (scripts/dlt_graph_diff.py: golden jaxpr
+#                            fingerprints for every warm-ladder program
+#                            across 4 configs — any structural drift fails
+#                            with a ±primitive diff; 100% contract+golden
+#                            coverage of warm_plan(); the differential
+#                            equivalence prover for the paged/int8/verify
+#                            variant axes)
 #   3. analysis test suite  (pytest -m analysis: one suite per audit pass)
 #   4. prefix-cache suite   (radix trie, token identity, eviction/pinning,
 #                            sanitizer acceptance — fast subset member)
@@ -106,6 +113,26 @@ echo "== graph audit (MESH-paged ladder, pp=2 x tp=2) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m distributed_llama_tpu.analysis.graph_audit \
   --kv-layout paged --pp 2 --tp 2 --speculative off
+
+echo "== graph contracts (golden fingerprints + coverage, 4 configs) =="
+# every warm_plan() program re-traced and diffed against the blessed
+# goldens in analysis/golden/ — ANY structural drift fails with a
+# ±primitive diff; --coverage proves contract + golden per ladder entry.
+# Intentional graph changes: scripts/dlt_graph_diff.py --bless (per
+# config) and put the golden diff in the PR.
+python scripts/dlt_graph_diff.py --check --coverage
+python scripts/dlt_graph_diff.py --check --coverage --kv-layout paged
+DLT_PALLAS_INTERPRET=1 \
+  python scripts/dlt_graph_diff.py --check --coverage \
+  --kv-layout paged --kv-dtype int8
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/dlt_graph_diff.py --check --coverage \
+  --kv-layout paged --pp 2 --tp 2 --speculative off
+
+echo "== graph contracts (differential equivalence prover) =="
+# paged = contiguous + page tables; int8 = f32 + quantization (zero pool
+# gathers); verify_k = prefill twin + argmax — anything else fails by name
+DLT_PALLAS_INTERPRET=1 python scripts/dlt_graph_diff.py --prove all
 
 echo "== analysis suite (pytest -m analysis) =="
 python -m pytest tests/ -q -m analysis -p no:cacheprovider

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Graph-contract CLI: `python scripts/dlt_graph_diff.py [--bless|--check|
+--coverage|--prove {paged,int8,verify,all}] [engine flags]`.
+
+Thin wrapper over distributed_llama_tpu.analysis.graph_diff so CI and
+operators run the same golden-fingerprint check, coverage gate, and
+differential equivalence prover the analysis tests assert against.
+`--bless` rewrites the blessed goldens after an INTENTIONAL graph change —
+the resulting analysis/golden/ file diff is the reviewable artifact.
+Engine flags are shared with graph_audit (one flag surface, so a blessed
+config and an audited config cannot drift apart syntactically).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from distributed_llama_tpu.analysis.graph_diff import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,3 +275,69 @@ def test_thread_release_covers_gateway_owned_loops():
         "        self.a = Autoscaler(bal)  # dlt: allow(thread-release)\n"
     )
     assert _rules(sup, rel="server/x.py") == []
+
+
+# --------------------------------------------------------------------------
+# env-surface: DLT_* reads must be on the declared /debug/config surface
+# --------------------------------------------------------------------------
+
+_SURFACE = ({"DLT_DECLARED"}, {"DLT_DECLARED", "DLT_DOC_ONLY"})
+
+
+def _env_rules(src, env_surface=_SURFACE, rel="distributed_llama_tpu/runtime/x.py"):
+    return lint.lint_source(src, "x.py", rel, env_surface=env_surface)
+
+
+def test_env_surface_flags_undeclared_read():
+    src = 'import os\nv = os.environ.get("DLT_FAKE_KNOB")\n'
+    vio = _env_rules(src)
+    assert [v.rule for v in vio] == ["env-surface"]
+    # the message names the offending variable and both missing surfaces
+    assert "DLT_FAKE_KNOB" in vio[0].msg
+    assert "DLT_ENV_SURFACE" in vio[0].msg
+    assert "README/docs" in vio[0].msg
+
+
+def test_env_surface_all_read_forms_are_seen():
+    getenv = 'import os\nv = os.getenv("DLT_FAKE_KNOB", "0")\n'
+    sub = 'import os\nv = os.environ["DLT_FAKE_KNOB"]\n'
+    from_import = 'from os import environ\nv = environ.get("DLT_FAKE_KNOB")\n'
+    for src in (getenv, sub, from_import):
+        assert [v.rule for v in _env_rules(src)] == ["env-surface"], src
+
+
+def test_env_surface_declared_and_documented_is_clean():
+    src = 'import os\nv = os.environ.get("DLT_DECLARED")\n'
+    assert _env_rules(src) == []
+    # documented-but-undeclared still flags (registry is the API surface)
+    doc_only = 'import os\nv = os.environ.get("DLT_DOC_ONLY")\n'
+    vio = _env_rules(doc_only)
+    assert [v.rule for v in vio] == ["env-surface"]
+    assert "README/docs" not in vio[0].msg
+
+
+def test_env_surface_scope_pragma_and_missing_context():
+    src = 'import os\nv = os.environ.get("DLT_FAKE_KNOB")\n'
+    # non-DLT vars and out-of-package files are not the lint's business
+    assert _env_rules('import os\nv = os.environ.get("HOME")\n') == []
+    assert _env_rules(src, rel="scripts/x.py") == []
+    # rule is off when no env-surface context could be resolved
+    assert _env_rules(src, env_surface=None) == []
+    sup = (
+        "import os\n"
+        'v = os.environ.get("DLT_FAKE_KNOB")  # dlt: allow(env-surface)\n'
+    )
+    assert _env_rules(sup) == []
+
+
+def test_env_surface_registry_resolves_from_repo():
+    """declared_env_surface parses the literal registry out of server/api.py
+    and documented_env_vars sweeps README + docs; both must cover the knobs
+    the tree actually reads (the repo-clean test proves the closure)."""
+    declared = lint.declared_env_surface(ROOT)
+    documented = lint.documented_env_vars(ROOT)
+    assert declared is not None and "DLT_KV_LAYOUT" in declared
+    assert documented is not None and declared <= documented, (
+        "declared knobs missing from docs: "
+        f"{sorted(declared - documented)}"
+    )

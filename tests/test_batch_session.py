@@ -338,3 +338,42 @@ def test_step_overrunning_seq_len_raises(tmp_path):
         s.step(8)  # pos 4 -> 28
     with pytest.raises(ValueError, match="overrun seq_len"):
         s.step(8)  # 28 + 1 + 8 > 32
+
+
+def test_admission_prefill_guard_keys_carry_full_chunk_identity(tmp_path):
+    """Regression: the admission-prefill dispatch (prefill_pending) must run
+    under the watchdog with the SAME ("prefill_row", size, kv_bucket) keys
+    warmup seeds. No guard — or a key missing the kv bucket — makes a
+    genuine first compile at a deeper bucket (prefix-cache resume) look
+    warm, so the watchdog applies the steady-state stall threshold to a
+    compile and reports a false EXEC_STALL."""
+    path = _model(tmp_path)
+    eng = InferenceEngine(path, compute_dtype="float32", batch=2, max_chunk=8)
+    s = BatchSession(eng)
+    s.admit(0, [5, 9, 17, 3])
+    s.step(4)
+
+    seen = []
+    real = eng._guard
+
+    def spy(label, key):
+        seen.append((label, key, key not in eng._warm))
+        return real(label, key)
+
+    eng._guard = spy
+    s.begin_admit(1, list(range(1, 20)))  # 19 tokens: full + tail chunks
+    while s.prefill_pending(1, 8):
+        s.step(4)  # interleave decode chunks like the Batcher does
+
+    rows = [x for x in seen if x[1] and x[1][0] == "prefill_row"]
+    assert rows, "admission prefill dispatched without a watchdog guard"
+    firsts = set()
+    for label, key, first in rows:
+        kind, size, kvb = key  # full per-chunk identity, not a coarse key
+        assert label == f"prefill_row[{size}|kv{kvb}]"
+        # compile-vs-warm classification follows EXACT key identity: the
+        # first dispatch of each (size, kv_bucket) gets the compile
+        # threshold, repeats the steady-state one
+        assert first == (key not in firsts), (label, key, first)
+        firsts.add(key)
+    assert len(firsts) >= 2, "ladder exercised only one chunk shape"

@@ -652,6 +652,45 @@ def test_row_local_eos_stops_decode_and_usage_accounting(tmp_path_factory):
     assert toks2 == toks[:first]
     assert req.n == first, f"decoded past EOS: n={req.n}, eos at {first}"
     assert req.n_out == first
+    # the chunk tail the engine decoded past the EOS is real compute: it
+    # must be counted as overrun waste (folded into the ledger's discarded
+    # tokens at completion), never silently vanish — and never inflate n
+    assert req.n + req.n_overrun == 8, (
+        f"chunk-tail accounting drifted: n={req.n} overrun={req.n_overrun}"
+    )
+
+
+def test_writer_stopped_row_retires_at_chunk_boundary(tmp_path_factory):
+    """A row whose writer flagged `stopped` mid-stream (slow client, HTTP
+    disconnect) must retire at the NEXT chunk boundary — the pre-dispatch
+    sweep — instead of decoding a further full chunk just to notice the
+    flag at its first token."""
+    import types
+
+    from distributed_llama_tpu.server import api as api_mod
+
+    eng = _batcher_engine(tmp_path_factory, "fi_stop_sweep")
+    state = types.SimpleNamespace(engine=eng, recover=lambda: None)
+    b = api_mod.Batcher(state, chunk_size=8)
+
+    toks = []
+    req_box = []
+
+    def on_token(t):
+        toks.append(t)
+        if len(toks) >= 3:
+            req_box[0].stopped = True
+
+    req = api_mod._BatchReq([3, 5], 64, 0.0, 0.9, None, on_token)
+    req_box.append(req)
+    b.submit(req)
+    # exactly 3 tokens were DELIVERED (the writer stops itself after the
+    # third and drain-discards the rest), and the row retired well short
+    # of its budget: the boundary sweep saw `stopped` without waiting for
+    # the flag to surface inside a dispatched chunk's consume loop
+    assert req.n_out == 3
+    assert 3 <= req.n < 64, f"stopped row ran its full budget: n={req.n}"
+    assert req.error is None
 
 
 def test_headroom_exhausted_row_finishes_cleanly(tmp_path_factory):

@@ -265,6 +265,31 @@ def test_shed_request_lands_in_ledger(goodput_server):
     )
 
 
+def test_chunk_tail_overrun_lands_in_discarded_waste(goodput_server):
+    """A row stopping mid-chunk (max_tokens below the chunk boundary): the
+    chunk tail the engine decoded past the stop is real compute waste — it
+    must land in the 'overrun' waste bucket WITHOUT inflating usage. The
+    pre-fix hole: those tokens appeared in neither generated nor discarded
+    counts, so the goodput ratio silently overstated efficiency."""
+    _, port, state = goodput_server
+    before = state.goodput.snapshot()
+    with _post(port, {
+        "messages": [{"role": "user", "content": "stop mid-chunk"}],
+        "max_tokens": 5,       # decode chunk is 8: 3 tokens of tail waste
+        "temperature": 0.7,    # sampled row: no speculative chunk resizing
+    }) as r:
+        out = json.loads(r.read())
+    assert out["usage"]["completion_tokens"] == 5
+    after = state.goodput.snapshot()
+    overrun = (
+        after["wasted_tokens"].get("overrun", 0)
+        - before["wasted_tokens"].get("overrun", 0)
+    )
+    assert overrun >= 1, "post-stop chunk tail vanished from the accounting"
+    delivered = after["delivered_tokens"] - before["delivered_tokens"]
+    assert delivered == 5
+
+
 def test_debug_config_resolved_snapshot(goodput_server):
     _, port, state = goodput_server
     cfg = _get_json(port, "/debug/config")
@@ -279,6 +304,11 @@ def test_debug_config_resolved_snapshot(goodput_server):
     assert "timeline_sample" in cfg["batcher"]
     assert cfg["tracing"]["ring_capacity"] > 0
     assert isinstance(cfg["env"], dict)
+    # the declared env-knob surface (the env-surface lint rule's registry):
+    # every DLT_* read in the tree is discoverable from a running replica
+    assert "DLT_KV_LAYOUT" in cfg["env_surface"]
+    assert "DLT_NO_WARMUP" in cfg["env_surface"]
+    assert cfg["env_surface"] == sorted(cfg["env_surface"])
 
 
 def test_batch_timeline_endpoint_records_steps(goodput_server):

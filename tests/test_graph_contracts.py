@@ -1,0 +1,307 @@
+"""Graph-contract tests: canonical fingerprint determinism, the golden
+bless→check/coverage lifecycle, drift/stale/hole reporting, the
+differential equivalence prover on the real variant axes, and the
+planted-mutation suite — one deliberate regression per contract clause
+(extra psum, de-donated cache, f32-touching quantized dot, reintroduced
+pool gather), each of which must fail with a diff naming the offending
+primitive."""
+
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.analysis import graph_audit as ga
+from distributed_llama_tpu.analysis import graph_diff as gd
+from distributed_llama_tpu.analysis import jaxpr_tools as jt
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.testing import tiny_header, write_tiny_model
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("contracts")
+    path = str(d / "m.m")
+    write_tiny_model(path, tiny_header(seq_len=128), seed=5)
+    return path
+
+
+def _engine(path, **kw):
+    # slim ladder: 2 prefill buckets, 1 decode bucket — enough programs to
+    # exercise every check without the full CLI config's trace bill
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("batch", 2)
+    kw.setdefault("max_chunk", 8)
+    kw.setdefault("decode_chunk_size", 4)
+    kw.setdefault("prefix_cache_mb", 0)
+    return InferenceEngine(path, **kw)
+
+
+@pytest.fixture(scope="module")
+def contig_engine(model_path):
+    eng = _engine(model_path)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def paged_engine(model_path):
+    eng = _engine(model_path, kv_layout="paged")
+    yield eng
+    eng.close()
+
+
+# -- canonical fingerprints --------------------------------------------------
+
+
+def test_fingerprint_alpha_invariant_and_deterministic():
+    """Two structurally identical programs built from different Python
+    variable names hash identically; a structurally different program
+    does not; and the canonical text never leaks object identities."""
+
+    def f(x, y):
+        return jnp.dot(x, y) + 1.0
+
+    def g(alpha, beta):
+        return jnp.dot(alpha, beta) + 1.0
+
+    s = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+    jf, jg = jax.make_jaxpr(f)(s, s), jax.make_jaxpr(g)(s, s)
+    assert jt.structural_hash(jf) == jt.structural_hash(jg)
+    jh = jax.make_jaxpr(lambda x, y: jnp.dot(x, y) * 2.0)(s, s)
+    assert jt.structural_hash(jf) != jt.structural_hash(jh)
+    canon = "\n".join(jt.normalize(jf))
+    assert "0x" not in canon, "canonical form leaked an object identity"
+    # the Fingerprint survives its JSON round trip exactly
+    fp = jt.fingerprint(jf)
+    assert jt.Fingerprint.from_dict(
+        json.loads(json.dumps(fp.to_dict()))
+    ) == fp
+
+
+def test_ladder_fingerprints_stable_across_retrace(contig_engine):
+    """Re-tracing the same engine's ladder yields byte-identical
+    fingerprints — the determinism the golden store depends on."""
+    a = gd.fingerprint_ladder(contig_engine)
+    b = gd.fingerprint_ladder(contig_engine)
+    assert {k: fp.hash for k, fp in a.items()} == {
+        k: fp.hash for k, fp in b.items()
+    }
+    # and the ladder covers the forward program kinds of this config
+    kinds = {k.split("[")[0] for k in a}
+    assert {"prefill", "decode", "prefill_row", "batch_decode"} <= kinds
+
+
+# -- golden lifecycle --------------------------------------------------------
+
+
+def test_bless_check_coverage_roundtrip(contig_engine, tmp_path):
+    gdir = str(tmp_path)
+    # before bless: check demands a bless, coverage reports golden holes
+    missing = gd.check_fingerprints(contig_engine, gdir)
+    assert len(missing) == 1 and "--bless" in missing[0]
+    holes = gd.coverage_problems(contig_engine, gdir)
+    assert holes and all("golden" in h for h in holes)
+    # bless, then both gates go green
+    path = gd.bless(contig_engine, gdir)
+    assert path.endswith(gd.config_key(contig_engine) + ".json")
+    assert gd.check_fingerprints(contig_engine, gdir) == []
+    assert gd.coverage_problems(contig_engine, gdir) == []
+
+
+def test_drift_growth_and_stale_goldens_reported(contig_engine, tmp_path):
+    """Tampering with the blessed file must surface all three failure
+    shapes: structural drift (with a ±primitive diff, not just a hash),
+    unreviewed ladder growth, and a stale golden."""
+    gdir = str(tmp_path)
+    path = gd.bless(contig_engine, gdir)
+    doc = json.loads(open(path).read())
+    keys = sorted(doc["programs"])
+    drifted, removed = keys[0], keys[1]
+    # plant a drift: pretend the blessed program had an extra psum
+    doc["programs"][drifted]["hash"] = "0" * 64
+    doc["programs"][drifted]["primitives"]["psum"] = 3
+    # plant growth: drop one golden so its program looks newly added
+    del doc["programs"][removed]
+    # plant staleness: a golden for a program no longer on the ladder
+    doc["programs"]["decode[99|kv999]"] = doc["programs"][drifted]
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    problems = gd.check_fingerprints(contig_engine, gdir)
+    text = "\n".join(problems)
+    assert any(drifted in p and "drift" in p for p in problems)
+    assert "-psum x3" in text, "drift diff must name the primitive delta"
+    assert any(removed in p and "no golden" in p for p in problems)
+    assert any("decode[99|kv999]" in p and "stale" in p for p in problems)
+
+
+def test_contract_for_unknown_kind_raises(contig_engine):
+    with pytest.raises(ga.GraphAuditError, match="mystery"):
+        ga.contract_for(contig_engine, ga.LadderEntry("mystery", 1, 64))
+
+
+def test_repo_goldens_cover_the_default_config():
+    """The checked-in goldens must cover the exact config the CI stage
+    checks — the dogfood criterion for the drift gate."""
+    assert gd.main(["--check", "--coverage"]) == 0
+
+
+# -- the differential equivalence prover -------------------------------------
+
+
+def test_prove_paged_equals_contiguous_plus_page_tables(
+    contig_engine, paged_engine
+):
+    assert gd.prove_variant_pair(
+        contig_engine, paged_engine, gd.PAGED_VS_CONTIGUOUS
+    ) == []
+
+
+def test_prove_int8_equals_f32_plus_quantization(model_path, monkeypatch):
+    # interpret mode makes the fused Pallas decode kernel CPU-traceable —
+    # without it the int8 arm would silently prove the HLO fallback
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    base = _engine(model_path, kv_layout="paged")
+    var = _engine(model_path, kv_layout="paged", cache_dtype="int8")
+    try:
+        assert gd.prove_variant_pair(base, var, gd.INT8_VS_F32) == []
+    finally:
+        base.close()
+        var.close()
+
+
+def test_prove_verify_is_a_prefill_twin(model_path):
+    eng = _engine(model_path, speculative="ngram", draft_k=8)
+    try:
+        assert gd.prove_verify_twin(eng) == []
+    finally:
+        eng.close()
+
+
+def test_prove_verify_fails_without_speculation(contig_engine):
+    """An engine with no verify ladder is a proof failure, not a silent
+    pass."""
+    problems = gd.prove_verify_twin(contig_engine)
+    assert problems and "no verify programs" in problems[0]
+
+
+# -- planted mutations: every contract clause has teeth ----------------------
+
+
+def _mutate(closed, extra, *lead_args):
+    """Replay a traced program's equations verbatim and append `extra()`'s
+    value to the outputs — the planted-regression harness: the result is
+    the REAL program plus exactly one deliberate deviation."""
+    args = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in closed.in_avals]
+
+    def bad(*xs):
+        outs = jax.core.eval_jaxpr(
+            closed.jaxpr, closed.consts, *xs[len(lead_args):]
+        )
+        return list(outs) + [extra(*xs[: len(lead_args)])]
+
+    return jax.make_jaxpr(bad)(*lead_args, *args)
+
+
+def _decode_entry(eng):
+    return [e for e in ga.warm_key_ladder(eng) if e.kind == "decode"][0]
+
+
+def test_planted_extra_psum_fails_the_proof(contig_engine, paged_engine):
+    """Mutation 1: one extra collective in the paged variant — the prover
+    must refuse it BY NAME even though the program is otherwise the real
+    paged decode."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llama_tpu.parallel.pipeline import shard_map
+
+    entry = _decode_entry(paged_engine)
+    base = ga.trace_entry(contig_engine, entry)
+    clean = ga.trace_entry(paged_engine, entry)
+    spec = gd.PAGED_VS_CONTIGUOUS
+    assert gd.prove_delta(
+        spec, jt.fingerprint(base), jt.fingerprint(clean)
+    ) == []
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+             check_vma=False)
+    def sneak(x):
+        return jax.lax.psum(x, "tp")
+
+    mutated = _mutate(clean, lambda: sneak(jnp.int32(0)))
+    problems = gd.prove_delta(
+        spec, jt.fingerprint(base), jt.fingerprint(mutated)
+    )
+    assert problems and any("psum" in p for p in problems), problems
+
+
+def test_planted_dedonated_cache_fails_donation_check():
+    """Mutation 2: the same program lowered without donate_argnums — the
+    donation clause must flag the lost aliasing."""
+    x = jnp.ones((8,), jnp.float32)
+    fn = lambda c, v: (c + v, c * 0)
+    donated = jax.jit(fn, donate_argnums=(0,)).lower(x, x)
+    assert ga.donation_check("decode", donated) == []
+    undonated = jax.jit(fn).lower(x, x)
+    problems = ga.donation_check("decode", undonated)
+    assert problems and "donation lost" in problems[0]
+
+
+def test_planted_f32_dot_breaks_the_quantized_budget(model_path):
+    """Mutation 3: one f32×f32 dot_general slipped into a bfloat16
+    engine's decode program — the contract's f32-dot budget (sized to the
+    sanctioned attention softmax-side products) must overflow."""
+    eng = _engine(model_path, compute_dtype="bfloat16", batch=1)
+    try:
+        entry = _decode_entry(eng)
+        contract = ga.contract_for(eng, entry)
+        assert contract.f32_dot_budget is not None
+        clean = ga.trace_entry(eng, entry)
+        assert ga.contract_problems(eng, contract, clean) == []
+        w = jnp.ones((4, 4), jnp.float32)
+        mutated = _mutate(clean, lambda: jnp.dot(w, w))
+        problems = ga.contract_problems(eng, contract, mutated)
+        assert problems and any(
+            "f32-input dot_general" in p and "budget" in p for p in problems
+        ), problems
+    finally:
+        eng.close()
+
+
+def test_planted_pool_gather_breaks_the_fused_decode_pin(
+    model_path, monkeypatch
+):
+    """Mutation 4: a gather that re-materializes the int8 KV pool in a
+    decode program whose contract pins pool gathers to ZERO (the fused
+    page-table-aware kernel, PR 17) — flagged by name, and NOT provable
+    away as 'allowed_removed' noise against the gather-heavy f32 base."""
+    monkeypatch.setenv("DLT_PALLAS_INTERPRET", "1")
+    eng = _engine(model_path, kv_layout="paged", cache_dtype="int8")
+    try:
+        entry = _decode_entry(eng)
+        contract = ga.contract_for(eng, entry)
+        assert contract.forbid_pool_gather == tuple(eng.cache.k.shape), (
+            "fused-decode contract did not pin pool gathers — the planted "
+            "mutation would be unreachable"
+        )
+        clean = ga.trace_entry(eng, entry)
+        assert ga.contract_problems(eng, contract, clean) == []
+        pool = jax.ShapeDtypeStruct(eng.cache.k.shape, eng.cache.k.dtype)
+        mutated = _mutate(
+            clean,
+            lambda p: jnp.take(p, jnp.zeros((1,), jnp.int32), axis=1),
+            pool,
+        )
+        problems = ga.contract_problems(eng, contract, mutated)
+        assert problems and any(
+            "gather" in p and "KV pool" in p for p in problems
+        ), problems
+    finally:
+        eng.close()
