@@ -1481,6 +1481,132 @@ def leg_kv_integrity():
     }
 
 
+def leg_kv_tiering():
+    """Tiered KV store leg (ISSUE 19, runtime/kv_tiering.py): a shared-
+    prefix working set ~3x the HBM prefix budget over identical traffic,
+    three arms: (A) all-in-HBM (budget holds everything — the ceiling),
+    (B) 1/3 budget with the host tier on (eviction demotes, a repeat hit
+    promotes through the warmed insert ladder), (C) 1/3 budget with
+    tiering off (eviction deletes — today's cold-prefill fallback). Bar:
+    arm B's hit-TTFT holds >= 80% of arm A's (retention = A/B), while
+    arm C pays full re-prefill. Engine-level (the server twin of this is
+    tests/test_kv_tiering.py): fetch + deferred apply before generate is
+    exactly the serialized completion path's sequence."""
+    import statistics as _st
+
+    from distributed_llama_tpu.runtime.engine import InferenceEngine
+    from distributed_llama_tpu.runtime.kv_tiering import TieredKvStore
+
+    path = build_model(
+        "llama_tier_q40_v1",
+        dim=512, hidden_dim=1536, n_layers=8, n_heads=8, n_kv_heads=4,
+        vocab_size=4096, seq_len=2048,
+    )
+    n_set = 9
+    prompts = [
+        [((i * 31 + s * 257) % 1000) + 1 for i in range(576)]
+        for s in range(n_set)
+    ]
+
+    def run(mb, host_mb, disk_mb, disk_dir):
+        eng = InferenceEngine(
+            path, compute_dtype="bfloat16", max_chunk=256,
+            decode_chunk_size=64, prefix_cache_mb=mb,
+        )
+        store = None
+        if host_mb or disk_mb:
+            store = TieredKvStore(
+                eng, host_mb=host_mb, disk_mb=disk_mb, disk_dir=disk_dir,
+                peers=[],
+            )
+            eng.kv_tier = store
+            eng.prefix_cache.tier = store
+        # compile warm-through on unrelated traffic (off the clock)
+        warm = [((i * 13) % 900) + 50 for i in range(576)]
+        for _ in range(2):
+            eng.reset()
+            eng.generate(warm, 592, sampler=None, on_token=lambda t: None)
+
+        def serve(ids):
+            # the serialized completion path's sequence: tier fetch on
+            # the handler thread, deferred insert applied on the engine
+            # thread (here: the same thread), then the unmodified
+            # admission path
+            if store is not None:
+                pending = store.fetch(ids).get("pending_kv")
+                if pending is not None:
+                    pending.apply(None)
+            eng.reset()
+            return eng.generate(
+                ids, len(ids) + 16, sampler=None, on_token=lambda t: None
+            )
+
+        for ids in prompts:  # pass 1: populate (and demote, arms B/C)
+            serve(ids)
+        if store is not None:
+            # settle: the demotion drain is async by design; the bench
+            # measures promotion, not a race with the drain thread
+            deadline = time.time() + 10.0
+            while not store._demote_q.empty() and time.time() < deadline:
+                time.sleep(0.05)
+        c0 = eng.stats.counters_snapshot()
+        ttfts = []
+        for ids in prompts:  # pass 2: the measured hit pass
+            ttfts.append(serve(ids).ttft_us / 1e3)
+        c1 = eng.stats.counters_snapshot()
+        delta = {
+            k: c1.get(k, 0) - c0.get(k, 0)
+            for k in (
+                "kv_tier_hits_host", "kv_tier_hits_disk",
+                "kv_tier_local_hits", "kv_tier_misses",
+                "kv_tier_promotions", "kv_tier_promoted_tokens",
+                "kv_tier_demoted_host", "kv_tier_demoted_disk",
+                "prefix_hit_tokens",
+            )
+        }
+        entry_bytes = max(
+            (e.nbytes for e in eng.prefix_cache._entries.values()),
+            default=0,
+        )
+        if store is not None:
+            store.close()
+        del eng
+        return _st.median(ttfts), delta, entry_bytes
+
+    import tempfile as _tf
+
+    with _tf.TemporaryDirectory(prefix="dlt_tier_bench_") as disk_dir:
+        # arm A: everything fits — measures the warm-splice ceiling and
+        # sizes the 1/3 budget for the constrained arms
+        hbm_ttft, hbm_c, entry_bytes = run(512, 0, 0, disk_dir)
+        ws_bytes = entry_bytes * n_set
+        small_mb = max(1, int(ws_bytes / 3 / (1024 * 1024)))
+        tier_ttft, tier_c, _ = run(small_mb, 256, 256, disk_dir)
+        cold_ttft, cold_c, _ = run(small_mb, 0, 0, disk_dir)
+
+    tier_hits = tier_c["kv_tier_hits_host"] + tier_c["kv_tier_hits_disk"]
+    lookups = (
+        tier_hits + tier_c["kv_tier_local_hits"] + tier_c["kv_tier_misses"]
+    )
+    assert tier_c["kv_tier_demoted_host"] > 0, tier_c  # eviction must demote
+    assert tier_c["kv_tier_promotions"] > 0, tier_c    # and hits must promote
+    retention = 100.0 * hbm_ttft / max(tier_ttft, 1e-9)
+    return {
+        "config": f"kv-tiering shared-prefix x{n_set}, budget 1/3 working set",
+        "hit_ttft_ms_hbm": round(hbm_ttft, 1),
+        "hit_ttft_ms_tiered": round(tier_ttft, 1),
+        "hit_ttft_ms_cold_fallback": round(cold_ttft, 1),
+        "tier_ttft_retention_pct": round(retention, 1),
+        "retention_bar_pct": 80.0,
+        "tier_hit_rate_pct": round(100.0 * tier_hits / max(lookups, 1), 1),
+        "tier_promoted_tokens": tier_c["kv_tier_promoted_tokens"],
+        "tier_demotions": tier_c["kv_tier_demoted_host"]
+        + tier_c["kv_tier_demoted_disk"],
+        "working_set_mb": round(ws_bytes / (1024 * 1024), 1),
+        "hbm_budget_mb_constrained": small_mb,
+    }
+
+
 def leg_loadtwin():
     """Fleet-control-plane leg (server/loadtwin.py + server/scheduler.py):
     the ISSUE-12 mixed-class SLO twin. One seeded bursty mixed-class trace
@@ -1879,6 +2005,13 @@ def main():
         print(f"# kv-integrity: {kvi}", file=sys.stderr)
     except Exception as e:
         print(f"# kv-integrity leg failed: {e!r}", file=sys.stderr)
+
+    try:
+        kvt = leg_kv_tiering()
+        configs.append(kvt)
+        print(f"# kv-tiering: {kvt}", file=sys.stderr)
+    except Exception as e:
+        print(f"# kv-tiering leg failed: {e!r}", file=sys.stderr)
 
     try:
         lt = leg_loadtwin()

@@ -238,6 +238,10 @@ class PrefixCache:
         self._bytes = 0
         self._clock = 0
         self._lock = threading.Lock()
+        # runtime/kv_tiering.TieredKvStore (or None): when set, eviction
+        # DEMOTES the victim down the host/disk tier ladder instead of
+        # simply deleting it — wired by the server after engine build
+        self.tier = None
 
     # -- construction -------------------------------------------------------
 
@@ -845,6 +849,13 @@ class PrefixCache:
         self._detach(entry)
         self._entries.pop(entry.tokens, None)
         self._bytes -= entry.nbytes
+        if self.tier is not None:
+            # demote-not-delete: capture the victim BEFORE its pages go
+            # back to the pool — the capture's gather dispatches on this
+            # same thread, so it is ordered ahead of any scatter that
+            # recycles them. `clear()` (engine recovery) bypasses this on
+            # purpose: a possibly-corrupt cache must not seed a tier.
+            self.tier.capture_demotion(entry)
         if entry.pages:
             self.page_pool.release(entry.pages)
         self._gauges()

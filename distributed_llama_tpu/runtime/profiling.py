@@ -771,6 +771,13 @@ def hbm_ledger(engine) -> dict:
         "headroom_bytes": None,
         "unattributed_bytes": None,
     }
+    tier = getattr(engine, "kv_tier", None)
+    if tier is not None:
+        # the tiered-KV store's host/disk occupancy rides the SAME ledger
+        # payload but as a SIBLING section, never a component: host RAM
+        # is not HBM, and folding it into `modeled` would fake
+        # measured-vs-modeled drift on every demotion wave
+        out["host_tier"] = tier.memory_snapshot()
     measured = _device_memory_stats(engine)
     if measured is not None:
         out["measured_bytes"] = measured["bytes_in_use"]

@@ -342,8 +342,9 @@ SLO_CLASSES = ("interactive", "standard", "batch")
 LEDGER_FIELDS = (
     "queue_us", "prefill_us", "decode_us", "spec_us",
     "remote_prefill_us", "kv_transfer_us", "kv_transfer_path",
-    "prompt_tokens", "prefix_hit_tokens", "generated_tokens",
-    "spec_accepted_tokens", "discarded_tokens", "retries",
+    "promotion_us", "prompt_tokens", "prefix_hit_tokens",
+    "generated_tokens", "spec_accepted_tokens", "discarded_tokens",
+    "retries",
 )
 
 
@@ -372,6 +373,9 @@ class GoodputLedger:
     kv_transfer_path: str = ""  # transport the shipped KV took ("device" |
     # "http"; "" = no transfer) — the per-request twin of the labeled
     # dlt_kv_transfer_us series
+    promotion_us: int = 0       # tiered-KV fetch wall: host/disk/peer tier
+    # lookup + transfer for this request's prefix (runtime/kv_tiering.py;
+    # 0 = no tier promotion)
     prompt_tokens: int = 0
     prefix_hit_tokens: int = 0   # prompt tokens resumed from the radix cache
     generated_tokens: int = 0    # delivered to the client (usage-visible)

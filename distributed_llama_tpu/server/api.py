@@ -1148,6 +1148,45 @@ class ApiState:
                 "⚠️  --role decode without --prefill-peer serves prompts "
                 "locally (unified behavior)"
             )
+        # tiered KV store (runtime/kv_tiering.py): eviction demotes down
+        # the HBM -> host RAM -> disk -> peer-fleet ladder and admission
+        # misses promote back up it. Any role runs it (tiers 1-2 are host
+        # memory; the tier-3 serve side is host memory too) — None unless
+        # some tier is configured via the DLT_KV_*_TIER_* knobs.
+        from ..runtime.kv_tiering import TieredKvStore
+
+        self.kv_tier = TieredKvStore.build(engine, goodput=self.goodput)
+        engine.kv_tier = self.kv_tier  # hbm_ledger's host_tier section
+        if self.kv_tier is not None and engine.prefix_cache is not None:
+            engine.prefix_cache.tier = self.kv_tier
+
+    def kv_tier_payload(self, ids, have_keys=()):
+        """The same-process fleet-cache provider contract (the tier-3 twin
+        of `prefill_extract`): serve the longest held host/disk-tier
+        bucket as SERIALIZED payload bytes, so the requester's verify
+        gate sees the same bytes a socket would carry. None = not held."""
+        if self.kv_tier is None:
+            return None
+        return self.kv_tier.serve_fetch(list(ids), have_keys=tuple(have_keys))
+
+    def _note_prefix_footprint(self, chain, ids):
+        """Attach the tokenized cacheable-prefix footprint — pages plus
+        STORED-WIDTH bytes (``_slice_nbytes`` reads the pool's real dtype,
+        so int8 caches report quantized bytes) — to this request's chain
+        keys in the hot-prefix tracker. The size half of the autoscaler's
+        size-aware warm-handoff ranking."""
+        pc = self.engine.prefix_cache
+        if not chain or pc is None:
+            return
+        from .disagg import prefill_boundary
+
+        P = prefill_boundary(len(ids), self.engine.cfg.seq_len)
+        if P <= 0:
+            return
+        pages = P // pc.page_pool.page_size if pc.paged else 0
+        self.hot_prefixes.note_size(
+            chain, pages, pc._slice_nbytes(self.engine, P)
+        )
 
     def prefill_extract(self, ids, have_keys=(), trace_id=None):
         """The same-process device-transport provider contract
@@ -1259,6 +1298,19 @@ class ApiState:
             disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
             if disagg_walls is not None:
                 pending_kv = disagg_walls.pop("pending_kv", None)
+            # tiered-KV promotion (runtime/kv_tiering.py): when the
+            # prefill tier shipped nothing, try the demotion ladder —
+            # host RAM, then disk, then the fleet cache. Same deferred-
+            # insert contract as the disagg pending; degrades to local
+            # prefill on any failure. note_chain teaches the prefetch-
+            # hint index what tokens this router chain resolves to.
+            tier_walls = None
+            self._note_prefix_footprint(params.get("_chain") or (), ids)
+            if self.kv_tier is not None:
+                self.kv_tier.note_chain(params.get("_chain") or (), ids)
+                if pending_kv is None:
+                    tier_walls = self.kv_tier.fetch(ids, trace)
+                    pending_kv = tier_walls.pop("pending_kv", None)
 
             base = []
             if prompt.public_prompt:
@@ -1355,6 +1407,8 @@ class ApiState:
                 req.ledger.kv_transfer_path = disagg_walls.get(
                     "kv_transfer_path", ""
                 )
+            if tier_walls is not None:
+                req.ledger.promotion_us = tier_walls["promotion_us"]
             # deferred external-KV insert: the Batcher loop applies it on
             # the engine thread right before this request's admission
             # (idempotent — a stall retry's second attempt reuses it)
@@ -1571,10 +1625,24 @@ class ApiState:
         # returned). The serialized path runs under self.lock, so the
         # deferred insert applies inline — this IS the engine thread here.
         disagg_walls = self.disagg.fetch(ids, trace) if self.disagg else None
+        applied_external = False
         if disagg_walls is not None:
             pending_kv = disagg_walls.pop("pending_kv", None)
             if pending_kv is not None:
                 pending_kv.apply(self)
+                applied_external = True
+        # tiered-KV promotion (runtime/kv_tiering.py): host/disk/peer
+        # ladder when the prefill tier shipped nothing. Inline apply —
+        # under self.lock this IS the engine thread.
+        tier_walls = None
+        self._note_prefix_footprint(params.get("_chain") or (), ids)
+        if self.kv_tier is not None:
+            self.kv_tier.note_chain(params.get("_chain") or (), ids)
+            if not applied_external:
+                tier_walls = self.kv_tier.fetch(ids, trace)
+                pending_tier = tier_walls.pop("pending_kv", None)
+                if pending_tier is not None:
+                    pending_tier.apply(self)
 
         buffer = []
         if prompt.public_prompt:
@@ -1604,6 +1672,8 @@ class ApiState:
             led.remote_prefill_us = disagg_walls["remote_prefill_us"]
             led.kv_transfer_us = disagg_walls["kv_transfer_us"]
             led.kv_transfer_path = disagg_walls.get("kv_transfer_path", "")
+        if tier_walls is not None:
+            led.promotion_us = tier_walls["promotion_us"]
         self._inflight_ledger = led
         spec_accept_0 = engine.stats.counters_snapshot().get(
             "spec_accepted_tokens", 0
@@ -1777,17 +1847,20 @@ class ApiState:
 
     def close(self):
         """Release the replica's engine-side resources: stop the Batcher
-        loop (failing anything still in flight), then close the engine —
-        which unsubscribes its recompile sentinel. Without this, a
-        server's engine lives forever on the Batcher's daemon thread and
-        its SEALED fatal sentinel keeps killing every later engine build
-        in the process (the cross-suite pollution class). Idempotent;
-        wired to the HTTP server's ``shutdown()``/``server_close()``."""
+        loop (failing anything still in flight), the tiered-KV store's
+        drain/prefetch loops, then close the engine — which unsubscribes
+        its recompile sentinel. Without this, a server's engine lives
+        forever on the Batcher's daemon thread and its SEALED fatal
+        sentinel keeps killing every later engine build in the process
+        (the cross-suite pollution class). Idempotent; wired to the HTTP
+        server's ``shutdown()``/``server_close()``."""
         if self._closed:
             return
         self._closed = True
         if self.batcher is not None:
             self.batcher.stop()
+        if self.kv_tier is not None:
+            self.kv_tier.close()
         self.engine.close()
 
     def _rebuild_engine(self):
@@ -1854,12 +1927,16 @@ DLT_ENV_SURFACE = (
     "DLT_GW_RECOVER_TIMEOUT_S",
     "DLT_HBM_DRIFT_MB",
     "DLT_I8_DIMSEM",
+    "DLT_KV_DISK_TIER_DIR",
+    "DLT_KV_DISK_TIER_MB",
     "DLT_KV_DTYPE",
+    "DLT_KV_HOST_TIER_MB",
     "DLT_KV_INTEGRITY_STRIKES",
     "DLT_KV_INTEGRITY_TTL_S",
     "DLT_KV_LAYOUT",
     "DLT_KV_PAGE",
     "DLT_KV_POOL_MB",
+    "DLT_KV_TIER_PEERS",
     "DLT_KV_TRANSPORT",
     "DLT_MOE_LAYER_FOLD",
     "DLT_NO_NATIVE",
@@ -1932,6 +2009,9 @@ def resolved_config(state: "ApiState") -> dict:
         },
         "role": state.role,
         "disagg": None if state.disagg is None else state.disagg.snapshot(),
+        "kv_tiering": (
+            None if state.kv_tier is None else state.kv_tier.snapshot()
+        ),
         "supervisor": state.supervisor.config.snapshot(),
         "quarantine": {
             "limit": state.quarantine.limit,
@@ -2024,6 +2104,15 @@ class Handler(BaseHTTPRequestHandler):
                     )
             if kvt_rows:
                 series["kv_transfer_us"] = kvt_rows
+            # tiered-KV promotion wall quantiles (runtime/kv_tiering.py):
+            # the per-request fetch wall (dlt_promotion_us) — the ledger's
+            # promotion_us field is the per-request twin
+            promo_pct = st.engine.stats.percentiles("promotion_us")
+            if promo_pct:
+                series["promotion_us"] = [
+                    ({"quantile": q}, round(v, 1))
+                    for q, v in sorted(promo_pct.items())
+                ]
             snap_counters = st.engine.stats.counters_snapshot()
             counter_series = {
                 "wasted_tokens": st.goodput.wasted_series()
@@ -2040,6 +2129,18 @@ class Handler(BaseHTTPRequestHandler):
                     for oc in ("verified", "rejected")
                 ],
             }
+            if st.kv_tier is not None:
+                # per-tier hit outcomes, zero-filled: the tiering
+                # dashboard exists before the first demotion ever lands —
+                # dlt_kv_tier_hits_total{tier=host|disk|peer} (+ misses)
+                counter_series["kv_tier_hits"] = [
+                    ({"tier": t}, snap_counters.get(f"kv_tier_hits_{t}", 0))
+                    for t in ("host", "disk", "peer")
+                ]
+                counter_series["kv_tier_demotions"] = [
+                    ({"tier": t}, snap_counters.get(f"kv_tier_demoted_{t}", 0))
+                    for t in ("host", "disk")
+                ]
             if st.batcher is not None:
                 # scheduler decisions by (class, action) — zero-filled so
                 # the preemption dashboard exists before the first incident
@@ -2226,6 +2327,13 @@ class Handler(BaseHTTPRequestHandler):
                 # per-replica table
                 "role": st.role,
                 "disagg": None if st.disagg is None else st.disagg.snapshot(),
+                # tiered KV store (runtime/kv_tiering.py): per-tier
+                # occupancy/budgets + fleet-cache peer health — the
+                # kv_tier_* counters ride steps.counters; the fleet
+                # scraper lifts this section into the per-replica table
+                "kv_tiering": (
+                    None if st.kv_tier is None else st.kv_tier.snapshot()
+                ),
                 # supervised engine lifecycle (runtime/supervisor.py):
                 # state, restart budget, transition counts — the /metrics
                 # twin is dlt_supervisor_transitions_total{state=...}
@@ -2244,6 +2352,9 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/v1/prefill":
             self._serve_prefill()
+            return
+        if self.path == "/v1/kv_fetch":
+            self._serve_kv_fetch()
             return
         if self.path == "/admin/drain_hint":
             # the gateway's crash-safety hint (Balancer.set_draining):
@@ -2297,11 +2408,28 @@ class Handler(BaseHTTPRequestHandler):
         # warm-handoff tracker: this request's router-compatible prefix
         # chain keys (None for garbage message shapes — the 400 below owns
         # those; one bounded-dict touch per request, never per token)
-        from .router import messages_prefix_text, prefix_chain
+        from .router import PREFETCH_CHAIN_HEADER, messages_prefix_text, \
+            parse_chain_header, prefix_chain
 
         prefix_text = messages_prefix_text(params.get("messages"))
         if prefix_text:
-            self.state.hot_prefixes.record(prefix_chain(prefix_text))
+            chain = prefix_chain(prefix_text)
+            self.state.hot_prefixes.record(chain)
+            # stash for the completion path: the tiered store's prefetch-
+            # hint index maps these router chain keys to the token prefix
+            # they resolve to (runtime/kv_tiering.py note_chain), and the
+            # hot-prefix tracker gets the tokenized footprint (note_size)
+            params["_chain"] = chain
+        # router prefetch hint (X-DLT-Prefetch-Chain): the gateway names
+        # the chain it EXPECTS here next, so the tiered store can lift the
+        # matching prefix disk/peer -> host before the request lands.
+        # Best-effort and bounded; garbage headers are ignored.
+        if self.state.kv_tier is not None:
+            hinted = parse_chain_header(
+                self.headers.get(PREFETCH_CHAIN_HEADER)
+            )
+            if hinted:
+                self.state.kv_tier.prefetch_hint(hinted)
 
         # poison-request quarantine (server/quarantine.py): fingerprint the
         # FULL conversation; a fingerprint already implicated in `limit`
@@ -2420,6 +2548,40 @@ class Handler(BaseHTTPRequestHandler):
                 "prefill_request", t0, now_us() - t0, ("n_ids",), (len(ids),),
                 always=True,
             )
+        self._respond(200, payload, ctype="application/octet-stream")
+
+    def _serve_kv_fetch(self):
+        """``POST /v1/kv_fetch`` (runtime/kv_tiering.py): fleet-cache tier.
+        A peer replica names a token prefix (plus a content-addressed skip
+        claim for pages it already holds) and gets back one verified binary
+        KV payload from this replica's tiered store — or a 404 the requester
+        treats exactly like a miss. Serving never touches the device: only
+        host/disk tiers answer, so a busy decode loop is never stalled by a
+        peer's cache fill."""
+        st = self.state
+        if st.kv_tier is None:
+            self._json(404, b'{"error":"kv tiering disabled"}')
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            params = json.loads(self.rfile.read(length) or b"{}")
+            ids = [int(t) for t in params["ids"]]
+            # malformed skip claims degrade to a full send, never an error
+            # (same contract as /v1/prefill)
+            try:
+                have = tuple(int(h, 16) for h in params.get("have", ()))
+            except (TypeError, ValueError):
+                have = ()
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            self._json(400, b'{"error":"ids (a token id list) required"}')
+            return
+        if not ids:
+            self._json(400, b'{"error":"empty ids"}')
+            return
+        payload = st.kv_tier.serve_fetch(ids, have_keys=have)
+        if payload is None:
+            self._json(404, b'{"error":"miss"}')
+            return
         self._respond(200, payload, ctype="application/octet-stream")
 
     def _serve_chat(self, params, stream):

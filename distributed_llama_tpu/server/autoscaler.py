@@ -249,7 +249,18 @@ class Autoscaler:
             chains = json.loads(body).get("chains", [])
         except Exception:
             return 0
-        keys = [c.get("key") for c in chains if isinstance(c, dict)]
+        # size-aware ranking: re-home the chains that are both hot AND
+        # expensive to recompute first — hits x stored bytes (the snapshot's
+        # `bytes` is stored-width, so int8 caches rank by real footprint).
+        # Chains without size info (never completed) fall back to hits-only.
+        chains = sorted(
+            (c for c in chains if isinstance(c, dict)),
+            key=lambda c: (
+                c.get("hits", 0) * (1 + c.get("bytes", 0)), c.get("hits", 0)
+            ),
+            reverse=True,
+        )
+        keys = [c.get("key") for c in chains]
         n = router.rehome_keys(
             [k for k in keys if k], remaining_keys, from_key=victim_key
         )

@@ -174,6 +174,13 @@ _GAUGE_SIGNALS = {
     "dlt_goodput_tokens_per_s": "goodput_tokens_per_s",
     "dlt_prefix_cache_bytes": "prefix_cache_bytes",
     "dlt_prefix_cache_entries": "prefix_cache_entries",
+    # tiered KV store (runtime/kv_tiering.py): per-tier occupancy, so
+    # router scoring and autoscaler drain-handoff are tier-aware
+    "dlt_kv_tier_host_bytes": "kv_tier_host_bytes",
+    "dlt_kv_tier_host_budget_bytes": "kv_tier_host_budget_bytes",
+    "dlt_kv_tier_host_entries": "kv_tier_host_entries",
+    "dlt_kv_tier_disk_bytes": "kv_tier_disk_bytes",
+    "dlt_kv_tier_disk_entries": "kv_tier_disk_entries",
 }
 
 #: cumulative counters turned into rates across consecutive scrapes
@@ -181,6 +188,7 @@ _RATE_SIGNALS = {
     "dlt_prefix_hit_tokens_total": "prefix_hit_tokens_per_s",
     "dlt_requests_completed_total": "requests_per_s",
     "dlt_shed_503_total": "shed_per_s",
+    "dlt_kv_tier_promoted_tokens_total": "kv_tier_promoted_tokens_per_s",
 }
 
 
@@ -368,7 +376,7 @@ class FleetScraper:
             return {}
         out = {}
         for k in ("batcher", "kv_pool", "speculative", "batch", "seq_len",
-                  "role", "disagg", "scheduler"):
+                  "role", "disagg", "scheduler", "kv_tiering"):
             if isinstance(payload, dict) and payload.get(k) is not None:
                 out[k] = payload[k]
         return out

@@ -87,7 +87,11 @@ from . import parse_query
 # poison-quarantine ledger + fingerprinting, the chat-body hash-text
 # builder, and the deadline resolution the retry loop stamps per attempt
 from .quarantine import QuarantineLedger, fp_hex, request_fingerprint
-from .router import messages_prefix_text
+from .router import (
+    PREFETCH_CHAIN_HEADER,
+    chain_header_value,
+    messages_prefix_text,
+)
 from .scheduler import DEADLINE_ENVS, DEADLINE_HEADER, resolve_deadline_ms
 
 BREAKER_CLOSED = "closed"
@@ -1304,13 +1308,25 @@ def handle_client(client: socket.socket, balancer: Balancer):
                     ),
                 )
             request_out = request
+            if plan is not None and plan.chain:
+                # router prefetch hint (runtime/kv_tiering.py): name the
+                # plan's chain keys so the backend's tiered KV store can
+                # lift the matching prefix disk/peer -> host while the
+                # prompt is still parsing. Re-stamped per attempt — a
+                # retry's new backend deserves the hint as much as the
+                # first choice did. Advisory: a backend without tiering
+                # ignores it.
+                request_out = _with_header(
+                    request_out, PREFETCH_CHAIN_HEADER,
+                    chain_header_value(plan.chain),
+                )
             if deadline_mono is not None:
                 # re-stamp the deadline with the REMAINING budget: one
                 # clock rides routing and every retry, without shipping an
                 # absolute timestamp between unsynchronized hosts
                 remaining_ms = int((deadline_mono - time.monotonic()) * 1e3)
                 request_out = _with_header(
-                    request, DEADLINE_HEADER, str(max(remaining_ms, 1))
+                    request_out, DEADLINE_HEADER, str(max(remaining_ms, 1))
                 )
             t_att = time.perf_counter()
             failed, forwarded, client_gone, sent, poison_fp = _proxy_once(

@@ -39,7 +39,7 @@ import json
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -88,6 +88,16 @@ class StubReplicaConfig:
     # poison incidents and serves /debug/quarantine — the gateway's
     # warm-restart recovery source (server/recovery.py)
     quarantine_limit: int = 2
+    # tiered-KV twin (runtime/kv_tiering.py): 0 = unbounded warm set
+    # (tiering N/A — the pre-tier stub behavior, and the default). With
+    # a budget, publishing past it LRU-demotes chain blocks to a
+    # host-tier set; a later hit on a demoted block still skips its
+    # prefill wall but pays promote_ms_per_token — the cheap host->HBM
+    # insert, vs host_chain_budget=0 where eviction deletes and the
+    # block re-prefills cold
+    hbm_chain_budget: int = 0       # warm chain blocks HBM holds (0 = all)
+    host_chain_budget: int = 4096   # demoted blocks the host tier holds
+    promote_ms_per_token: float = 0.005  # promotion wall per promoted token
 
 
 class _Ticket:
@@ -212,7 +222,11 @@ class _StubState:
         self.scheduler = SloScheduler()
         self.gate = _SlotGate(cfg, self.scheduler)
         self.hot_prefixes = HotPrefixTracker()
-        self.warm_chains: set = set()      # the radix cache twin
+        # the radix cache twin (LRU when cfg.hbm_chain_budget bounds it)
+        self.warm_chains: OrderedDict = OrderedDict()
+        # the host-tier twin: blocks demoted out of the HBM set. Survives
+        # a simulated supervisor rebuild on purpose — host RAM does.
+        self.host_chains: OrderedDict = OrderedDict()
         self.wasted: dict = {}             # (reason, class) -> tokens
         self.delivered: dict = {c: 0 for c in SLO_CLASSES}
         self._window: deque = deque()      # (t, n, class), 60 s trim
@@ -229,6 +243,52 @@ class _StubState:
     def incr(self, name: str, n: int = 1):
         with self.lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    def warm_hit(self, chain) -> tuple:
+        """``(hbm_blocks, promoted_blocks)`` — the leading chain blocks
+        found warm, walked in order: HBM blocks splice for free,
+        host-tier blocks count as hits but charge the promotion wall.
+        The walk stops at the first block in neither tier (the radix
+        semantics: coverage is a contiguous prefix)."""
+        warm = promoted = 0
+        with self.lock:
+            for ck in chain:
+                if ck in self.warm_chains:
+                    self.warm_chains.move_to_end(ck)
+                    warm += 1
+                elif ck in self.host_chains:
+                    promoted += 1
+                else:
+                    break
+            if promoted:
+                self.counters["kv_tier_hits_host"] = (
+                    self.counters.get("kv_tier_hits_host", 0) + 1
+                )
+        return warm, promoted
+
+    def warm_publish(self, chain):
+        """Publish the whole chain into the HBM twin; past
+        ``cfg.hbm_chain_budget`` the LRU blocks DEMOTE to the host-tier
+        twin (or vanish when ``host_chain_budget`` is 0 — the pre-tier
+        delete-on-evict fallback the bench arms compare against)."""
+        cfg = self.cfg
+        with self.lock:
+            for ck in chain:
+                self.host_chains.pop(ck, None)  # promoted back up
+                self.warm_chains[ck] = True
+                self.warm_chains.move_to_end(ck)
+            if cfg.hbm_chain_budget <= 0:
+                return
+            while len(self.warm_chains) > cfg.hbm_chain_budget:
+                ck, _ = self.warm_chains.popitem(last=False)
+                if cfg.host_chain_budget > 0:
+                    self.host_chains[ck] = True
+                    self.host_chains.move_to_end(ck)
+                    self.counters["kv_tier_demotions"] = (
+                        self.counters.get("kv_tier_demotions", 0) + 1
+                    )
+                    while len(self.host_chains) > cfg.host_chain_budget:
+                        self.host_chains.popitem(last=False)
 
     def add_waste(self, reason: str, klass: str, tokens: int):
         if tokens <= 0:
@@ -281,6 +341,7 @@ def _render_stub_metrics(st: _StubState) -> str:
     with st.lock:
         counters = dict(st.counters)
         wasted = dict(st.wasted)
+        host_entries = len(st.host_chains)
     gate = st.gate
     lines = []
     for k in ("requests_completed", "prefix_hit_tokens", "shed_503"):
@@ -316,6 +377,27 @@ def _render_stub_metrics(st: _StubState) -> str:
     lines.append("# TYPE dlt_scheduler_decisions_total counter")
     for lab, v in st.scheduler.decisions_series():
         lines.append(_prom("dlt_scheduler_decisions_total", lab, v))
+    if st.cfg.hbm_chain_budget > 0:
+        # tiered-KV twin families: the same names the real server emits
+        # from TieredKvStore.memory_snapshot(), so the FleetScraper lift
+        # and the router's w_tier host-fill term exercise end-to-end
+        # against the stub (16 KiB nominal bytes per 16-token block)
+        block_b = 16 * 1024
+        lines.append("# TYPE dlt_kv_tier_hits_total counter")
+        lines.append(_prom("dlt_kv_tier_hits_total", {"tier": "host"},
+                           counters.get("kv_tier_hits_host", 0)))
+        lines.append("# TYPE dlt_kv_tier_demotions_total counter")
+        lines.append(_prom("dlt_kv_tier_demotions_total", {"tier": "host"},
+                           counters.get("kv_tier_demotions", 0)))
+        tier_gauges = {
+            "dlt_kv_tier_host_bytes": host_entries * block_b,
+            "dlt_kv_tier_host_budget_bytes":
+                max(st.cfg.host_chain_budget, 0) * block_b,
+            "dlt_kv_tier_host_entries": host_entries,
+        }
+        for m, v in tier_gauges.items():
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(_prom(m, None, v))
     return "\n".join(lines) + "\n"
 
 
@@ -530,20 +612,21 @@ class StubEngineReplica:
                 max_tokens = int(params.get("max_tokens") or 16)
                 # prefix-cache twin: leading chain blocks already warm on
                 # THIS replica skip their prefill wall (16 tokens/block,
-                # the page-size equivalence the router is built around)
-                with st.lock:
-                    warm = 0
-                    for ck in chain:
-                        if ck in st.warm_chains:
-                            warm += 1
-                        else:
-                            break
-                hit_tokens = min(warm * 16, prompt_tokens)
+                # the page-size equivalence the router is built around);
+                # host-tier blocks (runtime/kv_tiering.py twin) also skip
+                # it but pay the cheaper promotion wall instead
+                warm, promoted = st.warm_hit(chain)
+                hit_tokens = min((warm + promoted) * 16, prompt_tokens)
                 if hit_tokens:
                     st.incr("prefix_hits")
                     st.incr("prefix_hit_tokens", hit_tokens)
                 cold = prompt_tokens - hit_tokens
-                time.sleep(cold * st.cfg.prefill_ms_per_token / 1000.0)
+                time.sleep(
+                    (
+                        cold * st.cfg.prefill_ms_per_token
+                        + promoted * 16 * st.cfg.promote_ms_per_token
+                    ) / 1000.0
+                )
                 if st.dying:
                     # hard-killed DURING prefill: die byte-less — the
                     # zero-byte failure shape the gateway's strike
@@ -559,8 +642,7 @@ class StubEngineReplica:
                         pass
                     self.close_connection = True
                     return
-                with st.lock:  # publish: the whole chain is warm now
-                    st.warm_chains.update(chain)
+                st.warm_publish(chain)  # whole chain warm; over-budget LRU demotes
                 # SSE decode: one chunk per simulated token
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")

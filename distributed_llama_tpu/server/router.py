@@ -66,6 +66,31 @@ REASONS = ("prefix_affinity", "headroom", "fallback_stale", "least_inflight")
 _FNV64_OFFSET = 0xCBF29CE484222325
 _FNV64_PRIME = 0x100000001B3
 
+#: router prefetch hint (runtime/kv_tiering.py): the gateway forwards the
+#: plan's chain keys on every proxied chat request as comma-joined
+#: zero-padded hex, so the backend's tiered KV store can lift the matching
+#: prefix disk/peer -> host BEFORE (or while) the prompt is tokenized.
+#: Purely advisory — stripping the header costs warmth, never correctness.
+PREFETCH_CHAIN_HEADER = "X-DLT-Prefetch-Chain"
+
+
+def chain_header_value(chain) -> str:
+    """Wire-encode router chain keys for :data:`PREFETCH_CHAIN_HEADER` —
+    the same zero-padded hex ``/debug/hot_prefixes`` speaks."""
+    return ",".join(f"{ck:016x}" for ck in chain)
+
+
+def parse_chain_header(value) -> list:
+    """Decode a :data:`PREFETCH_CHAIN_HEADER` value back to chain keys.
+    Garbage (missing, empty, non-hex fragments) degrades to ``[]`` — a
+    prefetch hint must never be able to fail a request."""
+    if not value:
+        return []
+    try:
+        return [int(p, 16) for p in str(value).split(",") if p.strip()]
+    except ValueError:
+        return []
+
 
 def fnv1a(data: bytes, h: int = _FNV64_OFFSET) -> int:
     """64-bit FNV-1a over ``data`` seeded with ``h`` — deterministic across
@@ -175,6 +200,7 @@ class RouterConfig:
     w_occupancy: float = 1.0     # 1 - batcher slot occupancy
     w_slo: float = 1.0           # TTFT-SLO attainment
     w_inflight: float = 0.5      # per-inflight-request penalty
+    w_tier: float = 0.5          # host-tier occupancy bonus (tiered KV)
 
     @classmethod
     def resolve(cls, policy: str | None = None) -> "RouterConfig":
@@ -188,6 +214,7 @@ class RouterConfig:
             w_occupancy=_env_float("DLT_ROUTER_W_OCCUPANCY", 1.0),
             w_slo=_env_float("DLT_ROUTER_W_SLO", 1.0),
             w_inflight=_env_float("DLT_ROUTER_W_INFLIGHT", 0.5),
+            w_tier=_env_float("DLT_ROUTER_W_TIER", 0.5),
         )
 
 
@@ -209,8 +236,13 @@ def score_backend(
       guessing high is how a dead replica keeps winning traffic. Fresh rows
       score KV-pool headroom (free-page fraction; contiguous replicas
       without a pool get full credit — they cannot exhaust), batcher
-      occupancy (free-slot fraction), and TTFT-SLO attainment, each capped
-      at its weight so no single signal can swamp the others;
+      occupancy (free-slot fraction), TTFT-SLO attainment, and — on
+      replicas running the tiered KV store (runtime/kv_tiering.py) —
+      host-tier fill (warm-but-demoted prefixes this replica can promote
+      without a prefill; replicas without a tier score zero here, so the
+      term is a tie-breaker among tiered replicas, never a penalty on
+      untiered ones), each capped at its weight so no single signal can
+      swamp the others;
     * ``inflight`` — the balancer's live connection count, a penalty in
       both regimes (it is the one signal that is never stale)."""
     s = 0.0
@@ -231,6 +263,10 @@ def score_backend(
             s += cfg.w_occupancy
         slo = signals.get("slo_ttft_attainment")
         s += cfg.w_slo * (slo if slo is not None else 1.0)
+        tier_budget = signals.get("kv_tier_host_budget_bytes")
+        if tier_budget:
+            fill = signals.get("kv_tier_host_bytes", 0) / tier_budget
+            s += cfg.w_tier * min(max(fill, 0.0), 1.0)
     s -= cfg.w_inflight * inflight
     return s
 

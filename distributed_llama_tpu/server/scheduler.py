@@ -300,12 +300,21 @@ class HotPrefixTracker:
     locality map learns (server/router.py ``prefix_chain``), computed
     replica-side over the chat messages text — so the snapshot's keys are
     directly re-homeable without any token-to-text mapping. Bounded LRU;
-    one lock hold per request (never per token)."""
+    one lock hold per request (never per token).
+
+    Each chain also carries the KV footprint it resolves to — pages and
+    STORED-WIDTH bytes (int8-quantized caches report quantized bytes, not
+    the compute-dtype size), attached by the completion path once the
+    prompt is tokenized (``note_size``). The autoscaler's warm handoff
+    ranks on hits x bytes: a chain that is both hot and expensive to
+    recompute is the one worth re-homing first."""
 
     def __init__(self, size: int = 4096):
         self.size = size
         self._lock = threading.Lock()
-        self._hits: "collections.OrderedDict[int, int]" = (
+        # key -> [hits, pages, nbytes]; pages/nbytes are the largest
+        # footprint seen (depths share keys; max is what a re-home moves)
+        self._hits: "collections.OrderedDict[int, list]" = (
             collections.OrderedDict()
         )
 
@@ -316,22 +325,46 @@ class HotPrefixTracker:
             return
         with self._lock:
             for ck in chain:
-                self._hits[ck] = self._hits.get(ck, 0) + 1
+                ent = self._hits.get(ck)
+                if ent is None:
+                    ent = self._hits[ck] = [0, 0, 0]
+                ent[0] += 1
                 self._hits.move_to_end(ck)
             while len(self._hits) > self.size:
                 self._hits.popitem(last=False)
 
+    def note_size(self, chain, pages: int, nbytes: int) -> None:
+        """Attach the cacheable-prefix footprint to a request's chain keys
+        (hits untouched — ``record`` already counted this request). Called
+        by the completion path, which knows the tokenized prefix boundary
+        and the cache's stored-width byte cost."""
+        if not chain or (pages <= 0 and nbytes <= 0):
+            return
+        with self._lock:
+            for ck in chain:
+                ent = self._hits.get(ck)
+                if ent is None:
+                    continue  # evicted (or never recorded): don't resurrect
+                ent[1] = max(ent[1], pages)
+                ent[2] = max(ent[2], nbytes)
+
     def snapshot(self, top_n: int = 64) -> dict:
         """The ``/debug/hot_prefixes`` payload: the hottest chain keys as
-        zero-padded hex (the handoff wire format), hit-count descending."""
+        zero-padded hex (the handoff wire format), hit-count descending
+        with stored bytes as the tiebreak, each with its KV footprint."""
         with self._lock:
             items = sorted(
-                self._hits.items(), key=lambda kv: kv[1], reverse=True
+                self._hits.items(),
+                key=lambda kv: (kv[1][0], kv[1][2]), reverse=True,
             )[:top_n]
             n = len(self._hits)
         return {
             "n_tracked": n,
             "chains": [
-                {"key": f"{ck:016x}", "hits": hits} for ck, hits in items
+                {
+                    "key": f"{ck:016x}", "hits": hits,
+                    "pages": pages, "bytes": nbytes,
+                }
+                for ck, (hits, pages, nbytes) in items
             ],
         }

@@ -40,9 +40,10 @@ _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _HIGHER = re.compile(
     r"tok_s|tokens_per_s|throughput_gain|acceptance|overlap_pct|mfu"
     r"|bw_utilization|attainment|rows_at_budget|scale_x|_gain"
-    r"|eff_gb_s|bytes_per_pos_ratio"
+    r"|eff_gb_s|bytes_per_pos_ratio|retention_pct|hit_rate"
 )
-#: metric-name fragments that mean "smaller is better"
+#: metric-name fragments that mean "smaller is better" (hit_ttft_ms_*:
+#: the tiering leg's promotion-path TTFT rides the generic _ms_ band)
 _LOWER = re.compile(
     r"_ms$|_ms_|_us$|_us_|overhead_pct|slowdown|inflation|wasted|_wall_"
     r"|abs_delta|logprob_abs"
