@@ -128,10 +128,25 @@ def _paged_args(engine):
     )
 
 
+def _grammar_sds(engine):
+    """The [S, V] int32 mask-table ShapeDtypeStruct a grammar-capable
+    engine threads into every decode/verify dispatch (runtime/grammar.py,
+    engine._gr_operand), or None when the arena is off. The per-row state
+    operand's shape differs per kind ([b] for decode chunks, [b, t] for
+    verify) — each tracing arm builds its own."""
+    gr = getattr(engine, "grammar", None)
+    if gr is None:
+        return None
+    return _sds(gr.table.shape, jnp.int32)
+
+
 def trace_entry(engine, entry: LadderEntry):
     """`jax.make_jaxpr` of the program `entry` names, with abstract token /
     position inputs and the engine's real params/cache closed over (tracing
-    reads shapes and shardings; nothing executes)."""
+    reads shapes and shardings; nothing executes). On a grammar-capable
+    engine the decode/batch_decode/verify arms carry the mask-table operand
+    pair — the production dispatches always thread it there, so a
+    fingerprint taken without it would hash a program serving never runs."""
     cfg, b = engine.cfg, engine.batch
     pt_sds, ps = _paged_args(engine)
     if entry.kind == "prefill":
@@ -171,19 +186,28 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.decode import decode_chunk
 
-            if engine.paged:
-                fn = lambda tok, pos, pt: decode_chunk(
+            # mirror engine._decode_chunk_any: paged engines add the page
+            # table, grammar-capable engines ALWAYS add the (mask table,
+            # [b] states) pair — both are part of the compiled shape
+            gt_sds = _grammar_sds(engine)
+            extra = [pt_sds] if engine.paged else []
+            if gt_sds is not None:
+                extra += [gt_sds, _sds((b,), jnp.int32)]
+
+            def fn(tok, pos, *ops):
+                it = iter(ops)
+                pt = next(it) if engine.paged else None
+                gtab = next(it) if gt_sds is not None else None
+                gst = next(it) if gt_sds is not None else None
+                return decode_chunk(
                     cfg, engine.params, engine.rope, engine.cache, tok, pos,
                     key, n_steps=entry.size, temperature=0.0, topp=0.9,
                     kv_len=entry.kv_len, page_table=pt, page_size=ps,
+                    grammar_table=gtab, grammar_state=gst,
                 )
-                return jax.make_jaxpr(fn)(
-                    _sds((b,), jnp.int32), _sds((), jnp.int32), pt_sds
-                )
-            fn = lambda tok, pos: decode_chunk(
-                cfg, engine.params, engine.rope, engine.cache, tok, pos, key,
-                n_steps=entry.size, temperature=0.0, topp=0.9,
-                kv_len=entry.kv_len,
+
+            return jax.make_jaxpr(fn)(
+                _sds((b,), jnp.int32), _sds((), jnp.int32), *extra
             )
         return jax.make_jaxpr(fn)(_sds((b,), jnp.int32), _sds((), jnp.int32))
     if entry.kind == "prefill_row":
@@ -237,20 +261,30 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.batch_session import batch_decode_chunk
 
-            if engine.paged:
-                fn = lambda tok, pos, keys, temp, topp, pt: batch_decode_chunk(
+            # mirror the warm dispatch (engine._warm_batch_decode /
+            # BatchSession.step): paged adds the page table, a grammar
+            # arena adds the (mask table, [b] states) operand pair
+            gt_sds = _grammar_sds(engine)
+            extra = [pt_sds] if engine.paged else []
+            if gt_sds is not None:
+                extra += [gt_sds, _sds((b,), jnp.int32)]
+
+            def fn(tok, pos, keys, temp, topp, *ops):
+                it = iter(ops)
+                pt = next(it) if engine.paged else None
+                gtab = next(it) if gt_sds is not None else None
+                gst = next(it) if gt_sds is not None else None
+                return batch_decode_chunk(
                     cfg, engine.params, engine.rope, engine.cache, tok, pos,
                     keys, temp, topp, n_steps=entry.size, kv_len=entry.kv_len,
                     page_table=pt, page_size=ps,
+                    grammar_table=gtab, grammar_state=gst,
                 )
-                return jax.make_jaxpr(fn)(
-                    _sds((b,), jnp.int32), _sds((b,), jnp.int32),
-                    _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
-                    _sds((b,), jnp.float32), pt_sds,
-                )
-            fn = lambda tok, pos, keys, temp, topp: batch_decode_chunk(
-                cfg, engine.params, engine.rope, engine.cache, tok, pos,
-                keys, temp, topp, n_steps=entry.size, kv_len=entry.kv_len,
+
+            return jax.make_jaxpr(fn)(
+                _sds((b,), jnp.int32), _sds((b,), jnp.int32),
+                _sds((b, 2), jnp.uint32), _sds((b,), jnp.float32),
+                _sds((b,), jnp.float32), *extra,
             )
         return jax.make_jaxpr(fn)(
             _sds((b,), jnp.int32), _sds((b,), jnp.int32),
@@ -310,17 +344,27 @@ def trace_entry(engine, entry: LadderEntry):
         else:
             from ..runtime.speculative import verify_chunk
 
-            if engine.paged:
-                fn = lambda toks, pos, pt: verify_chunk(
+            # mirror engine._dispatch_verify: on a grammar-capable engine
+            # the verify program ALWAYS carries the mask-table pair, with
+            # per-position [b, t] states (drafts advance the DFA in-graph)
+            gt_sds = _grammar_sds(engine)
+            extra = [pt_sds] if engine.paged else []
+            if gt_sds is not None:
+                extra += [gt_sds, _sds((b, entry.size), jnp.int32)]
+
+            def fn(toks, pos, *ops):
+                it = iter(ops)
+                pt = next(it) if engine.paged else None
+                gtab = next(it) if gt_sds is not None else None
+                gst = next(it) if gt_sds is not None else None
+                return verify_chunk(
                     cfg, engine.params, engine.rope, engine.cache, toks, pos,
                     kv_len=entry.kv_len, page_table=pt, page_size=ps,
+                    grammar_table=gtab, grammar_state=gst,
                 )
-                return jax.make_jaxpr(fn)(
-                    _sds((b, entry.size), jnp.int32), pos_sds, pt_sds
-                )
-            fn = lambda toks, pos: verify_chunk(
-                cfg, engine.params, engine.rope, engine.cache, toks, pos,
-                kv_len=entry.kv_len,
+
+            return jax.make_jaxpr(fn)(
+                _sds((b, entry.size), jnp.int32), pos_sds, *extra
             )
         return jax.make_jaxpr(fn)(_sds((b, entry.size), jnp.int32), pos_sds)
     if entry.kind in ("prefix_extract", "prefix_copy", "prefix_copy_row"):
@@ -691,6 +735,15 @@ def donation_problems(engine) -> list:
             else None
         )
         ps = engine.page_size
+        # grammar-capable engines serve the MASKED program class (the
+        # operand pair is part of every decode/batch_decode dispatch) —
+        # donation must be proven on that class, not the grammar-less twin
+        gt = (
+            jnp.zeros(engine.grammar.table.shape, jnp.int32)
+            if getattr(engine, "grammar", None) is not None
+            else None
+        )
+        gsb = jnp.zeros((b,), jnp.int32) if gt is not None else None
         check(
             "forward",
             forward.lower(
@@ -704,6 +757,7 @@ def donation_problems(engine) -> list:
                 cfg, engine.params, engine.rope, engine.cache, tokb, pos,
                 key, n_steps=1, temperature=0.0, topp=0.9, kv_len=kvb,
                 page_table=pt, page_size=ps,
+                grammar_table=gt, grammar_state=gsb,
             ),
         )
         if engine.paged:
@@ -725,6 +779,7 @@ def donation_problems(engine) -> list:
                     jnp.zeros((b,), jnp.int32), jnp.zeros((b, 2), jnp.uint32),
                     jnp.zeros((b,), jnp.float32), jnp.full((b,), 0.9, jnp.float32),
                     n_steps=1, kv_len=kvb, page_table=pt, page_size=ps,
+                    grammar_table=gt, grammar_state=gsb,
                 ),
             )
             if not engine.paged:
@@ -980,6 +1035,14 @@ def add_engine_args(p) -> None:
         "must match the float twin's (default: the compute-dtype default)",
     )
     p.add_argument(
+        "--grammar", action="store_true",
+        help="audit the MASKED program ladder: build the grammar "
+        "mask-table arena (runtime/grammar.py) so every decode/verify "
+        "program carries the [S, V] table + per-row state operands — the "
+        "class grammar-capable servers actually dispatch; the masked-vs-"
+        "unmasked equivalence axis lives in analysis/graph_diff.py",
+    )
+    p.add_argument(
         "--pp", type=int, default=1,
         help="audit on a pipeline-parallel mesh of this extent (needs that "
         "many devices — CI uses xla_force_host_platform_device_count); "
@@ -1023,6 +1086,10 @@ def engine_from_args(args, workdir: str):
         speculative=args.speculative, draft_k=args.draft_k,
         kv_layout=args.kv_layout, mesh=mesh,
         cache_dtype=args.kv_dtype,
+        # None keeps the library env-or-off default, so DLT_GRAMMAR=1
+        # experiments still reach the engine; the goldens stay keyed by
+        # the RESULT (config_key's _grS suffix), never the flag
+        grammar=True if getattr(args, "grammar", False) else None,
     )
 
 

@@ -24,7 +24,12 @@ Two static gates on top of the canonical fingerprints
     decode kernel} minus the HLO pool gathers (ops/kv_quant.py, PR 17) —
     with zero pool gathers when the fused kernel is active;
   - verify_k = prefill twin of the same shape + {argmax fusion}
-    (runtime/speculative.py) — same collectives, same dot census.
+    (runtime/speculative.py) — same collectives, same dot census;
+  - masked = unmasked + {mask-table gathers + comparison/where selects}
+    (runtime/grammar.py, PR 20) — grammar-constrained decoding may add
+    ONLY the [S, V] table lookups and the select that pins illegal
+    logits: no new dot, no new collective, and the prefill family
+    (which never samples) must be bit-identical.
 
   Any undeclared primitive, extra collective, changed dot-dtype census,
   reintroduced pool gather, or lost cache donation fails with a diff
@@ -96,10 +101,17 @@ def config_key(engine) -> str:
     # decode kernel becomes CPU-eligible) — a different program family,
     # hence a different golden file
     pi = "_pi" if getattr(cfg, "pallas_interpret", False) else ""
+    # a grammar arena threads the [S, V] mask-table + state operands into
+    # every decode/verify program (runtime/grammar.py) — a different
+    # program family, keyed by the arena's state capacity (the table
+    # operand's shape, hence part of every masked fingerprint)
+    gr = ""
+    if getattr(engine, "grammar", None) is not None:
+        gr = f"_gr{engine.grammar.n_states}"
     return (
         f"{layout}_{kv}_{compute}_b{engine.batch}"
         f"_c{engine.max_chunk}_d{engine.decode_chunk_size}"
-        f"_{spec}_{pfx}_{mesh}{pi}"
+        f"_{spec}_{pfx}_{mesh}{pi}{gr}"
     )
 
 
@@ -296,10 +308,30 @@ VERIFY_VS_PREFILL = TransformSpec(
     ),
 )
 
+#: masked = unmasked + the grammar constraint machinery, per decode step:
+#: the mask lookup (table[state] gather -> `>= 0` legality -> select_n
+#: pinning illegal logits to -inf) and the in-graph DFA advance
+#: (table[state, tok] gather -> `< 0` free-row guard -> select_n), plus
+#: the scan-carry plumbing (broadcast/concatenate/pjit) threading the
+#: state vector. NOTHING may be removed, and the dot census + collective
+#: multiset are pinned — masking is pure logits post-processing; an MXU
+#: or interconnect delta would mean the mask leaked into the forward.
+MASKED_VS_UNMASKED = TransformSpec(
+    name="masked-vs-unmasked",
+    allowed_added=frozenset(
+        {
+            "gather", "ge", "lt", "add", "select_n",
+            "broadcast_in_dim", "concatenate", "pjit",
+        }
+    ),
+    allowed_removed=frozenset(),
+)
+
 DECLARED_SPECS = {
     "paged": PAGED_VS_CONTIGUOUS,
     "int8": INT8_VS_F32,
     "verify": VERIFY_VS_PREFILL,
+    "masked": MASKED_VS_UNMASKED,
 }
 
 
@@ -406,6 +438,48 @@ def prove_variant_pair(base_engine, variant_engine, spec: TransformSpec) -> list
     return problems
 
 
+def prove_masked_twin(base_engine, masked_engine) -> list:
+    """Prove the grammar-capable engine's warm ladder equivalent to the
+    grammar-less twin's modulo MASKED_VS_UNMASKED. Two clauses sharpen the
+    generic variant proof: the ladder itself must be identical (masking
+    adds operands to existing programs, never new programs), and the
+    prefill family — which never samples — must be BIT-identical, not
+    merely delta-clean."""
+    spec = MASKED_VS_UNMASKED
+    if getattr(masked_engine, "grammar", None) is None:
+        return [
+            f"{spec.name}: variant engine built no grammar arena "
+            "(grammar-constrained decoding is single-chip device-decode "
+            "only) — nothing to prove"
+        ]
+    entries, unshared = _provable_entries(base_engine, masked_engine)
+    problems = []
+    if unshared:
+        problems.append(
+            f"{spec.name}: masking changed the warm ladder itself "
+            f"(unshared programs: {unshared}) — the arena must only add "
+            "operands to existing programs"
+        )
+    for entry in entries:
+        bf = fingerprint(ga.trace_entry(base_engine, entry))
+        vf = fingerprint(ga.trace_entry(masked_engine, entry))
+        if entry.kind in ("prefill", "prefill_row"):
+            if bf.hash != vf.hash:
+                problems.append(
+                    f"{spec.name} {entry_key(entry)}: prefill program "
+                    "changed under masking — prefill never samples, the "
+                    "mask operands must not reach it:\n      "
+                    + "\n      ".join(diff_fingerprints(bf, vf))
+                )
+            continue
+        problems += prove_delta(spec, bf, vf, entry_key(entry))
+    # masking must not cost the cache donation either (the masked scan
+    # carries the state vector through the same donated-cache loop)
+    for p in ga.donation_problems(masked_engine):
+        problems.append(f"{spec.name}: {p}")
+    return problems
+
+
 def prove_verify_twin(engine) -> list:
     """Prove every speculative verify program equivalent to a prefill twin
     of the same (size, kv) shape, modulo VERIFY_VS_PREFILL. The twin is
@@ -472,9 +546,11 @@ def main(argv=None) -> int:
         "a golden",
     )
     p.add_argument(
-        "--prove", choices=["paged", "int8", "verify", "all"], default=None,
+        "--prove",
+        choices=["paged", "int8", "verify", "masked", "all"], default=None,
         help="differential equivalence proof: paged-vs-contiguous, "
-        "int8-vs-f32 (paged), verify-vs-prefill twins, or all three",
+        "int8-vs-f32 (paged), verify-vs-prefill twins, "
+        "masked-vs-unmasked (grammar), or all of them",
     )
     args = p.parse_args(argv)
     if not (args.bless or args.coverage or args.prove):
@@ -511,7 +587,23 @@ def main(argv=None) -> int:
                 list(DECLARED_SPECS) if args.prove == "all" else [args.prove]
             )
         for mode in proofs:
-            if mode == "verify":
+            if mode == "masked" and (args.pp > 1 or args.tp > 1):
+                # grammar-constrained decoding is single-chip only — on a
+                # mesh config there is no masked ladder to prove
+                print("🔎 prove masked-vs-unmasked: skipped (mesh config; "
+                      "grammar is single-chip device-decode)")
+                continue
+            if mode == "masked":
+                base = ga.engine_from_args(
+                    _clone_args(args, grammar=False), d
+                )
+                var = ga.engine_from_args(_clone_args(args, grammar=True), d)
+                try:
+                    got = prove_masked_twin(base, var)
+                finally:
+                    base.close()
+                    var.close()
+            elif mode == "verify":
                 e = ga.engine_from_args(
                     _clone_args(args, speculative="ngram"), d
                 )

@@ -248,6 +248,16 @@ def make_engine(args) -> InferenceEngine:
                 "pipeline meshes: this topology keeps the contiguous KV layout"
             )
             kv_layout = "contiguous"
+    from .runtime.grammar import resolve_grammar_enabled
+
+    # grammar-constrained decoding (runtime/grammar.py): ON by default for
+    # the CLI/server entry points wherever it can actually serve — single-
+    # chip device-decode, like speculation and the prefix cache the arena
+    # composes with. Other topologies default off (an explicit DLT_GRAMMAR=1
+    # still reaches the engine, which warns and serves unconstrained);
+    # library engines constructed directly keep the env-or-off default.
+    gr_capable = mesh is None and not getattr(args, "host_decode", False)
+    grammar = resolve_grammar_enabled(None, default="1" if gr_capable else "0")
     try:
         engine = InferenceEngine(
             args.model,
@@ -266,6 +276,7 @@ def make_engine(args) -> InferenceEngine:
             kv_layout=kv_layout,
             kv_page_size=getattr(args, "kv_page_size", 0) or None,
             kv_pool_mb=getattr(args, "kv_pool_mb", 0) or None,
+            grammar=grammar,
         )
     except BaseException:
         # the main engine failed to build: release the draft engine's

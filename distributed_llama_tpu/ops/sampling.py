@@ -18,6 +18,31 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+#: additive mask for grammar-illegal tokens: large enough that exp()
+#: underflows to exactly 0 in every compute dtype, small enough to stay
+#: finite in bfloat16
+_MASKED = -1e30
+
+
+def apply_grammar_mask(
+    logits: jnp.ndarray,  # [..., vocab]
+    grammar_table: jnp.ndarray | None,  # [S, vocab] int32; -1 = illegal
+    grammar_state: jnp.ndarray | None,  # [...] int32 global DFA states
+) -> jnp.ndarray:
+    """Mask grammar-illegal tokens to -1e30 BEFORE any sampling branch.
+
+    One gather keyed by the per-row grammar-state operand derives the
+    boolean legality row (``table[state] >= 0``); unconstrained rows ride
+    the arena's all-legal FREE state, so the masked program computes
+    bit-identical logits for them and ONE warm program serves every
+    constrained/unconstrained mix (runtime/grammar.py). With no grammar
+    operands (grammar disabled at engine build) this is the identity —
+    the traced program is unchanged."""
+    if grammar_table is None or grammar_state is None:
+        return logits
+    legal = grammar_table[grammar_state] >= 0  # [..., vocab] bool
+    return jnp.where(legal, logits, jnp.asarray(_MASKED, logits.dtype))
+
 
 def sample_logits(
     logits: jnp.ndarray,  # [b, vocab] f32
@@ -67,6 +92,8 @@ def sample_logits_traced(
     key: jnp.ndarray,
     temperature: jnp.ndarray,  # traced scalar; <= 0 = greedy
     topp: jnp.ndarray,  # traced scalar; outside (0, 1) = full distribution
+    grammar_table: jnp.ndarray | None = None,  # [S, vocab] int32 arena
+    grammar_state: jnp.ndarray | None = None,  # [b] int32 global DFA states
 ) -> jnp.ndarray:
     """`sample_logits` with TRACED temperature/top-p scalars: ONE compiled
     program serves every sampling setting, so a sampled request can never
@@ -79,7 +106,10 @@ def sample_logits_traced(
     the exact argmax chain (bit-identical to the old static program at
     temperature 0); the top-p arm draws the same single
     `uniform(key, (b, 1))` the static program's 0 < topp < 1 branch drew,
-    so seeded top-p streams carry over too."""
+    so seeded top-p streams carry over too. Grammar operands (when the
+    engine threads them) mask illegal tokens BEFORE the cond, so both arms
+    sample from the constrained distribution."""
+    logits = apply_grammar_mask(logits, grammar_table, grammar_state)
 
     def greedy_arm(logits, key, temperature, topp):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -130,6 +160,8 @@ def sample_logits_per_row(
     subkeys_data: jnp.ndarray,  # [b, 2] uint32 per-row key states
     temperature: jnp.ndarray,  # [b] f32; <= 0 means greedy for that row
     topp: jnp.ndarray,  # [b] f32; outside (0, 1) means full-distribution
+    grammar_table: jnp.ndarray | None = None,  # [S, vocab] int32 arena
+    grammar_state: jnp.ndarray | None = None,  # [b] int32 global DFA states
 ) -> jnp.ndarray:
     """Per-row sampling parameters as TRACED vectors: one compiled program
     serves any mix of greedy/temperature/top-p rows (continuous batching
@@ -138,7 +170,10 @@ def sample_logits_per_row(
     structure — greedy / full-distribution vocab-order CDF / top-p
     sorted-order CDF — but the RNG structure necessarily differs (per-row
     key chains vs one shared key), so streams only reproduce against other
-    per-row-keyed runs with the same per-row key."""
+    per-row-keyed runs with the same per-row key. Grammar operands mask
+    illegal tokens up front, so every branch — greedy included — samples
+    from the constrained distribution."""
+    logits = apply_grammar_mask(logits, grammar_table, grammar_state)
     b, n = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 

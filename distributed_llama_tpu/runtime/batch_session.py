@@ -73,26 +73,41 @@ def batch_decode_chunk(
     kv_len: int | None = None,
     page_table: jnp.ndarray | None = None,  # paged KV layout (paged_kv.py)
     page_size: int | None = None,
+    grammar_table: jnp.ndarray | None = None,  # [S, vocab] int32 grammar
+    # arena (runtime/grammar.py); constrained rows carry their global DFA
+    # state, unconstrained rows ride the all-legal FREE state 0
+    grammar_state: jnp.ndarray | None = None,  # [b] int32
 ):
     """n_steps decode iterations with everything per-row and TRACED — one
     compiled program per (batch, n_steps, kv_len) serves any mix of
-    greedy/sampled/seeded rows. Returns (tokens [b, n_steps], cache, keys)."""
+    greedy/sampled/seeded rows (and, with grammar operands, any mix of
+    constrained/unconstrained rows). Returns (tokens [b, n_steps], cache,
+    keys) — plus the final grammar states when the operands are threaded."""
 
     def step(carry, _):
-        token, pos, cache, keys = carry
+        token, pos, cache, keys, gstate = carry
         logits, cache = forward_uncompiled(
             cfg, params, rope, cache, token[:, None], pos,
             logits_mode="last", kv_len=kv_len,
             page_table=page_table, page_size=page_size,
         )
         keys, subs = split_row_keys(keys)
-        nxt = sample_logits_per_row(logits, subs, temperature, topp)
-        return (nxt, pos + 1, cache, keys), nxt
+        nxt = sample_logits_per_row(
+            logits, subs, temperature, topp,
+            grammar_table=grammar_table, grammar_state=gstate,
+        )
+        if gstate is not None:
+            adv = grammar_table[gstate, nxt]
+            gstate = jnp.where(adv < 0, gstate, adv)
+        return (nxt, pos + 1, cache, keys, gstate), nxt
 
-    (_, _, cache, keys), toks = jax.lax.scan(
-        step, (token, pos, cache, keys), None, length=n_steps
+    (_, _, cache, keys, gout), toks = jax.lax.scan(
+        step, (token, pos, cache, keys, grammar_state), None, length=n_steps
     )
-    return jnp.transpose(toks, (1, 0)), cache, keys
+    toks = jnp.transpose(toks, (1, 0))
+    if grammar_state is not None:
+        return toks, cache, keys, gout
+    return toks, cache, keys
 
 
 @partial(jax.jit, static_argnames=("cfg", "kv_len"), donate_argnames=("cache",))
@@ -160,6 +175,10 @@ class BatchSession:
         self.temp = np.zeros((b,), np.float32)
         self.topp = np.full((b,), 0.9, np.float32)
         self.keys = np.zeros((b, 2), np.uint32)
+        # per-row GrammarSession (runtime/grammar.py) or None; the session
+        # object is SHARED with the request owner (the Batcher advances it
+        # per accepted token), this list only feeds the device state operand
+        self.grammars: list = [None] * b
         self._admits = 0  # distinguishes unseeded admissions' default keys
         # rows mid-admission: prompt + prefill progress, armed on completion
         # (begin_admit / prefill_pending — the Batcher's interleaved path)
@@ -198,12 +217,16 @@ class BatchSession:
         topp: float = 0.9,
         key_data=None,  # (hi, lo) uint32 pair; None derives from the row+pos
         trace=None,
+        grammar=None,
     ) -> None:
         """Prefill `prompt_tokens[:-1]` into `row` and arm the slot in one
         call (begin_admit + an unbounded prefill_pending). The row starts
         decoding on the next `step` call — admission latency is one prefill
         plus at most one in-flight chunk boundary."""
-        self.begin_admit(row, prompt_tokens, temperature, topp, key_data, trace)
+        self.begin_admit(
+            row, prompt_tokens, temperature, topp, key_data, trace,
+            grammar=grammar,
+        )
         self.prefill_pending(row)
 
     def begin_admit(
@@ -215,6 +238,7 @@ class BatchSession:
         key_data=None,
         trace=None,  # runtime/tracing.py Trace for this request (None = untraced):
         # admission-prefill chunks and the splice emit span events into it
+        grammar=None,  # GrammarSession constraining this row (None = free)
     ) -> None:
         """Stage an admission without running its prefill: the prompt then
         advances in bounded chunks via `prefill_pending`, scheduled by the
@@ -263,12 +287,15 @@ class BatchSession:
                     int((time.perf_counter() - t_match) * 1e6),
                     ("resume_tokens", "row"), (resume, row),
                 )
+        if grammar is not None and self.engine.grammar is None:
+            raise ValueError("this engine was built without a grammar arena")
         self._pending[row] = {
             "tokens": list(prompt_tokens),
             "done": 0,  # prefilled prefix length within tokens[:-1]
             "temperature": temperature,
             "topp": topp,
             "key_data": key_data,
+            "grammar": grammar,
             "resume": resume,  # chunk-bucket-aligned prefix-cache boundary
             "entry": entry,  # pinned PrefixEntry to splice, or None
             "trace": trace,
@@ -382,6 +409,7 @@ class BatchSession:
             self.temp[row] = st["temperature"]
             self.topp[row] = st["topp"]
             self.keys[row] = np.asarray(st["key_data"], np.uint32)  # dlt: allow(host-sync) — host tuple, no device source
+            self.grammars[row] = st["grammar"]
             self.active[row] = True
             del self._pending[row]
             if eng.prefix_cache is not None and not eng._in_warmup:
@@ -405,6 +433,7 @@ class BatchSession:
         self.active[row] = False
         self.pos[row] = self.seq_len
         self.temp[row] = 0.0  # greedy is the cheap sampling path for junk
+        self.grammars[row] = None  # the session's OWNER closes it
         st = self._pending.pop(row, None)
         if st is not None and st.get("entry") is not None:
             self.engine.prefix_cache.entry_release(st["entry"])
@@ -468,7 +497,10 @@ class BatchSession:
                 f"verify round would overrun seq_len={self.seq_len}: "
                 f"max row end {max(ends)} (draft bucket {K})"
             )
-        out = verify_row_round(eng, drafts, self.token, self.pos, self.seq_len)
+        out = verify_row_round(
+            eng, drafts, self.token, self.pos, self.seq_len,
+            grammars=self.grammars,
+        )
         for r, emitted in out.items():
             self.pos[r] += len(emitted)
             self.token[r] = emitted[-1]
@@ -516,6 +548,27 @@ class BatchSession:
                     token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
                     page_table=eng._pt_operand() if eng.paged else None,
                     page_size=eng.page_size,
+                )
+            elif eng.grammar is not None:
+                # grammar-capable engine: the SAME warm program serves
+                # constrained and free rows — the state vector (FREE 0 for
+                # unconstrained rows) is just another small operand. The
+                # in-graph final states are discarded: the host sessions
+                # are authoritative and re-advance from the fetched tokens
+                # before the next step is dispatched.
+                gr_state = jnp.asarray(
+                    np.fromiter(
+                        (g.row_state if g is not None else 0 for g in self.grammars),
+                        np.int32,
+                        count=len(self.grammars),
+                    )
+                )
+                toks, eng.cache, keys, _ = batch_decode_chunk(
+                    eng.cfg, eng.params, eng.rope, eng.cache,
+                    token, pos, keys, temp, topp, n_steps=n_steps, kv_len=kv_len,
+                    page_table=eng._pt_operand() if eng.paged else None,
+                    page_size=eng.page_size,
+                    grammar_table=eng._gr_operand(), grammar_state=gr_state,
                 )
             else:
                 toks, eng.cache, keys = batch_decode_chunk(

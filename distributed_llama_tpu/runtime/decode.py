@@ -53,6 +53,9 @@ def decode_chunk(
     page_table: jnp.ndarray | None = None,  # paged KV layout: [b, slots]
     # int32 (runtime/paged_kv.py); cache is then the page pools
     page_size: int | None = None,
+    grammar_table: jnp.ndarray | None = None,  # [S, vocab] int32 grammar
+    # arena (runtime/grammar.py): masks illegal tokens before sampling
+    grammar_state: jnp.ndarray | None = None,  # [b] int32 global DFA states
 ):
     """Run n_steps feed-forward+sample iterations on device.
 
@@ -61,21 +64,37 @@ def decode_chunk(
     without issuing a separate slice op — through the driver tunnel every
     host-issued device op costs a round trip, and the decode loop's per-chunk
     op count is the serving overhead floor.
+
+    With grammar operands the per-row DFA state rides the scan carry —
+    advanced in-graph from each sampled token, so intra-chunk masking needs
+    no host round trip — and the final states are returned as a 4th output
+    for the engine's lookahead dispatch to chain (like `last_token`).
     """
     temperature = jnp.asarray(temperature, jnp.float32)
     topp = jnp.asarray(topp, jnp.float32)
 
     def step(carry, _):
-        token, pos, cache, key = carry
+        token, pos, cache, key, gstate = carry
         logits, cache = forward_uncompiled(
             cfg, params, rope, cache, token[:, None], pos, logits_mode="last",
             kv_len=kv_len, page_table=page_table, page_size=page_size,
         )
         key, sub = jax.random.split(key)
-        nxt = sample_logits_traced(logits, sub, temperature, topp)
-        return (nxt, pos + 1, cache, key), nxt
+        nxt = sample_logits_traced(
+            logits, sub, temperature, topp,
+            grammar_table=grammar_table, grammar_state=gstate,
+        )
+        if gstate is not None:
+            adv = grammar_table[gstate, nxt]
+            gstate = jnp.where(adv < 0, gstate, adv)
+        return (nxt, pos + 1, cache, key, gstate), nxt
 
-    (last, _, cache, _), toks = jax.lax.scan(
-        step, (token, jnp.asarray(pos_start, jnp.int32), cache, key), None, length=n_steps
+    (last, _, cache, _, gout), toks = jax.lax.scan(
+        step,
+        (token, jnp.asarray(pos_start, jnp.int32), cache, key, grammar_state),
+        None, length=n_steps,
     )
-    return jnp.transpose(toks, (1, 0)), last, cache
+    toks = jnp.transpose(toks, (1, 0))
+    if grammar_state is not None:
+        return toks, last, cache, gout
+    return toks, last, cache

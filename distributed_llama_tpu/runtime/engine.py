@@ -206,6 +206,13 @@ class InferenceEngine:
         # (bucketed at {4, 8}). None = DLT_DRAFT_K env, default 4
         draft_source=None,  # DraftSource override; REQUIRED for "model"
         # (a speculative.ModelDraft wrapping the smaller draft engine)
+        grammar: bool | None = None,  # build the grammar mask-table arena
+        # (runtime/grammar.py) so /v1/chat response_format constrained
+        # decoding runs as a traced operand pair on the ordinary warm
+        # programs. None = DLT_GRAMMAR env (default off for library
+        # engines; the server entry point defaults it on). Single-chip
+        # device-decode only — mesh/host-decode engines warn-fallback to
+        # unconstrained, like the int8-KV topology gate
         kv_layout: str | None = None,  # "contiguous" (per-row seq_len KV
         # slabs — the reference shape and the bit-identity A/B arm) or
         # "paged" (fixed-size KV pages + per-row page tables, zero-copy
@@ -420,6 +427,30 @@ class InferenceEngine:
         # draft/verify/acceptance summary of the most recent speculative
         # generate (bench.py reads it; mirrors last_prefill_timing)
         self.last_spec_timing: dict | None = None
+        # grammar-constrained decoding (runtime/grammar.py): ONE device
+        # mask-table arena serves every live grammar as a traced
+        # (table, state) operand pair on the ordinary warm programs —
+        # installing a grammar bumps arena.version (a re-upload), never
+        # re-traces. Single-chip device-decode only for now: the pipeline
+        # programs and the per-token host loop don't thread the operands,
+        # so other topologies warn-fallback (a capability hint, not a
+        # topology contract — same shape as the int8-KV gate above).
+        from .grammar import GrammarArena, resolve_grammar_enabled
+
+        self.grammar = None
+        self._gr_cache = None  # (arena.version, device table) — the cached
+        # grammar mask-table operand; invalidated by any arena mutation
+        if resolve_grammar_enabled(grammar):
+            if mesh is not None or not device_decode:
+                import warnings
+
+                warnings.warn(
+                    "grammar-constrained decoding is single-chip "
+                    "device-decode only; this engine serves unconstrained",
+                    stacklevel=2,
+                )
+            else:
+                self.grammar = GrammarArena(self.cfg.vocab_size)
         self._in_warmup = False
         # engine lifetime anchor: the device-duty-cycle gauge (profiling
         # .roofline_view) reports busy-time as a fraction of this span
@@ -732,6 +763,16 @@ class InferenceEngine:
             self._pt_cache = (pool.version, dev)
         return self._pt_cache[1]
 
+    def _gr_operand(self):
+        """The device grammar mask-table operand (the GrammarArena's one
+        [S, V] int32 table), re-uploaded only when the arena's version
+        moved — a grammar install/evict is one host->device transfer, a
+        steady-state dispatch is zero (the `_pt_operand` discipline)."""
+        ar = self.grammar
+        if self._gr_cache is None or self._gr_cache[0] != ar.version:
+            self._gr_cache = (ar.version, jax.device_put(ar.table))
+        return self._gr_cache[1]
+
     def _ensure_pages(self, spans) -> None:
         """Make every (row, start, end) span privately writable before a
         dispatch writes it: allocates unmapped slots, replaces shared pages
@@ -885,7 +926,7 @@ class InferenceEngine:
                 with self._sanitizer_scope(), self._guard(
                     f"decode[{size}]", ("decode", size, kvb)
                 ):
-                    _, last, self.cache = self._decode_chunk_any(
+                    _, last, self.cache, _ = self._decode_chunk_any(
                         jnp.zeros((self.batch,), jnp.int32), jnp.int32(pos),
                         key, n_steps=size, temperature=0.0, topp=0.9,
                         kv_len=kvb,
@@ -897,7 +938,7 @@ class InferenceEngine:
                         # key — warming only the fresh host operand left
                         # that signature cold (a post-seal recompile on the
                         # first mid-stream chunk of every new size)
-                        _, _, self.cache = self._decode_chunk_any(
+                        _, _, self.cache, _ = self._decode_chunk_any(
                             last, jnp.int32(pos), key, n_steps=size,
                             temperature=0.0, topp=0.9, kv_len=kvb,
                         )
@@ -1094,6 +1135,22 @@ class InferenceEngine:
                 kv_len=kv_len,
                 page_table=self._pt_operand() if self.paged else None,
                 page_size=self.page_size,
+            )
+        elif self.grammar is not None:
+            from .batch_session import batch_decode_chunk
+
+            # the grammar operands are part of the compiled shape too
+            # (same rule as the paged operands below): BatchSession.step
+            # always threads them on a grammar-capable engine, so the warm
+            # program must carry them
+            _, self.cache, _, _ = batch_decode_chunk(
+                self.cfg, self.params, self.rope, self.cache,
+                token, pos_vec, keys, temp, topp, n_steps=n_steps,
+                kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
+                grammar_table=self._gr_operand(),
+                grammar_state=jnp.zeros((b,), jnp.int32),
             )
         else:
             from .batch_session import batch_decode_chunk
@@ -1356,39 +1413,67 @@ class InferenceEngine:
                 pc.publish_from_row(self, 0, tokens)
 
     def _decode_chunk_any(
-        self, token, pos, key, n_steps, temperature, topp, kv_len=None
+        self, token, pos, key, n_steps, temperature, topp, kv_len=None,
+        gr_state=None,
     ):
         """One on-device decode chunk on whichever execution path this
-        engine uses; returns (tokens [b, n], last_token [b], cache). `pos`
-        may be a scalar or a [b] per-row position vector (independent
-        sequences); both paths accept either."""
+        engine uses; returns (tokens [b, n], last_token [b], cache,
+        gr_out). `pos` may be a scalar or a [b] per-row position vector
+        (independent sequences); both paths accept either.
+
+        This is the ONE choke point for the grammar operand pair: a
+        grammar-capable engine threads (mask table, [b] states) into EVERY
+        decode dispatch — `gr_state=None` rides the all-legal FREE zeros,
+        so unconstrained traffic shares the same warm program — and
+        `gr_out` is the chunk's final device state vector for lookahead
+        callers to chain, like `last_token` (None on grammar-less engines
+        and the pipeline path, where the arena is gated off)."""
         if self.use_pipeline:
             from ..parallel.pipeline import pipeline_decode_chunk
 
-            return pipeline_decode_chunk(
+            toks, last, cache = pipeline_decode_chunk(
                 self.cfg, self.mesh, self.params, self.rope, self.cache,
                 token, pos, key, n_steps=n_steps, temperature=temperature,
                 topp=topp, kv_len=kv_len,
                 page_table=self._pt_operand() if self.paged else None,
                 page_size=self.page_size,
             )
+            return toks, last, cache, None
         from .decode import decode_chunk
 
+        if self.grammar is None:
+            toks, last, cache = decode_chunk(
+                self.cfg, self.params, self.rope, self.cache, token, pos,
+                key, n_steps=n_steps, temperature=temperature, topp=topp,
+                kv_len=kv_len,
+                page_table=self._pt_operand() if self.paged else None,
+                page_size=self.page_size,
+            )
+            return toks, last, cache, None
+        if gr_state is None:
+            gr_state = np.zeros((self.batch,), np.int32)
         return decode_chunk(
             self.cfg, self.params, self.rope, self.cache, token, pos, key,
             n_steps=n_steps, temperature=temperature, topp=topp, kv_len=kv_len,
             page_table=self._pt_operand() if self.paged else None,
             page_size=self.page_size,
+            grammar_table=self._gr_operand(), grammar_state=gr_state,
         )
 
-    def _dispatch_verify(self, tokens_np, pos, kv_len: int):
+    def _dispatch_verify(self, tokens_np, pos, kv_len: int, gr_states=None):
         """Dispatch one speculative verify forward (runtime/speculative.py):
         a prefill-shaped pass over [last_token, drafts...] returning logits
         at EVERY position plus their greedy argmax. `pos` is a host scalar
         (solo: rows aligned — the ("verify", size, kvb) program) or a [b]
         vector (per-row positions, parked rows at seq_len — the
         ("verify_row", ...) program). Dispatch-only: the caller fetches the
-        ids. Returns (ids_dev [b, t], logits_dev [b, t, vocab])."""
+        ids. Returns (ids_dev [b, t], logits_dev [b, t, vocab]).
+
+        On a grammar-capable engine the verify program ALWAYS carries the
+        mask-table operand pair: `gr_states` is [b, t] int32 per-position
+        global DFA states (None rides all-FREE zeros), and the returned
+        argmax chain is over MASKED logits — greedy acceptance can never
+        admit a grammar-illegal token, bonus position included."""
         per_row = np.ndim(pos) != 0
         if self.paged:
             # the verify feed writes positions [pos, pos + t) per live row
@@ -1431,11 +1516,20 @@ class InferenceEngine:
             return ids, logits
         from .speculative import verify_chunk
 
+        gr_table = gr_dev = None
+        if self.grammar is not None:
+            if gr_states is None:
+                gr_states = np.zeros(np.shape(tokens_np), np.int32)
+            gr_table = self._gr_operand()
+            # callers hand int32 ndarrays (verify_row_round / the solo
+            # verify path build them that way) — upload as-is, no cast
+            gr_dev = jax.device_put(gr_states)
         ids, logits, self.cache = verify_chunk(
             self.cfg, self.params, self.rope, self.cache, toks_dev, pos_dev,
             kv_len=kv_len,
             page_table=self._pt_operand() if self.paged else None,
             page_size=self.page_size,
+            grammar_table=gr_table, grammar_state=gr_dev,
         )
         return ids, logits
 
@@ -1459,6 +1553,10 @@ class InferenceEngine:
         on_token=None,
         stop_fn=None,
         pos_start: int = 0,
+        grammar=None,  # runtime/grammar.py GrammarSession: constrain this
+        # generation to the session's DFA (masked sampling + masked
+        # speculative verify); the session is advanced host-side from every
+        # emitted token and a terminal state stops like EOS
     ) -> GenerationResult:
         """The reference `inference()` loop (dllama.cpp:13-151): prefill all
         but the last prompt token, then decode until position `steps` or
@@ -1467,6 +1565,11 @@ class InferenceEngine:
         """
         if not prompt_tokens:
             raise ValueError("prompt tokens required")
+        if grammar is not None and self.grammar is None:
+            raise ValueError(
+                "this engine was built without a grammar arena "
+                "(grammar=True / DLT_GRAMMAR=1, single-chip device-decode)"
+            )
         if pos_start + len(prompt_tokens) > self.cfg.seq_len:
             raise ValueError("prompt is longer than the sequence length")
         res = GenerationResult(tokens=list(prompt_tokens), n_prompt_tokens=len(prompt_tokens))
@@ -1506,10 +1609,14 @@ class InferenceEngine:
             with self._sanitizer_scope():
                 if use_spec:
                     self._decode_speculative(
-                        res, token, pos, max_pos, on_token, stop_fn, wall0
+                        res, token, pos, max_pos, on_token, stop_fn, wall0,
+                        grammar=grammar,
                     )
                 else:
-                    self._decode_device(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
+                    self._decode_device(
+                        res, token, pos, max_pos, sampler, on_token, stop_fn,
+                        wall0, grammar=grammar,
+                    )
         else:
             self._decode_host(res, token, pos, max_pos, sampler, on_token, stop_fn, wall0)
         res.total_us = int((time.perf_counter() - wall0) * 1e6)
@@ -1538,6 +1645,8 @@ class InferenceEngine:
         sampler: Sampler | None = None,
         on_token=None,  # on_token(row, token) as tokens arrive
         stop_fn=None,  # stop_fn(row, token) -> bool, per row
+        grammars=None,  # per-row GrammarSession list (None entries =
+        # unconstrained rows riding the FREE state — mixed co-batching)
     ) -> list:
         """Generate independent continuations for `len(prompts)` different
         prompts in ONE batch — each batch row is its own sequence with its
@@ -1565,6 +1674,14 @@ class InferenceEngine:
             raise ValueError(f"need exactly {self.batch} prompts, got {len(prompts)}")
         if any(len(p) == 0 for p in prompts):
             raise ValueError("empty prompt")
+        if grammars is not None:
+            if self.grammar is None and any(g is not None for g in grammars):
+                raise ValueError(
+                    "this engine was built without a grammar arena "
+                    "(grammar=True / DLT_GRAMMAR=1, single-chip device-decode)"
+                )
+            if len(grammars) != self.batch:
+                raise ValueError("per-row grammars must match the batch size")
         lens = [len(p) for p in prompts]
         if isinstance(max_new_tokens, int):
             budgets = [max_new_tokens] * self.batch
@@ -1668,12 +1785,13 @@ class InferenceEngine:
             # their warm plan (and the sentinel's sealed ladder) carries no
             # verify programs, the same gate every other spec entry has.
             self._decode_batch_speculative(
-                prompts, lens, budgets, out, on_token, stop_fn
+                prompts, lens, budgets, out, on_token, stop_fn,
+                grammars=grammars,
             )
         else:
             self._decode_batch_chunked(
                 prompts, lens, budgets, out, on_token, stop_fn, key,
-                temperature, topp,
+                temperature, topp, grammars=grammars,
             )
         if pc is not None and not self._in_warmup and pre_t > 0 and resume == 0:
             # publish the rows' common prefix (row 0's copy, capped at its
@@ -1689,7 +1807,7 @@ class InferenceEngine:
 
     def _decode_batch_chunked(
         self, prompts, lens, budgets, out, on_token, stop_fn, key,
-        temperature, topp,
+        temperature, topp, grammars=None,
     ):
         """generate_batch's chunked decode loop: one-chunk lookahead +
         worker-thread fetch, exactly like _decode_device — chunk i+1's
@@ -1708,7 +1826,17 @@ class InferenceEngine:
         total_needed = max(budgets)
         planned = 0
         key_box = [key]
-        state = {"token": token, "pos": pos}
+        # grammar chain mirrors _decode_device's: lookahead chunks consume
+        # the previous chunk's device final states (rows without a session
+        # start at FREE 0 and stay there — the all-legal self-loop)
+        gr0 = None
+        if grammars is not None and any(g is not None for g in grammars):
+            gr0 = np.fromiter(
+                (g.row_state if g is not None else 0 for g in grammars),
+                np.int32,
+                count=len(grammars),
+            )
+        state = {"token": token, "pos": pos, "gr": gr0}
 
         def dispatch_chunk():
             nonlocal planned
@@ -1741,12 +1869,15 @@ class InferenceEngine:
                     for r in range(self.batch)
                     if not done[r] and lens[r] - 1 + planned < self.cfg.seq_len
                 )
-            toks, last, self.cache = self._decode_chunk_any(
+            toks, last, self.cache, gr_out = self._decode_chunk_any(
                 state["token"], state["pos"], sub, n_steps=n,
                 temperature=temperature, topp=topp, kv_len=kvb,
+                gr_state=state["gr"],
             )
             state["token"] = last
             state["pos"] = state["pos"] + n
+            if state["gr"] is not None:
+                state["gr"] = gr_out
             planned += n
             return toks, n, kvb
 
@@ -1769,9 +1900,17 @@ class InferenceEngine:
                             continue
                         tkn = int(host[r, j])
                         out[r].append(tkn)
+                        g = grammars[r] if grammars is not None else None
+                        if g is not None:
+                            g.advance(tkn)
                         if on_token is not None:
                             on_token(r, tkn)
                         if stop_fn is not None and stop_fn(r, tkn):
+                            done[r] = True
+                        elif g is not None and (g.done or g.at_terminal):
+                            # grammar completion stops the row like EOS:
+                            # this token is delivered, the chunk tail is
+                            # ordinary overrun
                             done[r] = True
                         elif len(out[r]) >= budgets[r]:
                             done[r] = True
@@ -1783,7 +1922,9 @@ class InferenceEngine:
                 else:
                     pending = nxt
 
-    def _decode_batch_speculative(self, prompts, lens, budgets, out, on_token, stop_fn):
+    def _decode_batch_speculative(
+        self, prompts, lens, budgets, out, on_token, stop_fn, grammars=None,
+    ):
         """generate_batch's speculative decode loop (greedy batches): every
         round drafts per row from the row's OWN context, then either one
         per-row-position verify dispatch (any row drafted; rows with no
@@ -1819,15 +1960,23 @@ class InferenceEngine:
                     # the shared per-row verify round (speculative.py):
                     # one dispatch, per-row acceptance, rows advance by
                     # their own 1..K+1 emitted tokens
-                    rounds = verify_row_round(self, drafts, token, pos, seq_len)
+                    rounds = verify_row_round(
+                        self, drafts, token, pos, seq_len, grammars=grammars,
+                    )
                     for r, emitted in rounds.items():
+                        g = grammars[r] if grammars is not None else None
                         pos[r] += len(emitted)
                         token[r] = emitted[-1]
                         for t in emitted:
                             out[r].append(t)
+                            if g is not None:
+                                g.advance(t)
                             if on_token is not None:
                                 on_token(r, t)
                             if stop_fn is not None and stop_fn(r, t):
+                                done[r] = True
+                                break
+                            if g is not None and (g.done or g.at_terminal):
                                 done[r] = True
                                 break
                             if len(out[r]) >= budgets[r]:
@@ -1854,20 +2003,38 @@ class InferenceEngine:
                         self._ensure_pages(
                             (r, pos[r], pos[r] + n) for r in live
                         )
+                    gr_state = None
+                    if grammars is not None and any(
+                        g is not None for g in grammars
+                    ):
+                        gr_state = np.fromiter(
+                            (
+                                g.row_state if g is not None else 0
+                                for g in grammars
+                            ),
+                            np.int32,
+                            count=len(grammars),
+                        )
                     tok_dev, pos_dev = jax.device_put((tv, pv))
                     with self._guard(f"decode_batch[{n}]", ("decode_batch", n, kvb)):
-                        toks, _, self.cache = self._decode_chunk_any(
+                        toks, _, self.cache, _ = self._decode_chunk_any(
                             tok_dev, pos_dev, key, n_steps=n, temperature=0.0,
-                            topp=0.9, kv_len=kvb,
+                            topp=0.9, kv_len=kvb, gr_state=gr_state,
                         )
                         host = self._host_fetch(toks)
                     for r in live:
+                        g = grammars[r] if grammars is not None else None
                         for j in range(n):
                             t = int(host[r, j])
                             out[r].append(t)
+                            if g is not None:
+                                g.advance(t)
                             if on_token is not None:
                                 on_token(r, t)
                             if stop_fn is not None and stop_fn(r, t):
+                                done[r] = True
+                                break
+                            if g is not None and (g.done or g.at_terminal):
                                 done[r] = True
                                 break
                             if len(out[r]) >= budgets[r]:
@@ -1906,7 +2073,10 @@ class InferenceEngine:
             if stop_fn is not None and stop_fn(token):
                 return
 
-    def _decode_device(self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0):
+    def _decode_device(
+        self, res, token, pos, max_pos, sampler, on_token, stop_fn, wall0,
+        grammar=None,
+    ):
         """Chunked on-device decode: K forward+sample steps per host call
         (runtime/decode.py), one token-array fetch per chunk."""
         import jax
@@ -1914,6 +2084,16 @@ class InferenceEngine:
         temperature = 0.0 if sampler is None else sampler.temperature
         topp = sampler.topp if sampler is not None else 0.9
         key = [_sampler_prng_key(sampler)]
+        # grammar chain: the lookahead chunk dispatches BEFORE this chunk's
+        # tokens reach the host, so its initial grammar states must be the
+        # previous chunk's on-device final states (gr_out), chained exactly
+        # like `last`. The host session stays authoritative between
+        # generations; inside the loop it only consumes (advance + stop).
+        gr_box = [
+            np.full((self.batch,), grammar.row_state, np.int32)
+            if grammar is not None
+            else None
+        ]
 
         def dispatch(at_pos, tok_arr, chunk=None):
             """Queue one device chunk (async); returns (tokens_device,
@@ -1929,10 +2109,13 @@ class InferenceEngine:
             kvb = self._kv_bucket(at_pos + n)
             if self.paged:
                 self._ensure_pages_all_rows(at_pos, at_pos + n)
-            toks, last, self.cache = self._decode_chunk_any(
+            toks, last, self.cache, gr_out = self._decode_chunk_any(
                 tok_arr, jnp.int32(at_pos), sub, n_steps=n,
                 temperature=temperature, topp=topp, kv_len=kvb,
+                gr_state=gr_box[0],
             )
+            if grammar is not None:
+                gr_box[0] = gr_out
             return toks, last, n, kvb
 
         if pos >= max_pos:
@@ -1997,6 +2180,8 @@ class InferenceEngine:
             for t in host_toks:
                 res.tokens.append(t)
                 pos += 1
+                if grammar is not None:
+                    grammar.advance(t)
                 if on_token is not None:
                     on_token(t)
                 if stop_fn is not None and stop_fn(t):
@@ -2005,9 +2190,15 @@ class InferenceEngine:
                     # plus the in-flight lookahead), which is harmless — a
                     # continuation re-writes those slots before reading them
                     return
+                if grammar is not None and (grammar.done or grammar.at_terminal):
+                    # grammar completion stops like EOS: the emitted token
+                    # is delivered; the chunk tail is ordinary overrun
+                    return
             pending = nxt
 
-    def _decode_speculative(self, res, token, pos, max_pos, on_token, stop_fn, wall0):
+    def _decode_speculative(
+        self, res, token, pos, max_pos, on_token, stop_fn, wall0, grammar=None,
+    ):
         """Greedy speculative decode (runtime/speculative.py): per round,
         the draft source proposes up to k tokens from the live context, ONE
         verify dispatch scores [token, drafts...] at every position, and
@@ -2046,6 +2237,12 @@ class InferenceEngine:
                     kmax = b
             td = time.perf_counter()
             drafts = ds.draft(list(res.tokens), kmax) if kmax else []
+            if grammar is not None and drafts:
+                # grammar-hostile drafts collapse to their legal prefix
+                # BEFORE the round is shaped: acceptance can then never
+                # reach an illegal proposal (the verify mask guards the
+                # argmax chain, this guards the match test's inputs)
+                drafts = drafts[: grammar.legal_prefix(drafts)]
             draft_us += int((time.perf_counter() - td) * 1e6)
             tv = time.perf_counter()
             if drafts:
@@ -2054,9 +2251,16 @@ class InferenceEngine:
                 size = K + 1
                 feed = [int(token)] + drafts + [0] * (K - len(drafts))
                 kvb = self._kv_bucket(pos + size)
+                gr_states = None
+                if grammar is not None:
+                    row = np.zeros((size,), np.int32)
+                    vs = grammar.verify_states(drafts)
+                    row[: len(vs)] = vs
+                    gr_states = np.repeat(row[None, :], self.batch, axis=0)
                 with self._guard(f"verify[{K}]", ("verify", size, kvb)):
                     ids_dev, _ = self._dispatch_verify(
-                        np.asarray([feed] * self.batch, np.int32), pos, kvb  # dlt: allow(host-sync) — host token list -> device operand prep
+                        np.asarray([feed] * self.batch, np.int32), pos, kvb,  # dlt: allow(host-sync) — host token list -> device operand prep
+                        gr_states=gr_states,
                     )
                     ids = self._host_fetch(ids_dev)[0]
                 a = accept_greedy(drafts, ids)
@@ -2089,10 +2293,15 @@ class InferenceEngine:
                 if self.paged:
                     self._ensure_pages_all_rows(pos, pos + n)
                 with self._guard(f"decode[{n}]", ("decode", n, kvb)):
-                    toks, _, self.cache = self._decode_chunk_any(
+                    toks, _, self.cache, _ = self._decode_chunk_any(
                         jnp.full((self.batch,), int(token), jnp.int32),
                         jnp.int32(pos), key, n_steps=n, temperature=0.0,
                         topp=0.9, kv_len=kvb,
+                        gr_state=(
+                            np.full((self.batch,), grammar.row_state, np.int32)
+                            if grammar is not None
+                            else None
+                        ),
                     )
                     emitted = [int(t) for t in self._host_fetch(toks)[0]]
                 dt = int((time.perf_counter() - tv) * 1e6)
@@ -2114,9 +2323,14 @@ class InferenceEngine:
                 res.tokens.append(t)
                 pos += 1
                 emitted_total += 1
+                if grammar is not None:
+                    grammar.advance(t)
                 if on_token is not None:
                     on_token(t)
                 if stop_fn is not None and stop_fn(t):
+                    stopped = True
+                    break
+                if grammar is not None and (grammar.done or grammar.at_terminal):
                     stopped = True
                     break
             token = res.tokens[-1]

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import forward_uncompiled
+from ..ops.sampling import apply_grammar_mask
 from .tracing import TRACER, to_us
 
 SPEC_MODES = ("off", "ngram", "model")
@@ -128,7 +129,7 @@ def spec_buckets(draft_k: int) -> tuple:
 )
 def verify_chunk(
     cfg, params, rope, cache, tokens, pos_start, kv_len=None,
-    page_table=None, page_size=None,
+    page_table=None, page_size=None, grammar_table=None, grammar_state=None,
 ):
     """One verify forward: a prefill-shaped pass over ``[last_token,
     d1..dk]`` returning logits at EVERY position (``logits_mode="all"``)
@@ -139,11 +140,20 @@ def verify_chunk(
     generate_batch / BatchSession verify). The cache is donated: the k+1
     KV writes land in place, exactly like a prefill chunk's.
 
+    Grammar operands (a grammar-capable engine ALWAYS threads them so the
+    warm program is shared): ``grammar_state`` is [b, t] int32 — position
+    j's global DFA state after walking the accepted feed prefix — and the
+    argmax chain is taken over the MASKED logits, so greedy acceptance can
+    never admit a token outside the grammar's legal set (the bonus token
+    included). Free/parked rows and positions past the acceptance horizon
+    ride the all-legal FREE state, where masking is the identity.
+
     Returns (greedy_ids [b, t] int32, logits [b, t, vocab] f32, cache)."""
     logits, cache = forward_uncompiled(
         cfg, params, rope, cache, tokens, pos_start, logits_mode="all",
         kv_len=kv_len, page_table=page_table, page_size=page_size,
     )
+    logits = apply_grammar_mask(logits, grammar_table, grammar_state)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
 
 
@@ -185,7 +195,9 @@ def choose_bucket(buckets, dmax: int) -> int:
     return next((k for k in buckets if k >= dmax), buckets[-1])
 
 
-def verify_row_round(engine, drafts: dict, token, pos, seq_len: int) -> dict:
+def verify_row_round(
+    engine, drafts: dict, token, pos, seq_len: int, grammars=None
+) -> dict:
     """ONE per-row verify round — the shared core of
     `BatchSession.spec_step` and `InferenceEngine._decode_batch_speculative`
     (a fix to feed assembly, bucketing, guard keys, or acceptance must land
@@ -194,6 +206,13 @@ def verify_row_round(engine, drafts: dict, token, pos, seq_len: int) -> dict:
     token/position state; rows absent from `drafts` are parked at
     `seq_len` (writes dropped, no progress).
 
+    `grammars` (row-indexable, entries None or GrammarSession) composes
+    structured decoding with speculation: each constrained row's drafts are
+    pre-truncated to their grammar-legal prefix, and the verify dispatch
+    carries a [b, K+1] per-position state operand so the argmax chain —
+    bonus token included — is taken over MASKED logits. A grammar-hostile
+    draft stream thus shows acceptance collapse, never an illegal emission.
+
     Assembles the [b, K+1] feed, dispatches the ("verify_row", K+1,
     kv-bucket) program under the sanitizer scope + watchdog, fetches the
     greedy ids, and returns {row: emitted tokens} after per-row
@@ -201,9 +220,18 @@ def verify_row_round(engine, drafts: dict, token, pos, seq_len: int) -> dict:
     the spec_verify[K] latency series). Callers advance their own
     position/token state from the returned rows."""
     rows = sorted(drafts)
-    dmax = max(len(drafts[r]) for r in rows)
+
+    def _sess(r):
+        return grammars[r] if grammars is not None else None
+
+    clean = {r: [int(t) for t in drafts[r]] for r in rows}
+    for r in rows:
+        g = _sess(r)
+        if g is not None:
+            clean[r] = clean[r][: g.legal_prefix(clean[r])]
+    dmax = max(len(clean[r]) for r in rows)
     K = choose_bucket(engine.spec_buckets, dmax)
-    clean = {r: [int(t) for t in drafts[r][:K]] for r in rows}
+    clean = {r: clean[r][:K] for r in rows}
     size = K + 1
     toks = np.zeros((engine.batch, size), np.int32)
     pv = np.full((engine.batch,), seq_len, np.int32)
@@ -212,11 +240,21 @@ def verify_row_round(engine, drafts: dict, token, pos, seq_len: int) -> dict:
         dr = clean[r]
         toks[r, 1 : 1 + len(dr)] = dr
         pv[r] = int(pos[r])
+    gr_states = None
+    if getattr(engine, "grammar", None) is not None and any(
+        _sess(r) is not None for r in rows
+    ):
+        gr_states = np.zeros((engine.batch, size), np.int32)
+        for r in rows:
+            g = _sess(r)
+            if g is not None:
+                vs = g.verify_states(clean[r])
+                gr_states[r, : len(vs)] = vs
     kvb = engine._kv_bucket(min(int(max(pv[r] for r in rows)) + size, seq_len))
     t0 = time.perf_counter()
     with engine._sanitizer_scope():
         with engine._guard(f"verify_row[{K}]", ("verify_row", size, kvb)):
-            ids_dev, _ = engine._dispatch_verify(toks, pv, kvb)
+            ids_dev, _ = engine._dispatch_verify(toks, pv, kvb, gr_states=gr_states)
             ids = engine._host_fetch(ids_dev)
     engine.stats.record(f"spec_verify[{K}]", (time.perf_counter() - t0) * 1e6)
     # one engine-level event per verify round (per-row acceptance spans are
@@ -383,7 +421,7 @@ class ModelDraft(DraftSource):
         with eng._sanitizer_scope(), eng._guard(
             f"draft_decode[{n}]", ("decode", n, kvb)
         ):
-            toks, _, eng.cache = eng._decode_chunk_any(
+            toks, _, eng.cache, _ = eng._decode_chunk_any(
                 jnp.full((1,), int(ctx[-1]), jnp.int32), jnp.int32(pos),
                 _greedy_prng_key(), n_steps=n, temperature=0.0, topp=0.9,
                 kv_len=kvb,

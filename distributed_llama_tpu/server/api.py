@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 import numpy as np
 
 from ..runtime.engine import InferenceEngine
+from ..runtime.grammar import GrammarError
 from ..runtime.telemetry import (
     GoodputAggregator,
     GoodputLedger,
@@ -192,7 +193,7 @@ class _BatchReq:
 
     def __init__(self, ids, max_new, temperature, topp, seed, on_token,
                  eos_ids=frozenset(), trace=None, slo_class=DEFAULT_CLASS,
-                 deadline=None):
+                 deadline=None, grammar=None):
         import queue
 
         self.ids = ids
@@ -231,6 +232,13 @@ class _BatchReq:
         # stops decoding at its EOS token instead of running up to a full
         # extra chunk before the writer thread's `stopped` flag is seen
         self.eos_ids = frozenset(eos_ids)
+        # structured output (runtime/grammar.py): the request's compiled
+        # grammar (None = unconstrained). The SESSION — the arena span +
+        # per-row DFA state — is built by the Batcher loop at admission, ON
+        # the engine thread: an arena install mutates the shared table the
+        # next dispatch uploads, so handler threads must never touch it.
+        self.grammar = grammar
+        self.grammar_session = None  # set at admission; closed at _finish
         self.stopped = False
         self.kv_external = None  # deferred disaggregated-KV insert
         # (server/disagg.PendingExternalKv): the Batcher loop applies it on
@@ -526,6 +534,11 @@ class Batcher:
                 session.publish_row(row, list(req.ids) + req.out_ids)
             except Exception:
                 self.state.engine.stats.incr("prefix_publish_failed")
+        if req.grammar_session is not None:
+            # release the arena span (zero-ref spans are LRU-evictable);
+            # the compiled grammar itself stays in the ApiState LRU
+            req.grammar_session.close()
+            req.grammar_session = None
         session.release(row)
         slots[row] = None
         req.done.set()
@@ -701,15 +714,29 @@ class Batcher:
                         req.kv_external.apply(self.state)
                         req.kv_external = None
                     key = self._key_for_seed(req.seed) if req.seed is not None else None
+                    if req.grammar is not None:
+                        # arena install on THIS thread (it mutates the
+                        # shared table the next dispatch uploads); mixed
+                        # constrained/unconstrained rows co-batch through
+                        # the one warm program — free rows ride state 0
+                        from ..runtime.grammar import GrammarSession
+
+                        req.grammar_session = GrammarSession(
+                            engine.grammar, req.grammar
+                        )
                     session.begin_admit(
                         row, req.ids, temperature=req.temperature,
                         topp=req.topp, key_data=key, trace=req.trace,
+                        grammar=req.grammar_session,
                     )
                     req.ledger.prefix_hit_tokens = session.pending_resume(row)
                     req.prefilling = True
                     slots[row] = req
                     self.scheduler.record(req.slo_class, "admit")
                 except Exception as e:
+                    if req.grammar_session is not None:
+                        req.grammar_session.close()
+                        req.grammar_session = None
                     req.error = e
                     req.done.set()
 
@@ -1024,9 +1051,16 @@ class Batcher:
                     if req._em_decode is not None:
                         req._em_decode(t_chunk_us, chunk_dur_us, len(per_row[row]))
                 row_toks = per_row[row]
+                gr = req.grammar_session
                 for i, t in enumerate(row_toks):
                     req.n += 1
                     req.out_ids.append(t)
+                    if gr is not None:
+                        # the host session is authoritative: re-advance it
+                        # from the fetched token before the next chunk's
+                        # state vector is assembled (the in-graph carry is
+                        # only its traced mirror)
+                        gr.advance(t)
                     try:
                         req.emit.put_nowait(t)
                     except queue.Full:
@@ -1038,7 +1072,17 @@ class Batcher:
                             "client fell too far behind the token stream"
                         )
                         req.stopped = True
-                    if req.stopped or req.n >= req.max_new or t in req.eos_ids:
+                    if (
+                        req.stopped or req.n >= req.max_new
+                        or t in req.eos_ids
+                        or (gr is not None and (gr.done or gr.at_terminal))
+                    ):
+                        # a grammar TERMINAL stop (the DFA reached a state
+                        # where only EOS remains legal) retires the row
+                        # exactly like EOS: the token that got it there was
+                        # DELIVERED — it lands in the goodput ledger as
+                        # generated, and the chunk tail past it is ordinary
+                        # overrun, not a new waste class.
                         # surplus tokens past max_new in this chunk are
                         # discarded; the row parks (session.release) so
                         # co-tenants keep full-size chunks. The eos_ids
@@ -1086,6 +1130,20 @@ class ApiState:
         # GET /debug/hot_prefixes so the gateway's autoscaler can re-home
         # affinity BEFORE draining this replica
         self.hot_prefixes = HotPrefixTracker()
+        # structured output (runtime/grammar.py): one request-format
+        # compiler shared by every handler thread — FNV-keyed LRU over
+        # DLT_GRAMMAR_CACHE_MB, so a fleet of identically-constrained
+        # requests compiles its grammar once. None when the engine serves
+        # unconstrained (mesh/host-decode, or DLT_GRAMMAR=0): any
+        # response_format then 400s in _compile_grammar.
+        from ..runtime.grammar import GrammarCompiler
+
+        self.grammar_compiler = (
+            GrammarCompiler(tokenizer, engine.cfg.vocab_size)
+            if engine.grammar is not None
+            else None
+        )
+        self._grammar_lock = threading.Lock()
         # crash-safe drain state (server/recovery.py): the gateway that
         # drains this replica also POSTs /admin/drain_hint so the replica
         # itself remembers it is draining (and WHO drained it, operator
@@ -1221,6 +1279,26 @@ class ApiState:
                 always=ledger.outcome != "ok",
             )
 
+    def _compile_grammar(self, params: dict):
+        """Resolve a request's ``response_format`` to a CompiledGrammar
+        (None = unconstrained; the OpenAI-style ``{"type": "text"}`` is
+        explicit unconstrained). Raises GrammarError — a 400 CLIENT error
+        the handler maps before the poison-strike arm: a malformed schema
+        must never cost a quarantine strike or an error-outcome ledger.
+        The compile itself runs under a lock (the LRU is shared across
+        handler threads); cache hits make it a dict probe."""
+        rf = params.get("response_format")
+        if rf is None or (isinstance(rf, dict) and rf.get("type") == "text"):
+            return None
+        if self.grammar_compiler is None:
+            raise GrammarError(
+                "response_format is not supported on this replica: "
+                "grammar-constrained decoding needs a single-chip "
+                "device-decode engine with DLT_GRAMMAR enabled"
+            )
+        with self._grammar_lock:
+            return self.grammar_compiler.compile_request(rf)
+
     def complete_batched(self, params: dict, emit, client_visible: bool = True,
                          trace=None):
         """One request's slice of a batched generation: encode, submit to the
@@ -1242,6 +1320,11 @@ class ApiState:
             raise PromptTooLong(
                 f"prompt ({len(ids)} tokens) exceeds the context window ({seq_len})"
             )
+        # structured output: compile response_format BEFORE any reservation
+        # or engine work — a malformed body raises GrammarError here and
+        # costs neither quota nor a ledger outcome (the handler's 400 owns
+        # it, exactly like PromptTooLong above)
+        grammar = self._compile_grammar(params)
         max_tokens = params.get("max_tokens", -1)
         budget = max_tokens if max_tokens and max_tokens > 0 else seq_len
         budget = max(1, min(budget, seq_len - len(ids)))
@@ -1371,6 +1454,7 @@ class ApiState:
                 trace=trace,
                 slo_class=klass,
                 deadline=deadline,
+                grammar=grammar,
             )
             req_box[:] = [req]
             return req
@@ -1571,6 +1655,11 @@ class ApiState:
                 # error OUTCOME — the batched path records nothing for
                 # these either, and error dashboards must not alarm on it
                 raise
+            except GrammarError:
+                # malformed response_format: same client-input 400 class as
+                # PromptTooLong (raised before any engine work) — never an
+                # error outcome, never a poison strike
+                raise
             except DeadlineExceeded:
                 self._record_ledger(
                     fail_ledger("deadline"), trace, waste_reason="deadline"
@@ -1605,6 +1694,12 @@ class ApiState:
                 f"prompt ({len(ids)} tokens) exceeds the context window ({seq_len})"
             )
 
+        # structured output: compile BEFORE any engine work (GrammarError
+        # here is a client 400, like PromptTooLong above); the session —
+        # arena span + per-row DFA state — is built inline further down:
+        # the serialized path runs under self.lock, so this IS the engine
+        # thread and the install is race-free
+        grammar = self._compile_grammar(params)
         prompt_end = len(ids) - 1
         max_tokens = params.get("max_tokens", -1)
         max_pred = min(prompt_end + max_tokens, seq_len) if max_tokens and max_tokens > 0 else seq_len
@@ -1711,13 +1806,18 @@ class ApiState:
                 return True
             return False
 
+        gr_sess = None
+        if grammar is not None:
+            from ..runtime.grammar import GrammarSession
+
+            gr_sess = GrammarSession(engine.grammar, grammar)
         try:
             # the engine emits this request's prefill/decode/spec spans
             # through its trace context for the duration of the generate
             engine.trace = trace
             res = engine.generate(
                 ids, max_pred, sampler=self.sampler, pos_start=0,
-                on_token=on_token, stop_fn=stop_fn,
+                on_token=on_token, stop_fn=stop_fn, grammar=gr_sess,
             )
         except ClientDisconnected:
             # the CLIENT dropped mid-stream (emit raised) — the engine and
@@ -1733,6 +1833,9 @@ class ApiState:
             raise
         finally:
             engine.trace = None
+            if gr_sess is not None:
+                gr_sess.close()  # release the arena span; the compiled
+                # grammar stays hot in the ApiState LRU
         if state.get("deadline_hit"):
             # generation stopped because the deadline passed mid-decode:
             # every decoded token is `deadline` waste (the parked ledger
@@ -1923,6 +2026,11 @@ DLT_ENV_SURFACE = (
     "DLT_DISAGG_TIMEOUT_S",
     "DLT_DRAFT_K",
     "DLT_FLIGHTREC_DIR",
+    "DLT_GRAMMAR",
+    "DLT_GRAMMAR_ARENA_MB",
+    "DLT_GRAMMAR_CACHE_MB",
+    "DLT_GRAMMAR_MAX_SPEC_KB",
+    "DLT_GRAMMAR_MAX_STATES",
     "DLT_GW_RECOVER",
     "DLT_GW_RECOVER_TIMEOUT_S",
     "DLT_HBM_DRIFT_MB",
@@ -2000,6 +2108,17 @@ def resolved_config(state: "ApiState") -> dict:
             "draft_k": eng.draft_k,
             "buckets": list(eng.spec_buckets),
         },
+        # structured output (runtime/grammar.py): arena occupancy + the
+        # request-format compiler's LRU counters; None when this replica
+        # serves unconstrained (mesh/host-decode, or DLT_GRAMMAR=0)
+        "grammar": None if eng.grammar is None else dict(
+            eng.grammar.snapshot(),
+            compiler=(
+                state.grammar_compiler.cache_stats()
+                if state.grammar_compiler is not None
+                else None
+            ),
+        ),
         "batcher": None if batcher is None else {
             "chunk_size": batcher.chunk,
             "prefill_budget": batcher.prefill_budget,
@@ -2298,6 +2417,18 @@ class Handler(BaseHTTPRequestHandler):
                 # spec_* counters ride steps.counters and /health too; this
                 # section is the one-stop operator view)
                 "speculative": spec_snapshot(st.engine),
+                # structured output (runtime/grammar.py): arena occupancy
+                # + compile-cache counters (None = unconstrained replica)
+                "grammar": (
+                    None if st.engine.grammar is None else dict(
+                        st.engine.grammar.snapshot(),
+                        compiler=(
+                            st.grammar_compiler.cache_stats()
+                            if st.grammar_compiler is not None
+                            else None
+                        ),
+                    )
+                ),
                 # paged KV pool occupancy (None on contiguous engines); the
                 # kv_cow_* / kv_pages_shared / kv_pool_* counters ride
                 # steps.counters like every other engine event
@@ -2633,6 +2764,15 @@ class Handler(BaseHTTPRequestHandler):
                         self._json(400, json.dumps({"error": str(e)}).encode())
                         return
                     raise
+                except GrammarError as e:
+                    # malformed/unsupported response_format: a client 400
+                    # raised before the first SSE byte — and crucially
+                    # BEFORE the generic arm below, so a grammar bomb never
+                    # lands a poison strike on its conversation fingerprint
+                    if not started[0]:
+                        self._json(400, json.dumps({"error": str(e)}).encode())
+                        return
+                    raise
                 except Overloaded as e:
                     # shed BEFORE any SSE byte goes out (the backlog check
                     # runs ahead of the first emit), so the 503 is clean
@@ -2683,6 +2823,12 @@ class Handler(BaseHTTPRequestHandler):
                         params, lambda d: None, client_visible=False, trace=tr
                     )
                 except PromptTooLong as e:
+                    self._json(400, json.dumps({"error": str(e)}).encode())
+                    return
+                except GrammarError as e:
+                    # client-input 400, ahead of the poison-strike arm: a
+                    # malformed response_format must never strike its
+                    # conversation's fingerprint
                     self._json(400, json.dumps({"error": str(e)}).encode())
                     return
                 except Overloaded as e:

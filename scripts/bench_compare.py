@@ -40,7 +40,7 @@ _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _HIGHER = re.compile(
     r"tok_s|tokens_per_s|throughput_gain|acceptance|overlap_pct|mfu"
     r"|bw_utilization|attainment|rows_at_budget|scale_x|_gain"
-    r"|eff_gb_s|bytes_per_pos_ratio|retention_pct|hit_rate"
+    r"|eff_gb_s|bytes_per_pos_ratio|retention_pct|hit_rate|valid_rate"
 )
 #: metric-name fragments that mean "smaller is better" (hit_ttft_ms_*:
 #: the tiering leg's promotion-path TTFT rides the generic _ms_ band)

@@ -39,6 +39,12 @@
 #                            stored-width census/ledger honesty, equal-
 #                            budget capacity, int8 ladder audit, sanitizer
 #                            acceptance, --kv-dtype over HTTP)
+#   8c. grammar suite        (structured decoding: regex/schema -> token
+#                            DFA compile + bomb defenses, arena spans +
+#                            session semantics, masked engine/speculative/
+#                            BatchSession streams with zero illegal tokens,
+#                            response_format over HTTP incl. SSE + 400s,
+#                            fatal-sanitizer mixed co-tenancy)
 #   9. fleet suite          (gateway federation scraper under the chaos
 #                            harness, per-replica signal table + staleness,
 #                            federated /metrics format, goodput-ledger
@@ -129,9 +135,16 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/dlt_graph_diff.py --check --coverage \
   --kv-layout paged --pp 2 --tp 2 --speculative off
 
+echo "== graph contracts (MASKED ladder goldens, grammar arena) =="
+# the grammar-capable engine's decode/verify programs carry the mask-table
+# operand pair — their own golden configs (config_key _gr suffix)
+python scripts/dlt_graph_diff.py --check --coverage --grammar
+python scripts/dlt_graph_diff.py --check --coverage --grammar --kv-layout paged
+
 echo "== graph contracts (differential equivalence prover) =="
 # paged = contiguous + page tables; int8 = f32 + quantization (zero pool
-# gathers); verify_k = prefill twin + argmax — anything else fails by name
+# gathers); verify_k = prefill twin + argmax; masked = unmasked +
+# gather/where (dots + collectives pinned) — anything else fails by name
 DLT_PALLAS_INTERPRET=1 python scripts/dlt_graph_diff.py --prove all
 
 echo "== analysis suite (pytest -m analysis) =="
@@ -154,6 +167,9 @@ python -m pytest tests/test_paged_kv.py -q -p no:cacheprovider
 
 echo "== kv-quant suite (int8 KV + fused paged decode attention) =="
 python -m pytest tests/test_kv_quant.py -q -p no:cacheprovider
+
+echo "== grammar suite (structured decoding: DFA, arena, masked engine, HTTP) =="
+python -m pytest tests/test_grammar.py -q -p no:cacheprovider
 
 echo "== fleet suite (federation + goodput + timeline) =="
 python -m pytest tests/test_fleet.py tests/test_goodput.py -q -p no:cacheprovider

@@ -1,0 +1,623 @@
+"""Grammar-constrained structured decoding (PR 20, runtime/grammar.py):
+compiler/DFA units, the device arena + host sessions, masked engine decode,
+grammar-hostile speculative drafts, mixed constrained/free co-batching, and
+the HTTP `response_format` surface — every level asserts ZERO illegal tokens
+via host replay and validates final output with the byte-DFA fullmatch
+oracle."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.formats.mfile import ArchType
+from distributed_llama_tpu.runtime import grammar as gr_mod
+from distributed_llama_tpu.runtime.batch_session import BatchSession
+from distributed_llama_tpu.runtime.engine import InferenceEngine
+from distributed_llama_tpu.runtime.grammar import (
+    FREE_STATE,
+    GrammarArena,
+    GrammarCompiler,
+    GrammarError,
+    GrammarSession,
+    parse_response_format,
+    regex_escape,
+    resolve_grammar_enabled,
+    schema_to_regex,
+)
+from distributed_llama_tpu.testing import (
+    ascii_vocab_tokenizer,
+    byte_vocab_tokenizer,
+    tiny_header,
+    write_tiny_model,
+    write_tiny_tokenizer,
+)
+from distributed_llama_tpu.tokenizer import Tokenizer
+
+CHATML = "{% for m in messages %}<|im_start|>...{% endfor %}"
+
+#: a schema every tiny random model can FINISH: booleans force a short,
+#: fully-determined tail (unbounded integers would run to max_tokens)
+BOOL_SCHEMA = {
+    "type": "object",
+    "properties": {"ok": {"type": "boolean"}},
+}
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("grammar")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=256,
+        vocab_size=288,
+    )
+    mp = str(d / "m.m")
+    write_tiny_model(mp, h, seed=3)
+    return mp
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return Tokenizer(byte_vocab_tokenizer(pad_to=288))
+
+
+@pytest.fixture(scope="module")
+def compiler(tok):
+    return GrammarCompiler(tok, vocab_size=288)
+
+
+def _engine(path, **kw):
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("max_chunk", 8)
+    kw.setdefault("decode_chunk_size", 4)
+    kw.setdefault("prefix_cache_mb", 0)
+    return InferenceEngine(path, **kw)
+
+
+def _replay(tok, grammar, gen_tokens):
+    """Walk `gen_tokens` through a FRESH session: returns (decoded bytes,
+    n_illegal, finished) — the authoritative legality/validity check for
+    any constrained stream, at any level of the stack."""
+    arena = GrammarArena(288, n_states=grammar.n_states + 1)
+    s = GrammarSession(arena, grammar)
+    out = b""
+    illegal = 0
+    for t in gen_tokens:
+        if s.done:
+            break
+        r = s.advance(int(t))
+        if r == "illegal":
+            illegal += 1
+        elif r != "eos":
+            out += tok.vocab[int(t)]
+        if s.done or s.at_terminal:
+            break
+    finished = s.done or s.at_terminal
+    s.close()
+    return out, illegal, finished
+
+
+# ---------------------------------------------------------------------------
+# Compiler / DFA units
+# ---------------------------------------------------------------------------
+
+
+def test_regex_compile_and_mask_invariants(compiler):
+    g = compiler.compile("regex", "(?:yes|no)")
+    assert g.fullmatch(b"yes") and g.fullmatch(b"no")
+    assert not g.fullmatch(b"maybe") and not g.fullmatch(b"ye")
+    # every token-reachable state keeps >= 1 legal token (the dead-end
+    # check ran at compile); eos is legal ONLY at accepting states
+    eos = sorted(g.eos_ids)
+    for s in range(g.n_states):
+        if g.accepting[s]:
+            assert all(g.table[s, e] >= 0 for e in eos)
+        else:
+            assert all(g.table[s, e] < 0 for e in eos)
+    # terminal = accepting AND only-eos-legal; "yes" / "no" end states are
+    # terminal (nothing may follow a complete alternative)
+    assert g.terminal.any()
+    for s in np.flatnonzero(g.terminal):
+        legal = np.flatnonzero(g.table[s] >= 0)
+        assert set(int(t) for t in legal) == set(int(e) for e in eos)
+
+
+def test_json_schema_boolean_roundtrip(compiler):
+    pat = schema_to_regex(BOOL_SCHEMA)
+    g = compiler.compile("json_schema", pat)
+    assert g.fullmatch(b'{"ok":true}') and g.fullmatch(b'{"ok":false}')
+    assert not g.fullmatch(b'{"ok":maybe}')
+    assert not g.fullmatch(b'{"ok": true}')  # canonical form: no whitespace
+
+
+def test_merged_pieces_are_legal_tokens(compiler, tok):
+    """The vocab lift covers MULTI-byte pieces: the byte-vocab fixture's
+    merged "hello" token must be legal in one step where the byte path
+    takes five."""
+    g = compiler.compile("regex", "hello world")
+    hello = tok.vocab.index(b"hello")
+    assert int(g.table[0, hello]) >= 0
+    # and the multi-byte hop lands on the same state as the byte walk
+    s = 0
+    for b in b"hello":
+        s = int(g.trans_byte[s, b])
+    assert int(g.table[0, hello]) == s
+
+
+def test_cache_hits_misses_evictions(tok, monkeypatch):
+    c = GrammarCompiler(tok, vocab_size=288)
+    c.compile("regex", "(?:a|b)")
+    c.compile("regex", "(?:a|b)")
+    st = c.cache_stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["entries"] == 1
+    assert st["bytes"] > 0
+    # a zero-MB budget keeps at most ONE entry: each new compile evicts
+    monkeypatch.setenv("DLT_GRAMMAR_CACHE_MB", "0")
+    c.compile("regex", "(?:c|d)")
+    st = c.cache_stats()
+    assert st["evictions"] == 1 and st["entries"] == 1
+
+
+def test_parse_response_format_rejects_malformed(monkeypatch):
+    for bad in (
+        "nope",
+        {"type": "banana"},
+        {"type": "regex"},
+        {"type": "regex", "regex": 7},
+        {"type": "json_schema"},
+        {"type": "json_schema", "json_schema": "notadict"},
+    ):
+        with pytest.raises(GrammarError):
+            parse_response_format(bad)
+    # OpenAI-style nesting unwraps the inner schema
+    kind, pat = parse_response_format(
+        {"type": "json_schema",
+         "json_schema": {"name": "t", "schema": BOOL_SCHEMA}}
+    )
+    assert kind == "json_schema" and pat == schema_to_regex(BOOL_SCHEMA)
+    # spec-KB cap: a zero budget rejects EVERY body
+    monkeypatch.setenv("DLT_GRAMMAR_MAX_SPEC_KB", "0")
+    with pytest.raises(GrammarError, match="DLT_GRAMMAR_MAX_SPEC_KB"):
+        parse_response_format({"type": "regex", "regex": "a"})
+
+
+def test_max_states_cap_is_the_bomb_defense(tok, monkeypatch):
+    monkeypatch.setenv("DLT_GRAMMAR_MAX_STATES", "4")
+    c = GrammarCompiler(tok, vocab_size=288)
+    with pytest.raises(GrammarError, match="exceeds"):
+        c.compile("regex", "abcdefghij")
+
+
+def test_vocab_gap_dead_end_detected():
+    """A grammar whose only path needs a byte the vocabulary cannot emit
+    must be REJECTED at compile — a constrained row masking the whole
+    vocab mid-generation would wedge."""
+    ascii_tok = Tokenizer(ascii_vocab_tokenizer(pad_to=288))
+    c = GrammarCompiler(ascii_tok, vocab_size=288)
+    with pytest.raises(GrammarError, match="dead-ends"):
+        c.compile("regex", "a\tb")  # tab: not in the printable-ASCII vocab
+
+
+def test_regex_escape_literals(compiler):
+    lit = "a+b(c)*[d]"
+    g = compiler.compile("regex", regex_escape(lit))
+    assert g.fullmatch(lit.encode())
+    assert not g.fullmatch(b"ab(c)*[d]")
+
+
+def test_resolve_grammar_enabled(monkeypatch):
+    monkeypatch.delenv("DLT_GRAMMAR", raising=False)
+    assert resolve_grammar_enabled(True) is True
+    assert resolve_grammar_enabled(False, default="1") is False
+    assert resolve_grammar_enabled(None, default="1") is True
+    assert resolve_grammar_enabled(None, default="0") is False
+    monkeypatch.setenv("DLT_GRAMMAR", "on")
+    assert resolve_grammar_enabled(None, default="0") is True
+
+
+# ---------------------------------------------------------------------------
+# Arena + host sessions
+# ---------------------------------------------------------------------------
+
+
+def test_arena_install_refcount_and_eviction(compiler):
+    a = GrammarArena(288, n_states=64)
+    assert (a.table[FREE_STATE] == FREE_STATE).all()  # all-legal self-loop
+    g1 = compiler.compile("regex", "(?:yes|no)")
+    v0 = a.version
+    s1 = GrammarSession(a, g1)
+    s2 = GrammarSession(a, g1)
+    assert s2.base == s1.base  # warm reuse: one span, two refs
+    assert a.version == v0 + 1  # the second install was a ref bump only
+    snap = a.snapshot()
+    assert snap["spans"] == 1 and snap["live"] == 1
+    s1.close()
+    s2.close()
+    assert a.snapshot()["live"] == 0
+    # a zero-ref span stays until space is needed, then evicts cleanly
+    big = compiler.compile("regex", "a" * 60)  # 61 states: forces reclaim
+    GrammarSession(a, big)
+    assert a.snapshot()["spans"] == 1  # g1's span was reclaimed
+    # a grammar larger than the whole arena is a typed refusal
+    with pytest.raises(GrammarError, match="arena"):
+        a.install(compiler.compile("regex", "b" * 70))
+
+
+def test_arena_exhausted_by_live_grammars(compiler):
+    a = GrammarArena(288, n_states=64)  # 64 is the arena floor
+    live = GrammarSession(a, compiler.compile("regex", "c" * 40))
+    with pytest.raises(GrammarError, match="exhausted"):
+        GrammarSession(a, compiler.compile("regex", "d" * 40))
+    live.close()
+
+
+def test_session_advance_terminal_eos_illegal(compiler, tok):
+    a = GrammarArena(288, n_states=64)
+    s = GrammarSession(a, compiler.compile("regex", "yes"))
+    eos = sorted(s.grammar.eos_ids)[0]
+    assert s.row_state == s.base  # state 0, constrained
+    assert s.is_legal(ord("y")) and not s.is_legal(ord("n"))
+    assert s.advance(ord("z")) == "illegal" and s.n_illegal == 1
+    assert s.state == 0  # an illegal token never moves the DFA
+    assert s.advance(ord("y")) == "ok"
+    assert s.advance(ord("e")) == "ok"
+    assert s.advance(ord("s")) == "terminal" and s.at_terminal
+    assert s.advance(eos) == "eos" and s.done
+    assert s.row_state == FREE_STATE  # finished rows ride FREE
+    assert s.advance(ord("y")) == "done"
+    assert s.is_legal(12345) is True  # done: everything rides free
+    s.close()
+
+
+def test_legal_prefix_and_verify_states(compiler):
+    a = GrammarArena(288, n_states=64)
+    s = GrammarSession(a, compiler.compile("regex", "yes"))
+    eos = sorted(s.grammar.eos_ids)[0]
+    drafts = [ord("y"), ord("e"), ord("q"), ord("s")]
+    assert s.legal_prefix(drafts) == 2  # truncated BEFORE the illegal 'q'
+    assert s.legal_prefix([ord("y"), eos]) == 1  # and before any eos
+    vs = s.verify_states(drafts)
+    assert vs.shape == (5,) and vs.dtype == np.int32
+    # position j = state before feeding drafts[j]; past the break -> FREE
+    assert vs[0] == s.base
+    walk = s.base
+    g = s.grammar
+    for j in (0, 1):
+        walk = s.base + int(g.table[walk - s.base, drafts[j]])
+        assert vs[j + 1] == walk
+    assert vs[3] == FREE_STATE and vs[4] == FREE_STATE
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine-level masked decode
+# ---------------------------------------------------------------------------
+
+
+def test_engine_constrained_generate_schema_valid(model_path, compiler, tok):
+    eng = _engine(model_path, grammar=True)
+    g = compiler.compile("json_schema", schema_to_regex(BOOL_SCHEMA))
+    sess = GrammarSession(eng.grammar, g)
+    prompt = [5, 9, 17, 3]
+    res = eng.generate(prompt, len(prompt) + 32, sampler=None, grammar=sess)
+    gen = res.tokens[len(prompt):]
+    assert gen, "constrained generation produced no tokens"
+    out, illegal, finished = _replay(tok, g, gen)
+    assert illegal == 0
+    assert finished, f"grammar did not terminate: {out!r}"
+    assert g.fullmatch(out), out
+    sess.close()
+    # a grammar-less engine refuses the kwarg with a typed error
+    plain = _engine(model_path)
+    arena = GrammarArena(288, n_states=64)
+    with pytest.raises(ValueError, match="without a grammar arena"):
+        plain.generate(prompt, len(prompt) + 8, grammar=GrammarSession(arena, g))
+
+
+def test_speculative_grammar_hostile_drafts(model_path, compiler, tok):
+    """Speculation is an EXECUTION strategy: the ngram draft source knows
+    nothing about the grammar (its proposals are grammar-hostile), yet the
+    constrained spec stream must equal the constrained non-spec stream
+    token for token, with zero illegal tokens — draft pre-truncation
+    (legal_prefix) plus the masked verify chain guarantee it."""
+    g = compiler.compile("json_schema", schema_to_regex(BOOL_SCHEMA))
+    prompt = [5, 9, 17, 3]
+
+    def run(spec):
+        eng = _engine(model_path, grammar=True,
+                      speculative="ngram" if spec else "off")
+        sess = GrammarSession(eng.grammar, g)
+        res = eng.generate(prompt, len(prompt) + 32, sampler=None, grammar=sess)
+        sess.close()
+        timing = eng.last_spec_timing if spec else None
+        return res.tokens[len(prompt):], timing
+
+    base, _ = run(False)
+    spec, timing = run(True)
+    assert spec == base
+    out, illegal, finished = _replay(tok, g, spec)
+    assert illegal == 0 and finished and g.fullmatch(out)
+    # the spec path actually ran (rounds recorded); under a hostile draft
+    # source acceptance may collapse but never admits an illegal token
+    assert timing is not None and timing["rounds"] >= 0
+
+
+def test_batch_session_mixed_constrained_and_free(model_path, compiler, tok):
+    """Co-batching: row 0 constrained, row 1 free — the free row's stream
+    must match its solo run exactly (the mask is a no-op at FREE_STATE),
+    and the constrained row must emit a schema-valid value."""
+    free_prompt = [7, 1]
+    solo = _engine(model_path)
+    want_free = solo.generate(free_prompt, len(free_prompt) + 25,
+                              sampler=None).tokens[len(free_prompt):][:24]
+
+    eng = _engine(model_path, batch=2, grammar=True)
+    g = compiler.compile("json_schema", schema_to_regex(BOOL_SCHEMA))
+    sess = GrammarSession(eng.grammar, g)
+    s = BatchSession(eng)
+    s.admit(0, [5, 9, 17, 3], grammar=sess)
+    s.admit(1, free_prompt)
+    got_con, got_free = [], []
+    for _ in range(6):
+        host = s.step(4)
+        got_free.extend(int(t) for t in host[1])
+        for t in host[0]:
+            # the caller owns the host session: re-advance it from every
+            # fetched token before the next chunk dispatch reads row_state
+            if not (sess.done or sess.at_terminal):
+                got_con.append(int(t))
+                sess.advance(int(t))
+    assert got_free == want_free
+    out, illegal, finished = _replay(tok, g, got_con)
+    assert sess.n_illegal == 0 and illegal == 0
+    assert finished and g.fullmatch(out), out
+    s.release(0)
+    sess.close()
+    # begin_admit on a grammar-less engine is the same typed refusal
+    plain = BatchSession(_engine(model_path, batch=2))
+    arena = GrammarArena(288, n_states=64)
+    with pytest.raises(ValueError, match="without a grammar arena"):
+        plain.admit(0, [5, 9], grammar=GrammarSession(arena, g))
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_raw(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def grammar_server(tmp_path_factory, model_path):
+    """A batched server with the grammar arena ON (the single-chip server
+    default) — warmup skipped; the fatal-sanitizer run below builds its own
+    warmed twin."""
+    import os
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+
+    d = tmp_path_factory.mktemp("grsrv")
+    tp = str(d / "t.t")
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    os.environ["DLT_NO_WARMUP"] = "1"
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    port = _free_port()
+    args = p.parse_args(
+        [
+            "inference", "--model", model_path, "--tokenizer", tp,
+            "--steps", "0", "--compute-dtype", "float32",
+            "--temperature", "0.0", "--port", str(port),
+            "--max-batch-size", "4",
+        ]
+    )
+    httpd = api_mod.serve(args)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield port, httpd.RequestHandlerClass.state
+    httpd.shutdown()
+    os.environ.pop("DLT_NO_WARMUP", None)
+
+
+RF_BOOL = {"type": "json_schema", "json_schema": {"name": "t", "schema": BOOL_SCHEMA}}
+
+
+def test_http_json_schema_non_stream(grammar_server, compiler):
+    port, state = grammar_server
+    assert state.engine.grammar is not None  # server default: arena ON
+    out = _post(port, {
+        "messages": [{"role": "user", "content": "emit the object"}],
+        "max_tokens": 32, "temperature": 0.0, "response_format": RF_BOOL,
+    })
+    content = out["choices"][0]["message"]["content"]
+    g = compiler.compile("json_schema", schema_to_regex(BOOL_SCHEMA))
+    assert g.fullmatch(content.encode()), content
+    # the terminal stop lands as an EOS-class stop: the reply is COMPLETE
+    # well short of max_tokens (not length-truncated), and every byte of
+    # the closing token was delivered
+    assert 0 < out["usage"]["completion_tokens"] < 32
+
+
+def test_http_regex_sse_stream(grammar_server, compiler):
+    port, _ = grammar_server
+    with _post_raw(port, {
+        "messages": [{"role": "user", "content": "yes or no"}],
+        "max_tokens": 16, "temperature": 0.0, "stream": True,
+        "response_format": {"type": "regex", "regex": "(?:yes|no)"},
+    }) as r:
+        raw = r.read().decode()
+    events = [e for e in raw.split("\r\n\r\n") if e.strip()]
+    assert events[-1].strip() == "data: [DONE]"
+    text = ""
+    finish = None
+    for e in events[:-1]:
+        chunk = json.loads(e[len("data: "):])
+        choice = chunk["choices"][0]
+        text += choice.get("delta", {}).get("content") or ""
+        finish = choice.get("finish_reason") or finish
+    assert compiler.compile("regex", "(?:yes|no)").fullmatch(text.encode()), text
+    assert finish == "stop"
+
+
+def test_http_malformed_response_format_is_400(grammar_server):
+    port, _ = grammar_server
+    for bad in (
+        {"type": "regex"},
+        {"type": "banana"},
+        {"type": "json_schema", "json_schema": {"schema": {"type": "warp"}}},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, {
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 4, "response_format": bad,
+            })
+        assert ei.value.code == 400
+    # the replica is unharmed: the very next plain request serves normally
+    out = _post(port, {
+        "messages": [{"role": "user", "content": "still alive"}],
+        "max_tokens": 4, "temperature": 0.0,
+    })
+    assert out["usage"]["completion_tokens"] > 0
+
+
+def test_http_mixed_cotenants_and_stats(grammar_server, compiler):
+    """Constrained and unconstrained requests co-batch in the same Batcher
+    round; /stats exposes arena occupancy + compile-cache counters and
+    /debug/config resolves the DLT_GRAMMAR knobs."""
+    port, _ = grammar_server
+    results = {}
+
+    def one(name, payload):
+        results[name] = _post(port, payload)
+
+    threads = [
+        threading.Thread(target=one, args=(n, p))
+        for n, p in (
+            ("con", {"messages": [{"role": "user", "content": "object"}],
+                     "max_tokens": 32, "temperature": 0.0,
+                     "response_format": RF_BOOL}),
+            ("free", {"messages": [{"role": "user", "content": "chat"}],
+                      "max_tokens": 8, "temperature": 0.0}),
+        )
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    g = compiler.compile("json_schema", schema_to_regex(BOOL_SCHEMA))
+    assert g.fullmatch(results["con"]["choices"][0]["message"]["content"].encode())
+    assert results["free"]["usage"]["completion_tokens"] > 0
+    snap = _get(port, "/stats")["grammar"]
+    assert snap is not None and snap["n_states"] >= 64
+    assert snap["compiler"]["misses"] >= 1
+    cfg = json.dumps(_get(port, "/debug/config"))
+    for knob in ("DLT_GRAMMAR", "DLT_GRAMMAR_CACHE_MB", "DLT_GRAMMAR_MAX_STATES",
+                 "DLT_GRAMMAR_ARENA_MB", "DLT_GRAMMAR_MAX_SPEC_KB"):
+        assert knob in cfg, knob
+
+
+@pytest.mark.slow
+def test_grammar_fatal_sanitizer_cotenancy(tmp_path_factory, monkeypatch):
+    """A WARMED server under DLT_SANITIZERS_FATAL=1 serves a MIXED round —
+    grammar-constrained greedy, plain sampled, plain greedy — with ZERO
+    post-warmup recompiles and zero blocking d2h on the dispatch thread:
+    the masked program class IS the warm ladder (the FREE state vector is
+    just another operand), so constrained co-tenants ride the same
+    compiled programs as everyone else."""
+    import socket
+
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+
+    monkeypatch.setenv("DLT_SANITIZERS", "1")
+    monkeypatch.setenv("DLT_SANITIZERS_FATAL", "1")
+    monkeypatch.setenv("DLT_COST_TABLE", "0")
+    monkeypatch.delenv("DLT_NO_WARMUP", raising=False)
+    d = tmp_path_factory.mktemp("grfatal")
+    h = tiny_header(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2, seq_len=128,
+        vocab_size=288,
+    )
+    mp, tp = str(d / "m.m"), str(d / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(
+        [
+            "inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+            "--compute-dtype", "float32", "--temperature", "0.8",
+            "--port", str(port), "--max-batch-size", "4",
+        ]
+    )
+    httpd = api_mod.serve(args)  # warms up: no DLT_NO_WARMUP here
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        payloads = (
+            {"messages": [{"role": "user", "content": "emit"}],
+             "max_tokens": 24, "temperature": 0.0,
+             "response_format": RF_BOOL},
+            {"messages": [{"role": "user", "content": "sampled"}],
+             "max_tokens": 6},
+            {"messages": [{"role": "user", "content": "greedy"}],
+             "max_tokens": 6, "temperature": 0.0},
+        )
+        results = {}
+
+        def one(i, payload):
+            results[i] = _post(port, payload, timeout=300)
+
+        threads = [
+            threading.Thread(target=one, args=(i, pl))
+            for i, pl in enumerate(payloads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 3
+        for out in results.values():
+            assert out["choices"][0]["message"] is not None
+        counters = _get(port, "/stats")["steps"]["counters"]
+        assert counters.get("sanitizer_recompiles", 0) == 0, counters
+    finally:
+        httpd.shutdown()

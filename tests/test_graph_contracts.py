@@ -190,6 +190,41 @@ def test_prove_verify_fails_without_speculation(contig_engine):
     assert problems and "no verify programs" in problems[0]
 
 
+def test_prove_masked_equals_unmasked_plus_gather_where(
+    contig_engine, model_path
+):
+    """masked = unmasked + {mask-table gathers, legality compares, where
+    selects} and NOTHING else — same dots, same collectives, identical
+    prefill family (runtime/grammar.py, the PR 20 axis)."""
+    var = _engine(model_path, grammar=True)
+    try:
+        assert var.grammar is not None
+        # the arena changes the program family, so the golden store must
+        # key masked configs apart from their unmasked twins
+        key = gd.config_key(var)
+        assert f"_gr{var.grammar.n_states}" in key
+        assert gd.config_key(contig_engine) not in (key,)
+        assert gd.prove_masked_twin(contig_engine, var) == []
+    finally:
+        var.close()
+
+
+def test_prove_masked_rejects_grammarless_variant(contig_engine):
+    """Proving against a variant that built no arena is a failure, not a
+    silent pass."""
+    problems = gd.prove_masked_twin(contig_engine, contig_engine)
+    assert problems and "no grammar arena" in problems[0]
+
+
+def test_repo_goldens_cover_the_masked_configs():
+    """The checked-in goldens must cover the masked CI configs too — the
+    dogfood criterion for the grammar drift gate."""
+    assert gd.main(["--check", "--coverage", "--grammar"]) == 0
+    assert gd.main(
+        ["--check", "--coverage", "--grammar", "--kv-layout", "paged"]
+    ) == 0
+
+
 # -- planted mutations: every contract clause has teeth ----------------------
 
 
@@ -305,3 +340,28 @@ def test_planted_pool_gather_breaks_the_fused_decode_pin(
         ), problems
     finally:
         eng.close()
+
+
+def test_planted_dot_breaks_the_masked_proof(contig_engine, model_path):
+    """Mutation 5: one extra dot_general smuggled into the masked decode
+    program — grammar masking is pure logits post-processing, so any MXU
+    delta must fail the masked-vs-unmasked proof by name."""
+    var = _engine(model_path, grammar=True)
+    try:
+        entry = _decode_entry(var)
+        base = ga.trace_entry(contig_engine, entry)
+        clean = ga.trace_entry(var, entry)
+        spec = gd.MASKED_VS_UNMASKED
+        assert gd.prove_delta(
+            spec, jt.fingerprint(base), jt.fingerprint(clean)
+        ) == []
+        w = jnp.ones((4, 4), jnp.float32)
+        mutated = _mutate(clean, lambda: jnp.dot(w, w))
+        problems = gd.prove_delta(
+            spec, jt.fingerprint(base), jt.fingerprint(mutated)
+        )
+        assert problems and any("dot_general" in p for p in problems), (
+            problems
+        )
+    finally:
+        var.close()

@@ -376,3 +376,68 @@ def test_replica_strikes_and_refuses_with_422(tmp_path, monkeypatch):
         assert q_lines and float(q_lines[0].rsplit(" ", 1)[1]) > 0
     finally:
         httpd.shutdown()
+
+
+def test_grammar_bomb_is_client_400_never_a_strike(tmp_path, monkeypatch):
+    """Grammar bombs (PR 20): a malformed, state-bomb, or over-budget
+    `response_format` body is a CLIENT error — the replica answers 400
+    before any engine work, no matter how many times the same body is
+    replayed, and the poison ledger never records a strike (a 422
+    quarantine of a merely-malformed grammar would let one bad client
+    script blackhole its whole conversation fingerprint)."""
+    from distributed_llama_tpu.cli import build_arg_parser
+    from distributed_llama_tpu.server import api as api_mod
+    from distributed_llama_tpu.testing import (
+        tiny_header, write_tiny_model, write_tiny_tokenizer,
+    )
+
+    h = tiny_header(dim=64, hidden_dim=128, n_layers=2, seq_len=128,
+                    vocab_size=288)
+    mp, tp = str(tmp_path / "m.m"), str(tmp_path / "t.t")
+    write_tiny_model(mp, h, seed=3)
+    write_tiny_tokenizer(tp, pad_to=288, chat_template=CHATML)
+    monkeypatch.setenv("DLT_NO_WARMUP", "1")
+    monkeypatch.setenv("DLT_COST_TABLE", "0")
+    p = build_arg_parser()
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(
+        ["inference", "--model", mp, "--tokenizer", tp, "--steps", "0",
+         "--compute-dtype", "float32", "--temperature", "0.0",
+         "--max-batch-size", "2", "--port", str(_free_port())]
+    )
+    httpd = api_mod.serve(args)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = args.port
+    try:
+        bombs = (
+            {"type": "regex"},                        # malformed: no pattern
+            {"type": "regex", "regex": "a" * 400},    # state bomb: DFA cap
+            {"type": "regex", "regex": "ok",
+             "pad": "x" * (70 * 1024)},               # spec-KB budget bomb
+        )
+        for bomb in bombs:
+            for _ in range(4):  # same body past any strike limit: still 400
+                body = json.dumps({
+                    "messages": [{"role": "user", "content": "same convo"}],
+                    "max_tokens": 4, "response_format": bomb,
+                }).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    data=body, headers={"Content-Type": "application/json"},
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                assert ei.value.code == 400  # never 422, never 500
+                assert ei.value.headers.get(POISON_HEADER) is None
+        # the ledger holds ZERO implicated fingerprints...
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=30
+        ) as r:
+            health = json.loads(r.read())
+        assert health["quarantine"]["implicated"] == []
+        # ...and the same conversation still serves once the format is fixed
+        with _post(port, [{"role": "user", "content": "same convo"}]) as r:
+            assert r.status == 200
+    finally:
+        httpd.shutdown()
